@@ -1,0 +1,32 @@
+"""petastorm_tpu — a TPU-native Parquet data access framework.
+
+A from-scratch re-design of the capabilities of petastorm (see SURVEY.md) for
+JAX/TPU: Unischema + codecs over Parquet, a materialization write path, a
+row-group-ventilating batched read path, and bridges to JAX (sharded
+``jax.Array`` loaders), tf.data and PyTorch.
+
+Public API parity target: ``petastorm/__init__.py:15-17`` exports exactly
+``make_reader``, ``make_batch_reader``, ``TransformSpec`` and
+``NoDataAvailableError``; this package adds ``make_jax_loader`` as the
+TPU-native entry point.
+"""
+
+from petastorm_tpu.errors import NoDataAvailableError  # noqa: F401
+from petastorm_tpu.transform import TransformSpec  # noqa: F401
+
+__version__ = '0.1.0'
+
+
+def make_reader(*args, **kwargs):
+    from petastorm_tpu.reader import make_reader as _make_reader
+    return _make_reader(*args, **kwargs)
+
+
+def make_batch_reader(*args, **kwargs):
+    from petastorm_tpu.reader import make_batch_reader as _make_batch_reader
+    return _make_batch_reader(*args, **kwargs)
+
+
+def make_jax_loader(*args, **kwargs):
+    from petastorm_tpu.jax import make_jax_loader as _make_jax_loader
+    return _make_jax_loader(*args, **kwargs)

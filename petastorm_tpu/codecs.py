@@ -1,0 +1,325 @@
+"""Field codecs: (de)serialize rich field values into Parquet-storable cells.
+
+From-scratch re-design of ``petastorm/codecs.py`` with the same on-disk byte
+formats (so datasets written by the reference and by this framework interop):
+
+* :class:`CompressedImageCodec` — png/jpeg bytes as produced by OpenCV
+  (``codecs.py:58-130``), RGB channel order at the API boundary.
+* :class:`NdarrayCodec` — the ``np.save`` .npy byte stream (``codecs.py:133-171``).
+* :class:`CompressedNdarrayCodec` — ``np.savez_compressed`` bytes (``codecs.py:174-212``).
+* :class:`ScalarCodec` — plain typed parquet cells (``codecs.py:215-271``).
+
+Differences from the reference, deliberately:
+
+* Codecs declare an **arrow** storage type (:meth:`arrow_type`); Spark types
+  are derived from arrow only when pyspark is installed.
+* Every codec also implements :meth:`decode_batch`, a vectorized batch decode
+  used by the TPU host pipeline (the reference decodes strictly row-by-row via
+  ``utils.decode_row``). This is the seam where native/Pallas batched decoders
+  plug in.
+* Codecs are JSON-describable (``codec_to_json``/``codec_from_json``) for the
+  versioned footer format, instead of being pickled with the schema.
+"""
+
+from abc import ABCMeta, abstractmethod
+from decimal import Decimal
+from io import BytesIO
+
+import numpy as np
+import pyarrow as pa
+
+from petastorm_tpu.unischema import numpy_to_arrow_type
+
+
+class DataframeColumnCodec(metaclass=ABCMeta):
+    """Abstract codec contract (reference: ``petastorm/codecs.py:36-55``)."""
+
+    @abstractmethod
+    def encode(self, unischema_field, value):
+        """Encode a single value into its parquet-storable form."""
+
+    @abstractmethod
+    def decode(self, unischema_field, encoded):
+        """Decode a single stored cell back into its numpy form."""
+
+    def decode_batch(self, unischema_field, encoded_iterable):
+        """Decode many cells; default is a python loop, codecs may vectorize."""
+        return [self.decode(unischema_field, v) for v in encoded_iterable]
+
+    @abstractmethod
+    def arrow_type(self, unischema_field):
+        """The arrow DataType of the stored column."""
+
+    def spark_dtype(self, unischema_field):
+        """Spark type of the stored column (requires pyspark)."""
+        return arrow_to_spark_type(self.arrow_type(unischema_field))
+
+    # JSON description for the versioned footer
+    def to_json_dict(self):
+        return {'type': type(self).__name__}
+
+
+class CompressedImageCodec(DataframeColumnCodec):
+    """Store uint8/uint16 images as png or jpeg bytes.
+
+    Byte-compatible with the reference codec (``petastorm/codecs.py:58-130``):
+    images are RGB at the API boundary and channel-swapped to OpenCV's BGR for
+    encode/decode of 3-channel images.
+    """
+
+    def __init__(self, image_codec='png', quality=80):
+        if image_codec not in ('png', 'jpeg', 'jpg'):
+            raise ValueError('Unsupported image codec: %r' % image_codec)
+        self._image_codec = '.' + image_codec
+        self._quality = quality
+
+    @property
+    def image_codec(self):
+        return self._image_codec[1:]
+
+    def encode(self, unischema_field, value):
+        import cv2
+        if unischema_field.numpy_dtype != value.dtype:
+            raise ValueError('Field %r dtype %s != value dtype %s'
+                             % (unischema_field.name, unischema_field.numpy_dtype, value.dtype))
+        if not unischema_field.is_shape_compliant(value.shape):
+            raise ValueError('Field %r: image shape %s does not match %s'
+                             % (unischema_field.name, value.shape, unischema_field.shape))
+        bgr = value[:, :, (2, 1, 0)] if value.ndim == 3 and value.shape[2] == 3 else value
+        ok, encoded = cv2.imencode(self._image_codec, bgr,
+                                   [int(cv2.IMWRITE_JPEG_QUALITY), self._quality])
+        if not ok:
+            raise RuntimeError('cv2.imencode failed for field %r' % unischema_field.name)
+        return bytearray(encoded)
+
+    def decode(self, unischema_field, encoded):
+        import cv2
+        raw = np.frombuffer(bytes(encoded), dtype=np.uint8)
+        image = cv2.imdecode(raw, cv2.IMREAD_UNCHANGED)
+        if image is None:
+            raise ValueError('cv2.imdecode failed for field %r' % unischema_field.name)
+        if image.ndim == 3 and image.shape[2] == 3:
+            image = image[:, :, (2, 1, 0)]
+        return image.astype(unischema_field.numpy_dtype, copy=False)
+
+    def decode_batch(self, unischema_field, encoded_iterable):
+        # cv2 releases the GIL inside imdecode; a plain loop here is already
+        # parallelizable across pool workers. A native batched decoder can
+        # override this seam later without touching callers.
+        return [self.decode(unischema_field, v) for v in encoded_iterable]
+
+    def arrow_type(self, unischema_field):
+        return pa.binary()
+
+    def to_json_dict(self):
+        return {'type': 'CompressedImageCodec',
+                'image_codec': self.image_codec, 'quality': self._quality}
+
+
+class NdarrayCodec(DataframeColumnCodec):
+    """Store any numpy ndarray as .npy bytes (``petastorm/codecs.py:133-171``)."""
+
+    def encode(self, unischema_field, value):
+        _check_ndarray(unischema_field, value)
+        buf = BytesIO()
+        np.save(buf, value, allow_pickle=False)
+        return bytearray(buf.getvalue())
+
+    def decode(self, unischema_field, encoded):
+        arr = np.load(BytesIO(bytes(encoded)), allow_pickle=False)
+        return arr
+
+    def arrow_type(self, unischema_field):
+        return pa.binary()
+
+
+class CompressedNdarrayCodec(DataframeColumnCodec):
+    """Store a numpy ndarray zlib-compressed (``petastorm/codecs.py:174-212``)."""
+
+    def encode(self, unischema_field, value):
+        _check_ndarray(unischema_field, value)
+        buf = BytesIO()
+        np.savez_compressed(buf, arr=value)
+        return bytearray(buf.getvalue())
+
+    def decode(self, unischema_field, encoded):
+        with np.load(BytesIO(bytes(encoded)), allow_pickle=False) as npz:
+            return npz['arr']
+
+    def arrow_type(self, unischema_field):
+        return pa.binary()
+
+
+class ScalarCodec(DataframeColumnCodec):
+    """Store a scalar as a typed parquet cell (``petastorm/codecs.py:215-271``).
+
+    The reference parameterizes this codec with a Spark type; here it is
+    parameterized with an **arrow** type (a numpy dtype or a Spark type are
+    also accepted and converted), keeping Spark optional.
+    """
+
+    def __init__(self, storage_type):
+        self._arrow_type = _as_arrow_type(storage_type)
+
+    def encode(self, unischema_field, value):
+        at = self._arrow_type
+        if pa.types.is_integer(at):
+            return int(value)
+        if pa.types.is_floating(at):
+            return float(value)
+        if pa.types.is_boolean(at):
+            return bool(value)
+        if pa.types.is_string(at) or pa.types.is_large_string(at):
+            if isinstance(value, Decimal):
+                return str(value)
+            if isinstance(value, bytes):
+                return value.decode('utf-8')
+            return str(value)
+        if pa.types.is_binary(at) or pa.types.is_large_binary(at):
+            return bytes(value)
+        if pa.types.is_decimal(at):
+            return Decimal(str(value))
+        if pa.types.is_timestamp(at) or pa.types.is_date(at):
+            return value
+        raise ValueError('ScalarCodec: unsupported storage type %s' % at)
+
+    def decode(self, unischema_field, encoded):
+        if unischema_field.numpy_dtype is Decimal:
+            return Decimal(encoded)
+        return unischema_field.numpy_dtype(encoded)
+
+    def decode_batch(self, unischema_field, encoded_iterable):
+        if unischema_field.numpy_dtype is Decimal:
+            return [Decimal(v) for v in encoded_iterable]
+        return np.asarray(list(encoded_iterable)).astype(unischema_field.numpy_dtype)
+
+    def arrow_type(self, unischema_field):
+        return self._arrow_type
+
+    def to_json_dict(self):
+        return {'type': 'ScalarCodec', 'arrow_type': str(self._arrow_type)}
+
+
+def _check_ndarray(unischema_field, value):
+    if not isinstance(value, np.ndarray):
+        raise ValueError('Field %r: expected ndarray, got %s'
+                         % (unischema_field.name, type(value)))
+    want = np.dtype(unischema_field.numpy_dtype)
+    # Flexible dtypes (str/bytes) carry an item length; compare by kind only.
+    matches = (want.kind == value.dtype.kind if want.kind in 'SU'
+               else want == value.dtype)
+    if not matches:
+        raise ValueError('Field %r dtype %s != value dtype %s'
+                         % (unischema_field.name, unischema_field.numpy_dtype, value.dtype))
+    if not unischema_field.is_shape_compliant(value.shape):
+        raise ValueError('Field %r: shape %s does not match %s'
+                         % (unischema_field.name, value.shape, unischema_field.shape))
+
+
+# ---------------------------------------------------------------------------
+# storage-type conversions
+# ---------------------------------------------------------------------------
+
+_ARROW_TYPE_PARSERS = {
+    'bool': pa.bool_, 'int8': pa.int8, 'uint8': pa.uint8, 'int16': pa.int16,
+    'uint16': pa.uint16, 'int32': pa.int32, 'uint32': pa.uint32,
+    'int64': pa.int64, 'uint64': pa.uint64, 'halffloat': pa.float16,
+    'float': pa.float32, 'double': pa.float64, 'string': pa.string,
+    'large_string': pa.large_string, 'binary': pa.binary,
+    'large_binary': pa.large_binary,
+}
+
+
+def _parse_arrow_type(type_str):
+    if type_str in _ARROW_TYPE_PARSERS:
+        return _ARROW_TYPE_PARSERS[type_str]()
+    if type_str.startswith('timestamp'):
+        unit = type_str[type_str.index('[') + 1:type_str.index(']')]
+        return pa.timestamp(unit)
+    if type_str.startswith('decimal'):
+        inner = type_str[type_str.index('(') + 1:type_str.index(')')]
+        precision, scale = (int(x) for x in inner.split(','))
+        return pa.decimal128(precision, scale)
+    raise ValueError('Cannot parse arrow type string %r' % type_str)
+
+
+def _as_arrow_type(storage_type):
+    """Accept an arrow DataType, a numpy dtype, or a Spark DataType."""
+    if isinstance(storage_type, pa.DataType):
+        return storage_type
+    if isinstance(storage_type, str):
+        return _parse_arrow_type(storage_type)
+    try:
+        return numpy_to_arrow_type(storage_type)
+    except (ValueError, TypeError):
+        pass
+    # Possibly a Spark type instance; map via its simpleString.
+    simple = getattr(storage_type, 'simpleString', None)
+    if callable(simple):
+        return _spark_simple_string_to_arrow(simple())
+    raise ValueError('Cannot interpret %r as a storage type' % (storage_type,))
+
+
+_SPARK_SIMPLE_TO_ARROW = {
+    'boolean': pa.bool_(), 'tinyint': pa.int8(), 'smallint': pa.int16(),
+    'int': pa.int32(), 'bigint': pa.int64(), 'float': pa.float32(),
+    'double': pa.float64(), 'string': pa.string(), 'binary': pa.binary(),
+    'timestamp': pa.timestamp('us'), 'date': pa.date32(),
+}
+
+
+def _spark_simple_string_to_arrow(simple):
+    if simple in _SPARK_SIMPLE_TO_ARROW:
+        return _SPARK_SIMPLE_TO_ARROW[simple]
+    if simple.startswith('decimal'):
+        inner = simple[simple.index('(') + 1:simple.index(')')]
+        precision, scale = (int(x) for x in inner.split(','))
+        return pa.decimal128(precision, scale)
+    raise ValueError('Cannot map spark type %r to arrow' % simple)
+
+
+def arrow_to_spark_type(arrow_type):
+    """Map an arrow DataType to a Spark DataType (requires pyspark)."""
+    from pyspark.sql import types as T
+    mapping = {
+        pa.bool_(): T.BooleanType(), pa.int8(): T.ByteType(),
+        pa.int16(): T.ShortType(), pa.int32(): T.IntegerType(),
+        pa.int64(): T.LongType(), pa.uint8(): T.ShortType(),
+        pa.uint16(): T.IntegerType(), pa.uint32(): T.LongType(),
+        pa.float32(): T.FloatType(), pa.float64(): T.DoubleType(),
+        pa.string(): T.StringType(), pa.binary(): T.BinaryType(),
+    }
+    if arrow_type in mapping:
+        return mapping[arrow_type]
+    if pa.types.is_timestamp(arrow_type):
+        return T.TimestampType()
+    if pa.types.is_decimal(arrow_type):
+        return T.DecimalType(arrow_type.precision, arrow_type.scale)
+    if pa.types.is_list(arrow_type):
+        return T.ArrayType(arrow_to_spark_type(arrow_type.value_type))
+    raise ValueError('Cannot map arrow type %s to spark' % arrow_type)
+
+
+# ---------------------------------------------------------------------------
+# JSON (de)serialization of codec descriptions
+# ---------------------------------------------------------------------------
+
+def codec_to_json(codec):
+    if codec is None:
+        return None
+    return codec.to_json_dict()
+
+
+def codec_from_json(d):
+    if d is None:
+        return None
+    kind = d['type']
+    if kind == 'CompressedImageCodec':
+        return CompressedImageCodec(d['image_codec'], d['quality'])
+    if kind == 'NdarrayCodec':
+        return NdarrayCodec()
+    if kind == 'CompressedNdarrayCodec':
+        return CompressedNdarrayCodec()
+    if kind == 'ScalarCodec':
+        return ScalarCodec(_parse_arrow_type(d['arrow_type']))
+    raise ValueError('Unknown codec type in schema JSON: %r' % kind)

@@ -1,0 +1,29 @@
+"""Exception types for petastorm_tpu.
+
+Parity target: ``petastorm/errors.py:16`` (``NoDataAvailableError``) plus the
+metadata error types from ``petastorm/etl/dataset_metadata.py:38-49``.
+"""
+
+
+class PetastormTpuError(Exception):
+    """Base class for all framework-specific errors."""
+
+
+class NoDataAvailableError(PetastormTpuError):
+    """Raised when a reader ends up with zero work items.
+
+    The most common cause is requesting more shards than there are row-groups
+    in the dataset (reference: ``petastorm/reader.py:547-549``).
+    """
+
+
+class MetadataError(PetastormTpuError):
+    """Dataset metadata is missing or malformed (``dataset_metadata.py:38``)."""
+
+
+class MetadataGenerationError(MetadataError):
+    """Metadata could not be generated (``dataset_metadata.py:45``)."""
+
+
+class DecodeFieldError(PetastormTpuError):
+    """A field value failed codec decode (``petastorm/utils.py:48``)."""

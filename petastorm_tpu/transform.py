@@ -1,0 +1,63 @@
+"""TransformSpec: user transforms executed on decode workers, outside jit.
+
+Parity with ``petastorm/transform.py:27-89``. The callable runs host-side on a
+pool worker (row dict for ``make_reader``, pandas DataFrame for
+``make_batch_reader``); it is explicitly *not* traced by XLA — device-side
+per-batch transforms belong in :mod:`petastorm_tpu.ops`.
+"""
+
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+
+class TransformSpec:
+    """Describes a worker-side transform and its effect on the schema.
+
+    :param func: callable applied to each row dict (row readers) or to a whole
+        row-group pandas DataFrame (batch readers). May be None for pure
+        schema edits (field removal/selection).
+    :param edit_fields: list of ``UnischemaField`` (or 4-tuples
+        ``(name, numpy_dtype, shape, nullable)``) added/replaced by the
+        transform.
+    :param removed_fields: list of field names deleted by the transform.
+    :param selected_fields: if not None, exactly these field names remain,
+        in this order (mutually exclusive with removed_fields).
+    """
+
+    def __init__(self, func=None, edit_fields=None, removed_fields=None,
+                 selected_fields=None):
+        if removed_fields and selected_fields:
+            raise ValueError('removed_fields and selected_fields are mutually exclusive')
+        self.func = func
+        self.edit_fields = [self._as_field(f) for f in (edit_fields or [])]
+        self.removed_fields = list(removed_fields or [])
+        self.selected_fields = list(selected_fields) if selected_fields is not None else None
+
+    @staticmethod
+    def _as_field(f):
+        if isinstance(f, UnischemaField):
+            return f
+        name, numpy_dtype, shape, nullable = f
+        return UnischemaField(name, numpy_dtype, shape, None, nullable)
+
+    def __call__(self, data):
+        return self.func(data) if self.func is not None else data
+
+
+def transform_schema(schema, transform_spec):
+    """Apply a TransformSpec's declarative edits to a schema.
+
+    Reference: ``petastorm/transform.py:60-89``.
+    """
+    edited = dict(schema.fields)
+    for f in transform_spec.edit_fields:
+        edited[f.name] = f
+    for name in transform_spec.removed_fields:
+        edited.pop(name, None)
+    if transform_spec.selected_fields is not None:
+        missing = [n for n in transform_spec.selected_fields if n not in edited]
+        if missing:
+            raise ValueError('selected_fields not present after edits: %s' % missing)
+        ordered = [edited[n] for n in transform_spec.selected_fields]
+    else:
+        ordered = list(edited.values())
+    return Unischema('%s_transformed' % schema._name, ordered)

@@ -1,0 +1,448 @@
+"""Unischema: a single schema definition usable across Parquet, numpy, JAX, TF and Torch.
+
+This is a from-scratch, TPU-first re-design of the reference's schema system
+(``petastorm/unischema.py``). The public surface intentionally matches the
+reference — ``UnischemaField`` (:50), ``Unischema`` (:174), ``create_schema_view``
+(:199), ``from_arrow_schema`` (:302), ``match_unischema_fields`` (:437),
+``insert_explicit_nulls`` (:409) — so that users of the reference can migrate,
+but the implementation differs where TPU ingest wants it to:
+
+* The on-disk schema serialization is **versioned JSON**, not a Python pickle
+  (the reference pickles the schema into the Parquet footer and calls that
+  fragile itself, ``etl/dataset_metadata.py:201-202``). Legacy pickled schemas
+  are still readable via :mod:`petastorm_tpu.etl.legacy`.
+* Column-major first: a schema can render itself to an Arrow schema directly
+  (``as_arrow_schema``); Spark is an optional add-on instead of a core
+  dependency.
+* ``make_namedtuple`` identity is cached per (schema-name, field-names) so the
+  tf.data bridge sees a stable structure type across reader restarts
+  (reference: ``_NamedtupleCache``, ``unischema.py:88``).
+"""
+
+import copy
+import re
+import sys
+from collections import OrderedDict, namedtuple
+from decimal import Decimal
+
+import numpy as np
+import pyarrow as pa
+
+# ---------------------------------------------------------------------------
+# numpy <-> arrow type mapping
+# ---------------------------------------------------------------------------
+
+_NUMPY_TO_ARROW = {
+    np.bool_: pa.bool_(),
+    np.int8: pa.int8(),
+    np.uint8: pa.uint8(),
+    np.int16: pa.int16(),
+    np.uint16: pa.uint16(),
+    np.int32: pa.int32(),
+    np.uint32: pa.uint32(),
+    np.int64: pa.int64(),
+    np.uint64: pa.uint64(),
+    np.float16: pa.float16(),
+    np.float32: pa.float32(),
+    np.float64: pa.float64(),
+    np.str_: pa.string(),
+    np.bytes_: pa.binary(),
+    np.datetime64: pa.timestamp('ns'),
+    Decimal: pa.string(),
+}
+
+# Arrow type (by id) -> numpy dtype. Mirrors the mapping table at
+# ``petastorm/unischema.py:467-501`` but is arrow-first instead of spark-first.
+_ARROW_TO_NUMPY = {
+    pa.bool_(): np.bool_,
+    pa.int8(): np.int8,
+    pa.uint8(): np.uint8,
+    pa.int16(): np.int16,
+    pa.uint16(): np.uint16,
+    pa.int32(): np.int32,
+    pa.uint32(): np.uint32,
+    pa.int64(): np.int64,
+    pa.uint64(): np.uint64,
+    pa.float16(): np.float16,
+    pa.float32(): np.float32,
+    pa.float64(): np.float64,
+    pa.string(): np.str_,
+    pa.large_string(): np.str_,
+    pa.binary(): np.bytes_,
+    pa.large_binary(): np.bytes_,
+    pa.date32(): np.datetime64,
+    pa.date64(): np.datetime64,
+}
+
+
+def arrow_to_numpy_dtype(arrow_type):
+    """Map an arrow DataType to the numpy dtype class used in UnischemaField."""
+    if arrow_type in _ARROW_TO_NUMPY:
+        return _ARROW_TO_NUMPY[arrow_type]
+    if pa.types.is_timestamp(arrow_type):
+        return np.datetime64
+    if pa.types.is_decimal(arrow_type):
+        return Decimal
+    if pa.types.is_dictionary(arrow_type):
+        return arrow_to_numpy_dtype(arrow_type.value_type)
+    raise ValueError('Cannot map arrow type %s to a numpy dtype' % arrow_type)
+
+
+def numpy_to_arrow_type(numpy_dtype):
+    """Map a numpy dtype (class or instance) to an arrow DataType."""
+    key = np.dtype(numpy_dtype).type if numpy_dtype is not Decimal else Decimal
+    if key in _NUMPY_TO_ARROW:
+        return _NUMPY_TO_ARROW[key]
+    raise ValueError('Cannot map numpy dtype %s to an arrow type' % numpy_dtype)
+
+
+# ---------------------------------------------------------------------------
+# UnischemaField
+# ---------------------------------------------------------------------------
+
+class UnischemaField:
+    """A single typed field of a :class:`Unischema`.
+
+    Attributes: ``name``, ``numpy_dtype``, ``shape`` (tuple; ``None`` entries
+    are wildcard dims), ``codec`` (or None for plain-parquet columns),
+    ``nullable``.
+
+    Equality and hashing intentionally ignore the codec, matching the
+    reference semantics (``petastorm/unischema.py:39-47``): two fields that
+    produce the same in-memory value are "the same field" even if stored
+    differently.
+    """
+
+    __slots__ = ('name', 'numpy_dtype', 'shape', 'codec', 'nullable')
+
+    def __init__(self, name, numpy_dtype, shape=(), codec=None, nullable=False):
+        if not isinstance(shape, tuple):
+            raise ValueError('shape must be a tuple, got %r' % (shape,))
+        object.__setattr__(self, 'name', name)
+        object.__setattr__(self, 'numpy_dtype', numpy_dtype)
+        object.__setattr__(self, 'shape', shape)
+        object.__setattr__(self, 'codec', codec)
+        object.__setattr__(self, 'nullable', nullable)
+
+    def __setattr__(self, key, value):
+        raise AttributeError('UnischemaField is immutable')
+
+    def _key(self):
+        return (self.name, self.numpy_dtype, self.shape, self.nullable)
+
+    def __eq__(self, other):
+        if not isinstance(other, UnischemaField):
+            return NotImplemented
+        return self._key() == other._key()
+
+    def __ne__(self, other):
+        result = self.__eq__(other)
+        return result if result is NotImplemented else not result
+
+    def __hash__(self):
+        return hash(self._key())
+
+    def __repr__(self):
+        return ('UnischemaField(name=%r, numpy_dtype=%r, shape=%r, codec=%r, nullable=%r)'
+                % (self.name, self.numpy_dtype, self.shape, self.codec, self.nullable))
+
+    # -- structural helpers -------------------------------------------------
+
+    @property
+    def is_scalar(self):
+        return len(self.shape) == 0
+
+    def is_shape_compliant(self, value_shape):
+        """True when ``value_shape`` matches ``self.shape`` with None wildcards.
+
+        Reference: ``petastorm/codecs.py:274-294`` (``_is_compliant_shape``).
+        """
+        if len(value_shape) != len(self.shape):
+            return False
+        return all(want is None or want == got
+                   for want, got in zip(self.shape, value_shape))
+
+    def arrow_storage_type(self):
+        """The arrow type this field occupies in a materialized Parquet file."""
+        if self.codec is not None:
+            return self.codec.arrow_type(self)
+        if self.shape:
+            return pa.list_(numpy_to_arrow_type(self.numpy_dtype))
+        return numpy_to_arrow_type(self.numpy_dtype)
+
+    # -- JSON (de)serialization for the dataset footer ----------------------
+
+    def to_json_dict(self):
+        from petastorm_tpu.codecs import codec_to_json
+        if self.numpy_dtype is Decimal:
+            dtype_name = 'decimal'
+        else:
+            dtype_name = np.dtype(self.numpy_dtype).name if self.numpy_dtype not in (np.str_, np.bytes_) \
+                else ('str' if self.numpy_dtype is np.str_ else 'bytes')
+        return {
+            'name': self.name,
+            'numpy_dtype': dtype_name,
+            'shape': list(self.shape),
+            'codec': codec_to_json(self.codec),
+            'nullable': bool(self.nullable),
+        }
+
+    @classmethod
+    def from_json_dict(cls, d):
+        from petastorm_tpu.codecs import codec_from_json
+        dtype_name = d['numpy_dtype']
+        if dtype_name == 'decimal':
+            numpy_dtype = Decimal
+        elif dtype_name == 'str':
+            numpy_dtype = np.str_
+        elif dtype_name == 'bytes':
+            numpy_dtype = np.bytes_
+        else:
+            numpy_dtype = np.dtype(dtype_name).type
+        shape = tuple(None if s is None else int(s) for s in d['shape'])
+        return cls(d['name'], numpy_dtype, shape, codec_from_json(d['codec']),
+                   bool(d['nullable']))
+
+
+# ---------------------------------------------------------------------------
+# Stable namedtuple cache
+# ---------------------------------------------------------------------------
+
+class _NamedtupleRegistry:
+    """Returns the *same* namedtuple class for the same (name, fields) pair.
+
+    tf.data compares structure types by class identity; recreating a reader
+    must therefore yield the identical namedtuple class
+    (reference: ``petastorm/unischema.py:88-113``).
+    """
+
+    _instances = {}
+
+    @classmethod
+    def get(cls, type_name, field_names):
+        key = (type_name, tuple(field_names))
+        if key not in cls._instances:
+            cls._instances[key] = namedtuple(type_name, field_names)
+        return cls._instances[key]
+
+
+# ---------------------------------------------------------------------------
+# Unischema
+# ---------------------------------------------------------------------------
+
+class Unischema:
+    """An ordered collection of :class:`UnischemaField`.
+
+    Fields are exposed as attributes (``schema.field_name``) and via the
+    ``fields`` OrderedDict. Field order is the declaration order
+    (the reference's ``'preserve_input_order'`` mode, ``unischema.py:33-36`` —
+    the legacy alphabetical mode is not carried forward).
+    """
+
+    def __init__(self, name, fields):
+        self._name = name
+        self._fields = OrderedDict((f.name, f) for f in fields)
+        if len(self._fields) != len(fields):
+            seen, dupes = set(), []
+            for f in fields:
+                if f.name in seen:
+                    dupes.append(f.name)
+                seen.add(f.name)
+            raise ValueError('Duplicate field names in schema %r: %s' % (name, dupes))
+        for f in fields:
+            if hasattr(self, f.name):
+                raise ValueError('Field name %r collides with a Unischema attribute' % f.name)
+            setattr(self, f.name, f)
+
+    # -- basic accessors ----------------------------------------------------
+
+    @property
+    def fields(self):
+        return self._fields
+
+    def __iter__(self):
+        return iter(self._fields.values())
+
+    def __len__(self):
+        return len(self._fields)
+
+    def __repr__(self):
+        lines = ['%s(%s: [' % (type(self).__name__, self._name)]
+        lines.extend('  %r,' % f for f in self)
+        lines.append('])')
+        return '\n'.join(lines)
+
+    # -- views and matching -------------------------------------------------
+
+    def create_schema_view(self, fields):
+        """A new Unischema with a subset of fields.
+
+        ``fields`` may contain :class:`UnischemaField` instances or regex
+        pattern strings (reference: ``unischema.py:199-241``).
+        """
+        regexes = [f for f in fields if isinstance(f, str)]
+        explicit = [f for f in fields if not isinstance(f, str)]
+        for f in explicit:
+            mine = self._fields.get(f.name)
+            if mine is None or mine != f:
+                raise ValueError('Field %r does not belong to schema %r' % (f.name, self._name))
+        matched = set(f.name for f in match_unischema_fields(self, regexes)) if regexes else set()
+        keep = matched | set(f.name for f in explicit)
+        view_fields = [f for f in self if f.name in keep]
+        return Unischema('%s_view' % self._name, view_fields)
+
+    # -- rendering ----------------------------------------------------------
+
+    def as_arrow_schema(self):
+        """Arrow schema of the *materialized* (encoded) representation."""
+        return pa.schema([pa.field(f.name, f.arrow_storage_type(), nullable=f.nullable)
+                          for f in self])
+
+    def as_spark_schema(self):
+        """Spark StructType of the materialized representation (optional dep).
+
+        Reference: ``petastorm/unischema.py:264-280``.
+        """
+        from pyspark.sql.types import StructField, StructType  # optional dependency
+        from petastorm_tpu.codecs import arrow_to_spark_type
+        struct_fields = []
+        for f in self:
+            spark_type = (f.codec.spark_dtype(f) if f.codec is not None
+                          else arrow_to_spark_type(f.arrow_storage_type()))
+            struct_fields.append(StructField(f.name, spark_type, f.nullable))
+        return StructType(struct_fields)
+
+    def make_namedtuple(self, **kwargs):
+        """Build one row instance of this schema's namedtuple (None-filled)."""
+        cls = self.namedtuple
+        values = {k: kwargs.get(k) for k in self._fields}
+        return cls(**values)
+
+    def make_namedtuple_tf(self, **kwargs):
+        cls = self.namedtuple
+        return cls(**{k: kwargs[k] for k in self._fields})
+
+    @property
+    def namedtuple(self):
+        """Stable namedtuple class for rows of this schema."""
+        return _NamedtupleRegistry.get('%s_row' % self._name, list(self._fields))
+
+    # -- serialization ------------------------------------------------------
+
+    def to_json_dict(self):
+        return {
+            'version': 1,
+            'name': self._name,
+            'fields': [f.to_json_dict() for f in self],
+        }
+
+    @classmethod
+    def from_json_dict(cls, d):
+        if d.get('version') != 1:
+            raise ValueError('Unsupported unischema JSON version: %r' % d.get('version'))
+        return cls(d['name'], [UnischemaField.from_json_dict(fd) for fd in d['fields']])
+
+    # -- inference from plain parquet ---------------------------------------
+
+    @classmethod
+    def from_arrow_schema(cls, arrow_schema, omit_unsupported_fields=True,
+                          partition_columns=(), name='inferred'):
+        """Infer a Unischema from a plain (non-petastorm) arrow schema.
+
+        list<primitive> columns become 1-d wildcard arrays; nested
+        list<list<...>> columns are skipped (with the same silent-skip
+        semantics as ``petastorm/unischema.py:337-342``) unless
+        ``omit_unsupported_fields`` is False, in which case they raise.
+        """
+        fields = []
+        for arrow_field in arrow_schema:
+            atype = arrow_field.type
+            try:
+                if pa.types.is_list(atype) or pa.types.is_large_list(atype):
+                    value_type = atype.value_type
+                    if pa.types.is_nested(value_type):
+                        raise ValueError('Nested list field %r is not supported' % arrow_field.name)
+                    fields.append(UnischemaField(arrow_field.name,
+                                                 arrow_to_numpy_dtype(value_type),
+                                                 (None,), None, arrow_field.nullable))
+                else:
+                    fields.append(UnischemaField(arrow_field.name,
+                                                 arrow_to_numpy_dtype(atype),
+                                                 (), None, arrow_field.nullable))
+            except ValueError:
+                if not omit_unsupported_fields:
+                    raise
+        for part in partition_columns:
+            if part not in {f.name for f in fields}:
+                fields.append(UnischemaField(part, np.str_, (), None, False))
+        return cls(name, fields)
+
+
+def match_unischema_fields(schema, field_regexes):
+    """Return fields of ``schema`` whose names fully match any of the regexes.
+
+    Uses ``re.fullmatch`` semantics, like the reference's current behavior
+    (``petastorm/unischema.py:437-465``; the legacy prefix-``match`` fallback
+    and its warning are deliberately not reproduced).
+    """
+    if not field_regexes:
+        return []
+    compiled = [re.compile(p) for p in field_regexes]
+    return [f for f in schema if any(c.fullmatch(f.name) for c in compiled)]
+
+
+def dict_to_encoded_row(schema, row_dict):
+    """Validate and codec-encode a row dict into parquet-storable values.
+
+    The write-path equivalent of the reference's ``dict_to_spark_row``
+    (``petastorm/unischema.py:359-406``) minus the Spark Row wrapper: returns a
+    plain dict whose values are encoded (bytes for codec'd ndarrays, python
+    scalars/lists for the rest) ready for an arrow table.
+    """
+    if not isinstance(row_dict, dict):
+        raise TypeError('row must be a dict, got %s' % type(row_dict))
+    unknown = set(row_dict.keys()) - set(schema.fields.keys())
+    if unknown:
+        raise ValueError('Attempt to write fields not in schema %s: %s'
+                         % (schema._name, sorted(unknown)))
+    encoded = {}
+    for field in schema:
+        value = row_dict.get(field.name)
+        if value is None:
+            if not field.nullable:
+                raise ValueError('Field %r is not nullable but got None' % field.name)
+            encoded[field.name] = None
+        elif field.codec is not None:
+            encoded[field.name] = field.codec.encode(field, value)
+        else:
+            encoded[field.name] = _encode_plain(field, value)
+    return encoded
+
+
+def _encode_plain(field, value):
+    """Encode a codec-less field into an arrow-friendly python value."""
+    if field.shape:
+        arr = np.asarray(value)
+        if not field.is_shape_compliant(arr.shape):
+            raise ValueError('Field %r: value shape %s does not match %s'
+                             % (field.name, arr.shape, field.shape))
+        return arr.ravel().tolist()
+    if isinstance(value, np.generic):
+        return value.item()
+    return value
+
+
+def insert_explicit_nulls(schema, row_dict):
+    """Add explicit ``None`` entries for nullable fields missing from the dict.
+
+    Raises for missing non-nullable fields. Reference:
+    ``petastorm/unischema.py:409-434``.
+    """
+    for field in schema:
+        if field.name in row_dict:
+            continue
+        if field.nullable:
+            row_dict[field.name] = None
+        else:
+            raise ValueError('Field %r is not found in row and is not nullable' % field.name)
+    return row_dict

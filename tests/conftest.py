@@ -1,0 +1,61 @@
+"""Shared test configuration.
+
+Forces JAX onto a virtual 8-device CPU platform *before* jax is imported
+anywhere, so sharding/mesh tests exercise real multi-device code paths without
+TPU hardware (SURVEY.md §4: multi-node stand-in strategy).
+"""
+
+import os
+
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+_flags = os.environ.get('XLA_FLAGS', '')
+if '--xla_force_host_platform_device_count' not in _flags:
+    os.environ['XLA_FLAGS'] = (_flags + ' --xla_force_host_platform_device_count=8').strip()
+
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope='session')
+def synthetic_dataset(tmp_path_factory):
+    """Session-scoped canonical petastorm_tpu dataset (rich 14-field schema).
+
+    Mirrors the reference's ``synthetic_dataset`` fixture strategy
+    (``petastorm/tests/conftest.py:89-98``) without Spark: rows generated with
+    :func:`tests.test_common.create_test_dataset`.
+    """
+    from tests.test_common import create_test_dataset
+    path = str(tmp_path_factory.mktemp('synthetic')) + '/dataset'
+    url = 'file://' + path
+    data = create_test_dataset(url, range(100), num_files=4, rowgroup_size=10)
+
+    class _Dataset:
+        pass
+
+    d = _Dataset()
+    d.url = url
+    d.path = path
+    d.data = data
+    return d
+
+
+@pytest.fixture(scope='session')
+def scalar_dataset(tmp_path_factory):
+    """Plain (non-petastorm) parquet store for make_batch_reader paths."""
+    from tests.test_common import create_test_scalar_dataset
+    path = str(tmp_path_factory.mktemp('scalar')) + '/dataset'
+    url = 'file://' + path
+    data = create_test_scalar_dataset(url, num_rows=100, num_files=4)
+
+    class _Dataset:
+        pass
+
+    d = _Dataset()
+    d.url = url
+    d.path = path
+    d.data = data
+    return d

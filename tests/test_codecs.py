@@ -1,0 +1,153 @@
+"""Codec round-trip tests (parity model: petastorm/tests/test_codec_*.py)."""
+
+from decimal import Decimal
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from petastorm_tpu.codecs import (
+    CompressedImageCodec, CompressedNdarrayCodec, NdarrayCodec, ScalarCodec,
+    codec_from_json, codec_to_json,
+)
+from petastorm_tpu.unischema import UnischemaField
+
+
+def _roundtrip(codec, field, value):
+    return codec.decode(field, codec.encode(field, value))
+
+
+class TestScalarCodec:
+    def test_int_roundtrip(self):
+        f = UnischemaField('x', np.int32, ())
+        c = ScalarCodec(pa.int32())
+        assert _roundtrip(c, f, np.int32(42)) == 42
+        assert isinstance(_roundtrip(c, f, 42), np.int32)
+
+    def test_float_string_bool(self):
+        assert _roundtrip(ScalarCodec(pa.float64()),
+                          UnischemaField('x', np.float64, ()), 1.5) == 1.5
+        assert _roundtrip(ScalarCodec(pa.string()),
+                          UnischemaField('x', np.str_, ()), 'héllo') == 'héllo'
+        assert _roundtrip(ScalarCodec(pa.bool_()),
+                          UnischemaField('x', np.bool_, ()), True)
+
+    def test_decimal(self):
+        f = UnischemaField('x', Decimal, ())
+        c = ScalarCodec(pa.string())
+        out = _roundtrip(c, f, Decimal('123.4567'))
+        assert out == Decimal('123.4567')
+
+    def test_decode_batch_vectorized(self):
+        f = UnischemaField('x', np.int16, ())
+        c = ScalarCodec(pa.int32())
+        out = c.decode_batch(f, [1, 2, 3])
+        assert out.dtype == np.int16
+        np.testing.assert_array_equal(out, [1, 2, 3])
+
+    def test_accepts_numpy_dtype_param(self):
+        c = ScalarCodec(np.int64)
+        assert c.arrow_type(None) == pa.int64()
+
+
+class TestNdarrayCodec:
+    @pytest.mark.parametrize('dtype', [np.uint8, np.int64, np.float32, np.float64])
+    def test_roundtrip(self, dtype):
+        f = UnischemaField('a', dtype, (None, 3))
+        c = NdarrayCodec()
+        arr = (np.random.rand(7, 3) * 100).astype(dtype)
+        np.testing.assert_array_equal(_roundtrip(c, f, arr), arr)
+
+    def test_unicode_array(self):
+        f = UnischemaField('a', np.dtype('<U5').type, (None,))
+        c = NdarrayCodec()
+        arr = np.array(['abc', 'défgh'], dtype='<U5')
+        out = c.decode(f, c.encode(UnischemaField('a', arr.dtype.type, (None,)), arr))
+        np.testing.assert_array_equal(out, arr)
+
+    def test_shape_mismatch_raises(self):
+        f = UnischemaField('a', np.float32, (2, 2))
+        with pytest.raises(ValueError, match='shape'):
+            NdarrayCodec().encode(f, np.zeros((3, 3), dtype=np.float32))
+
+    def test_dtype_mismatch_raises(self):
+        f = UnischemaField('a', np.float32, (2,))
+        with pytest.raises(ValueError, match='dtype'):
+            NdarrayCodec().encode(f, np.zeros((2,), dtype=np.float64))
+
+
+class TestCompressedNdarrayCodec:
+    def test_roundtrip_compresses(self):
+        f = UnischemaField('a', np.float64, (None, None))
+        c = CompressedNdarrayCodec()
+        arr = np.zeros((100, 100))
+        encoded = c.encode(f, arr)
+        assert len(encoded) < arr.nbytes / 10  # zeros compress well
+        np.testing.assert_array_equal(c.decode(f, encoded), arr)
+
+
+class TestCompressedImageCodec:
+    def test_png_lossless_roundtrip(self):
+        f = UnischemaField('im', np.uint8, (12, 10, 3))
+        c = CompressedImageCodec('png')
+        img = np.random.randint(0, 255, (12, 10, 3), dtype=np.uint8)
+        np.testing.assert_array_equal(_roundtrip(c, f, img), img)
+
+    def test_grayscale(self):
+        f = UnischemaField('im', np.uint8, (12, 10))
+        c = CompressedImageCodec('png')
+        img = np.random.randint(0, 255, (12, 10), dtype=np.uint8)
+        np.testing.assert_array_equal(_roundtrip(c, f, img), img)
+
+    def test_jpeg_lossy_close(self):
+        f = UnischemaField('im', np.uint8, (32, 32, 3))
+        c = CompressedImageCodec('jpeg', quality=95)
+        img = np.full((32, 32, 3), 128, dtype=np.uint8)
+        out = _roundtrip(c, f, img)
+        assert out.shape == img.shape
+        assert np.abs(out.astype(int) - img.astype(int)).mean() < 5
+
+    def test_channel_order_is_rgb(self):
+        # A pure-red RGB image must come back pure red (BGR swap correctness).
+        f = UnischemaField('im', np.uint8, (4, 4, 3))
+        c = CompressedImageCodec('png')
+        img = np.zeros((4, 4, 3), dtype=np.uint8)
+        img[:, :, 0] = 255
+        out = _roundtrip(c, f, img)
+        np.testing.assert_array_equal(out, img)
+
+    def test_uint16_png(self):
+        f = UnischemaField('im', np.uint16, (8, 8))
+        c = CompressedImageCodec('png')
+        img = np.random.randint(0, 2 ** 16 - 1, (8, 8), dtype=np.uint16)
+        np.testing.assert_array_equal(_roundtrip(c, f, img), img)
+
+    def test_bad_codec_name(self):
+        with pytest.raises(ValueError):
+            CompressedImageCodec('gif')
+
+    def test_decode_batch(self):
+        f = UnischemaField('im', np.uint8, (6, 6, 3))
+        c = CompressedImageCodec('png')
+        imgs = [np.random.randint(0, 255, (6, 6, 3), dtype=np.uint8) for _ in range(4)]
+        encoded = [c.encode(f, im) for im in imgs]
+        out = c.decode_batch(f, encoded)
+        for got, want in zip(out, imgs):
+            np.testing.assert_array_equal(got, want)
+
+
+def test_codec_json_roundtrip():
+    for codec in [CompressedImageCodec('jpeg', 70), NdarrayCodec(),
+                  CompressedNdarrayCodec(), ScalarCodec(pa.int32()), None]:
+        d = codec_to_json(codec)
+        restored = codec_from_json(d)
+        assert type(restored) is type(codec)
+    restored = codec_from_json(codec_to_json(ScalarCodec(pa.decimal128(10, 2))))
+    assert restored.arrow_type(None) == pa.decimal128(10, 2)
+
+
+def test_reference_byte_compat_npy():
+    """NdarrayCodec bytes must be a plain .npy stream (np.load readable)."""
+    f = UnischemaField('a', np.int32, (3,))
+    encoded = NdarrayCodec().encode(f, np.array([1, 2, 3], dtype=np.int32))
+    assert bytes(encoded[:6]) == b'\x93NUMPY'

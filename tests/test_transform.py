@@ -1,0 +1,54 @@
+"""TransformSpec tests (parity model: petastorm/tests/test_transform.py)."""
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.transform import TransformSpec, transform_schema
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+
+def _schema():
+    return Unischema('S', [
+        UnischemaField('a', np.int32, ()),
+        UnischemaField('b', np.float32, (4,)),
+        UnischemaField('c', np.str_, ()),
+    ])
+
+
+def test_removed_fields():
+    spec = TransformSpec(removed_fields=['b'])
+    out = transform_schema(_schema(), spec)
+    assert list(out.fields) == ['a', 'c']
+
+
+def test_edit_fields_with_tuples_and_fields():
+    spec = TransformSpec(edit_fields=[
+        ('b', np.float64, (8,), False),
+        UnischemaField('d', np.int8, (), None, True),
+    ])
+    out = transform_schema(_schema(), spec)
+    assert out.b.numpy_dtype is np.float64
+    assert out.b.shape == (8,)
+    assert out.d.nullable
+
+
+def test_selected_fields_order():
+    spec = TransformSpec(selected_fields=['c', 'a'])
+    out = transform_schema(_schema(), spec)
+    assert list(out.fields) == ['c', 'a']
+
+
+def test_selected_missing_raises():
+    with pytest.raises(ValueError):
+        transform_schema(_schema(), TransformSpec(selected_fields=['zzz']))
+
+
+def test_removed_and_selected_mutually_exclusive():
+    with pytest.raises(ValueError):
+        TransformSpec(removed_fields=['a'], selected_fields=['b'])
+
+
+def test_func_is_applied():
+    spec = TransformSpec(func=lambda d: {**d, 'a': d['a'] * 2})
+    assert spec({'a': 21})['a'] == 42
+    assert TransformSpec()( {'a': 1})['a'] == 1

@@ -1,0 +1,164 @@
+"""Unit tests for the schema kernel (parity model: petastorm/tests/test_unischema.py)."""
+
+from decimal import Decimal
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from petastorm_tpu.codecs import CompressedImageCodec, NdarrayCodec, ScalarCodec
+from petastorm_tpu.unischema import (
+    Unischema, UnischemaField, dict_to_encoded_row, insert_explicit_nulls,
+    match_unischema_fields,
+)
+
+
+def _schema():
+    return Unischema('TestSchema', [
+        UnischemaField('id', np.int64, (), ScalarCodec(pa.int64()), False),
+        UnischemaField('value', np.float64, (), ScalarCodec(pa.float64()), True),
+        UnischemaField('image', np.uint8, (16, 32, 3), CompressedImageCodec('png'), False),
+        UnischemaField('matrix', np.float32, (None, 4), NdarrayCodec(), False),
+    ])
+
+
+def test_fields_accessible_as_attributes_and_dict():
+    s = _schema()
+    assert s.id is s.fields['id']
+    assert list(s.fields) == ['id', 'value', 'image', 'matrix']
+    assert len(s) == 4
+
+
+def test_duplicate_field_names_raise():
+    with pytest.raises(ValueError, match='Duplicate'):
+        Unischema('S', [UnischemaField('a', np.int32, ()),
+                        UnischemaField('a', np.int64, ())])
+
+
+def test_field_equality_ignores_codec():
+    f1 = UnischemaField('x', np.int32, (), ScalarCodec(pa.int32()), False)
+    f2 = UnischemaField('x', np.int32, (), None, False)
+    f3 = UnischemaField('x', np.int64, (), None, False)
+    assert f1 == f2
+    assert hash(f1) == hash(f2)
+    assert f1 != f3
+
+
+def test_field_is_immutable():
+    f = UnischemaField('x', np.int32, ())
+    with pytest.raises(AttributeError):
+        f.name = 'y'
+
+
+def test_shape_compliance_with_wildcards():
+    f = UnischemaField('m', np.float32, (None, 4))
+    assert f.is_shape_compliant((7, 4))
+    assert not f.is_shape_compliant((7, 5))
+    assert not f.is_shape_compliant((7,))
+
+
+def test_create_schema_view_with_fields_and_regex():
+    s = _schema()
+    view = s.create_schema_view([s.id, 'im.*'])
+    assert set(view.fields) == {'id', 'image'}
+    # Order preserved from the parent schema
+    assert list(view.fields) == ['id', 'image']
+
+
+def test_create_schema_view_rejects_foreign_field():
+    s = _schema()
+    foreign = UnischemaField('other', np.int32, ())
+    with pytest.raises(ValueError):
+        s.create_schema_view([foreign])
+
+
+def test_match_unischema_fields_fullmatch_semantics():
+    s = _schema()
+    # 'i' alone must not prefix-match 'id'/'image' (fullmatch semantics)
+    assert match_unischema_fields(s, ['i']) == []
+    names = {f.name for f in match_unischema_fields(s, ['i.*'])}
+    assert names == {'id', 'image'}
+
+
+def test_namedtuple_identity_stable():
+    s1 = _schema()
+    s2 = _schema()
+    assert s1.namedtuple is s2.namedtuple
+    row = s1.make_namedtuple(id=3)
+    assert row.id == 3 and row.value is None
+
+
+def test_as_arrow_schema_types():
+    s = _schema()
+    arrow = s.as_arrow_schema()
+    assert arrow.field('id').type == pa.int64()
+    assert arrow.field('image').type == pa.binary()
+    assert arrow.field('value').nullable
+
+
+def test_json_roundtrip_preserves_everything():
+    s = _schema()
+    restored = Unischema.from_json_dict(s.to_json_dict())
+    assert list(restored.fields) == list(s.fields)
+    for name in s.fields:
+        assert restored.fields[name] == s.fields[name]
+    assert isinstance(restored.image.codec, CompressedImageCodec)
+    assert restored.image.codec.image_codec == 'png'
+    assert isinstance(restored.matrix.codec, NdarrayCodec)
+
+
+def test_json_roundtrip_decimal_and_strings():
+    s = Unischema('S', [
+        UnischemaField('d', Decimal, (), ScalarCodec(pa.string()), False),
+        UnischemaField('s', np.str_, (), ScalarCodec(pa.string()), False),
+        UnischemaField('b', np.bytes_, (), ScalarCodec(pa.binary()), True),
+    ])
+    r = Unischema.from_json_dict(s.to_json_dict())
+    assert r.d.numpy_dtype is Decimal
+    assert r.s.numpy_dtype is np.str_
+    assert r.b.numpy_dtype is np.bytes_
+
+
+def test_from_arrow_schema_inference():
+    arrow = pa.schema([
+        pa.field('a', pa.int32()),
+        pa.field('b', pa.string()),
+        pa.field('c', pa.list_(pa.float32())),
+        pa.field('nested', pa.list_(pa.list_(pa.int8()))),
+    ])
+    s = Unischema.from_arrow_schema(arrow)
+    assert s.a.numpy_dtype is np.int32 and s.a.shape == ()
+    assert s.b.numpy_dtype is np.str_
+    assert s.c.shape == (None,) and s.c.numpy_dtype is np.float32
+    assert 'nested' not in s.fields  # silently skipped
+    with pytest.raises(ValueError):
+        Unischema.from_arrow_schema(arrow, omit_unsupported_fields=False)
+
+
+def test_dict_to_encoded_row_validates_and_encodes():
+    s = _schema()
+    img = np.random.randint(0, 255, (16, 32, 3), dtype=np.uint8)
+    mat = np.random.rand(5, 4).astype(np.float32)
+    row = dict_to_encoded_row(s, {'id': 1, 'value': 2.5, 'image': img, 'matrix': mat})
+    assert row['id'] == 1
+    assert isinstance(row['image'], bytearray)
+    assert isinstance(row['matrix'], bytearray)
+
+    with pytest.raises(ValueError, match='not in schema'):
+        dict_to_encoded_row(s, {'id': 1, 'bogus': 0})
+    with pytest.raises(ValueError, match='not nullable'):
+        dict_to_encoded_row(s, {'id': None, 'value': 1.0, 'image': img, 'matrix': mat})
+    # nullable field may be None
+    row = dict_to_encoded_row(s, {'id': 1, 'value': None, 'image': img, 'matrix': mat})
+    assert row['value'] is None
+
+
+def test_insert_explicit_nulls():
+    s = Unischema('S', [
+        UnischemaField('req', np.int32, (), None, False),
+        UnischemaField('opt', np.int32, (), None, True),
+    ])
+    d = insert_explicit_nulls(s, {'req': 1})
+    assert d['opt'] is None
+    with pytest.raises(ValueError):
+        insert_explicit_nulls(s, {'opt': 1})
