@@ -59,6 +59,10 @@ class DataframeColumnCodec(metaclass=ABCMeta):
         return {'type': type(self).__name__}
 
 
+# RGB(A) <-> BGR(A) channel reorder used at the OpenCV boundary.
+_CHANNEL_SWAP = {3: (2, 1, 0), 4: (2, 1, 0, 3)}
+
+
 class CompressedImageCodec(DataframeColumnCodec):
     """Store uint8/uint16 images as png or jpeg bytes.
 
@@ -85,9 +89,13 @@ class CompressedImageCodec(DataframeColumnCodec):
         if not unischema_field.is_shape_compliant(value.shape):
             raise ValueError('Field %r: image shape %s does not match %s'
                              % (unischema_field.name, value.shape, unischema_field.shape))
-        bgr = value[:, :, (2, 1, 0)] if value.ndim == 3 and value.shape[2] == 3 else value
-        ok, encoded = cv2.imencode(self._image_codec, bgr,
-                                   [int(cv2.IMWRITE_JPEG_QUALITY), self._quality])
+        if value.ndim == 3 and value.shape[2] not in (3, 4):
+            raise ValueError('Field %r: images must be 2-d, HxWx3 or HxWx4; got shape %s'
+                             % (unischema_field.name, value.shape))
+        bgr = value[:, :, _CHANNEL_SWAP[value.shape[2]]] if value.ndim == 3 else value
+        params = ([int(cv2.IMWRITE_JPEG_QUALITY), self._quality]
+                  if self._image_codec in ('.jpeg', '.jpg') else [])
+        ok, encoded = cv2.imencode(self._image_codec, bgr, params)
         if not ok:
             raise RuntimeError('cv2.imencode failed for field %r' % unischema_field.name)
         return bytearray(encoded)
@@ -98,8 +106,8 @@ class CompressedImageCodec(DataframeColumnCodec):
         image = cv2.imdecode(raw, cv2.IMREAD_UNCHANGED)
         if image is None:
             raise ValueError('cv2.imdecode failed for field %r' % unischema_field.name)
-        if image.ndim == 3 and image.shape[2] == 3:
-            image = image[:, :, (2, 1, 0)]
+        if image.ndim == 3 and image.shape[2] in (3, 4):
+            image = image[:, :, _CHANNEL_SWAP[image.shape[2]]]
         return image.astype(unischema_field.numpy_dtype, copy=False)
 
     def decode_batch(self, unischema_field, encoded_iterable):
@@ -234,8 +242,16 @@ def _parse_arrow_type(type_str):
     if type_str in _ARROW_TYPE_PARSERS:
         return _ARROW_TYPE_PARSERS[type_str]()
     if type_str.startswith('timestamp'):
-        unit = type_str[type_str.index('[') + 1:type_str.index(']')]
-        return pa.timestamp(unit)
+        inner = type_str[type_str.index('[') + 1:type_str.index(']')]
+        if ',' in inner:  # e.g. 'timestamp[us, tz=UTC]'
+            unit, tz_part = (s.strip() for s in inner.split(',', 1))
+            tz = tz_part.split('=', 1)[1] if '=' in tz_part else None
+            return pa.timestamp(unit, tz)
+        return pa.timestamp(inner)
+    if type_str.startswith('date32'):
+        return pa.date32()
+    if type_str.startswith('date64'):
+        return pa.date64()
     if type_str.startswith('decimal'):
         inner = type_str[type_str.index('(') + 1:type_str.index(')')]
         precision, scale = (int(x) for x in inner.split(','))

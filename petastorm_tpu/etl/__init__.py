@@ -1,0 +1,35 @@
+"""ETL: dataset materialization, footer metadata, and row-group indexing.
+
+Reference layer: ``petastorm/etl/`` (SURVEY.md §2.3). The write path here is
+Spark-free — pyarrow writes parquet; a Spark adapter can wrap it — and the
+footer schema format is versioned JSON instead of a Python pickle.
+"""
+
+from abc import ABCMeta, abstractmethod
+
+
+class RowGroupIndexerBase(metaclass=ABCMeta):
+    """Base class for row-group indexers (reference: ``petastorm/etl/__init__.py:21``)."""
+
+    @property
+    @abstractmethod
+    def index_name(self):
+        """Unique name of this index."""
+
+    @property
+    @abstractmethod
+    def column_names(self):
+        """Column names needed to build the index."""
+
+    @property
+    @abstractmethod
+    def indexed_values(self):
+        """All values the index can look up."""
+
+    @abstractmethod
+    def get_row_group_indexes(self, value_key):
+        """Row-group ids containing ``value_key``."""
+
+    @abstractmethod
+    def build_index(self, decoded_rows, piece_index):
+        """Consume rows of one row-group and update the index."""

@@ -1,0 +1,483 @@
+"""Dataset materialization and footer metadata (read+write).
+
+Re-design of ``petastorm/etl/dataset_metadata.py`` without Spark:
+
+* The writer is pyarrow-based (:class:`DatasetWriter` / :func:`write_dataset`)
+  with hive partitioning and bounded row-group sizes; a Spark job can still be
+  wrapped with :func:`materialize_dataset` exactly like the reference
+  (``dataset_metadata.py:52``) — the context manager only owns the metadata
+  footer, not the data write.
+* The schema is stored as **versioned JSON** under ``petastorm_tpu.unischema.v1``
+  (the reference pickles it, ``dataset_metadata.py:194-205``). Legacy pickled
+  schemas written by the reference are still readable
+  (:mod:`petastorm_tpu.etl.legacy`).
+* Row-group discovery keeps the reference's 3-way fallback
+  (``dataset_metadata.py:244-296``): footer key → ``_metadata`` summary →
+  per-file footer scan.
+"""
+
+import json
+import logging
+import os
+import posixpath
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from contextlib import contextmanager
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from petastorm_tpu.errors import MetadataError
+from petastorm_tpu.fs import get_filesystem_and_path_or_paths, normalize_dir_url
+from petastorm_tpu.unischema import Unischema, dict_to_encoded_row
+
+logger = logging.getLogger(__name__)
+
+# Versioned JSON footer keys written by this framework.
+UNISCHEMA_KEY = b'petastorm_tpu.unischema.v1'
+ROW_GROUPS_PER_FILE_KEY = b'petastorm_tpu.num_row_groups_per_file.v1'
+
+# Keys written by the reference implementation (read-compat only;
+# ``petastorm/etl/dataset_metadata.py:34-35``).
+LEGACY_UNISCHEMA_KEY = b'dataset-toolkit.unischema.v1'
+LEGACY_ROW_GROUPS_PER_FILE_KEY = b'dataset-toolkit.num_row_groups_per_file.v1'
+
+_SUMMARY_FILES = ('_metadata', '_common_metadata')
+DEFAULT_ROW_GROUP_SIZE_MB = 32  # reference default: spark_dataset_converter.py:43
+
+
+class RowGroupPiece:
+    """One unit of ventilated work: a single row-group of a single file."""
+
+    __slots__ = ('path', 'row_group', 'partition_values', 'num_rows')
+
+    def __init__(self, path, row_group, partition_values=None, num_rows=None):
+        self.path = path
+        self.row_group = row_group
+        self.partition_values = partition_values or {}
+        self.num_rows = num_rows
+
+    def __repr__(self):
+        return 'RowGroupPiece(%r, rg=%d)' % (self.path, self.row_group)
+
+    def __eq__(self, other):
+        return (isinstance(other, RowGroupPiece)
+                and (self.path, self.row_group) == (other.path, other.row_group))
+
+    def __hash__(self):
+        return hash((self.path, self.row_group))
+
+
+def _parse_hive_partitions(relpath):
+    """Extract ``{key: value}`` from hive-style ``key=value`` directories."""
+    parts = {}
+    for segment in relpath.split('/')[:-1]:
+        if '=' in segment:
+            key, _, value = segment.partition('=')
+            parts[key] = value
+    return parts
+
+
+class ParquetDatasetInfo:
+    """Resolved view of a parquet dataset directory on any fsspec filesystem.
+
+    Replaces the reference's use of the (long-removed) legacy
+    ``pq.ParquetDataset`` pieces API with an explicit file inventory +
+    hive-partition parse. Paths are stored fs-relative (no scheme).
+    """
+
+    def __init__(self, dataset_url_or_urls, storage_options=None, validate=True):
+        self.url = dataset_url_or_urls
+        fs, path_or_paths = get_filesystem_and_path_or_paths(
+            dataset_url_or_urls, storage_options)
+        self.fs = fs
+        if isinstance(path_or_paths, list):
+            self.root_path = posixpath.dirname(path_or_paths[0])
+            self.file_paths = sorted(path_or_paths)
+        else:
+            self.root_path = path_or_paths
+            self.file_paths = self._discover_files(fs, path_or_paths)
+        if validate and not self.file_paths:
+            raise MetadataError('No parquet files found under %r' % (dataset_url_or_urls,))
+        self._common_metadata = _UNSET
+        self._metadata = _UNSET
+        self._schema = None
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _discover_files(fs, root):
+        if fs.isfile(root):
+            return [root]
+        files = []
+        root_norm = root.rstrip('/')
+        for path in fs.find(root):
+            rel = posixpath.relpath(path, root_norm)
+            # Skip hidden/metadata entries anywhere in the relative path, so
+            # e.g. Spark's _temporary/.../part-*.parquet never counts as data.
+            segments = rel.split('/')
+            if any(seg.startswith(('.', '_')) for seg in segments):
+                continue
+            if segments[-1].endswith('.crc'):
+                continue
+            files.append(path)
+        return sorted(files)
+
+    # -- footers ------------------------------------------------------------
+
+    def _read_summary(self, name):
+        path = posixpath.join(self.root_path, name)
+        try:
+            if not self.fs.exists(path):
+                return None
+        except (OSError, ValueError):
+            return None
+        with self.fs.open(path, 'rb') as f:
+            return pq.read_metadata(f)
+
+    @property
+    def common_metadata(self):
+        with self._lock:
+            if self._common_metadata is _UNSET:
+                self._common_metadata = self._read_summary('_common_metadata')
+            return self._common_metadata
+
+    @property
+    def summary_metadata(self):
+        with self._lock:
+            if self._metadata is _UNSET:
+                self._metadata = self._read_summary('_metadata')
+            return self._metadata
+
+    @property
+    def arrow_schema(self):
+        """Physical arrow schema (from the first data file's footer)."""
+        if self._schema is None:
+            with self.fs.open(self.file_paths[0], 'rb') as f:
+                self._schema = pq.read_schema(f)
+        return self._schema
+
+    def relpath(self, path):
+        rel = posixpath.relpath(path, self.root_path)
+        return rel
+
+    def partition_values_for(self, path):
+        return _parse_hive_partitions(self.relpath(path))
+
+    @property
+    def partition_keys(self):
+        keys = []
+        for path in self.file_paths:
+            for k in self.partition_values_for(path):
+                if k not in keys:
+                    keys.append(k)
+        return keys
+
+    def open(self, path):
+        return self.fs.open(path, 'rb')
+
+
+class _Unset:
+    pass
+
+
+_UNSET = _Unset()
+
+
+# ---------------------------------------------------------------------------
+# Row-group discovery (read side)
+# ---------------------------------------------------------------------------
+
+def load_row_groups(dataset_info, footer_scan_workers=8):
+    """Enumerate all row-groups of a dataset as :class:`RowGroupPiece` list.
+
+    3-way fallback, mirroring ``petastorm/etl/dataset_metadata.py:244-353``:
+    footer metadata key (ours or the reference's) → ``_metadata`` summary file
+    → parallel footer scan of every data file. Piece order is sorted by path
+    then row-group index so epochs are reproducible.
+    """
+    counts = _row_group_counts_from_common_metadata(dataset_info)
+    if counts is None:
+        counts = _row_group_counts_from_summary(dataset_info)
+    if counts is None:
+        counts = _row_group_counts_from_footers(dataset_info, footer_scan_workers)
+
+    pieces = []
+    for path in dataset_info.file_paths:
+        rel = dataset_info.relpath(path)
+        if rel not in counts:
+            raise MetadataError('No row-group count recorded for file %r' % rel)
+        partitions = dataset_info.partition_values_for(path)
+        for rg in range(counts[rel]):
+            pieces.append(RowGroupPiece(path, rg, partitions))
+    return pieces
+
+
+def _row_group_counts_from_common_metadata(dataset_info):
+    cm = dataset_info.common_metadata
+    if cm is None or cm.metadata is None:
+        return None
+    meta = cm.metadata
+    raw = meta.get(ROW_GROUPS_PER_FILE_KEY) or meta.get(LEGACY_ROW_GROUPS_PER_FILE_KEY)
+    if raw is None:
+        return None
+    return {k: int(v) for k, v in json.loads(raw.decode('utf-8')).items()}
+
+
+def _row_group_counts_from_summary(dataset_info):
+    summary = dataset_info.summary_metadata
+    if summary is None or summary.num_row_groups == 0:
+        return None
+    counts = {}
+    for i in range(summary.num_row_groups):
+        file_path = summary.row_group(i).column(0).file_path
+        if not file_path:
+            return None
+        counts[file_path] = counts.get(file_path, 0) + 1
+    return counts
+
+
+def _row_group_counts_from_footers(dataset_info, workers):
+    def count(path):
+        with dataset_info.open(path) as f:
+            return dataset_info.relpath(path), pq.read_metadata(f).num_row_groups
+
+    with ThreadPoolExecutor(max_workers=workers) as pool:
+        return dict(pool.map(count, dataset_info.file_paths))
+
+
+# ---------------------------------------------------------------------------
+# Schema recovery
+# ---------------------------------------------------------------------------
+
+def get_schema(dataset_info):
+    """Load the Unischema stored in the dataset footer.
+
+    Reads our JSON format first, then falls back to depickling a
+    reference-written schema (``dataset_metadata.py:356-385``).
+    """
+    cm = dataset_info.common_metadata
+    if cm is None or cm.metadata is None:
+        raise MetadataError(
+            'Could not find _common_metadata file for %r. Use materialize_dataset '
+            'or the petastorm-tpu-generate-metadata CLI to add petastorm metadata '
+            'to an existing dataset.' % dataset_info.url)
+    meta = cm.metadata
+    if UNISCHEMA_KEY in meta:
+        return Unischema.from_json_dict(json.loads(meta[UNISCHEMA_KEY].decode('utf-8')))
+    if LEGACY_UNISCHEMA_KEY in meta:
+        from petastorm_tpu.etl.legacy import depickle_legacy_unischema
+        return depickle_legacy_unischema(meta[LEGACY_UNISCHEMA_KEY])
+    raise MetadataError('_common_metadata of %r carries no unischema entry'
+                        % dataset_info.url)
+
+
+def get_schema_from_dataset_url(dataset_url_or_urls, storage_options=None):
+    """Unischema of the dataset at a URL (``dataset_metadata.py:388-407``)."""
+    return get_schema(ParquetDatasetInfo(dataset_url_or_urls, storage_options))
+
+
+def infer_or_load_unischema(dataset_info):
+    """Stored Unischema if present, else inferred from the parquet schema.
+
+    Reference: ``dataset_metadata.py:410-417``.
+    """
+    try:
+        return get_schema(dataset_info)
+    except MetadataError:
+        logger.info('Dataset %s has no petastorm metadata; inferring schema from '
+                    'the parquet footer', dataset_info.url)
+        return Unischema.from_arrow_schema(dataset_info.arrow_schema,
+                                           partition_columns=dataset_info.partition_keys)
+
+
+# ---------------------------------------------------------------------------
+# Footer metadata write
+# ---------------------------------------------------------------------------
+
+def add_to_dataset_metadata(dataset_info, key, value):
+    """Merge one ``key: value`` entry into the dataset's ``_common_metadata``.
+
+    Equivalent of ``petastorm/utils.py:88-132`` on the modern pyarrow API:
+    existing entries are preserved; the base schema comes from the existing
+    summary file or the first data file's footer.
+    """
+    cm = dataset_info.common_metadata
+    if cm is not None:
+        base_schema = cm.schema.to_arrow_schema()
+        existing = dict(cm.metadata or {})
+    else:
+        base_schema = dataset_info.arrow_schema
+        existing = dict(base_schema.metadata or {})
+    existing[key if isinstance(key, bytes) else key.encode()] = (
+        value if isinstance(value, bytes) else value.encode())
+    schema = base_schema.with_metadata(existing)
+    path = posixpath.join(dataset_info.root_path, '_common_metadata')
+    with dataset_info.fs.open(path, 'wb') as f:
+        pq.write_metadata(schema, f)
+    # Drop any stale checksum left by other writers (``utils.py:125-132``).
+    crc = posixpath.join(dataset_info.root_path, '._common_metadata.crc')
+    try:
+        if dataset_info.fs.exists(crc):
+            dataset_info.fs.rm(crc)
+    except (OSError, ValueError):
+        pass
+    # Invalidate the cached footer.
+    dataset_info._common_metadata = _UNSET
+
+
+def _write_dataset_footer(dataset_url, schema, storage_options=None):
+    info = ParquetDatasetInfo(dataset_url, storage_options)
+    counts = _row_group_counts_from_footers(info, workers=8)
+    add_to_dataset_metadata(info, ROW_GROUPS_PER_FILE_KEY,
+                            json.dumps(counts).encode('utf-8'))
+    # add_to_dataset_metadata invalidated info's cached footer, so the second
+    # merge sees the first key without re-listing the dataset tree.
+    add_to_dataset_metadata(info, UNISCHEMA_KEY,
+                            json.dumps(schema.to_json_dict()).encode('utf-8'))
+
+
+@contextmanager
+def materialize_dataset(dataset_url, schema, row_group_size_mb=None,
+                        storage_options=None, spark=None):
+    """Context manager that adds petastorm_tpu metadata after a dataset write.
+
+    Drop-in analogue of the reference context manager
+    (``etl/dataset_metadata.py:52-133``): run any parquet-producing job in the
+    body (a :class:`DatasetWriter`, a Spark write, ...) and the footer
+    (`_common_metadata` with schema JSON + row-group counts) is written on
+    exit. ``spark``/``row_group_size_mb`` are accepted for signature
+    compatibility; when a SparkSession is passed, the parquet block size conf
+    is set for the duration of the body.
+    """
+    conf_was_set = False
+    saved_conf = None
+    if spark is not None and row_group_size_mb:
+        hadoop_conf = spark.sparkContext._jsc.hadoopConfiguration()
+        saved_conf = hadoop_conf.get('parquet.block.size')
+        conf_was_set = True
+        hadoop_conf.setInt('parquet.block.size', row_group_size_mb * 1024 * 1024)
+    try:
+        yield
+    finally:
+        if conf_was_set:
+            hadoop_conf = spark.sparkContext._jsc.hadoopConfiguration()
+            if saved_conf is not None:
+                hadoop_conf.set('parquet.block.size', saved_conf)
+            else:
+                hadoop_conf.unset('parquet.block.size')
+    _write_dataset_footer(normalize_dir_url(dataset_url), schema, storage_options)
+
+
+# ---------------------------------------------------------------------------
+# Spark-free writer
+# ---------------------------------------------------------------------------
+
+class DatasetWriter:
+    """Writes encoded rows into one or more parquet files with hive partitioning.
+
+    This is the pyarrow replacement for the reference's Spark write
+    (``rdd.map(dict_to_spark_row).write.parquet``, SURVEY.md §3.3): rows are
+    codec-encoded with :func:`dict_to_encoded_row`, buffered, and flushed as
+    parquet row-groups of ``rowgroup_size_rows`` rows.
+    """
+
+    def __init__(self, dataset_url, schema, rowgroup_size_rows=1000,
+                 partition_by=(), file_prefix='part', storage_options=None):
+        self.schema = schema
+        self.rowgroup_size_rows = rowgroup_size_rows
+        self.partition_by = tuple(partition_by)
+        self._url = normalize_dir_url(dataset_url)
+        self._file_prefix = file_prefix
+        self.fs, self.root_path = get_filesystem_and_path_or_paths(
+            self._url, storage_options)
+        self.fs.makedirs(self.root_path, exist_ok=True)
+        self._arrow_schema = self._storage_schema()
+        self._writers = {}
+        self._buffers = {}
+        self._file_seq = 0
+
+    def _storage_schema(self):
+        fields = [pa.field(f.name, f.arrow_storage_type(), nullable=True)
+                  for f in self.schema if f.name not in self.partition_by]
+        return pa.schema(fields)
+
+    def _partition_dir(self, row):
+        segments = []
+        for key in self.partition_by:
+            if key not in row:
+                raise ValueError('Row is missing partition column %r' % key)
+            segments.append('%s=%s' % (key, row[key]))
+        return '/'.join(segments)
+
+    def _writer_for(self, part_dir):
+        if part_dir not in self._writers:
+            directory = posixpath.join(self.root_path, part_dir) if part_dir else self.root_path
+            self.fs.makedirs(directory, exist_ok=True)
+            path = posixpath.join(directory, '%s-%05d.parquet' % (self._file_prefix, self._file_seq))
+            self._file_seq += 1
+            sink = self.fs.open(path, 'wb')
+            self._writers[part_dir] = (pq.ParquetWriter(sink, self._arrow_schema), sink)
+            self._buffers[part_dir] = []
+        return self._writers[part_dir][0]
+
+    def write_row_dict(self, row_dict):
+        encoded = dict_to_encoded_row(self.schema, row_dict)
+        part_dir = self._partition_dir(encoded)
+        self._writer_for(part_dir)
+        buf = self._buffers[part_dir]
+        buf.append(encoded)
+        if len(buf) >= self.rowgroup_size_rows:
+            self._flush(part_dir)
+
+    def write_row_dicts(self, row_dicts):
+        for row in row_dicts:
+            self.write_row_dict(row)
+
+    def new_file(self):
+        """Close current files; subsequent rows open fresh parquet files."""
+        self._close_writers()
+
+    def _flush(self, part_dir):
+        rows = self._buffers[part_dir]
+        if not rows:
+            return
+        columns = {}
+        for field in self._arrow_schema:
+            values = [r[field.name] for r in rows]
+            columns[field.name] = pa.array(values, type=field.type)
+        table = pa.table(columns, schema=self._arrow_schema)
+        self._writers[part_dir][0].write_table(table)
+        self._buffers[part_dir] = []
+
+    def _close_writers(self):
+        for part_dir in list(self._writers):
+            self._flush(part_dir)
+            writer, sink = self._writers.pop(part_dir)
+            writer.close()
+            sink.close()
+            self._buffers.pop(part_dir, None)
+
+    def close(self):
+        self._close_writers()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.close()
+
+
+def write_dataset(dataset_url, schema, rows, rowgroup_size_rows=1000,
+                  num_files=1, partition_by=(), storage_options=None):
+    """One-call materialization: write ``rows`` and the metadata footer."""
+    rows = list(rows)
+    with materialize_dataset(dataset_url, schema, storage_options=storage_options):
+        with DatasetWriter(dataset_url, schema, rowgroup_size_rows,
+                           partition_by, storage_options=storage_options) as writer:
+            if num_files <= 1:
+                writer.write_row_dicts(rows)
+            else:
+                per_file = max(1, (len(rows) + num_files - 1) // num_files)
+                for start in range(0, len(rows), per_file):
+                    writer.write_row_dicts(rows[start:start + per_file])
+                    writer.new_file()

@@ -1,0 +1,176 @@
+"""Read schemas pickled into footers by the reference implementation.
+
+The reference stores its ``Unischema`` as a Python pickle under
+``dataset-toolkit.unischema.v1`` (``petastorm/etl/dataset_metadata.py:194-205``)
+— including pre-rename module paths (``av.experimental.deepdrive.dataset_toolkit``,
+``petastorm/etl/legacy.py:22-47``). This module depickles those blobs into
+:class:`petastorm_tpu.unischema.Unischema` **without importing petastorm or
+pyspark**, using shim classes and a restricted unpickler.
+
+Security: footers are untrusted input. ``find_class`` only resolves an
+explicit allowlist (numpy scalars/dtypes, OrderedDict, Decimal) and maps every
+petastorm/pyspark class onto inert local shims; anything else raises.
+"""
+
+import io
+import pickle
+from collections import OrderedDict, namedtuple
+from decimal import Decimal
+
+import numpy as np
+import pyarrow as pa
+
+from petastorm_tpu import codecs as tpu_codecs
+from petastorm_tpu.errors import MetadataError
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+_LEGACY_PACKAGES = ('petastorm', 'av.experimental.deepdrive.dataset_toolkit')
+
+# numpy names a pickled schema may legitimately reference: the dtype machinery
+# and scalar type classes. Nothing that does I/O or code execution.
+_SAFE_NUMPY_NAMES = frozenset([
+    'dtype', 'ndarray', '_reconstruct', 'scalar',
+    'bool_', 'int8', 'uint8', 'int16', 'uint16', 'int32', 'uint32',
+    'int64', 'uint64', 'float16', 'float32', 'float64', 'longdouble',
+    'complex64', 'complex128', 'str_', 'bytes_', 'unicode_', 'string_',
+    'object_', 'datetime64', 'timedelta64', 'void', 'generic', 'number',
+    'integer', 'signedinteger', 'unsignedinteger', 'floating', 'inexact',
+    'flexible', 'character', 'intc', 'intp', 'int_', 'uint', 'single', 'double',
+])
+
+# The reference's UnischemaField is a NamedTuple with this exact field order
+# (``petastorm/unischema.py:50-66``); pickles reconstruct it positionally.
+_ShimField = namedtuple('_ShimField', ['name', 'numpy_dtype', 'shape', 'codec', 'nullable'])
+_ShimField.__new__.__defaults__ = (None, False)
+
+
+class _ShimObject:
+    """Generic stand-in for a pickled reference/pyspark object: records its
+    origin and accepts any instance state."""
+
+    _shim_module = None
+    _shim_name = None
+
+    def __init__(self, *args, **kwargs):
+        self._shim_args = args
+        self._shim_kwargs = kwargs
+
+    def __setstate__(self, state):
+        if isinstance(state, dict):
+            self.__dict__.update(state)
+        else:
+            self.__dict__['_shim_state'] = state
+
+
+def _make_shim(module, name):
+    return type('_Shim_%s' % name, (_ShimObject,),
+                {'_shim_module': module, '_shim_name': name})
+
+
+class _RestrictedUnpickler(pickle.Unpickler):
+    _ALLOWED = {
+        ('collections', 'OrderedDict'): OrderedDict,
+        ('decimal', 'Decimal'): Decimal,
+        ('builtins', 'frozenset'): frozenset,
+        ('builtins', 'set'): set,
+        ('builtins', 'object'): object,
+        ('copyreg', '_reconstructor'): __import__('copyreg')._reconstructor,
+    }
+
+    def find_class(self, module, name):
+        if (module, name) in self._ALLOWED:
+            return self._ALLOWED[(module, name)]
+        if module == 'numpy' or module.startswith('numpy.'):
+            # numpy dtype/scalar reconstruction only — a fixed allowlist of
+            # reconstruction helpers and scalar-type classes, never arbitrary
+            # numpy callables (numpy.load etc. must stay unreachable).
+            if name in _SAFE_NUMPY_NAMES:
+                if module in ('numpy.core.multiarray', 'numpy._core.multiarray'):
+                    from numpy._core import multiarray
+                    return getattr(multiarray, name)
+                return getattr(np, name)
+            raise pickle.UnpicklingError(
+                'Refusing to depickle numpy attribute %s.%s from a dataset footer'
+                % (module, name))
+        for pkg in _LEGACY_PACKAGES:
+            if module == pkg + '.unischema' and name == 'UnischemaField':
+                return _ShimField
+            if module.startswith(pkg + '.') or module == pkg:
+                return _make_shim(module, name)
+        if module.startswith('pyspark.'):
+            return _make_shim(module, name)
+        raise pickle.UnpicklingError(
+            'Refusing to depickle %s.%s from a dataset footer' % (module, name))
+
+
+def _loads(blob):
+    return _RestrictedUnpickler(io.BytesIO(blob)).load()
+
+
+# ---------------------------------------------------------------------------
+# shim → petastorm_tpu conversion
+# ---------------------------------------------------------------------------
+
+_SPARK_TYPE_NAME_TO_ARROW = {
+    'BooleanType': pa.bool_(), 'ByteType': pa.int8(), 'ShortType': pa.int16(),
+    'IntegerType': pa.int32(), 'LongType': pa.int64(), 'FloatType': pa.float32(),
+    'DoubleType': pa.float64(), 'StringType': pa.string(),
+    'BinaryType': pa.binary(), 'TimestampType': pa.timestamp('us'),
+    'DateType': pa.date32(),
+}
+
+
+def _convert_codec(shim):
+    if shim is None:
+        return None
+    name = getattr(type(shim), '_shim_name', None)
+    state = getattr(shim, '__dict__', {})
+    if name == 'NdarrayCodec':
+        return tpu_codecs.NdarrayCodec()
+    if name == 'CompressedNdarrayCodec':
+        return tpu_codecs.CompressedNdarrayCodec()
+    if name == 'CompressedImageCodec':
+        image_codec = state.get('_image_codec', '.png').lstrip('.')
+        return tpu_codecs.CompressedImageCodec(image_codec, state.get('_quality', 80))
+    if name == 'ScalarCodec':
+        spark_type = state.get('_spark_type')
+        return tpu_codecs.ScalarCodec(_convert_spark_type(spark_type))
+    raise MetadataError('Unknown legacy codec class %r in pickled schema' % name)
+
+
+def _convert_spark_type(shim):
+    name = getattr(type(shim), '_shim_name', None)
+    if name in _SPARK_TYPE_NAME_TO_ARROW:
+        return _SPARK_TYPE_NAME_TO_ARROW[name]
+    if name == 'DecimalType':
+        state = getattr(shim, '__dict__', {})
+        return pa.decimal128(state.get('precision', 38), state.get('scale', 18))
+    raise MetadataError('Unknown legacy spark type %r in pickled schema' % name)
+
+
+def _convert_field(shim_field):
+    if isinstance(shim_field, _ShimField):
+        name, numpy_dtype, shape, codec, nullable = shim_field
+    else:  # very old pickles may carry a shim object with attributes
+        d = shim_field.__dict__
+        name, numpy_dtype, shape = d['name'], d['numpy_dtype'], d['shape']
+        codec, nullable = d.get('codec'), d.get('nullable', False)
+    return UnischemaField(name, numpy_dtype, tuple(shape),
+                          _convert_codec(codec), bool(nullable))
+
+
+def depickle_legacy_unischema(blob):
+    """Decode a reference-pickled Unischema blob into our Unischema."""
+    obj = _loads(blob)
+    d = getattr(obj, '__dict__', None)
+    if d is None:
+        raise MetadataError('Pickled schema has unexpected structure: %r' % type(obj))
+    name = d.get('_name', 'legacy')
+    fields = d.get('_fields')
+    if fields is None:
+        raise MetadataError('Pickled schema carries no _fields')
+    if isinstance(fields, dict):
+        shim_fields = list(fields.values())
+    else:
+        shim_fields = list(fields)
+    return Unischema(name, [_convert_field(f) for f in shim_fields])

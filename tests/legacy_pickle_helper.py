@@ -1,0 +1,96 @@
+"""Builds a pickle byte stream shaped exactly like the reference's pickled
+Unischema (module paths ``petastorm.unischema`` / ``petastorm.codecs`` /
+``pyspark.sql.types``) without importing petastorm or pyspark.
+
+Strategy: register synthetic modules in sys.modules carrying classes whose
+``__module__``/``__qualname__`` match the reference's, pickle an instance
+graph, then remove the modules again.
+"""
+
+import pickle
+import sys
+import types
+from collections import OrderedDict
+from typing import NamedTuple, Any, Optional, Tuple
+
+import numpy as np
+
+
+def make_reference_style_pickle():
+    mods = {}
+
+    def new_module(name):
+        m = types.ModuleType(name)
+        mods[name] = m
+        return m
+
+    new_module('petastorm')
+    new_module('pyspark')
+    new_module('pyspark.sql')
+    m_uni = new_module('petastorm.unischema')
+    m_cod = new_module('petastorm.codecs')
+    m_spark = new_module('pyspark.sql.types')
+
+    class UnischemaField(NamedTuple):
+        name: str
+        numpy_dtype: Any
+        shape: Tuple[Optional[int], ...]
+        codec: Optional[Any] = None
+        nullable: Optional[bool] = False
+
+    UnischemaField.__module__ = 'petastorm.unischema'
+    UnischemaField.__qualname__ = 'UnischemaField'
+    m_uni.UnischemaField = UnischemaField
+
+    class Unischema:
+        pass
+
+    Unischema.__module__ = 'petastorm.unischema'
+    Unischema.__qualname__ = 'Unischema'
+    m_uni.Unischema = Unischema
+
+    class ScalarCodec:
+        pass
+
+    class CompressedImageCodec:
+        pass
+
+    for cls in (ScalarCodec, CompressedImageCodec):
+        cls.__module__ = 'petastorm.codecs'
+        cls.__qualname__ = cls.__name__
+    m_cod.ScalarCodec = ScalarCodec
+    m_cod.CompressedImageCodec = CompressedImageCodec
+
+    class IntegerType:
+        pass
+
+    IntegerType.__module__ = 'pyspark.sql.types'
+    IntegerType.__qualname__ = 'IntegerType'
+    m_spark.IntegerType = IntegerType
+
+    saved = {k: sys.modules.get(k) for k in mods}
+    sys.modules.update(mods)
+    try:
+        int_type = IntegerType()
+        scalar = ScalarCodec()
+        scalar._spark_type = int_type
+        image = CompressedImageCodec()
+        image._image_codec = '.png'
+        image._quality = 80
+
+        fields = [
+            UnischemaField('id', np.int32, (), scalar, False),
+            UnischemaField('image', np.uint8, (None, None, 3), image, False),
+        ]
+        schema = Unischema()
+        schema._name = 'LegacySchema'
+        schema._fields = OrderedDict((f.name, f) for f in fields)
+        for f in fields:
+            setattr(schema, f.name, f)
+        return pickle.dumps(schema, protocol=2)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                sys.modules.pop(k, None)
+            else:
+                sys.modules[k] = v

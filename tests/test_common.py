@@ -1,0 +1,107 @@
+"""Canonical synthetic datasets for the test suite.
+
+Mirrors the reference's fixture strategy (``petastorm/tests/test_common.py``):
+a rich multi-codec ``TestSchema`` materialized into a real on-disk dataset,
+plus a plain (non-petastorm) scalar parquet store.
+"""
+
+from decimal import Decimal
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from petastorm_tpu.codecs import (
+    CompressedImageCodec, CompressedNdarrayCodec, NdarrayCodec, ScalarCodec,
+)
+from petastorm_tpu.etl.dataset_metadata import write_dataset
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+TestSchema = Unischema('TestSchema', [
+    UnischemaField('partition_key', np.str_, (), ScalarCodec(pa.string()), False),
+    UnischemaField('id', np.int64, (), ScalarCodec(pa.int64()), False),
+    UnischemaField('id2', np.int32, (), ScalarCodec(pa.int32()), False),
+    UnischemaField('id_float', np.float64, (), ScalarCodec(pa.float64()), False),
+    UnischemaField('id_odd', np.bool_, (), ScalarCodec(pa.bool_()), False),
+    UnischemaField('python_primitive_uint8', np.uint8, (), ScalarCodec(pa.uint8()), False),
+    UnischemaField('image_png', np.uint8, (16, 32, 3), CompressedImageCodec('png'), False),
+    UnischemaField('matrix', np.float32, (10, 20, 30), NdarrayCodec(), False),
+    UnischemaField('decimal', Decimal, (), ScalarCodec(pa.string()), False),
+    UnischemaField('matrix_uint16', np.uint16, (2, 3), NdarrayCodec(), False),
+    UnischemaField('matrix_string', np.bytes_, (None, None), NdarrayCodec(), False),
+    UnischemaField('empty_matrix_string', np.bytes_, (None,), NdarrayCodec(), False),
+    UnischemaField('matrix_nullable', np.uint16, (None, 14), NdarrayCodec(), True),
+    UnischemaField('sensor_name', np.str_, (1,), NdarrayCodec(), False),
+    UnischemaField('string_array_nullable', np.str_, (None,), NdarrayCodec(), True),
+    UnischemaField('compressed', np.float64, (4, 5), CompressedNdarrayCodec(), False),
+])
+
+
+def _row(i, seed=0):
+    rng = np.random.RandomState(seed * 100000 + i)
+    return {
+        'partition_key': 'p_%d' % (i % 5),
+        'id': i,
+        'id2': i % 2,
+        'id_float': float(i),
+        'id_odd': bool(i % 2),
+        'python_primitive_uint8': i % 255,
+        'image_png': rng.randint(0, 255, (16, 32, 3)).astype(np.uint8),
+        'matrix': rng.rand(10, 20, 30).astype(np.float32),
+        'decimal': Decimal('%d.%d' % (i, i % 100)),
+        'matrix_uint16': rng.randint(0, 2 ** 16 - 1, (2, 3)).astype(np.uint16),
+        'matrix_string': np.array([[b'a%d' % i, b'bc'], [b'd', b'ef%d' % i]], dtype=np.bytes_),
+        'empty_matrix_string': np.array([], dtype=np.bytes_),
+        'matrix_nullable': (rng.randint(0, 255, (3, 14)).astype(np.uint16)
+                            if i % 3 else None),
+        'sensor_name': np.array(['sensor_%d' % i], dtype=np.str_),
+        'string_array_nullable': (np.array(['abc', 'x_%d' % i], dtype=np.str_)
+                                  if i % 4 else None),
+        'compressed': rng.rand(4, 5).astype(np.float64),
+    }
+
+
+def create_test_dataset(url, ids, num_files=4, rowgroup_size=10, partition_by=()):
+    """Materialize TestSchema rows for the given ids; returns the row dicts."""
+    rows = [_row(i) for i in ids]
+    write_dataset(url, TestSchema, rows, rowgroup_size_rows=rowgroup_size,
+                  num_files=num_files, partition_by=partition_by)
+    return rows
+
+
+def create_test_scalar_dataset(url, num_rows=100, num_files=4):
+    """Plain parquet (no petastorm metadata) for make_batch_reader tests."""
+    from petastorm_tpu.fs import get_filesystem_and_path_or_paths
+    fs, path = get_filesystem_and_path_or_paths(url)
+    fs.makedirs(path, exist_ok=True)
+    rows = []
+    for i in range(num_rows):
+        rows.append({
+            'id': i,
+            'int_fixed_size_list': list(range(i, i + 3)),
+            'datetime': np.datetime64('2019-01-02') + np.timedelta64(i, 'D'),
+            'timestamp': np.datetime64('2005-02-25T03:30') + np.timedelta64(i, 'm'),
+            'string': 'hello_%d' % i,
+            'string2': 'world_%d' % (i % 3),
+            'float64': i * 0.66,
+        })
+    per_file = (num_rows + num_files - 1) // num_files
+    for file_idx in range(num_files):
+        chunk = rows[file_idx * per_file:(file_idx + 1) * per_file]
+        if not chunk:
+            continue
+        table = pa.table({
+            'id': pa.array([r['id'] for r in chunk], pa.int64()),
+            'int_fixed_size_list': pa.array([r['int_fixed_size_list'] for r in chunk],
+                                            pa.list_(pa.int64())),
+            'datetime': pa.array([r['datetime'].astype('datetime64[D]').item() for r in chunk],
+                                 pa.date32()),
+            'timestamp': pa.array([r['timestamp'].astype('datetime64[us]') for r in chunk],
+                                  pa.timestamp('us')),
+            'string': pa.array([r['string'] for r in chunk], pa.string()),
+            'string2': pa.array([r['string2'] for r in chunk], pa.string()),
+            'float64': pa.array([r['float64'] for r in chunk], pa.float64()),
+        })
+        with fs.open('%s/part-%05d.parquet' % (path, file_idx), 'wb') as f:
+            pq.write_table(table, f, row_group_size=13)
+    return rows

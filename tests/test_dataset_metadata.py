@@ -1,0 +1,166 @@
+"""ETL/metadata tests (parity model: petastorm/tests/test_dataset_metadata.py,
+test_metadata_read.py)."""
+
+import json
+import pickle
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from petastorm_tpu.errors import MetadataError
+from petastorm_tpu.etl.dataset_metadata import (
+    LEGACY_UNISCHEMA_KEY, ROW_GROUPS_PER_FILE_KEY, UNISCHEMA_KEY,
+    DatasetWriter, ParquetDatasetInfo, add_to_dataset_metadata, get_schema,
+    get_schema_from_dataset_url, infer_or_load_unischema, load_row_groups,
+    materialize_dataset, write_dataset,
+)
+from petastorm_tpu.unischema import Unischema, UnischemaField
+from petastorm_tpu.codecs import ScalarCodec, NdarrayCodec
+
+
+def _tiny_schema():
+    return Unischema('Tiny', [
+        UnischemaField('id', np.int64, (), ScalarCodec(pa.int64()), False),
+        UnischemaField('vec', np.float32, (3,), NdarrayCodec(), False),
+    ])
+
+
+def _tiny_rows(n):
+    return [{'id': i, 'vec': np.arange(3, dtype=np.float32) + i} for i in range(n)]
+
+
+def test_write_dataset_creates_metadata_and_rowgroups(tmp_path):
+    url = 'file://' + str(tmp_path / 'ds')
+    write_dataset(url, _tiny_schema(), _tiny_rows(25), rowgroup_size_rows=10)
+    info = ParquetDatasetInfo(url)
+    assert info.common_metadata is not None
+    meta = info.common_metadata.metadata
+    assert UNISCHEMA_KEY in meta
+    assert ROW_GROUPS_PER_FILE_KEY in meta
+    pieces = load_row_groups(info)
+    assert len(pieces) == 3  # 10 + 10 + 5
+    schema = get_schema(info)
+    assert list(schema.fields) == ['id', 'vec']
+
+
+def test_write_dataset_multiple_files(tmp_path):
+    url = 'file://' + str(tmp_path / 'ds')
+    write_dataset(url, _tiny_schema(), _tiny_rows(40), rowgroup_size_rows=5, num_files=4)
+    info = ParquetDatasetInfo(url)
+    assert len(info.file_paths) == 4
+    assert len(load_row_groups(info)) == 8
+
+
+def test_partitioned_write(tmp_path):
+    schema = Unischema('P', [
+        UnischemaField('part', np.str_, (), ScalarCodec(pa.string()), False),
+        UnischemaField('id', np.int64, (), ScalarCodec(pa.int64()), False),
+    ])
+    url = 'file://' + str(tmp_path / 'ds')
+    rows = [{'part': 'a' if i < 5 else 'b', 'id': i} for i in range(10)]
+    write_dataset(url, schema, rows, rowgroup_size_rows=100, partition_by=['part'])
+    info = ParquetDatasetInfo(url)
+    assert len(info.file_paths) == 2
+    assert info.partition_keys == ['part']
+    pieces = load_row_groups(info)
+    parts = {p.partition_values['part'] for p in pieces}
+    assert parts == {'a', 'b'}
+
+
+def test_load_row_groups_footer_scan_fallback(tmp_path):
+    """A dataset without _common_metadata must still enumerate row-groups."""
+    url = 'file://' + str(tmp_path / 'ds')
+    write_dataset(url, _tiny_schema(), _tiny_rows(20), rowgroup_size_rows=10)
+    # Remove the footer file.
+    (tmp_path / 'ds' / '_common_metadata').unlink()
+    info = ParquetDatasetInfo(url)
+    assert info.common_metadata is None
+    assert len(load_row_groups(info)) == 2
+
+
+def test_infer_schema_from_plain_parquet(tmp_path, scalar_dataset):
+    info = ParquetDatasetInfo(scalar_dataset.url)
+    with pytest.raises(MetadataError):
+        get_schema(info)
+    schema = infer_or_load_unischema(info)
+    assert 'id' in schema.fields
+    assert schema.int_fixed_size_list.shape == (None,)
+
+
+def test_get_schema_from_dataset_url(synthetic_dataset):
+    schema = get_schema_from_dataset_url(synthetic_dataset.url)
+    assert 'image_png' in schema.fields
+    assert schema.image_png.shape == (16, 32, 3)
+
+
+def test_add_to_dataset_metadata_preserves_existing(tmp_path):
+    url = 'file://' + str(tmp_path / 'ds')
+    write_dataset(url, _tiny_schema(), _tiny_rows(5))
+    info = ParquetDatasetInfo(url)
+    add_to_dataset_metadata(info, b'my.custom.key', b'hello')
+    info2 = ParquetDatasetInfo(url)
+    meta = info2.common_metadata.metadata
+    assert meta[b'my.custom.key'] == b'hello'
+    assert UNISCHEMA_KEY in meta
+
+
+def test_materialize_dataset_context_manager(tmp_path):
+    url = 'file://' + str(tmp_path / 'ds')
+    schema = _tiny_schema()
+    with materialize_dataset(url, schema):
+        with DatasetWriter(url, schema, rowgroup_size_rows=4) as w:
+            w.write_row_dicts(_tiny_rows(9))
+    info = ParquetDatasetInfo(url)
+    assert len(load_row_groups(info)) == 3
+    assert get_schema(info) is not None
+
+
+def test_materialize_skips_footer_on_body_failure(tmp_path):
+    url = 'file://' + str(tmp_path / 'ds')
+    with pytest.raises(RuntimeError):
+        with materialize_dataset(url, _tiny_schema()):
+            raise RuntimeError('write failed')
+
+
+def test_legacy_pickled_schema_depickling(tmp_path):
+    """A footer with a reference-style pickled schema must decode.
+
+    We synthesize the pickle with stand-in classes whose module/qualname match
+    the reference's (no petastorm import needed).
+    """
+    from tests.legacy_pickle_helper import make_reference_style_pickle
+    blob = make_reference_style_pickle()
+    from petastorm_tpu.etl.legacy import depickle_legacy_unischema
+    schema = depickle_legacy_unischema(blob)
+    assert list(schema.fields) == ['id', 'image']
+    assert schema.id.numpy_dtype is np.int32
+    from petastorm_tpu.codecs import CompressedImageCodec, ScalarCodec as TpuScalarCodec
+    assert isinstance(schema.image.codec, CompressedImageCodec)
+    assert schema.image.codec.image_codec == 'png'
+    assert isinstance(schema.id.codec, TpuScalarCodec)
+    assert schema.id.codec.arrow_type(None) == pa.int32()
+
+
+def test_legacy_depickler_refuses_malicious_pickle():
+    evil = pickle.dumps(print)  # builtins.print is not allowlisted
+    from petastorm_tpu.etl.legacy import depickle_legacy_unischema
+    with pytest.raises(pickle.UnpicklingError):
+        depickle_legacy_unischema(evil)
+
+
+def test_read_legacy_footer_keys(tmp_path):
+    """Datasets whose footer uses the reference's key names are readable."""
+    url = 'file://' + str(tmp_path / 'ds')
+    write_dataset(url, _tiny_schema(), _tiny_rows(10), rowgroup_size_rows=5)
+    info = ParquetDatasetInfo(url)
+    meta = dict(info.common_metadata.metadata)
+    counts = meta.pop(ROW_GROUPS_PER_FILE_KEY)
+    meta.pop(UNISCHEMA_KEY)
+    # Rewrite footer with ONLY legacy-style count key.
+    base_schema = info.common_metadata.schema.to_arrow_schema().with_metadata(
+        {b'dataset-toolkit.num_row_groups_per_file.v1': counts})
+    import pyarrow.parquet as pq
+    pq.write_metadata(base_schema, str(tmp_path / 'ds' / '_common_metadata'))
+    info = ParquetDatasetInfo(url)
+    assert len(load_row_groups(info)) == 2
