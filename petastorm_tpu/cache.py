@@ -1,0 +1,107 @@
+"""Row-group caches.
+
+Parity with ``petastorm/cache.py`` + ``local_disk_cache.py``, minus the
+``diskcache`` dependency: :class:`LocalDiskCache` is a small self-contained
+file cache (pickled values, sharded dirs, size-bounded LRU by access time).
+"""
+
+import hashlib
+import logging
+import os
+import pickle
+import threading
+from abc import ABCMeta, abstractmethod
+
+logger = logging.getLogger(__name__)
+
+
+class CacheBase(metaclass=ABCMeta):
+    @abstractmethod
+    def get(self, key, fill_cache_func):
+        """Value for ``key``; on miss call ``fill_cache_func``, store, return."""
+
+    def cleanup(self):
+        """Release resources (no-op by default)."""
+
+
+class NullCache(CacheBase):
+    """Never caches (reference: ``cache.py:30-39``)."""
+
+    def get(self, key, fill_cache_func):
+        return fill_cache_func()
+
+
+class LocalDiskCache(CacheBase):
+    """File-backed cache with a soft size bound and LRU eviction.
+
+    :param path: cache directory (created if needed).
+    :param size_limit_bytes: soft cap; least-recently-accessed entries are
+        evicted when exceeded.
+    :param expected_row_size_bytes: accepted for reference API compatibility.
+    """
+
+    _SHARDS = 64
+
+    def __init__(self, path, size_limit_bytes, expected_row_size_bytes=None,
+                 cleanup=False, **_unused):
+        self._path = path
+        self._size_limit = size_limit_bytes
+        self._cleanup_on_exit = cleanup
+        self._lock = threading.Lock()
+        os.makedirs(path, exist_ok=True)
+
+    def _entry_path(self, key):
+        digest = hashlib.sha1(str(key).encode('utf-8')).hexdigest()
+        shard = digest[:2]
+        return os.path.join(self._path, shard, digest + '.pkl')
+
+    def get(self, key, fill_cache_func):
+        entry = self._entry_path(key)
+        try:
+            with open(entry, 'rb') as f:
+                value = pickle.load(f)
+            os.utime(entry)  # LRU touch
+            return value
+        except (OSError, pickle.UnpicklingError, EOFError):
+            pass
+        value = fill_cache_func()
+        try:
+            os.makedirs(os.path.dirname(entry), exist_ok=True)
+            tmp = entry + '.tmp.%d' % os.getpid()
+            with open(tmp, 'wb') as f:
+                pickle.dump(value, f, protocol=pickle.HIGHEST_PROTOCOL)
+            os.replace(tmp, entry)
+            self._maybe_evict()
+        except OSError:
+            logger.warning('LocalDiskCache failed to store %r', key, exc_info=True)
+        return value
+
+    def _maybe_evict(self):
+        with self._lock:
+            entries = []
+            total = 0
+            for root, _, files in os.walk(self._path):
+                for name in files:
+                    p = os.path.join(root, name)
+                    try:
+                        st = os.stat(p)
+                    except OSError:
+                        continue
+                    entries.append((st.st_atime, st.st_size, p))
+                    total += st.st_size
+            if total <= self._size_limit:
+                return
+            entries.sort()  # oldest access first
+            for _, size, p in entries:
+                try:
+                    os.remove(p)
+                    total -= size
+                except OSError:
+                    pass
+                if total <= self._size_limit:
+                    break
+
+    def cleanup(self):
+        if self._cleanup_on_exit:
+            import shutil
+            shutil.rmtree(self._path, ignore_errors=True)

@@ -18,11 +18,11 @@ Re-design of ``petastorm/etl/dataset_metadata.py`` without Spark:
 
 import json
 import logging
-import os
 import posixpath
 import threading
 from concurrent.futures import ThreadPoolExecutor
 from contextlib import contextmanager
+from urllib.parse import quote, unquote
 
 import pyarrow as pa
 import pyarrow.parquet as pq
@@ -43,19 +43,20 @@ LEGACY_UNISCHEMA_KEY = b'dataset-toolkit.unischema.v1'
 LEGACY_ROW_GROUPS_PER_FILE_KEY = b'dataset-toolkit.num_row_groups_per_file.v1'
 
 _SUMMARY_FILES = ('_metadata', '_common_metadata')
-DEFAULT_ROW_GROUP_SIZE_MB = 32  # reference default: spark_dataset_converter.py:43
+# Row-group size bound used by the Spark converter (reference default:
+# ``spark_dataset_converter.py:43``); pass to DatasetWriter(rowgroup_size_mb=...).
+DEFAULT_ROW_GROUP_SIZE_MB = 32
 
 
 class RowGroupPiece:
     """One unit of ventilated work: a single row-group of a single file."""
 
-    __slots__ = ('path', 'row_group', 'partition_values', 'num_rows')
+    __slots__ = ('path', 'row_group', 'partition_values')
 
-    def __init__(self, path, row_group, partition_values=None, num_rows=None):
+    def __init__(self, path, row_group, partition_values=None):
         self.path = path
         self.row_group = row_group
         self.partition_values = partition_values or {}
-        self.num_rows = num_rows
 
     def __repr__(self):
         return 'RowGroupPiece(%r, rg=%d)' % (self.path, self.row_group)
@@ -69,12 +70,16 @@ class RowGroupPiece:
 
 
 def _parse_hive_partitions(relpath):
-    """Extract ``{key: value}`` from hive-style ``key=value`` directories."""
+    """Extract ``{key: value}`` from hive-style ``key=value`` directories.
+
+    Values are URL-unquoted, symmetric with the writer's escaping (and with
+    Spark/Hive, which percent-encode special characters in partition values).
+    """
     parts = {}
     for segment in relpath.split('/')[:-1]:
         if '=' in segment:
             key, _, value = segment.partition('=')
-            parts[key] = value
+            parts[key] = unquote(value)
     return parts
 
 
@@ -382,9 +387,12 @@ class DatasetWriter:
     """
 
     def __init__(self, dataset_url, schema, rowgroup_size_rows=1000,
-                 partition_by=(), file_prefix='part', storage_options=None):
+                 partition_by=(), file_prefix='part', storage_options=None,
+                 rowgroup_size_mb=None):
         self.schema = schema
         self.rowgroup_size_rows = rowgroup_size_rows
+        self.rowgroup_size_bytes = (rowgroup_size_mb * 1024 * 1024
+                                    if rowgroup_size_mb else None)
         self.partition_by = tuple(partition_by)
         self._url = normalize_dir_url(dataset_url)
         self._file_prefix = file_prefix
@@ -394,7 +402,9 @@ class DatasetWriter:
         self._arrow_schema = self._storage_schema()
         self._writers = {}
         self._buffers = {}
+        self._buffer_bytes = {}
         self._file_seq = 0
+        self._files_written = 0
 
     def _storage_schema(self):
         fields = [pa.field(f.name, f.arrow_storage_type(), nullable=True)
@@ -406,7 +416,7 @@ class DatasetWriter:
         for key in self.partition_by:
             if key not in row:
                 raise ValueError('Row is missing partition column %r' % key)
-            segments.append('%s=%s' % (key, row[key]))
+            segments.append('%s=%s' % (key, quote(str(row[key]), safe='')))
         return '/'.join(segments)
 
     def _writer_for(self, part_dir):
@@ -420,6 +430,18 @@ class DatasetWriter:
             self._buffers[part_dir] = []
         return self._writers[part_dir][0]
 
+    @staticmethod
+    def _row_nbytes(encoded):
+        total = 0
+        for v in encoded.values():
+            if isinstance(v, (bytes, bytearray)):
+                total += len(v)
+            elif isinstance(v, list):
+                total += 8 * len(v)
+            else:
+                total += 8
+        return total
+
     def write_row_dict(self, row_dict):
         encoded = dict_to_encoded_row(self.schema, row_dict)
         part_dir = self._partition_dir(encoded)
@@ -428,6 +450,11 @@ class DatasetWriter:
         buf.append(encoded)
         if len(buf) >= self.rowgroup_size_rows:
             self._flush(part_dir)
+        elif self.rowgroup_size_bytes is not None:
+            self._buffer_bytes[part_dir] = (self._buffer_bytes.get(part_dir, 0)
+                                            + self._row_nbytes(encoded))
+            if self._buffer_bytes[part_dir] >= self.rowgroup_size_bytes:
+                self._flush(part_dir)
 
     def write_row_dicts(self, row_dicts):
         for row in row_dicts:
@@ -439,6 +466,7 @@ class DatasetWriter:
 
     def _flush(self, part_dir):
         rows = self._buffers[part_dir]
+        self._buffer_bytes[part_dir] = 0
         if not rows:
             return
         columns = {}
@@ -456,8 +484,13 @@ class DatasetWriter:
             writer.close()
             sink.close()
             self._buffers.pop(part_dir, None)
+            self._files_written += 1
 
     def close(self):
+        if self._files_written == 0 and not self._writers and not self.partition_by:
+            # Zero-row dataset: still produce one (empty) parquet file so the
+            # dataset is a valid, readable store rather than a footer error.
+            self._writer_for('')
         self._close_writers()
 
     def __enter__(self):
@@ -468,12 +501,14 @@ class DatasetWriter:
 
 
 def write_dataset(dataset_url, schema, rows, rowgroup_size_rows=1000,
-                  num_files=1, partition_by=(), storage_options=None):
+                  num_files=1, partition_by=(), storage_options=None,
+                  rowgroup_size_mb=None):
     """One-call materialization: write ``rows`` and the metadata footer."""
     rows = list(rows)
     with materialize_dataset(dataset_url, schema, storage_options=storage_options):
         with DatasetWriter(dataset_url, schema, rowgroup_size_rows,
-                           partition_by, storage_options=storage_options) as writer:
+                           partition_by, storage_options=storage_options,
+                           rowgroup_size_mb=rowgroup_size_mb) as writer:
             if num_files <= 1:
                 writer.write_row_dicts(rows)
             else:

@@ -86,7 +86,10 @@ class _RestrictedUnpickler(pickle.Unpickler):
             # numpy callables (numpy.load etc. must stay unreachable).
             if name in _SAFE_NUMPY_NAMES:
                 if module in ('numpy.core.multiarray', 'numpy._core.multiarray'):
-                    from numpy._core import multiarray
+                    try:  # numpy >= 2.0
+                        from numpy._core import multiarray
+                    except ImportError:  # numpy 1.x
+                        from numpy.core import multiarray
                     return getattr(multiarray, name)
                 return getattr(np, name)
             raise pickle.UnpicklingError(

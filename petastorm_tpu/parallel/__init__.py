@@ -1,0 +1,5 @@
+"""Distributed/parallel utilities: pod-aware sharding, mesh helpers, device
+staging policy. The reference's distributed contract is shard-per-rank with no
+collectives (SURVEY.md §2.2, §5.8); here the rank/size default from the JAX
+distributed runtime and the device-mesh utilities integrate with
+``jax.sharding``."""

@@ -1,0 +1,49 @@
+"""Pod-aware shard assignment.
+
+The reference requires manual ``cur_shard``/``shard_count`` and only
+cross-checks them against Horovod/MPI env vars
+(``petastorm/spark/spark_dataset_converter.py:122-159``). On TPU pods the
+source of truth is the JAX distributed runtime: every host is
+``jax.process_index()`` of ``jax.process_count()``, so sharding defaults from
+there — zero configuration on a pod, no communication (shards stay disjoint by
+construction, SURVEY.md §5.8).
+"""
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def _jax_process_info():
+    try:
+        import jax
+        return jax.process_index(), jax.process_count()
+    except Exception:  # noqa: BLE001 - jax absent or uninitialized
+        return None, None
+
+
+def default_shard_info(cur_shard, shard_count):
+    """Resolve (cur_shard, shard_count), filling defaults from JAX.
+
+    Rules:
+    * both None → single process: no sharding unless a multi-process JAX
+      runtime is active, in which case shard by process.
+    * both set → use them (validated).
+    * one set → error (ambiguous), matching the reference's strictness
+      (``petastorm/reader.py:376-382``).
+    """
+    if cur_shard is None and shard_count is None:
+        index, count = _jax_process_info()
+        if count is not None and count > 1:
+            logger.info('Sharding dataset by JAX process: shard %d of %d',
+                        index, count)
+            return index, count
+        return None, None
+    if cur_shard is None or shard_count is None:
+        raise ValueError('cur_shard and shard_count must be specified together '
+                         '(got cur_shard=%r, shard_count=%r)'
+                         % (cur_shard, shard_count))
+    if not 0 <= cur_shard < shard_count:
+        raise ValueError('cur_shard %r must be in [0, shard_count=%r)'
+                         % (cur_shard, shard_count))
+    return cur_shard, shard_count

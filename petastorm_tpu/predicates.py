@@ -1,0 +1,129 @@
+"""Row-level predicates evaluated on decode workers.
+
+Parity with ``petastorm/predicates.py:26-183``: composable predicates that
+declare the fields they need (``get_fields``) and vote per row
+(``do_include``). Predicates on partition columns are additionally pushed down
+to whole row-groups by the Reader (``reader.py:577-608`` in the reference).
+
+``in_pseudorandom_split`` keeps the reference's md5 bucketing so existing
+train/val/test splits reproduce bit-for-bit across frameworks and hosts
+(multi-host determinism without communication, SURVEY.md §7.3).
+"""
+
+import hashlib
+from abc import ABCMeta, abstractmethod
+from functools import reduce as _reduce
+
+
+class PredicateBase(metaclass=ABCMeta):
+    @abstractmethod
+    def get_fields(self):
+        """Set of field names this predicate reads."""
+
+    @abstractmethod
+    def do_include(self, values):
+        """True to keep the row; ``values`` is a dict of the requested fields."""
+
+
+class in_set(PredicateBase):
+    """Keep rows whose field value is in a given set."""
+
+    def __init__(self, inclusion_values, predicate_field):
+        self._values = set(inclusion_values)
+        self._field = predicate_field
+
+    def get_fields(self):
+        return {self._field}
+
+    def do_include(self, values):
+        return values[self._field] in self._values
+
+
+class in_intersection(PredicateBase):
+    """Keep rows whose (array) field intersects a given set."""
+
+    def __init__(self, inclusion_values, predicate_field):
+        self._values = set(inclusion_values)
+        self._field = predicate_field
+
+    def get_fields(self):
+        return {self._field}
+
+    def do_include(self, values):
+        return not self._values.isdisjoint(values[self._field])
+
+
+class in_lambda(PredicateBase):
+    """Arbitrary user function over a set of fields (runs on workers, host-side)."""
+
+    def __init__(self, predicate_fields, predicate_func, state_arg=None):
+        self._fields = set(predicate_fields)
+        self._func = predicate_func
+        self._state_arg = state_arg
+
+    def get_fields(self):
+        return self._fields
+
+    def do_include(self, values):
+        if self._state_arg is not None:
+            return self._func(values, self._state_arg)
+        return self._func(values)
+
+
+class in_negate(PredicateBase):
+    def __init__(self, predicate):
+        self._predicate = predicate
+
+    def get_fields(self):
+        return self._predicate.get_fields()
+
+    def do_include(self, values):
+        return not self._predicate.do_include(values)
+
+
+class in_reduce(PredicateBase):
+    """Combine several predicates with a reduction (e.g. ``all``/``any``)."""
+
+    def __init__(self, predicate_list, reduce_func):
+        self._predicates = list(predicate_list)
+        self._reduce_func = reduce_func
+
+    def get_fields(self):
+        return set().union(*(p.get_fields() for p in self._predicates))
+
+    def do_include(self, values):
+        return self._reduce_func([p.do_include(values) for p in self._predicates])
+
+
+def _md5_fraction(value):
+    """Deterministic hash of a value onto [0, 1) — identical to the
+    reference's bucketing (``predicates.py:39-41``) for cross-compat."""
+    digest = hashlib.md5(str(value).encode('utf-8')).hexdigest()
+    return int(digest, 16) % 10 ** 8 / float(10 ** 8)
+
+
+class in_pseudorandom_split(PredicateBase):
+    """Deterministic fractional split on a hash of a field value.
+
+    ``fraction_list`` partitions [0,1); a row belongs to subset ``i`` when the
+    md5-fraction of its field value falls in the i-th interval.
+    """
+
+    def __init__(self, fraction_list, subset_index, predicate_field):
+        if not 0 <= subset_index < len(fraction_list):
+            raise ValueError('subset_index out of range')
+        if sum(fraction_list) > 1.0 + 1e-9:
+            raise ValueError('fractions must sum to at most 1')
+        self._field = predicate_field
+        starts = [0.0]
+        for f in fraction_list:
+            starts.append(starts[-1] + f)
+        self._lo = starts[subset_index]
+        self._hi = starts[subset_index + 1]
+
+    def get_fields(self):
+        return {self._field}
+
+    def do_include(self, values):
+        frac = _md5_fraction(values[self._field])
+        return self._lo <= frac < self._hi

@@ -1,0 +1,405 @@
+"""Reader: the read-path front end (``make_reader`` / ``make_batch_reader``).
+
+Re-design of ``petastorm/reader.py`` for TPU hosts. Same contract — open a
+(materialized or plain) Parquet dataset, enumerate row-groups, filter them by
+predicate/selector/shard, ventilate them to a decode pool, iterate results —
+with these deliberate differences:
+
+* Column-major core: every pool result is a decoded :class:`ColumnBatch`;
+  ``make_reader`` row iteration is a view over it (SURVEY.md §7.1).
+* Sharding defaults come from ``jax.process_index()/process_count()`` when a
+  distributed JAX runtime is initialized (:mod:`petastorm_tpu.parallel.sharding`)
+  instead of manual ``cur_shard``/Horovod env checks.
+* Checkpointable iteration state (``state_dict``/``load_state_dict``) — the
+  reference can only restart epochs from scratch (SURVEY.md §5.4).
+"""
+
+import logging
+import warnings
+
+from petastorm_tpu.arrow_worker import RowGroupWorker
+from petastorm_tpu.cache import LocalDiskCache, NullCache
+from petastorm_tpu.errors import MetadataError, NoDataAvailableError
+from petastorm_tpu.etl.dataset_metadata import (
+    ParquetDatasetInfo, infer_or_load_unischema, load_row_groups,
+)
+from petastorm_tpu.transform import transform_schema
+from petastorm_tpu.workers import EmptyResultError
+from petastorm_tpu.workers.dummy_pool import DummyPool
+from petastorm_tpu.workers.thread_pool import ThreadPool
+from petastorm_tpu.workers.ventilator import ConcurrentVentilator
+
+logger = logging.getLogger(__name__)
+
+# Extra row-groups ventilated beyond worker count: bounds host memory while
+# keeping workers busy (reference: ``reader.py:44-46``).
+_VENTILATE_EXTRA_ROWGROUPS = 2
+
+
+def make_reader(dataset_url, schema_fields=None, reader_pool_type='thread',
+                workers_count=4, results_queue_size=50, shuffle_row_groups=True,
+                shuffle_row_drop_partitions=1, predicate=None,
+                rowgroup_selector=None, num_epochs=1, cur_shard=None,
+                shard_count=None, seed=0, cache_type='null', cache_location=None,
+                cache_size_limit=None, cache_row_size_estimate=None,
+                transform_spec=None, ngram=None, storage_options=None):
+    """Reader over a petastorm_tpu/petastorm materialized dataset, iterating
+    rows as namedtuples with all codecs decoded.
+
+    Parity: ``petastorm/reader.py:61-196``. Use :func:`make_batch_reader` for
+    plain Parquet stores or column-batch output.
+    """
+    info = ParquetDatasetInfo(dataset_url, storage_options)
+    try:
+        from petastorm_tpu.etl.dataset_metadata import get_schema
+        get_schema(info)
+    except MetadataError:
+        warnings.warn('Dataset at %s is missing petastorm metadata; the schema '
+                      'will be inferred. Consider make_batch_reader for plain '
+                      'Parquet stores' % dataset_url)
+
+    return Reader(info, schema_fields=schema_fields,
+                  reader_pool_type=reader_pool_type, workers_count=workers_count,
+                  results_queue_size=results_queue_size,
+                  shuffle_row_groups=shuffle_row_groups,
+                  shuffle_row_drop_partitions=shuffle_row_drop_partitions,
+                  predicate=predicate, rowgroup_selector=rowgroup_selector,
+                  num_epochs=num_epochs, cur_shard=cur_shard,
+                  shard_count=shard_count, seed=seed,
+                  cache=_make_cache(cache_type, cache_location, cache_size_limit,
+                                    cache_row_size_estimate),
+                  transform_spec=transform_spec, ngram=ngram, batched_output=False)
+
+
+def make_batch_reader(dataset_url_or_urls, schema_fields=None,
+                      reader_pool_type='thread', workers_count=4,
+                      results_queue_size=50, shuffle_row_groups=True,
+                      shuffle_row_drop_partitions=1, predicate=None,
+                      rowgroup_selector=None, num_epochs=1, cur_shard=None,
+                      shard_count=None, seed=0, cache_type='null',
+                      cache_location=None, cache_size_limit=None,
+                      cache_row_size_estimate=None, transform_spec=None,
+                      storage_options=None):
+    """Reader yielding whole row-groups as namedtuples of column arrays.
+
+    Works on any Parquet store, petastorm metadata or not
+    (parity: ``petastorm/reader.py:198-328``).
+    """
+    info = ParquetDatasetInfo(dataset_url_or_urls, storage_options)
+    return Reader(info, schema_fields=schema_fields,
+                  reader_pool_type=reader_pool_type, workers_count=workers_count,
+                  results_queue_size=results_queue_size,
+                  shuffle_row_groups=shuffle_row_groups,
+                  shuffle_row_drop_partitions=shuffle_row_drop_partitions,
+                  predicate=predicate, rowgroup_selector=rowgroup_selector,
+                  num_epochs=num_epochs, cur_shard=cur_shard,
+                  shard_count=shard_count, seed=seed,
+                  cache=_make_cache(cache_type, cache_location, cache_size_limit,
+                                    cache_row_size_estimate),
+                  transform_spec=transform_spec, ngram=None, batched_output=True)
+
+
+def _make_cache(cache_type, location, size_limit, row_size_estimate):
+    if cache_type in (None, 'null', 'none'):
+        return NullCache()
+    if cache_type == 'local-disk':
+        if not location or not size_limit:
+            raise ValueError("cache_type='local-disk' requires cache_location "
+                             'and cache_size_limit')
+        return LocalDiskCache(location, size_limit, row_size_estimate)
+    raise ValueError('Unknown cache_type %r' % cache_type)
+
+
+def _make_pool(reader_pool_type, workers_count, results_queue_size):
+    if reader_pool_type == 'thread':
+        return ThreadPool(workers_count, results_queue_size)
+    if reader_pool_type == 'process':
+        from petastorm_tpu.workers.process_pool import ProcessPool
+        return ProcessPool(workers_count, results_queue_size)
+    if reader_pool_type == 'dummy':
+        return DummyPool()
+    raise ValueError("reader_pool_type must be one of 'thread', 'process', "
+                     "'dummy'; got %r" % reader_pool_type)
+
+
+class Reader:
+    """Iterator over dataset rows (or column batches).
+
+    The 5-step construction mirrors ``petastorm/reader.py:384-391``:
+    1. resolve dataset + schema, 2. normalize the requested schema view,
+    3. enumerate + filter row-groups, 4. build the ventilator, 5. start the
+    worker pool.
+    """
+
+    def __init__(self, dataset_info, schema_fields=None, reader_pool_type='thread',
+                 workers_count=4, results_queue_size=50, shuffle_row_groups=True,
+                 shuffle_row_drop_partitions=1, predicate=None,
+                 rowgroup_selector=None, num_epochs=1, cur_shard=None,
+                 shard_count=None, seed=0, cache=None, transform_spec=None,
+                 ngram=None, batched_output=True):
+        self.dataset_info = dataset_info
+        self.batched_output = batched_output and ngram is None
+        self.ngram = ngram
+
+        if ngram is not None and not ngram.timestamp_overlap and \
+                shuffle_row_drop_partitions > 1:
+            raise NotImplementedError('Using timestamp deduplication with '
+                                      'shuffle_row_drop_partitions is not supported')
+
+        # (1) schema
+        self.stored_schema = infer_or_load_unischema(dataset_info)
+
+        # (2) requested view (pre-transform), then transform edit
+        if ngram is not None:
+            ngram.resolve_regex_field_names(self.stored_schema)
+            fields = ngram.get_field_names_at_all_timesteps()
+            self.loaded_schema = (self.stored_schema.create_schema_view(fields)
+                                  if fields else self.stored_schema)
+        elif schema_fields is not None:
+            self.loaded_schema = self.stored_schema.create_schema_view(schema_fields)
+        else:
+            self.loaded_schema = self.stored_schema
+        if transform_spec is not None:
+            self.schema = transform_schema(self.loaded_schema, transform_spec)
+        else:
+            self.schema = self.loaded_schema
+
+        # (3) row-groups: enumerate, then predicate-pushdown/selector/shard
+        all_pieces = load_row_groups(dataset_info)
+        self._row_groups = all_pieces
+        piece_indices = list(range(len(all_pieces)))
+        piece_indices, worker_predicate = self._apply_predicate_pushdown(
+            piece_indices, predicate)
+        piece_indices = self._apply_selector(piece_indices, rowgroup_selector)
+        piece_indices = self._apply_sharding(piece_indices, cur_shard, shard_count)
+        if not piece_indices:
+            raise NoDataAvailableError(
+                'No row-groups left to read for this reader (dataset %s): '
+                'check shard/predicate/selector configuration' % dataset_info.url)
+        self._piece_indices = piece_indices
+
+        # (4) ventilator items
+        items = []
+        for idx in piece_indices:
+            for drop in range(shuffle_row_drop_partitions):
+                items.append({'piece_index': idx,
+                              'worker_predicate': worker_predicate,
+                              'shuffle_row_drop_partition':
+                                  (drop, shuffle_row_drop_partitions),
+                              'item_index': len(items)})
+        self._pool = _make_pool(reader_pool_type, workers_count, results_queue_size)
+        self._num_epochs = num_epochs
+        self._ventilator = ConcurrentVentilator(
+            self._pool.ventilate, items, iterations=num_epochs,
+            max_ventilation_queue_size=self._pool.workers_count
+            + _VENTILATE_EXTRA_ROWGROUPS,
+            randomize_item_order=shuffle_row_groups, random_seed=seed,
+            pass_epoch=True)
+
+        # (5) start workers; ventilation begins lazily on first read so that
+        # load_state_dict can reposition the cursor first.
+        self._pool.start(RowGroupWorker,
+                         worker_args={
+                             'dataset_info': dataset_info,
+                             'schema': self.schema,
+                             'loaded_schema': self.loaded_schema,
+                             'stored_schema': self.stored_schema,
+                             'transform_spec': transform_spec,
+                             'cache': cache,
+                             'ngram': ngram,
+                             'row_groups': all_pieces,
+                         },
+                         ventilator=self._ventilator, start_ventilator=False)
+
+        self.last_row_consumed = False
+        self._started = False
+        self._stopped = False
+        self._current_batch = None
+        self._batch_cursor = 0
+        # Per-epoch sets of fully-consumed item indices (for exact resume).
+        self._consumed_by_epoch = {}
+        self._num_items = len(items)
+
+    # -- construction helpers ------------------------------------------------
+
+    def _apply_predicate_pushdown(self, piece_indices, predicate):
+        """Predicates referencing only partition keys filter whole row-groups;
+        others go to the workers (reference: ``reader.py:577-608``)."""
+        if predicate is None:
+            return piece_indices, None
+        pred_fields = predicate.get_fields()
+        partition_keys = set(self.dataset_info.partition_keys)
+        if pred_fields and pred_fields <= partition_keys:
+            kept = [i for i in piece_indices
+                    if predicate.do_include(
+                        {k: self._row_groups[i].partition_values.get(k)
+                         for k in pred_fields})]
+            return kept, None
+        return piece_indices, predicate
+
+    def _apply_selector(self, piece_indices, selector):
+        if selector is None:
+            return piece_indices
+        from petastorm_tpu.etl.rowgroup_indexing import get_row_group_indexes
+        index_dict = get_row_group_indexes(self.dataset_info)
+        needed = selector.get_index_names()
+        missing = [n for n in needed if n not in index_dict]
+        if missing:
+            raise ValueError('Dataset has no row-group index named %s' % missing)
+        selected = selector.select_row_groups(index_dict)
+        return [i for i in piece_indices if i in selected]
+
+    def _apply_sharding(self, piece_indices, cur_shard, shard_count):
+        """Modulo assignment of row-groups to data-parallel ranks.
+
+        Defaults from the JAX distributed runtime when only one of the two
+        args is provided (reference requires both, ``reader.py:537-554``).
+        """
+        from petastorm_tpu.parallel.sharding import default_shard_info
+        cur_shard, shard_count = default_shard_info(cur_shard, shard_count)
+        if shard_count is None:
+            return piece_indices
+        if shard_count > len(piece_indices):
+            raise NoDataAvailableError(
+                'Number of row-groups in the dataset (%d) must be greater or '
+                'equal to the number of requested shards (%d)'
+                % (len(piece_indices), shard_count))
+        return [i for n, i in enumerate(piece_indices) if n % shard_count == cur_shard]
+
+    # -- iteration -----------------------------------------------------------
+
+    def __iter__(self):
+        return self
+
+    def _ensure_started(self):
+        if not self._started:
+            self._ventilator.start()
+            self._started = True
+
+    def __next__(self):
+        if self._stopped:
+            raise RuntimeError('Trying to read a sample from a stopped reader')
+        self._ensure_started()
+        if self.batched_output:
+            try:
+                batch = self._pool.get_results()
+            except EmptyResultError:
+                self.last_row_consumed = True
+                raise StopIteration from None
+            self._mark_consumed(batch)
+            return self.schema.make_namedtuple(**batch.columns)
+        if self.ngram is not None:
+            try:
+                return self._pool.get_results()
+            except EmptyResultError:
+                self.last_row_consumed = True
+                raise StopIteration from None
+        # row-at-a-time view over column batches
+        while self._current_batch is None or self._batch_cursor >= self._current_batch.length:
+            if self._current_batch is not None:
+                self._mark_consumed(self._current_batch)
+            try:
+                self._current_batch = self._pool.get_results()
+                self._batch_cursor = 0
+            except EmptyResultError:
+                self.last_row_consumed = True
+                self._current_batch = None
+                raise StopIteration from None
+        row = self._current_batch.row(self._batch_cursor)
+        self._batch_cursor += 1
+        return self.schema.make_namedtuple(**row)
+
+    def _mark_consumed(self, batch):
+        item_index = getattr(batch, 'item_index', None)
+        if item_index is not None and batch.epoch is not None:
+            self._consumed_by_epoch.setdefault(batch.epoch, set()).add(item_index)
+
+    def next(self):
+        return self.__next__()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def reset(self):
+        """Restart the epoch sweep. Only valid after full consumption
+        (reference: ``reader.py:468-492``)."""
+        if not self.last_row_consumed:
+            raise NotImplementedError(
+                'Resetting a reader while in the middle of iteration is not '
+                'supported; consume all samples first')
+        self._ventilator.reset()
+        self.last_row_consumed = False
+        self._current_batch = None
+        self._batch_cursor = 0
+
+    def stop(self):
+        self._pool.stop()
+        self._stopped = True
+
+    def join(self):
+        self._pool.join()
+
+    def cleanup(self):
+        pass
+
+    def exit(self):
+        self.stop()
+        self.join()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.stop()
+        self.join()
+
+    @property
+    def diagnostics(self):
+        return self._pool.diagnostics
+
+    # -- checkpointable iteration state --------------------------------------
+
+    def state_dict(self):
+        """Row-group-granular iteration state — a capability the reference
+        lacks (SURVEY.md §5.4).
+
+        Semantics: **at-least-once**. Resume restarts at the earliest epoch
+        with unconsumed row-groups, skipping the ones already fully consumed
+        in that epoch; row-groups in flight (or consumed in a *later* epoch
+        due to pipelining across the epoch boundary) are re-read.
+        """
+        vent_seed = self._ventilator.state_dict()['seed']
+        epochs_seen = sorted(self._consumed_by_epoch)
+        if not epochs_seen:
+            resume_epoch, consumed = 0, []
+        else:
+            incomplete = [e for e in epochs_seen
+                          if len(self._consumed_by_epoch[e]) < self._num_items]
+            if incomplete:
+                resume_epoch = incomplete[0]
+                consumed = sorted(self._consumed_by_epoch[resume_epoch])
+            else:
+                resume_epoch, consumed = epochs_seen[-1] + 1, []
+        if self._num_epochs is None:
+            iterations_remaining = None
+        else:
+            iterations_remaining = max(0, self._num_epochs - resume_epoch)
+        return {
+            'version': 1,
+            'seed': vent_seed,
+            'epoch': resume_epoch,
+            'iterations_remaining': iterations_remaining,
+            'consumed_items': consumed,
+        }
+
+    def load_state_dict(self, state):
+        """Reposition the iteration before the first read."""
+        if self._started:
+            raise RuntimeError('load_state_dict must be called before iteration '
+                               'starts')
+        self._ventilator.load_state_dict({
+            'epoch': state['epoch'],
+            'cursor': 0,
+            'seed': state['seed'],
+            'iterations_remaining': state['iterations_remaining'],
+        })
+        self._ventilator.exclude_from_next_epoch(state['consumed_items'])

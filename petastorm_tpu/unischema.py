@@ -283,9 +283,13 @@ class Unischema:
         regexes = [f for f in fields if isinstance(f, str)]
         explicit = [f for f in fields if not isinstance(f, str)]
         for f in explicit:
-            mine = self._fields.get(f.name)
-            if mine is None or mine != f:
-                raise ValueError('Field %r does not belong to schema %r' % (f.name, self._name))
+            # Match by NAME only and substitute this schema's own field: a
+            # passed instance may carry stale codec/shape info (e.g. obtained
+            # from another view or an older schema version) — same rationale
+            # as the reference (``unischema.py:221-236``).
+            if f.name not in self._fields:
+                raise ValueError('Field %r does not belong to schema %r'
+                                 % (f.name, self._name))
         matched = set(f.name for f in match_unischema_fields(self, regexes)) if regexes else set()
         keep = matched | set(f.name for f in explicit)
         view_fields = [f for f in self if f.name in keep]
@@ -420,8 +424,19 @@ def dict_to_encoded_row(schema, row_dict):
 
 
 def _encode_plain(field, value):
-    """Encode a codec-less field into an arrow-friendly python value."""
+    """Encode a codec-less field into an arrow-friendly python value.
+
+    Only scalars and 1-d arrays (stored as list<primitive>) are supported
+    without a codec; for >=2-d data the shape would be unrecoverable from the
+    flat parquet list, so it must use an ndarray codec (the reference rejects
+    all non-scalar codec-less fields, ``unischema.py:166``).
+    """
     if field.shape:
+        if len(field.shape) > 1:
+            raise ValueError(
+                'Field %r: %d-dimensional data cannot be stored without a '
+                'codec (the flat parquet list loses the shape). Use '
+                'NdarrayCodec/CompressedNdarrayCodec.' % (field.name, len(field.shape)))
         arr = np.asarray(value)
         if not field.is_shape_compliant(arr.shape):
             raise ValueError('Field %r: value shape %s does not match %s'

@@ -1,0 +1,27 @@
+"""Worker-pool runtime: the framework's intra-host scheduler/executor.
+
+Re-design of ``petastorm/workers_pool/`` (SURVEY.md §2.2). The pool contract is
+identical — ``start(worker_class, worker_args, ventilator) / ventilate /
+get_results / stop / join`` — but the implementations are written for a TPU VM
+host: thread workers by default (pyarrow + cv2 release the GIL on the hot
+path), a spawned-process ZMQ pool for GIL-heavy user transforms, and a
+synchronous dummy pool for debugging/profiling.
+"""
+
+
+class EmptyResultError(Exception):
+    """Raised by ``get_results`` when all ventilated work is done
+    (reference: ``workers_pool/__init__.py:16``)."""
+
+
+class TimeoutWaitingForResultError(Exception):
+    """Raised when a result did not arrive within the poll timeout."""
+
+
+class VentilatedItemProcessedMessage:
+    """Control message a worker publishes after finishing one work item
+    (reference: ``workers_pool/__init__.py:25``)."""
+
+
+class WorkerTerminationRequested(Exception):
+    """Raised inside a worker to abort processing during shutdown."""

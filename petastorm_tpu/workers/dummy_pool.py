@@ -1,0 +1,78 @@
+"""Synchronous in-caller-thread pool for debugging and profiling.
+
+Re-design of ``petastorm/workers_pool/dummy_pool.py:20-91``: all work runs
+lazily on the caller's thread inside ``get_results`` so profilers and
+debuggers see the full pipeline.
+"""
+
+import time
+from collections import deque
+
+from petastorm_tpu.workers import EmptyResultError, VentilatedItemProcessedMessage
+
+
+class DummyPool:
+    def __init__(self, workers_count=1, results_queue_size=None):
+        self._worker = None
+        self._ventilator = None
+        self._work_items = deque()
+        self._results = deque()
+
+    @property
+    def workers_count(self):
+        return 1
+
+    def start(self, worker_class, worker_args=None, ventilator=None,
+              start_ventilator=True):
+        if self._worker is not None:
+            raise RuntimeError('DummyPool already started')
+        self._worker = worker_class(0, self._results.append, worker_args)
+        self._worker.initialize()
+        self._ventilator = ventilator
+        if ventilator is not None and start_ventilator:
+            ventilator.start()
+
+    def ventilate(self, *args, **kwargs):
+        self._work_items.append((args, kwargs))
+
+    def get_results(self, timeout=None):
+        while True:
+            if self._results:
+                result = self._results.popleft()
+                if isinstance(result, VentilatedItemProcessedMessage):
+                    continue
+                if isinstance(result, Exception):
+                    raise result
+                return result
+            if not self._work_items:
+                if self._ventilator is None or self._ventilator.completed():
+                    raise EmptyResultError()
+                # The ventilator thread may still be pushing items.
+                time.sleep(0.001)
+                continue
+            args, kwargs = self._work_items.popleft()
+            try:
+                self._worker.process(*args, **kwargs)
+            except Exception as e:  # noqa: BLE001 - surfaced to the consumer
+                if self._ventilator is not None:
+                    self._ventilator.processed_item()
+                raise e
+            if self._ventilator is not None:
+                self._ventilator.processed_item()
+
+    def stop(self):
+        if self._ventilator is not None:
+            self._ventilator.stop()
+
+    def join(self):
+        if self._worker is not None:
+            self._worker.shutdown()
+
+    @property
+    def diagnostics(self):
+        return {'pending_work_items': len(self._work_items),
+                'pending_results': len(self._results)}
+
+    @property
+    def results_qsize(self):
+        return len(self._results)

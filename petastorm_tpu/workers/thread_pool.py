@@ -1,0 +1,183 @@
+"""Thread worker pool (re-design of ``petastorm/workers_pool/thread_pool.py``).
+
+The default executor on a TPU VM host: pyarrow parquet reads, zlib, and cv2
+image decode all release the GIL, so threads scale across the host's cores
+without process-spawn or serialization overhead.
+"""
+
+import logging
+import queue
+import threading
+import time
+from cProfile import Profile
+from pstats import Stats
+
+from petastorm_tpu.workers import (
+    EmptyResultError, TimeoutWaitingForResultError, VentilatedItemProcessedMessage,
+)
+
+logger = logging.getLogger(__name__)
+
+_RESULTS_QUEUE_SIZE_DEFAULT = 50
+_POLL_INTERVAL_S = 0.05
+
+
+class _WorkerExit(Exception):
+    """Internal signal: the pool is stopping."""
+
+
+class ThreadPool:
+    """N daemon worker threads over stdlib queues.
+
+    Contract (shared with ProcessPool/DummyPool): ``start`` → ``ventilate``\\*
+    → ``get_results``\\* → ``stop`` → ``join``. Worker exceptions are
+    forwarded through the results queue and re-raised in the consumer
+    (reference: ``thread_pool.py:68-75``).
+    """
+
+    def __init__(self, workers_count, results_queue_size=_RESULTS_QUEUE_SIZE_DEFAULT,
+                 profiling_enabled=False):
+        self._workers_count = workers_count
+        self._results_queue = queue.Queue(maxsize=results_queue_size)
+        self._work_queue = queue.Queue()
+        self._stop_event = threading.Event()
+        self._threads = []
+        self._workers = []
+        self._ventilator = None
+        self._ventilated_items = 0
+        self._processed_items = 0
+        self._counter_lock = threading.Lock()
+        self._profiling_enabled = profiling_enabled
+        self._profiles = []
+
+    @property
+    def workers_count(self):
+        return self._workers_count
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self, worker_class, worker_args=None, ventilator=None,
+              start_ventilator=True):
+        if self._threads:
+            raise RuntimeError('ThreadPool already started')
+        for worker_id in range(self._workers_count):
+            worker = worker_class(worker_id, self._publish, worker_args)
+            self._workers.append(worker)
+            thread = threading.Thread(target=self._worker_loop, args=(worker,),
+                                      daemon=True)
+            thread.start()
+            self._threads.append(thread)
+        self._ventilator = ventilator
+        if ventilator is not None and start_ventilator:
+            ventilator.start()
+
+    def ventilate(self, *args, **kwargs):
+        with self._counter_lock:
+            self._ventilated_items += 1
+        self._work_queue.put((args, kwargs))
+
+    def get_results(self, timeout=None):
+        """Next result, blocking; raises :class:`EmptyResultError` at the end.
+
+        End-of-data is: results queue drained ∧ all ventilated items processed
+        ∧ ventilator (if any) has completed (reference: ``thread_pool.py:157-160``).
+        """
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            try:
+                result = self._results_queue.get(timeout=_POLL_INTERVAL_S)
+            except queue.Empty:
+                with self._counter_lock:
+                    all_done = (self._ventilated_items == self._processed_items)
+                if all_done and (self._ventilator is None or self._ventilator.completed()):
+                    raise EmptyResultError()
+                if deadline is not None and time.monotonic() > deadline:
+                    raise TimeoutWaitingForResultError()
+                continue
+            if isinstance(result, VentilatedItemProcessedMessage):
+                with self._counter_lock:
+                    self._processed_items += 1
+                if self._ventilator is not None:
+                    self._ventilator.processed_item()
+                continue
+            if isinstance(result, Exception):
+                self.stop()
+                self.join()
+                raise result
+            return result
+
+    def stop(self):
+        if self._ventilator is not None:
+            self._ventilator.stop()
+        self._stop_event.set()
+
+    def join(self):
+        if not self._stop_event.is_set():
+            raise RuntimeError('Must call stop() before join()')
+        for thread in self._threads:
+            thread.join()
+        self._threads = []
+        for worker in self._workers:
+            worker.shutdown()
+        if self._profiling_enabled and self._profiles:
+            stats = Stats(self._profiles[0])
+            for p in self._profiles[1:]:
+                stats.add(p)
+            stats.sort_stats('cumulative').print_stats()
+
+    @property
+    def diagnostics(self):
+        return {
+            'output_queue_size': self._results_queue.qsize(),
+            'items_ventilated': self._ventilated_items,
+            'items_processed': self._processed_items,
+        }
+
+    @property
+    def results_qsize(self):
+        return self._results_queue.qsize()
+
+    # -- internals ----------------------------------------------------------
+
+    def _publish(self, data):
+        """Stop-aware put: never deadlocks a worker against a full results
+        queue during shutdown (reference: ``thread_pool.py:200-214``)."""
+        while not self._stop_event.is_set():
+            try:
+                self._results_queue.put(data, timeout=_POLL_INTERVAL_S)
+                return
+            except queue.Full:
+                continue
+        raise _WorkerExit()
+
+    def _worker_loop(self, worker):
+        profiler = Profile() if self._profiling_enabled else None
+        if profiler:
+            self._profiles.append(profiler)
+        try:
+            worker.initialize()
+            while not self._stop_event.is_set():
+                try:
+                    args, kwargs = self._work_queue.get(timeout=_POLL_INTERVAL_S)
+                except queue.Empty:
+                    continue
+                try:
+                    if profiler:
+                        profiler.enable()
+                    worker.process(*args, **kwargs)
+                    if profiler:
+                        profiler.disable()
+                    self._publish(VentilatedItemProcessedMessage())
+                except _WorkerExit:
+                    return
+                except Exception as e:  # noqa: BLE001 - forwarded to consumer
+                    if profiler:
+                        profiler.disable()
+                    logger.debug('Worker %d forwarding exception', worker.worker_id,
+                                 exc_info=True)
+                    try:
+                        self._publish(e)
+                    except _WorkerExit:
+                        return
+        except _WorkerExit:
+            pass

@@ -1,0 +1,35 @@
+"""Stub workers for runtime tests (model: workers_pool/tests/stub_workers.py)."""
+
+import time
+
+from petastorm_tpu.workers.worker_base import WorkerBase
+
+
+class IdentityWorker(WorkerBase):
+    def process(self, *args, **kwargs):
+        for a in args:
+            self.publish_func(a)
+        for v in kwargs.values():
+            self.publish_func(v)
+
+
+class SleepyIdentityWorker(WorkerBase):
+    def process(self, value, sleep_s=0.01):
+        time.sleep(sleep_s)
+        self.publish_func(value)
+
+
+class ExceptionOnFiveWorker(WorkerBase):
+    """Publishes its input unless it equals 5, then raises."""
+
+    def process(self, value):
+        if value == 5:
+            raise ValueError('value was 5')
+        self.publish_func(value)
+
+
+class MultiplyingWorker(WorkerBase):
+    """Uses worker args: publishes value * args['factor']."""
+
+    def process(self, value):
+        self.publish_func(value * self.args['factor'])

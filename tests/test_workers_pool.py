@@ -1,0 +1,230 @@
+"""Runtime tests across pool flavors (model: workers_pool/tests/test_workers_pool.py,
+test_ventilator.py)."""
+
+import threading
+import time
+
+import pytest
+
+from petastorm_tpu.workers import EmptyResultError
+from petastorm_tpu.workers.dummy_pool import DummyPool
+from petastorm_tpu.workers.thread_pool import ThreadPool
+from petastorm_tpu.workers.ventilator import ConcurrentVentilator
+from tests.stub_workers import (
+    ExceptionOnFiveWorker, IdentityWorker, MultiplyingWorker, SleepyIdentityWorker,
+)
+
+POOLS = [lambda: ThreadPool(1), lambda: ThreadPool(4), lambda: DummyPool()]
+POOL_IDS = ['thread-1', 'thread-4', 'dummy']
+
+
+def _drain(pool):
+    out = []
+    while True:
+        try:
+            out.append(pool.get_results())
+        except EmptyResultError:
+            return out
+
+
+@pytest.mark.parametrize('make_pool', POOLS, ids=POOL_IDS)
+def test_identity_roundtrip(make_pool):
+    pool = make_pool()
+    pool.start(IdentityWorker)
+    for i in range(20):
+        pool.ventilate(i)
+    results = sorted(_drain(pool))
+    assert results == list(range(20))
+    pool.stop()
+    pool.join()
+
+
+@pytest.mark.parametrize('make_pool', POOLS, ids=POOL_IDS)
+def test_worker_args(make_pool):
+    pool = make_pool()
+    pool.start(MultiplyingWorker, worker_args={'factor': 3})
+    for i in range(5):
+        pool.ventilate(i)
+    assert sorted(_drain(pool)) == [0, 3, 6, 9, 12]
+    pool.stop()
+    pool.join()
+
+
+@pytest.mark.parametrize('make_pool', POOLS, ids=POOL_IDS)
+def test_exception_propagates_to_consumer(make_pool):
+    pool = make_pool()
+    pool.start(ExceptionOnFiveWorker)
+    for i in range(10):
+        pool.ventilate(i)
+    with pytest.raises(ValueError, match='value was 5'):
+        while True:
+            pool.get_results()
+
+
+@pytest.mark.parametrize('make_pool', POOLS, ids=POOL_IDS)
+def test_empty_pool_raises_empty_result(make_pool):
+    pool = make_pool()
+    pool.start(IdentityWorker)
+    with pytest.raises(EmptyResultError):
+        pool.get_results()
+    pool.stop()
+    pool.join()
+
+
+@pytest.mark.parametrize('make_pool', POOLS, ids=POOL_IDS)
+def test_with_ventilator_single_epoch(make_pool):
+    pool = make_pool()
+    vent = ConcurrentVentilator(pool.ventilate,
+                                [{'value': i} for i in range(30)],
+                                iterations=1, max_ventilation_queue_size=4)
+    pool.start(IdentityWorker, ventilator=vent)
+    assert sorted(_drain(pool)) == list(range(30))
+    pool.stop()
+    pool.join()
+
+
+@pytest.mark.parametrize('make_pool', POOLS, ids=POOL_IDS)
+def test_with_ventilator_multiple_epochs(make_pool):
+    pool = make_pool()
+    vent = ConcurrentVentilator(pool.ventilate,
+                                [{'value': i} for i in range(7)], iterations=3)
+    pool.start(IdentityWorker, ventilator=vent)
+    results = _drain(pool)
+    assert len(results) == 21
+    assert sorted(results) == sorted(list(range(7)) * 3)
+    pool.stop()
+    pool.join()
+
+
+def test_ventilator_randomizes_order_per_epoch():
+    received = []
+    vent = ConcurrentVentilator(lambda value: received.append(value),
+                                [{'value': i} for i in range(50)], iterations=2,
+                                randomize_item_order=True, random_seed=7)
+    vent.start()
+    while not vent.completed():
+        time.sleep(0.01)
+        for _ in range(len(received)):
+            vent.processed_item()
+    epoch1, epoch2 = received[:50], received[50:100]
+    assert sorted(epoch1) == list(range(50))
+    assert sorted(epoch2) == list(range(50))
+    assert epoch1 != list(range(50))  # shuffled
+    assert epoch1 != epoch2  # reshuffled between epochs
+
+
+def test_ventilator_deterministic_given_seed():
+    def collect(seed):
+        got = []
+        vent = ConcurrentVentilator(lambda value: got.append(value),
+                                    [{'value': i} for i in range(20)], iterations=1,
+                                    randomize_item_order=True, random_seed=seed)
+        vent.start()
+        while not vent.completed():
+            time.sleep(0.005)
+            for _ in range(len(got)):
+                vent.processed_item()
+        return got
+
+    assert collect(3) == collect(3)
+    assert collect(3) != collect(4)
+
+
+def test_ventilator_backpressure_bounds_in_flight():
+    in_flight_high_water = [0]
+    lock = threading.Lock()
+    outstanding = [0]
+
+    def tracked(value):
+        with lock:
+            outstanding[0] += 1
+            in_flight_high_water[0] = max(in_flight_high_water[0], outstanding[0])
+
+    vent = ConcurrentVentilator(tracked, [{'value': i} for i in range(100)],
+                                iterations=1, max_ventilation_queue_size=5)
+    vent.start()
+    deadline = time.monotonic() + 10
+    while not vent.completed() and time.monotonic() < deadline:
+        time.sleep(0.002)
+        with lock:
+            if outstanding[0] > 0:
+                outstanding[0] -= 1
+                vent.processed_item()
+    assert in_flight_high_water[0] <= 5
+
+
+def test_ventilator_checkpoint_resume():
+    first = []
+    vent = ConcurrentVentilator(lambda value: first.append(value),
+                                [{'value': i} for i in range(10)], iterations=1,
+                                randomize_item_order=True, random_seed=11,
+                                max_ventilation_queue_size=3)
+    vent.start()
+    while True:
+        state = vent.state_dict()
+        if state['cursor'] == len(first) >= 3:
+            break
+        time.sleep(0.001)
+    vent.stop()
+    consumed = first[:state['cursor']]
+
+    rest = []
+    vent2 = ConcurrentVentilator(lambda value: rest.append(value),
+                                 [{'value': i} for i in range(10)], iterations=1,
+                                 randomize_item_order=True, random_seed=11)
+    vent2.load_state_dict(state)
+    vent2.start()
+    while not vent2.completed():
+        time.sleep(0.005)
+        for _ in range(len(rest)):
+            vent2.processed_item()
+    # Union of pre-checkpoint and post-resume covers each item exactly once.
+    assert sorted(consumed + rest) == list(range(10))
+
+
+def test_ventilator_reset_reruns_epochs():
+    got = []
+    vent = ConcurrentVentilator(lambda value: got.append(value),
+                                [{'value': i} for i in range(5)], iterations=2)
+    vent.start()
+    while not vent.completed():
+        time.sleep(0.005)
+        for _ in range(len(got)):
+            vent.processed_item()
+    assert len(got) == 10
+    vent.reset()
+    while not vent.completed():
+        time.sleep(0.005)
+        for _ in range(len(got)):
+            vent.processed_item()
+    assert len(got) == 20
+
+
+def test_thread_pool_requires_stop_before_join():
+    pool = ThreadPool(1)
+    pool.start(IdentityWorker)
+    with pytest.raises(RuntimeError):
+        pool.join()
+    pool.stop()
+    pool.join()
+
+
+def test_thread_pool_stop_mid_stream_does_not_hang():
+    pool = ThreadPool(2, results_queue_size=2)
+    pool.start(SleepyIdentityWorker)
+    for i in range(50):
+        pool.ventilate(i, sleep_s=0.001)
+    pool.get_results()
+    pool.stop()
+    pool.join()  # must not deadlock against the full results queue
+
+
+def test_diagnostics_exposed():
+    pool = ThreadPool(1)
+    pool.start(IdentityWorker)
+    pool.ventilate(1)
+    pool.get_results()
+    d = pool.diagnostics
+    assert d['items_ventilated'] == 1
+    pool.stop()
+    pool.join()
