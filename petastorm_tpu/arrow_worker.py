@@ -18,9 +18,34 @@ import hashlib
 import numpy as np
 import pyarrow.parquet as pq
 
+from petastorm_tpu.cache import NullCache
+from petastorm_tpu.codecs import decode_batch_with_nulls
 from petastorm_tpu.workers.worker_base import WorkerBase
 
 _ALL_ROWS = slice(None)
+
+
+def typed_partition_value(field, value):
+    """Cast a hive-partition path string to the schema field's numpy dtype.
+
+    Without this, predicates would compare typed data values against raw
+    partition-directory strings (e.g. ``5 != '5'``) and silently match
+    nothing.
+    """
+    if field is None or value is None:
+        return value
+    try:
+        dtype = np.dtype(field.numpy_dtype)
+    except TypeError:  # e.g. Decimal
+        return value
+    if dtype.kind in 'iuf':
+        try:
+            return dtype.type(value)
+        except (TypeError, ValueError):
+            return value
+    if dtype.kind == 'b':
+        return value in (True, 'true', 'True', '1', 1)
+    return value
 
 
 class ColumnBatch:
@@ -64,7 +89,8 @@ class RowGroupWorker(WorkerBase):
     def process(self, piece_index, worker_predicate=None,
                 shuffle_row_drop_partition=(0, 1), item_index=None, epoch=None):
         piece = self._row_groups[piece_index]
-        if self._cache is not None:
+        if self._cache is not None and not isinstance(self._cache, NullCache) \
+                and worker_predicate is None:
             cache_key = self._cache_key(piece, worker_predicate,
                                         shuffle_row_drop_partition)
             batch = self._cache.get(
@@ -95,10 +121,14 @@ class RowGroupWorker(WorkerBase):
     # -- internals ----------------------------------------------------------
 
     def _cache_key(self, piece, worker_predicate, drop_partition):
+        # Reader rejects cache+predicate up front, so the predicate never
+        # needs to participate in the key (which would require a stable,
+        # content-addressed predicate identity).
+        assert worker_predicate is None
         url_hash = hashlib.md5(
             str(self._dataset_info.url).encode('utf-8')).hexdigest()
-        return '%s:%s:rg%d:%s:%s' % (url_hash, self._dataset_info.relpath(piece.path),
-                                     piece.row_group, worker_predicate, drop_partition)
+        return '%s:%s:rg%d:%s' % (url_hash, self._dataset_info.relpath(piece.path),
+                                  piece.row_group, drop_partition)
 
     def _parquet_file(self, path):
         if path not in self._parquet_files:
@@ -128,7 +158,8 @@ class RowGroupWorker(WorkerBase):
         num_rows = table.num_rows
         row_indices = np.arange(num_rows) if keep is None else np.flatnonzero(keep)
 
-        row_indices = self._apply_row_drop(row_indices, drop_partition)
+        overlap = self._ngram.length - 1 if self._ngram is not None else 0
+        row_indices = self._apply_row_drop(row_indices, drop_partition, overlap)
         if row_indices.size == 0:
             return None
 
@@ -141,12 +172,10 @@ class RowGroupWorker(WorkerBase):
             columns[name] = self._decode_column(name, selected)
         for name in partition_keys:
             field = self._stored_schema.fields.get(name)
-            value = piece.partition_values[name]
+            value = self._typed_partition_value(field, piece.partition_values[name])
             dtype = np.dtype(field.numpy_dtype) if field is not None else np.dtype(object)
-            if dtype.kind in 'iuf':
-                value = dtype.type(value)
             columns[name] = np.full(row_indices.size, value,
-                                    dtype=dtype if dtype.kind != 'U' else object)
+                                    dtype=dtype if dtype.kind in 'iufb' else object)
 
         batch = ColumnBatch(columns, row_indices.size)
         if self._transform_spec is not None:
@@ -169,20 +198,38 @@ class RowGroupWorker(WorkerBase):
         n = pred_table.num_rows
         for name in pred_fields:
             if name in piece.partition_values:
-                decoded[name] = np.full(n, piece.partition_values[name], dtype=object)
+                field = self._stored_schema.fields.get(name)
+                value = self._typed_partition_value(field, piece.partition_values[name])
+                decoded[name] = np.full(n, value, dtype=object)
         mask = np.empty(n, dtype=bool)
         for i in range(n):
             mask[i] = predicate.do_include({f: decoded[f][i] for f in pred_fields})
         return mask
 
     @staticmethod
-    def _apply_row_drop(row_indices, drop_partition):
+    def _typed_partition_value(field, value):
+        """Hive partition values are stored as path strings; cast them to the
+        schema's dtype so predicates and output columns see typed values."""
+        return typed_partition_value(field, value)
+
+    @staticmethod
+    def _apply_row_drop(row_indices, drop_partition, overlap=0):
         """Keep 1/k of the rows (contiguous split ``j`` of ``k``), improving
-        shuffle decorrelation (reference: ``_read_with_shuffle_row_drop``)."""
+        shuffle decorrelation (reference: ``_read_with_shuffle_row_drop``).
+
+        With an NGram, each partition borrows the first ``overlap``
+        (= ngram length - 1) rows of the next partition so windows spanning
+        the split boundary are not lost (``py_dict_reader_worker.py:266-271``).
+        """
         j, k = drop_partition
         if k <= 1:
             return row_indices
-        return np.array_split(row_indices, k)[j]
+        parts = np.array_split(row_indices, k)
+        selected = parts[j]
+        if overlap and j + 1 < k:
+            borrow = np.concatenate(parts[j + 1:])[:overlap]
+            selected = np.concatenate([selected, borrow])
+        return selected
 
     def _decode_column(self, name, arrow_col):
         """Arrow column → decoded numpy values (vectorized where possible).
@@ -197,16 +244,7 @@ class RowGroupWorker(WorkerBase):
         values = arrow_col.to_pylist()
         if field is None or field.codec is None:
             return self._collate_plain(field, arrow_col, values)
-        decoded = [None] * len(values)
-        non_null_idx = [i for i, v in enumerate(values) if v is not None]
-        non_null = self._batch_decode(field, [values[i] for i in non_null_idx])
-        for slot, i in enumerate(non_null_idx):
-            decoded[i] = non_null[slot]
-        return self._stack(decoded)
-
-    @staticmethod
-    def _batch_decode(field, encoded_values):
-        return field.codec.decode_batch(field, encoded_values)
+        return self._stack(decode_batch_with_nulls(field, values))
 
     def _collate_plain(self, field, arrow_col, values):
         """Codec-less columns (plain parquet / make_batch_reader path)."""

@@ -49,6 +49,19 @@ class LocalDiskCache(CacheBase):
         self._cleanup_on_exit = cleanup
         self._lock = threading.Lock()
         os.makedirs(path, exist_ok=True)
+        # Running byte total avoids walking the whole tree on every store;
+        # the full walk happens only at init and when the cap is crossed.
+        self._total = self._scan_total()
+
+    def _scan_total(self):
+        total = 0
+        for root, _, files in os.walk(self._path):
+            for name in files:
+                try:
+                    total += os.stat(os.path.join(root, name)).st_size
+                except OSError:
+                    pass
+        return total
 
     def _entry_path(self, key):
         digest = hashlib.sha1(str(key).encode('utf-8')).hexdigest()
@@ -70,8 +83,13 @@ class LocalDiskCache(CacheBase):
             tmp = entry + '.tmp.%d' % os.getpid()
             with open(tmp, 'wb') as f:
                 pickle.dump(value, f, protocol=pickle.HIGHEST_PROTOCOL)
+            size = os.stat(tmp).st_size
             os.replace(tmp, entry)
-            self._maybe_evict()
+            with self._lock:
+                self._total += size
+                over_limit = self._total > self._size_limit
+            if over_limit:
+                self._maybe_evict()
         except OSError:
             logger.warning('LocalDiskCache failed to store %r', key, exc_info=True)
         return value
@@ -90,6 +108,7 @@ class LocalDiskCache(CacheBase):
                     entries.append((st.st_atime, st.st_size, p))
                     total += st.st_size
             if total <= self._size_limit:
+                self._total = total
                 return
             entries.sort()  # oldest access first
             for _, size, p in entries:
@@ -100,6 +119,7 @@ class LocalDiskCache(CacheBase):
                     pass
                 if total <= self._size_limit:
                     break
+            self._total = total
 
     def cleanup(self):
         if self._cleanup_on_exit:

@@ -59,6 +59,19 @@ class DataframeColumnCodec(metaclass=ABCMeta):
         return {'type': type(self).__name__}
 
 
+def decode_batch_with_nulls(unischema_field, values):
+    """Batch-decode a column whose cells may be None (nullable fields): null
+    cells bypass the codec and stay None, non-null cells go through the
+    codec's vectorized ``decode_batch``. Positions are preserved."""
+    non_null_idx = [i for i, v in enumerate(values) if v is not None]
+    decoded = unischema_field.codec.decode_batch(
+        unischema_field, [values[i] for i in non_null_idx])
+    out = [None] * len(values)
+    for slot, i in enumerate(non_null_idx):
+        out[i] = decoded[slot]
+    return out
+
+
 # RGB(A) <-> BGR(A) channel reorder used at the OpenCV boundary.
 _CHANNEL_SWAP = {3: (2, 1, 0), 4: (2, 1, 0, 3)}
 

@@ -12,6 +12,7 @@ from concurrent.futures import ThreadPoolExecutor
 
 import pyarrow.parquet as pq
 
+from petastorm_tpu.codecs import decode_batch_with_nulls
 from petastorm_tpu.errors import MetadataError
 from petastorm_tpu.etl.dataset_metadata import (
     ParquetDatasetInfo, add_to_dataset_metadata, get_schema, load_row_groups,
@@ -48,7 +49,9 @@ def build_rowgroup_index(dataset_url, indexers, storage_options=None, workers=8)
             field = schema.fields[name]
             values = table.column(name).to_pylist()
             if field.codec is not None:
-                columns[name] = field.codec.decode_batch(field, values)
+                # Null cells bypass the codec (nullable columns are exactly
+                # what FieldNotNullIndexer exists for).
+                columns[name] = decode_batch_with_nulls(field, values)
             else:
                 columns[name] = values
         n = table.num_rows

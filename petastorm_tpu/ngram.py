@@ -1,0 +1,198 @@
+"""NGram: sliding-window sequence readout over timestamp-sorted rows.
+
+Re-design of ``petastorm/ngram.py`` for the column-major worker: instead of
+sliding a window over a list of row dicts, window admission is computed
+**vectorized on the timestamp column** (a cumulative count of delta-threshold
+violations makes every window's validity an O(1) lookup), and only surviving
+windows materialize per-timestep namedtuples. Semantics parity:
+
+* ``fields``: ``{timestep(int): [UnischemaField | regex str]}``; window length
+  is ``max(keys) - min(keys) + 1`` (``ngram.py:127-132``); keys may have gaps
+  (the in-between timesteps carry no fields but still consume a row).
+* ``delta_threshold``: max allowed gap between *consecutive* rows inside a
+  window (inclusive), measured on ``timestamp_field`` (``ngram.py:178-193``).
+* ``timestamp_overlap=False``: windows may not share timestamps — a window is
+  admitted only if it starts strictly after the previous admitted window's end
+  (``ngram.py:248-253``).
+* Rows must already be sorted by timestamp within the row-group; unsorted data
+  raises ``NotImplementedError`` (``ngram.py:243-246``). Windows never cross
+  row-group boundaries (``ngram.py:85-91``).
+"""
+
+import numbers
+
+import numpy as np
+
+from petastorm_tpu.unischema import UnischemaField, match_unischema_fields
+
+
+class NGram:
+    """Sliding-window readout: each emitted item is
+    ``{timestep: namedtuple-of-fields-at-that-timestep}``."""
+
+    def __init__(self, fields, delta_threshold, timestamp_field,
+                 timestamp_overlap=True):
+        self._validate(fields, delta_threshold, timestamp_field, timestamp_overlap)
+        self._fields = fields
+        self._delta_threshold = delta_threshold
+        self._timestamp_field = timestamp_field
+        self.timestamp_overlap = timestamp_overlap
+
+    # -- construction --------------------------------------------------------
+
+    @staticmethod
+    def _validate(fields, delta_threshold, timestamp_field, timestamp_overlap):
+        if not isinstance(fields, dict) or not fields:
+            raise ValueError('fields must be a non-empty dict of '
+                             '{timestep: [field|regex]}')
+        for key, value in fields.items():
+            if not isinstance(key, numbers.Integral):
+                raise ValueError('fields keys must be integers; got %r' % (key,))
+            if not isinstance(value, list):
+                raise ValueError('Each fields value must be a list of unischema '
+                                 'fields / regular expressions')
+            for f in value:
+                if not isinstance(f, (UnischemaField, str)):
+                    raise ValueError('All field values must be UnischemaField '
+                                     'or regular expression strings')
+        if not isinstance(delta_threshold, numbers.Number) or \
+                isinstance(delta_threshold, bool):
+            raise ValueError('delta_threshold must be a number')
+        if not isinstance(timestamp_field, (UnischemaField, str)):
+            raise ValueError('timestamp_field must be a UnischemaField or a '
+                             'regular expression string')
+        if not isinstance(timestamp_overlap, bool):
+            raise ValueError('timestamp_overlap must be a bool')
+
+    @property
+    def length(self):
+        return max(self._fields) - min(self._fields) + 1
+
+    @property
+    def fields(self):
+        return self._fields
+
+    @property
+    def delta_threshold(self):
+        return self._delta_threshold
+
+    @property
+    def timestamp_field(self):
+        return self._timestamp_field
+
+    def resolve_regex_field_names(self, schema):
+        """Replace regex strings in ``fields``/``timestamp_field`` with the
+        matching :class:`UnischemaField` objects (``ngram.py:195-205``)."""
+        self._fields = {k: self._convert_fields(schema, v)
+                        for k, v in self._fields.items()}
+        ts = self._convert_fields(schema, [self._timestamp_field])
+        if len(ts) != 1:
+            raise ValueError('timestamp_field must match exactly one unischema '
+                             'field; matched %d' % len(ts))
+        self._timestamp_field = ts[0]
+
+    @staticmethod
+    def _convert_fields(schema, field_list):
+        regexes = [f for f in field_list if isinstance(f, str)]
+        fields = [f for f in field_list if isinstance(f, UnischemaField)]
+        if len(fields) + len(regexes) != len(field_list):
+            raise ValueError('fields/timestamp_field entries must be '
+                             'UnischemaField objects or regex strings')
+        return fields + match_unischema_fields(schema, regexes)
+
+    # -- schema queries ------------------------------------------------------
+
+    def get_field_names_at_timestep(self, timestep):
+        if timestep not in self._fields:
+            return []
+        return [f.name for f in self._fields[timestep]]
+
+    def get_schema_at_timestep(self, schema, timestep):
+        names = set(self.get_field_names_at_timestep(timestep))
+        return schema.create_schema_view(
+            [schema.fields[n] for n in schema.fields if n in names])
+
+    def get_field_names_at_all_timesteps(self):
+        """Union of fields over all timesteps plus the timestamp field (the
+        timestamp is always loaded so window admission can be evaluated)."""
+        fields = {f for flist in self._fields.values() for f in flist}
+        fields.add(self._timestamp_field)
+        return list(fields)
+
+    # -- window formation ----------------------------------------------------
+
+    def form_ngram(self, batch, schema):
+        """All admitted windows of a decoded column batch.
+
+        :param batch: a :class:`~petastorm_tpu.arrow_worker.ColumnBatch` whose
+            columns include the timestamp field.
+        :param schema: the loaded :class:`Unischema` (namedtuple source).
+        :return: list of ``{timestep: namedtuple}`` dicts.
+        """
+        ts_name = self._ts_name()
+        ts = np.asarray(batch.columns[ts_name])
+        n = int(ts.shape[0])
+        L = self.length
+        if n < L:
+            return []
+        if np.any(ts[1:] < ts[:-1]):
+            raise NotImplementedError(
+                'NGram assumes data sorted by the %s field within each '
+                'row-group, which is not the case' % ts_name)
+        # valid_start[i] ⇔ no delta violation inside rows [i, i+L).
+        if L > 1:
+            violations = (np.diff(ts) > self._delta_threshold).astype(np.int64)
+            cum = np.concatenate([[0], np.cumsum(violations)])
+            valid_start = (cum[L - 1:] - cum[:n - L + 1]) == 0
+        else:
+            valid_start = np.ones(n, dtype=bool)
+
+        starts = np.flatnonzero(valid_start)
+        if not self.timestamp_overlap:
+            kept = []
+            prev_end_ts = None
+            for i in starts:
+                if prev_end_ts is not None and ts[i] <= prev_end_ts:
+                    continue
+                kept.append(i)
+                prev_end_ts = ts[i + L - 1]
+            starts = kept
+
+        base = min(self._fields)
+        ts_schemas = {k: self.get_schema_at_timestep(schema, k) for k in self._fields}
+        windows = []
+        for i in starts:
+            window = {}
+            for key in self._fields:
+                offset = int(i) + (key - base)
+                names = ts_schemas[key].fields
+                row = {name: batch.columns[name][offset] for name in names}
+                window[key] = ts_schemas[key].make_namedtuple(**row)
+            windows.append(window)
+        return windows
+
+    def make_namedtuple(self, schema, ngram_as_dicts):
+        """``{timestep: dict}`` → ``{timestep: namedtuple}`` using the schema
+        view at each timestep (``ngram.py:272-295``)."""
+        out = {}
+        for timestep, row in ngram_as_dicts.items():
+            view = self.get_schema_at_timestep(schema, timestep)
+            out[timestep] = view.make_namedtuple(**row)
+        return out
+
+    def _ts_name(self):
+        ts = self._timestamp_field
+        return ts.name if isinstance(ts, UnischemaField) else ts
+
+    # -- comparison ----------------------------------------------------------
+
+    def __eq__(self, other):
+        if not isinstance(other, NGram):
+            return NotImplemented
+        if set(self._fields) != set(other._fields):
+            return False
+        return all(set(self._fields[k]) == set(other._fields[k])
+                   for k in self._fields)
+
+    def __ne__(self, other):
+        return not self == other

@@ -12,7 +12,6 @@ train/val/test splits reproduce bit-for-bit across frameworks and hosts
 
 import hashlib
 from abc import ABCMeta, abstractmethod
-from functools import reduce as _reduce
 
 
 class PredicateBase(metaclass=ABCMeta):

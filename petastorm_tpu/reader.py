@@ -145,6 +145,13 @@ class Reader:
                 shuffle_row_drop_partitions > 1:
             raise NotImplementedError('Using timestamp deduplication with '
                                       'shuffle_row_drop_partitions is not supported')
+        if predicate is not None and cache is not None and \
+                not isinstance(cache, NullCache):
+            # A cached row-group must be predicate-independent; predicates
+            # have no stable content identity to key on (reference forbids
+            # the combination too, ``reader.py:416-418``).
+            raise RuntimeError('Local cache is not supported together with '
+                               'predicates')
 
         # (1) schema
         self.stored_schema = infer_or_load_unischema(dataset_info)
@@ -230,9 +237,12 @@ class Reader:
         pred_fields = predicate.get_fields()
         partition_keys = set(self.dataset_info.partition_keys)
         if pred_fields and pred_fields <= partition_keys:
+            from petastorm_tpu.arrow_worker import typed_partition_value
             kept = [i for i in piece_indices
                     if predicate.do_include(
-                        {k: self._row_groups[i].partition_values.get(k)
+                        {k: typed_partition_value(
+                            self.stored_schema.fields.get(k),
+                            self._row_groups[i].partition_values.get(k))
                          for k in pred_fields})]
             return kept, None
         return piece_indices, predicate
@@ -330,6 +340,9 @@ class Reader:
         self.last_row_consumed = False
         self._current_batch = None
         self._batch_cursor = 0
+        # The new sweep restarts epoch numbering from 0; stale consumption
+        # records would otherwise corrupt state_dict()'s resume math.
+        self._consumed_by_epoch = {}
 
     def stop(self):
         self._pool.stop()

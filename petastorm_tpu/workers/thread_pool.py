@@ -49,6 +49,7 @@ class ThreadPool:
         self._counter_lock = threading.Lock()
         self._profiling_enabled = profiling_enabled
         self._profiles = []
+        self._error = None
 
     @property
     def workers_count(self):
@@ -84,6 +85,10 @@ class ThreadPool:
         """
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
+            if self._error is not None:
+                # A worker error is terminal: every subsequent read re-raises
+                # it instead of hanging on counters that will never reconcile.
+                raise self._error
             try:
                 result = self._results_queue.get(timeout=_POLL_INTERVAL_S)
             except queue.Empty:
@@ -101,6 +106,7 @@ class ThreadPool:
                     self._ventilator.processed_item()
                 continue
             if isinstance(result, Exception):
+                self._error = result
                 self.stop()
                 self.join()
                 raise result
@@ -177,6 +183,9 @@ class ThreadPool:
                                  exc_info=True)
                     try:
                         self._publish(e)
+                        # Keep ventilated/processed counters consistent so the
+                        # ventilator's in-flight accounting cannot wedge.
+                        self._publish(VentilatedItemProcessedMessage())
                     except _WorkerExit:
                         return
         except _WorkerExit:

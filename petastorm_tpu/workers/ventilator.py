@@ -120,6 +120,7 @@ class ConcurrentVentilator(Ventilator):
         self._completed = False
         self._stop_requested = False
         self._cursor = 0
+        self._epoch = 0
         self._in_flight = 0
         self._iterations_remaining = self._initial_iterations
         self.start()
@@ -178,16 +179,24 @@ class ConcurrentVentilator(Ventilator):
                         self._cv.wait(_VENTILATION_INTERVAL_S)
                     if self._stop_requested:
                         return
+                    # in_flight must rise BEFORE the item reaches the pool:
+                    # a worker's processed_item() decrement may otherwise
+                    # precede the increment and be lost to the >=0 clamp.
                     self._in_flight += 1
                     item_index = order[self._cursor]
-                    self._cursor += 1
                 if self._pass_epoch:
                     self._ventilate_fn(epoch=self._epoch, **self._items[item_index])
                 else:
                     self._ventilate_fn(**self._items[item_index])
-            self._cursor = 0
-            self._epoch += 1
-            if self._iterations_remaining is not None:
-                self._iterations_remaining -= 1
+                # The cursor advances only after the item was handed to the
+                # pool, so a state_dict() snapshot can never skip an item that
+                # was not ventilated (at-least-once resume semantics).
+                with self._cv:
+                    self._cursor += 1
+            with self._cv:
+                self._epoch += 1
+                self._cursor = 0
+                if self._iterations_remaining is not None:
+                    self._iterations_remaining -= 1
         with self._cv:
             self._cv.notify_all()

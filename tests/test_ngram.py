@@ -1,0 +1,218 @@
+"""NGram unit + end-to-end tests (reference: ``petastorm/tests/test_ngram.py``
+and ``test_ngram_end_to_end.py``)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from petastorm_tpu.arrow_worker import ColumnBatch
+from petastorm_tpu.codecs import ScalarCodec
+from petastorm_tpu.ngram import NGram
+from petastorm_tpu.reader import make_reader
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+from tests.test_common import TestSchema
+
+TsSchema = Unischema('TsSchema', [
+    UnischemaField('ts', np.int64, (), ScalarCodec(pa.int64()), False),
+    UnischemaField('value', np.int32, (), ScalarCodec(pa.int32()), False),
+    UnischemaField('other', np.float64, (), ScalarCodec(pa.float64()), False),
+])
+
+
+def _batch(ts_values, values=None):
+    ts = np.asarray(ts_values, dtype=np.int64)
+    n = len(ts)
+    vals = np.asarray(values if values is not None else np.arange(n), dtype=np.int32)
+    other = np.arange(n, dtype=np.float64) * 0.5
+    return ColumnBatch({'ts': ts, 'value': vals, 'other': other}, n)
+
+
+def _resolved(fields, delta, overlap=True, timestamp='ts'):
+    ngram = NGram(fields=fields, delta_threshold=delta, timestamp_field=timestamp,
+                  timestamp_overlap=overlap)
+    ngram.resolve_regex_field_names(TsSchema)
+    return ngram
+
+
+class TestNGramUnit:
+    def test_length(self):
+        assert _resolved({0: ['value'], 1: ['value']}, 1).length == 2
+        assert _resolved({-1: ['value'], 1: ['value']}, 1).length == 3
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            NGram(fields=None, delta_threshold=1, timestamp_field='ts')
+        with pytest.raises(ValueError):
+            NGram(fields={0: 'not-a-list'}, delta_threshold=1, timestamp_field='ts')
+        with pytest.raises(ValueError):
+            NGram(fields={0: [5]}, delta_threshold=1, timestamp_field='ts')
+        with pytest.raises(ValueError):
+            NGram(fields={0: ['value']}, delta_threshold='x', timestamp_field='ts')
+        with pytest.raises(ValueError):
+            NGram(fields={0: ['value']}, delta_threshold=1, timestamp_field=7)
+        with pytest.raises(ValueError):
+            NGram(fields={0: ['value']}, delta_threshold=1, timestamp_field='ts',
+                  timestamp_overlap='yes')
+        with pytest.raises(ValueError):
+            NGram(fields={0.5: ['value']}, delta_threshold=1, timestamp_field='ts')
+
+    def test_regex_resolution(self):
+        ngram = _resolved({0: ['va.*'], 1: [TsSchema.fields['other']]}, 1)
+        assert ngram.get_field_names_at_timestep(0) == ['value']
+        assert ngram.get_field_names_at_timestep(1) == ['other']
+        assert ngram.get_field_names_at_timestep(9) == []
+
+    def test_timestamp_regex_must_be_unique(self):
+        ngram = NGram(fields={0: ['value']}, delta_threshold=1, timestamp_field='.*')
+        with pytest.raises(ValueError, match='exactly one'):
+            ngram.resolve_regex_field_names(TsSchema)
+
+    def test_schema_at_timestep(self):
+        ngram = _resolved({0: ['value'], 1: ['value', 'other']}, 1)
+        view = ngram.get_schema_at_timestep(TsSchema, 1)
+        assert set(view.fields) == {'value', 'other'}
+
+    def test_loads_timestamp_field(self):
+        ngram = _resolved({0: ['value']}, 1)
+        names = {f.name for f in ngram.get_field_names_at_all_timesteps()}
+        assert names == {'value', 'ts'}
+
+    def test_equality(self):
+        a = _resolved({0: ['value'], 1: ['other']}, 1)
+        b = _resolved({0: ['value'], 1: ['other']}, 5)
+        c = _resolved({0: ['value'], 1: ['value']}, 1)
+        assert a == b  # delta not part of identity (reference semantics)
+        assert a != c
+
+
+class TestFormNGram:
+    def test_dense_windows(self):
+        ngram = _resolved({0: ['value'], 1: ['value', 'other']}, 1)
+        windows = ngram.form_ngram(_batch([0, 1, 2, 3]), TsSchema)
+        assert len(windows) == 3
+        for w, start in zip(windows, range(3)):
+            assert w[0].value == start
+            assert w[1].value == start + 1
+            assert w[1].other == (start + 1) * 0.5
+            assert not hasattr(w[0], 'other')
+
+    def test_delta_threshold_gap(self):
+        # Gaps > threshold drop windows spanning them (reference Case 2).
+        ngram = _resolved({-1: ['value'], 0: ['value']}, 4)
+        windows = ngram.form_ngram(_batch([0, 3, 8, 10, 11, 20, 30]), TsSchema)
+        starts = [w[-1].value for w in windows]
+        assert starts == [0, 2, 3]
+
+    def test_all_windows_dropped(self):
+        ngram = _resolved({0: ['value'], 1: ['value']}, 5)
+        assert ngram.form_ngram(_batch([0, 10, 20, 30]), TsSchema) == []
+
+    def test_sparse_timestep_keys(self):
+        # {-1, 1} → length 3; middle row consumed but projected to no fields.
+        ngram = _resolved({-1: ['value'], 1: ['value']}, 1)
+        windows = ngram.form_ngram(_batch([0, 1, 2, 3]), TsSchema)
+        assert len(windows) == 2
+        assert windows[0][-1].value == 0
+        assert windows[0][1].value == 2
+        assert set(windows[0]) == {-1, 1}
+
+    def test_non_overlapping(self):
+        ngram = _resolved({0: ['value'], 1: ['value'], 2: ['value']}, 1,
+                          overlap=False)
+        windows = ngram.form_ngram(_batch([0, 1, 2, 3, 4, 5]), TsSchema)
+        assert [w[0].value for w in windows] == [0, 3]
+
+    def test_unsorted_raises(self):
+        ngram = _resolved({0: ['value'], 1: ['value']}, 1)
+        with pytest.raises(NotImplementedError, match='sorted'):
+            ngram.form_ngram(_batch([3, 1, 2]), TsSchema)
+
+    def test_short_batch(self):
+        ngram = _resolved({0: ['value'], 1: ['value'], 2: ['value']}, 1)
+        assert ngram.form_ngram(_batch([0, 1]), TsSchema) == []
+
+    def test_make_namedtuple(self):
+        ngram = _resolved({0: ['value'], 1: ['value', 'other']}, 1)
+        nt = ngram.make_namedtuple(TsSchema, {0: {'value': 1},
+                                              1: {'value': 2, 'other': 0.5}})
+        assert nt[0].value == 1
+        assert nt[1].other == 0.5
+
+
+@pytest.mark.parametrize('pool_type', ['dummy', 'thread'])
+class TestNGramEndToEnd:
+    """Dataset fixture: ids 0..99 over 4 files, row-groups of ≤10 dense ids —
+    windows form within each row-group only (reference ``ngram.py:85-91``)."""
+
+    def _expected_window_count(self, window):
+        # 4 files x 25 rows = rowgroups of (10, 10, 5) per file.
+        return 4 * sum(max(0, n - window + 1) for n in (10, 10, 5))
+
+    def test_basic(self, synthetic_dataset, pool_type):
+        fields = {0: ['^id$', '^id2$'], 1: ['^id$', '^sensor_name$']}
+        ngram = NGram(fields=fields, delta_threshold=1, timestamp_field='^id$')
+        with make_reader(synthetic_dataset.url, ngram=ngram, num_epochs=1,
+                         shuffle_row_groups=False, reader_pool_type=pool_type,
+                         workers_count=2) as reader:
+            windows = list(reader)
+        assert len(windows) == self._expected_window_count(2)
+        for w in windows:
+            assert w[1].id == w[0].id + 1
+            assert w[1].sensor_name[0] == 'sensor_%d' % w[1].id
+            assert not hasattr(w[0], 'sensor_name')
+
+    def test_length_three_with_decoded_image(self, synthetic_dataset, pool_type):
+        fields = {0: ['^id$'], 1: ['^id$', '^image_png$'], 2: ['^id$']}
+        ngram = NGram(fields=fields, delta_threshold=1, timestamp_field='^id$')
+        with make_reader(synthetic_dataset.url, ngram=ngram, num_epochs=1,
+                         shuffle_row_groups=False, reader_pool_type=pool_type,
+                         workers_count=2) as reader:
+            windows = list(reader)
+        assert len(windows) == self._expected_window_count(3)
+        by_start = {w[0].id: w for w in windows}
+        expected = {r['id']: r for r in synthetic_dataset.data}
+        some = by_start[min(by_start)]
+        np.testing.assert_array_equal(some[1].image_png,
+                                      expected[some[1].id]['image_png'])
+
+    def test_shuffle_row_drop_overlap(self, synthetic_dataset, pool_type):
+        fields = {0: ['^id$'], 1: ['^id$']}
+        ngram = NGram(fields=fields, delta_threshold=1, timestamp_field='^id$')
+        with make_reader(synthetic_dataset.url, ngram=ngram, num_epochs=1,
+                         shuffle_row_groups=False, reader_pool_type=pool_type,
+                         shuffle_row_drop_partitions=2,
+                         workers_count=2) as reader:
+            windows = list(reader)
+        # Partition-boundary borrow keeps every within-rowgroup window alive.
+        starts = sorted(w[0].id for w in windows)
+        assert len(starts) == self._expected_window_count(2)
+
+    def test_non_overlap_end_to_end(self, synthetic_dataset, pool_type):
+        fields = {0: ['^id$'], 1: ['^id$']}
+        ngram = NGram(fields=fields, delta_threshold=1, timestamp_field='^id$',
+                      timestamp_overlap=False)
+        with make_reader(synthetic_dataset.url, ngram=ngram, num_epochs=1,
+                         shuffle_row_groups=False, reader_pool_type=pool_type,
+                         workers_count=2) as reader:
+            windows = list(reader)
+        seen = [w[k].id for w in windows for k in (0, 1)]
+        assert len(seen) == len(set(seen))
+
+
+def test_non_overlap_with_row_drop_rejected(synthetic_dataset):
+    ngram = NGram(fields={0: ['^id$'], 1: ['^id$']}, delta_threshold=1,
+                  timestamp_field='^id$', timestamp_overlap=False)
+    with pytest.raises(NotImplementedError):
+        make_reader(synthetic_dataset.url, ngram=ngram,
+                    shuffle_row_drop_partitions=2)
+
+
+def test_ngram_with_explicit_unischema_fields(synthetic_dataset):
+    fields = {0: [TestSchema.fields['id']], 1: [TestSchema.fields['id']]}
+    ngram = NGram(fields=fields, delta_threshold=1,
+                  timestamp_field=TestSchema.fields['id'])
+    with make_reader(synthetic_dataset.url, ngram=ngram, num_epochs=1,
+                     shuffle_row_groups=False, reader_pool_type='dummy') as reader:
+        w = next(reader)
+    assert w[1].id == w[0].id + 1
