@@ -63,6 +63,17 @@ class LocalDiskCache(CacheBase):
                     pass
         return total
 
+    def __getstate__(self):
+        # Locks don't cross the process-pool spawn boundary; each process
+        # gets its own (the cache is safe across processes via atomic rename).
+        state = self.__dict__.copy()
+        del state['_lock']
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._lock = threading.Lock()
+
     def _entry_path(self, key):
         digest = hashlib.sha1(str(key).encode('utf-8')).hexdigest()
         shard = digest[:2]

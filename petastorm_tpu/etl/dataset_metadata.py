@@ -109,6 +109,20 @@ class ParquetDatasetInfo:
         self._schema = None
         self._lock = threading.Lock()
 
+    def __getstate__(self):
+        # Ships across the process-pool spawn boundary: drop the lock and the
+        # cached pyarrow metadata objects (re-read lazily in the worker).
+        state = self.__dict__.copy()
+        del state['_lock']
+        state['_common_metadata'] = _UNSET
+        state['_metadata'] = _UNSET
+        state['_schema'] = None
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._lock = threading.Lock()
+
     @staticmethod
     def _discover_files(fs, root):
         if fs.isfile(root):

@@ -1,0 +1,50 @@
+"""Result-payload serializers for the process pool boundary.
+
+Parity with ``petastorm/reader_impl/pickle_serializer.py`` and
+``arrow_table_serializer.py``: a serializer turns a worker result into bytes
+for the ZMQ hop and back. :class:`PickleSerializer` (protocol 5, out-of-band
+buffers capable) is the default — :class:`~petastorm_tpu.arrow_worker.ColumnBatch`
+payloads are dicts of numpy arrays, which pickle ships with a single memcpy.
+:class:`ArrowTableSerializer` streams a ``pyarrow.Table`` as a RecordBatch
+stream for consumers that stay in Arrow.
+"""
+
+import pickle
+from abc import ABCMeta, abstractmethod
+
+import pyarrow as pa
+
+
+class SerializerBase(metaclass=ABCMeta):
+    @abstractmethod
+    def serialize(self, value):
+        """value → bytes-like."""
+
+    @abstractmethod
+    def deserialize(self, payload):
+        """bytes-like → value."""
+
+
+class PickleSerializer(SerializerBase):
+    """Default payload codec (reference: ``pickle_serializer.py:17-23``)."""
+
+    def serialize(self, value):
+        return pickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL)
+
+    def deserialize(self, payload):
+        return pickle.loads(payload)
+
+
+class ArrowTableSerializer(SerializerBase):
+    """``pyarrow.Table`` ↔ RecordBatch-stream bytes
+    (reference: ``arrow_table_serializer.py:18-33``)."""
+
+    def serialize(self, table):
+        sink = pa.BufferOutputStream()
+        with pa.ipc.new_stream(sink, table.schema) as writer:
+            writer.write_table(table)
+        return sink.getvalue().to_pybytes()
+
+    def deserialize(self, payload):
+        with pa.ipc.open_stream(pa.BufferReader(payload)) as reader:
+            return reader.read_all()

@@ -127,6 +127,13 @@ class UnischemaField:
     def __setattr__(self, key, value):
         raise AttributeError('UnischemaField is immutable')
 
+    def __reduce__(self):
+        # Immutability breaks pickle's default slot restore (it uses setattr);
+        # reconstruct through __init__ instead. Needed for the process pool.
+        return (UnischemaField,
+                (self.name, self.numpy_dtype, self.shape, self.codec,
+                 self.nullable))
+
     def _key(self):
         return (self.name, self.numpy_dtype, self.shape, self.nullable)
 

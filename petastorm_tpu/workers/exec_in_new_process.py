@@ -1,0 +1,58 @@
+"""Spawn (never fork) a Python function in a fresh interpreter.
+
+Forking a process that holds JVM/libhdfs/XLA runtime state is unsafe; the
+reference hit the same problem (``workers_pool/exec_in_new_process.py:26-48``)
+and solved it the same way: dill-serialize ``(func, args, kwargs)`` to a temp
+file and ``Popen`` a clean ``python -m`` bootstrap that loads and runs it.
+On a TPU VM this also guarantees workers never inherit a TPU client handle.
+"""
+
+import os
+import subprocess
+import sys
+import tempfile
+
+import dill
+
+
+def exec_in_new_process(func, *args, **kwargs):
+    """Run ``func(*args, **kwargs)`` in a brand-new Python process.
+
+    :return: the :class:`subprocess.Popen` handle.
+    """
+    fd, payload_path = tempfile.mkstemp(prefix='petastorm_tpu_spawn_',
+                                        suffix='.dill')
+    with os.fdopen(fd, 'wb') as f:
+        dill.dump((func, args, kwargs), f)
+    env = dict(os.environ)
+    # Decode workers must never grab the TPU chip the trainer owns — force
+    # CPU even when the parent exported JAX_PLATFORMS=tpu.
+    env['JAX_PLATFORMS'] = 'cpu'
+    # The fresh interpreter must be able to import this package (and the
+    # caller's modules, e.g. user worker classes) even when the parent got
+    # them via sys.path manipulation rather than an installed distribution.
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    extra_paths = [p for p in [pkg_root] + sys.path if p]
+    existing = env.get('PYTHONPATH')
+    if existing:
+        extra_paths.append(existing)
+    seen = set()
+    deduped = [p for p in extra_paths if not (p in seen or seen.add(p))]
+    env['PYTHONPATH'] = os.pathsep.join(deduped)
+    return subprocess.Popen(
+        [sys.executable, '-m', 'petastorm_tpu.workers.exec_in_new_process',
+         payload_path],
+        env=env)
+
+
+def _main():
+    payload_path = sys.argv[1]
+    with open(payload_path, 'rb') as f:
+        func, args, kwargs = dill.load(f)
+    os.unlink(payload_path)
+    func(*args, **kwargs)
+
+
+if __name__ == '__main__':
+    _main()

@@ -295,3 +295,38 @@ def test_diagnostics_property(synthetic_dataset):
     with make_reader(synthetic_dataset.url, reader_pool_type='thread') as reader:
         next(reader)
         assert 'items_ventilated' in reader.diagnostics
+
+
+# -- process pool (spawned workers over ZMQ) --------------------------------
+# Dedicated tests rather than full POOLS parametrization: each spawn costs
+# ~1-2s of interpreter+import startup, so the full matrix would dominate
+# suite runtime without adding coverage.
+
+def test_process_pool_simple_read(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, reader_pool_type='process',
+                     workers_count=2, shuffle_row_groups=False) as reader:
+        rows = list(reader)
+    assert len(rows) == 100
+    expected = _fields_by_id(synthetic_dataset.data)
+    for row in rows[:5]:
+        _check_simple_row(row, expected[row.id])
+
+
+def test_process_pool_batch_reader(scalar_dataset):
+    with make_batch_reader(scalar_dataset.url, reader_pool_type='process',
+                           workers_count=2) as reader:
+        ids = [i for batch in reader for i in batch.id]
+    assert sorted(ids) == list(range(100))
+
+
+def test_process_pool_worker_error_propagates(synthetic_dataset):
+    from petastorm_tpu.transform import TransformSpec
+
+    def _boom(frame):
+        raise ValueError('decode exploded')
+
+    with pytest.raises(ValueError, match='decode exploded'):
+        with make_reader(synthetic_dataset.url, reader_pool_type='process',
+                         workers_count=2,
+                         transform_spec=TransformSpec(_boom)) as reader:
+            list(reader)

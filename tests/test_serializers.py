@@ -1,0 +1,27 @@
+"""Serializer round-trips (reference: ``tests/test_pickle_serializer.py``,
+``test_arrow_table_serializer.py``)."""
+
+import numpy as np
+import pyarrow as pa
+
+from petastorm_tpu.arrow_worker import ColumnBatch
+from petastorm_tpu.serializers import ArrowTableSerializer, PickleSerializer
+
+
+def test_pickle_roundtrip_column_batch():
+    s = PickleSerializer()
+    batch = ColumnBatch({'a': np.arange(5), 'b': np.ones((5, 3), np.float32)},
+                        5, item_index=2, epoch=1)
+    out = s.deserialize(s.serialize(batch))
+    assert out.length == 5
+    assert out.item_index == 2 and out.epoch == 1
+    np.testing.assert_array_equal(out.columns['a'], batch.columns['a'])
+    np.testing.assert_array_equal(out.columns['b'], batch.columns['b'])
+
+
+def test_arrow_table_roundtrip():
+    s = ArrowTableSerializer()
+    table = pa.table({'x': pa.array([1, 2, 3], pa.int64()),
+                      'y': pa.array(['a', 'b', 'c'])})
+    out = s.deserialize(s.serialize(table))
+    assert out.equals(table)

@@ -14,8 +14,11 @@ from tests.stub_workers import (
     ExceptionOnFiveWorker, IdentityWorker, MultiplyingWorker, SleepyIdentityWorker,
 )
 
-POOLS = [lambda: ThreadPool(1), lambda: ThreadPool(4), lambda: DummyPool()]
-POOL_IDS = ['thread-1', 'thread-4', 'dummy']
+from petastorm_tpu.workers.process_pool import ProcessPool
+
+POOLS = [lambda: ThreadPool(1), lambda: ThreadPool(4), lambda: DummyPool(),
+         lambda: ProcessPool(2)]
+POOL_IDS = ['thread-1', 'thread-4', 'dummy', 'process-2']
 
 
 def _drain(pool):
