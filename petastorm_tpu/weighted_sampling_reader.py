@@ -1,0 +1,91 @@
+"""Probabilistic multiplexer over several readers.
+
+Parity with ``petastorm/weighted_sampling_reader.py:20-115``: each ``next()``
+draws one underlying reader with the given probability and returns its next
+item. Readers must agree on output schema/mode; exhaustion of ANY reader ends
+the mix (so relative mixing ratios hold throughout).
+"""
+
+import numpy as np
+
+
+class WeightedSamplingReader:
+    """:param readers: list of opened readers (same schema, same
+        batched/ngram mode).
+    :param probabilities: relative weights, one per reader (normalized
+        internally).
+    :param seed: RNG seed for reproducible mixing.
+    """
+
+    def __init__(self, readers, probabilities, seed=None):
+        if len(readers) != len(probabilities):
+            raise ValueError('readers and probabilities must have equal '
+                             'lengths (%d != %d)'
+                             % (len(readers), len(probabilities)))
+        if not readers:
+            raise ValueError('At least one reader is required')
+        if any(p < 0 for p in probabilities) or sum(probabilities) <= 0:
+            raise ValueError('probabilities must be non-negative with a '
+                             'positive sum')
+        first = readers[0]
+        for other in readers[1:]:
+            if set(other.schema.fields) != set(first.schema.fields):
+                raise ValueError(
+                    'All readers must share the same output schema; %s != %s'
+                    % (sorted(other.schema.fields), sorted(first.schema.fields)))
+            if other.batched_output != first.batched_output:
+                raise ValueError('All readers must have the same '
+                                 'batched_output mode')
+            if (other.ngram is None) != (first.ngram is None) or (
+                    first.ngram is not None and other.ngram != first.ngram):
+                raise ValueError('All readers must use the same NGram spec '
+                                 '(or none)')
+        self._readers = readers
+        self._cum = np.cumsum(np.asarray(probabilities, dtype=np.float64))
+        self._cum /= self._cum[-1]
+        self._rng = np.random.RandomState(seed)
+
+    # The mix exposes the shared reader surface.
+    @property
+    def schema(self):
+        return self._readers[0].schema
+
+    @property
+    def batched_output(self):
+        return self._readers[0].batched_output
+
+    @property
+    def ngram(self):
+        return self._readers[0].ngram
+
+    @property
+    def last_row_consumed(self):
+        """True once any underlying reader ran dry (which ends the mix)."""
+        return any(getattr(r, 'last_row_consumed', False)
+                   for r in self._readers)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        choice = int(np.searchsorted(self._cum, self._rng.random_sample(),
+                                     side='right'))
+        return next(self._readers[min(choice, len(self._readers) - 1)])
+
+    def next(self):
+        return self.__next__()
+
+    def stop(self):
+        for r in self._readers:
+            r.stop()
+
+    def join(self):
+        for r in self._readers:
+            r.join()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.stop()
+        self.join()

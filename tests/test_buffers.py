@@ -1,0 +1,186 @@
+"""Shuffling buffer tests (reference: ``tests/test_shuffling_buffer.py``)."""
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.buffers import (
+    BatchedNoopShufflingBuffer, BatchedRandomShufflingBuffer,
+    NoopShufflingBuffer, RandomShufflingBuffer,
+)
+
+
+class TestNoop:
+    def test_fifo(self):
+        buf = NoopShufflingBuffer()
+        buf.add_many([1, 2, 3])
+        assert buf.size == 3
+        assert [buf.retrieve() for _ in range(3)] == [1, 2, 3]
+        assert not buf.can_retrieve
+        buf.finish()
+        assert not buf.can_add
+
+
+class TestRandom:
+    def test_holds_until_min_after_retrieve(self):
+        buf = RandomShufflingBuffer(10, min_after_retrieve=3, seed=0)
+        buf.add_many([1, 2])
+        assert not buf.can_retrieve  # below the decorrelation floor
+        buf.add_many([3])
+        assert buf.can_retrieve
+
+    def test_floor_equal_to_capacity_does_not_deadlock(self):
+        buf = RandomShufflingBuffer(3, min_after_retrieve=3, seed=0)
+        buf.add_many([1, 2, 3])
+        assert not buf.can_add
+        assert buf.can_retrieve
+
+    def test_floor_above_capacity_rejected(self):
+        with pytest.raises(ValueError, match='capacity'):
+            RandomShufflingBuffer(3, min_after_retrieve=4)
+
+    def test_finish_drains_fully(self):
+        buf = RandomShufflingBuffer(10, min_after_retrieve=5, seed=0)
+        buf.add_many([1, 2, 3])
+        buf.finish()
+        out = []
+        while buf.can_retrieve:
+            out.append(buf.retrieve())
+        assert sorted(out) == [1, 2, 3]
+
+    def test_capacity_gates_can_add(self):
+        buf = RandomShufflingBuffer(3, min_after_retrieve=0, seed=0)
+        buf.add_many([1, 2])
+        assert buf.can_add
+        buf.add_many([3, 4])  # single add may overshoot capacity
+        assert not buf.can_add
+        with pytest.raises(RuntimeError):
+            buf.add_many([5])
+
+    def test_all_items_come_out_exactly_once(self):
+        buf = RandomShufflingBuffer(1000, min_after_retrieve=10, seed=1)
+        buf.add_many(list(range(500)))
+        out = []
+        while buf.can_retrieve:
+            out.append(buf.retrieve())
+        buf.finish()
+        while buf.can_retrieve:
+            out.append(buf.retrieve())
+        assert sorted(out) == list(range(500))
+
+    def test_output_is_shuffled(self):
+        buf = RandomShufflingBuffer(1000, min_after_retrieve=0, seed=2)
+        buf.add_many(list(range(200)))
+        buf.finish()
+        out = [buf.retrieve() for _ in range(200)]
+        assert out != list(range(200))
+
+
+def _chunk(start, n):
+    return {'id': np.arange(start, start + n),
+            'vec': np.arange(start, start + n, dtype=np.float32).reshape(-1, 1)
+            * np.ones((1, 4), np.float32)}
+
+
+class TestBatchedNoop:
+    def test_rebatches_preserving_order(self):
+        buf = BatchedNoopShufflingBuffer(batch_size=7)
+        buf.add_many(_chunk(0, 10))
+        buf.add_many(_chunk(10, 10))
+        batches = []
+        while buf.can_retrieve:
+            batches.append(buf.retrieve())
+        buf.finish()
+        while buf.can_retrieve:
+            batches.append(buf.retrieve())
+        assert [len(b['id']) for b in batches] == [7, 7, 6]
+        np.testing.assert_array_equal(
+            np.concatenate([b['id'] for b in batches]), np.arange(20))
+        last = batches[-1]
+        np.testing.assert_array_equal(last['vec'][:, 0], last['id'])
+
+    def test_empty_chunk_ignored(self):
+        buf = BatchedNoopShufflingBuffer(batch_size=2)
+        buf.add_many(_chunk(0, 0))
+        assert buf.size == 0
+
+
+class TestBatchedRandom:
+    def test_exactly_once_and_row_alignment(self):
+        buf = BatchedRandomShufflingBuffer(
+            shuffling_buffer_capacity=64, min_after_retrieve=16,
+            batch_size=8, extra_capacity=32, seed=0)
+        seen = []
+        start = 0
+        for _ in range(6):
+            buf.add_many(_chunk(start, 16))
+            start += 16
+            while buf.can_retrieve:
+                b = buf.retrieve()
+                # rows must stay internally consistent across columns
+                np.testing.assert_array_equal(b['vec'][:, 2], b['id'])
+                seen.extend(b['id'].tolist())
+        buf.finish()
+        while buf.can_retrieve:
+            b = buf.retrieve()
+            np.testing.assert_array_equal(b['vec'][:, 2], b['id'])
+            seen.extend(b['id'].tolist())
+        assert sorted(seen) == list(range(start))
+
+    def test_shuffles_across_chunks(self):
+        buf = BatchedRandomShufflingBuffer(
+            shuffling_buffer_capacity=100, min_after_retrieve=50,
+            batch_size=10, extra_capacity=100, seed=3)
+        buf.add_many(_chunk(0, 100))
+        first = buf.retrieve()['id']
+        assert not np.array_equal(first, np.arange(10))
+
+    def test_chunk_overflow_raises(self):
+        buf = BatchedRandomShufflingBuffer(
+            shuffling_buffer_capacity=4, min_after_retrieve=0, batch_size=2,
+            extra_capacity=0, seed=0)
+        with pytest.raises(RuntimeError, match='extra_capacity'):
+            buf.add_many(_chunk(0, 10))
+
+    def test_min_after_retrieve_floor(self):
+        buf = BatchedRandomShufflingBuffer(
+            shuffling_buffer_capacity=100, min_after_retrieve=20,
+            batch_size=5, extra_capacity=0, seed=0)
+        buf.add_many(_chunk(0, 15))
+        assert not buf.can_retrieve
+        buf.add_many(_chunk(15, 5))
+        assert buf.can_retrieve
+
+    def test_batch_size_above_capacity_rejected(self):
+        with pytest.raises(ValueError, match='capacity'):
+            BatchedRandomShufflingBuffer(
+                shuffling_buffer_capacity=4, min_after_retrieve=0,
+                batch_size=8)
+
+    def test_dtype_widening_no_truncation(self):
+        buf = BatchedRandomShufflingBuffer(
+            shuffling_buffer_capacity=10, min_after_retrieve=0, batch_size=10,
+            extra_capacity=10, seed=0)
+        buf.add_many({'s': np.array(['abc', 'de'])})
+        buf.add_many({'s': np.array(['abcdefghij'])})
+        buf.finish()
+        out = []
+        while buf.can_retrieve:
+            out.extend(buf.retrieve()['s'].tolist())
+        assert sorted(out) == ['abc', 'abcdefghij', 'de']
+
+    def test_object_dtype_columns(self):
+        buf = BatchedRandomShufflingBuffer(
+            shuffling_buffer_capacity=10, min_after_retrieve=0, batch_size=4,
+            extra_capacity=10, seed=0)
+        ragged = np.empty(6, dtype=object)
+        for i in range(6):
+            ragged[i] = np.arange(i + 1)
+        buf.add_many({'id': np.arange(6), 'ragged': ragged})
+        buf.finish()
+        rows = 0
+        while buf.can_retrieve:
+            b = buf.retrieve()
+            for rid, arr in zip(b['id'], b['ragged']):
+                assert len(arr) == rid + 1
+            rows += len(b['id'])
+        assert rows == 6

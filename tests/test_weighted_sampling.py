@@ -1,0 +1,80 @@
+"""WeightedSamplingReader tests
+(reference: ``tests/test_weighted_sampling_reader.py``)."""
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.reader import make_reader
+from petastorm_tpu.weighted_sampling_reader import WeightedSamplingReader
+
+
+def _reader(url, **kw):
+    kw.setdefault('num_epochs', None)
+    kw.setdefault('shuffle_row_groups', False)
+    kw.setdefault('reader_pool_type', 'dummy')
+    return make_reader(url, **kw)
+
+
+def test_basic_iteration(synthetic_dataset):
+    with _reader(synthetic_dataset.url) as a, _reader(synthetic_dataset.url) as b:
+        mix = WeightedSamplingReader([a, b], [0.8, 0.2], seed=0)
+        for _ in range(100):
+            assert hasattr(next(mix), 'id')
+
+
+def test_choice_distribution(synthetic_dataset):
+    class _Counting:
+        def __init__(self, reader, bucket, counts):
+            self._reader = reader
+            self._bucket = bucket
+            self._counts = counts
+            self.schema = reader.schema
+            self.batched_output = reader.batched_output
+            self.ngram = reader.ngram
+
+        def __next__(self):
+            self._counts[self._bucket] += 1
+            return next(self._reader)
+
+        def stop(self):
+            self._reader.stop()
+
+        def join(self):
+            self._reader.join()
+
+    counts = [0, 0]
+    with _reader(synthetic_dataset.url) as a, _reader(synthetic_dataset.url) as b:
+        mix = WeightedSamplingReader(
+            [_Counting(a, 0, counts), _Counting(b, 1, counts)],
+            [0.75, 0.25], seed=42)
+        for _ in range(1000):
+            next(mix)
+    ratio = counts[0] / 1000.0
+    assert 0.70 < ratio < 0.80, counts
+
+
+def test_schema_mismatch_rejected(synthetic_dataset):
+    with _reader(synthetic_dataset.url) as a, \
+            _reader(synthetic_dataset.url, schema_fields=['^id$']) as b:
+        with pytest.raises(ValueError, match='same output schema'):
+            WeightedSamplingReader([a, b], [0.5, 0.5])
+
+
+def test_bad_probabilities(synthetic_dataset):
+    with _reader(synthetic_dataset.url) as a:
+        with pytest.raises(ValueError):
+            WeightedSamplingReader([a], [0.5, 0.5])
+        with pytest.raises(ValueError):
+            WeightedSamplingReader([a], [-1.0])
+        with pytest.raises(ValueError):
+            WeightedSamplingReader([], [])
+
+
+def test_deterministic_with_seed(synthetic_dataset):
+    ids_runs = []
+    for _ in range(2):
+        with _reader(synthetic_dataset.url) as a, \
+                _reader(synthetic_dataset.url) as b:
+            mix = WeightedSamplingReader([a, b], [0.5, 0.5], seed=7)
+            ids_runs.append([next(mix).id for _ in range(50)])
+    assert ids_runs[0] == ids_runs[1]
