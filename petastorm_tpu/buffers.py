@@ -57,6 +57,8 @@ class NoopShufflingBuffer(ShufflingBufferBase):
         self._done = False
 
     def add_many(self, items):
+        if not self.can_add:
+            raise RuntimeError('add_many called on a finished buffer')
         self._items.extend(items)
 
     def retrieve(self):
@@ -188,6 +190,8 @@ class BatchedNoopShufflingBuffer(BatchedShufflingBufferBase):
         self._done = False
 
     def add_many(self, columns):
+        if not self.can_add:
+            raise RuntimeError('add_many called on a finished buffer')
         n = _leading_dim(columns)
         if n == 0:
             return

@@ -1,0 +1,4 @@
+"""JAX bridge: sharded ``jax.Array`` batch loaders (the TPU-native
+equivalent of the reference's tf/torch consumer layers)."""
+
+from petastorm_tpu.jax.loader import JaxLoader, MASK_FIELD, make_jax_loader  # noqa: F401

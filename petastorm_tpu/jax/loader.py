@@ -1,0 +1,289 @@
+"""JAX device stage: Parquet row-groups → sharded ``jax.Array`` batches.
+
+This is the layer the reference does not have (its consumers stop at numpy /
+torch / tf tensors): decoded column batches are re-batched to a fixed size,
+optionally shuffled, cast per a dtype policy, and staged into device HBM as
+``jax.Array``s laid out for a ``jax.sharding.Mesh`` — with the host→device
+transfer overlapped with consumption (double/triple buffering, bounded by
+``prefetch``).
+
+Design notes (SURVEY.md §7.1/§7.2 step 4):
+
+* Fixed batch sizes + 'drop'/'pad' last-batch policies keep every step's
+  shapes static, so the training step compiles once (XLA requirement).
+* Sharding uses ``jax.make_array_from_process_local_data``: each host feeds
+  only its own shard (the reader is already sharded by
+  ``jax.process_index()``), and the resulting global array's batch axis is
+  laid out over the mesh's data axes — collectives then ride ICI.
+* All decode/shuffle/cast work happens on a background staging thread; the
+  consumer thread only dequeues ready device arrays.
+"""
+
+import logging
+import queue
+import threading
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_SENTINEL_END = object()
+
+#: name of the validity-mask column added under ``last_batch='pad'``
+MASK_FIELD = 'valid_mask'
+
+
+def make_jax_loader(dataset_url_or_urls, batch_size, mesh=None, data_axes=None,
+                    fields=None, shuffle_rows=False,
+                    shuffling_queue_capacity=None, min_after_retrieve=None,
+                    extra_capacity=None, seed=0, last_batch='drop',
+                    dtypes=None, prefetch=2, num_epochs=1,
+                    reader_factory=None, **reader_kwargs):
+    """Create a :class:`JaxLoader` over a Parquet dataset.
+
+    :param batch_size: rows per emitted batch **per host**. With a mesh, must
+        divide evenly over the mesh's data-axis shards.
+    :param mesh: optional :class:`jax.sharding.Mesh`; batches become global
+        ``jax.Array``s whose leading axis is sharded over ``data_axes``.
+    :param data_axes: mesh axis name(s) to shard the batch axis over
+        (default: all mesh axis names).
+    :param fields: field name/regex list forwarded to the reader
+        (column projection).
+    :param shuffle_rows: decorrelate rows across row-groups with a
+        :class:`~petastorm_tpu.buffers.BatchedRandomShufflingBuffer`.
+    :param last_batch: ``'drop'`` (default: constant shapes), ``'pad'``
+        (zero-pad + ``valid_mask`` bool column), or ``'short'`` (emit the
+        ragged tail batch — breaks shape stability under jit).
+    :param dtypes: optional ``{field: numpy dtype}`` cast applied on host
+        before staging (e.g. ``{'image': jnp.bfloat16}``).
+    :param prefetch: number of device batches staged ahead of the consumer.
+    :param reader_factory: reader constructor (defaults to
+        :func:`petastorm_tpu.reader.make_batch_reader`).
+    :param reader_kwargs: forwarded to the reader factory (predicates,
+        sharding overrides, pool type, ...).
+    """
+    from petastorm_tpu.reader import make_batch_reader
+    factory = reader_factory or make_batch_reader
+    reader = factory(dataset_url_or_urls, schema_fields=fields,
+                     num_epochs=num_epochs, **reader_kwargs)
+    try:
+        return JaxLoader(reader, batch_size, mesh=mesh, data_axes=data_axes,
+                         shuffle_rows=shuffle_rows,
+                         shuffling_queue_capacity=shuffling_queue_capacity,
+                         min_after_retrieve=min_after_retrieve,
+                         extra_capacity=extra_capacity, seed=seed,
+                         last_batch=last_batch, dtypes=dtypes, prefetch=prefetch)
+    except Exception:
+        reader.stop()
+        reader.join()
+        raise
+
+
+class JaxLoader:
+    """Iterator of ``{field: jax.Array}`` batches over a batched reader."""
+
+    def __init__(self, reader, batch_size, mesh=None, data_axes=None,
+                 shuffle_rows=False, shuffling_queue_capacity=None,
+                 min_after_retrieve=None, extra_capacity=None, seed=0,
+                 last_batch='drop', dtypes=None, prefetch=2):
+        if last_batch not in ('drop', 'pad', 'short'):
+            raise ValueError("last_batch must be 'drop', 'pad' or 'short'; "
+                             'got %r' % (last_batch,))
+        if not getattr(reader, 'batched_output', True):
+            raise ValueError(
+                'JaxLoader requires a batched reader (make_batch_reader); '
+                'make_batch_reader decodes codec fields too, so a row reader '
+                'is never needed here')
+        self._reader = reader
+        self._batch_size = batch_size
+        self._mesh = mesh
+        self._last_batch = last_batch
+        self._dtypes = dict(dtypes or {})
+        self._prefetch = max(1, prefetch)
+        self._seed = seed
+        self._shuffle_rows = shuffle_rows
+        self._shuffling_queue_capacity = shuffling_queue_capacity
+        self._min_after_retrieve = min_after_retrieve
+        self._extra_capacity = extra_capacity
+        self._sharding = self._resolve_sharding(mesh, data_axes, batch_size)
+        self._stage_thread = None
+        self._out_queue = None
+        self._stop_event = threading.Event()
+        self._stage_error = None
+        self._exhausted = False
+
+    # -- sharding ------------------------------------------------------------
+
+    @staticmethod
+    def _resolve_sharding(mesh, data_axes, batch_size):
+        if mesh is None:
+            return None
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+        axes = tuple(data_axes) if data_axes is not None else tuple(mesh.axis_names)
+        n_shards = 1
+        for a in axes:
+            n_shards *= mesh.shape[a]
+        if batch_size * jax.process_count() % max(1, n_shards):
+            raise ValueError(
+                'global batch (%d per host x %d hosts) must divide evenly '
+                'over the %d data shards of mesh axes %s'
+                % (batch_size, jax.process_count(), n_shards, axes))
+        return NamedSharding(mesh, PartitionSpec(axes))
+
+    # -- iteration -----------------------------------------------------------
+
+    def __iter__(self):
+        if self._stage_thread is not None:
+            raise RuntimeError('JaxLoader supports a single iteration pass; '
+                               'construct a new loader (or use num_epochs) '
+                               'for more')
+        self._out_queue = queue.Queue(maxsize=self._prefetch)
+        self._stage_thread = threading.Thread(target=self._stage_loop,
+                                              daemon=True)
+        self._stage_thread.start()
+        return self
+
+    def __next__(self):
+        if self._out_queue is None:
+            iter(self)
+        if self._exhausted:
+            raise StopIteration
+        while True:
+            try:
+                item = self._out_queue.get(timeout=0.1)
+            except queue.Empty:
+                if self._stage_error is not None:
+                    raise self._stage_error
+                continue
+            if item is _SENTINEL_END:
+                self._exhausted = True
+                if self._stage_error is not None:
+                    raise self._stage_error
+                raise StopIteration
+            return item
+
+    # -- staging pipeline (background thread) --------------------------------
+
+    def _make_buffer(self):
+        from petastorm_tpu.buffers import (
+            BatchedNoopShufflingBuffer, BatchedRandomShufflingBuffer,
+        )
+        if not self._shuffle_rows:
+            return BatchedNoopShufflingBuffer(self._batch_size)
+        capacity = self._shuffling_queue_capacity or 4 * self._batch_size
+        min_after = (self._min_after_retrieve
+                     if self._min_after_retrieve is not None
+                     else capacity // 2)
+        # extra capacity must absorb one whole row-group on top of capacity;
+        # overridable for datasets with very large row-groups.
+        extra = (self._extra_capacity if self._extra_capacity is not None
+                 else max(capacity, 100000))
+        return BatchedRandomShufflingBuffer(
+            capacity, min_after, self._batch_size,
+            extra_capacity=extra, seed=self._seed)
+
+    def _stage_loop(self):
+        try:
+            buf = self._make_buffer()
+            for batch in self._reader:
+                columns = batch._asdict() if hasattr(batch, '_asdict') else batch
+                buf.add_many(dict(columns))
+                while buf.can_retrieve:
+                    self._emit(buf.retrieve())
+                    if self._stop_event.is_set():
+                        return
+                if self._stop_event.is_set():
+                    return
+            buf.finish()
+            while buf.can_retrieve:
+                self._emit(buf.retrieve())
+                if self._stop_event.is_set():
+                    return
+        except Exception as e:  # noqa: BLE001 - surfaced to consumer
+            self._stage_error = e
+        finally:
+            self._put_blocking(_SENTINEL_END)
+
+    def _emit(self, host_batch):
+        n = len(next(iter(host_batch.values())))
+        if n < self._batch_size:
+            if self._last_batch == 'drop':
+                return
+            if self._last_batch == 'pad':
+                host_batch = self._pad(host_batch, n)
+            # 'short': ship as-is
+        elif self._last_batch == 'pad':
+            host_batch = dict(host_batch)
+            host_batch[MASK_FIELD] = np.ones(n, dtype=bool)
+        self._put_blocking(self._to_device(host_batch))
+
+    def _pad(self, host_batch, n):
+        out = {}
+        for name, arr in host_batch.items():
+            arr = np.asarray(arr)
+            pad_shape = (self._batch_size - n,) + arr.shape[1:]
+            out[name] = np.concatenate([arr, np.zeros(pad_shape, arr.dtype)])
+        mask = np.zeros(self._batch_size, dtype=bool)
+        mask[:n] = True
+        out[MASK_FIELD] = mask
+        return out
+
+    def _to_device(self, host_batch):
+        import jax
+        device_batch = {}
+        for name, arr in host_batch.items():
+            arr = np.asarray(arr)
+            if arr.dtype == object:
+                raise TypeError(
+                    'Field %r has variable shape (object dtype) and cannot '
+                    'be staged to device; project it away with fields=, or '
+                    'densify/pad it with a TransformSpec' % name)
+            want = self._dtypes.get(name)
+            if want is not None:
+                arr = arr.astype(want)
+            if self._sharding is not None:
+                device_batch[name] = jax.make_array_from_process_local_data(
+                    self._sharding, arr)
+            else:
+                device_batch[name] = jax.device_put(arr)
+        return device_batch
+
+    def _put_blocking(self, item):
+        while not self._stop_event.is_set():
+            try:
+                self._out_queue.put(item, timeout=0.1)
+                return
+            except queue.Full:
+                continue
+
+    # -- lifecycle -----------------------------------------------------------
+
+    @property
+    def schema(self):
+        return self._reader.schema
+
+    @property
+    def reader(self):
+        return self._reader
+
+    def state_dict(self):
+        """Checkpoint passthrough (row-group granular, at-least-once; see
+        :meth:`petastorm_tpu.reader.Reader.state_dict`)."""
+        return self._reader.state_dict()
+
+    def load_state_dict(self, state):
+        self._reader.load_state_dict(state)
+
+    def stop(self):
+        self._stop_event.set()
+        if self._stage_thread is not None:
+            self._stage_thread.join(timeout=10)
+        self._reader.stop()
+        self._reader.join()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.stop()

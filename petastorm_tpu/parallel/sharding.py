@@ -10,15 +10,28 @@ construction, SURVEY.md §5.8).
 """
 
 import logging
+import sys
 
 logger = logging.getLogger(__name__)
 
 
 def _jax_process_info():
+    """(process_index, process_count) of an ALREADY-LIVE JAX runtime.
+
+    Deliberately never triggers backend initialization: merely constructing a
+    reader must not grab an accelerator (or hang on a wedged one). On a pod,
+    user code runs ``jax.distributed.initialize()`` (or any jax op) before
+    building readers, so the live-backend check passes there.
+    """
+    if 'jax' not in sys.modules:
+        return None, None
     try:
         import jax
+        from jax._src import xla_bridge
+        if not xla_bridge.backends_are_initialized():
+            return None, None
         return jax.process_index(), jax.process_count()
-    except Exception:  # noqa: BLE001 - jax absent or uninitialized
+    except Exception:  # noqa: BLE001 - private API drift or init failure
         return None, None
 
 

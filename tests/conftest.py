@@ -7,7 +7,9 @@ TPU hardware (SURVEY.md §4: multi-node stand-in strategy).
 
 import os
 
-os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+# Force CPU even when the environment preselects a TPU platform: the test
+# suite must exercise the virtual 8-device mesh, never the real chip.
+os.environ['JAX_PLATFORMS'] = 'cpu'
 _flags = os.environ.get('XLA_FLAGS', '')
 if '--xla_force_host_platform_device_count' not in _flags:
     os.environ['XLA_FLAGS'] = (_flags + ' --xla_force_host_platform_device_count=8').strip()
@@ -15,6 +17,12 @@ if '--xla_force_host_platform_device_count' not in _flags:
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The environment's TPU plugin can pre-register itself at interpreter start
+# (sitecustomize) and win over the env var; the config update is decisive.
+import jax  # noqa: E402
+
+jax.config.update('jax_platforms', 'cpu')
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

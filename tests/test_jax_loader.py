@@ -1,0 +1,139 @@
+"""JAX device-stage tests on the virtual 8-device CPU mesh
+(conftest sets ``xla_force_host_platform_device_count=8``)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from petastorm_tpu.jax import MASK_FIELD, make_jax_loader
+
+
+def _mesh(shape, names):
+    devices = np.asarray(jax.devices()[:int(np.prod(shape))]).reshape(shape)
+    return Mesh(devices, names)
+
+
+def test_fixed_batches_single_device(scalar_dataset):
+    with make_jax_loader(scalar_dataset.url, batch_size=16,
+                         fields=['^id$', '^float64$'],
+                         shuffle_row_groups=False) as loader:
+        batches = list(loader)
+    # 100 rows → 6 full batches of 16, tail of 4 dropped
+    assert len(batches) == 6
+    ids = np.concatenate([np.asarray(b['id']) for b in batches])
+    assert len(set(ids.tolist())) == 96
+    assert all(isinstance(b['id'], jax.Array) for b in batches)
+
+
+def test_sharded_over_mesh(scalar_dataset):
+    mesh = _mesh((8,), ('data',))
+    with make_jax_loader(scalar_dataset.url, batch_size=16, mesh=mesh,
+                         fields=['^id$', '^float64$'],
+                         shuffle_row_groups=False) as loader:
+        batch = next(iter(loader))
+    arr = batch['id']
+    assert arr.shape == (16,)
+    assert arr.sharding == NamedSharding(mesh, PartitionSpec(('data',)))
+    # every device holds 2 rows
+    assert {s.data.shape for s in arr.addressable_shards} == {(2,)}
+    # a jitted global sum sees all rows
+    total = jax.jit(lambda x: jnp.sum(x))(batch['float64'])
+    np.testing.assert_allclose(
+        float(total), float(np.sum(np.asarray(batch['float64']))), rtol=1e-6)
+
+
+def test_2d_mesh_data_axis_subset(scalar_dataset):
+    mesh = _mesh((4, 2), ('data', 'model'))
+    with make_jax_loader(scalar_dataset.url, batch_size=8, mesh=mesh,
+                         data_axes=('data',), fields=['^id$'],
+                         shuffle_row_groups=False) as loader:
+        batch = next(iter(loader))
+    assert batch['id'].sharding.spec == PartitionSpec(('data',))
+    # replicated over 'model': 8 shards but only 4 distinct row groups of 2
+    assert {s.data.shape for s in batch['id'].addressable_shards} == {(2,)}
+
+
+def test_pad_policy_masks_tail(scalar_dataset):
+    with make_jax_loader(scalar_dataset.url, batch_size=16, last_batch='pad',
+                         fields=['^id$'], shuffle_row_groups=False) as loader:
+        batches = list(loader)
+    assert len(batches) == 7
+    mask = np.asarray(batches[-1][MASK_FIELD])
+    assert mask.sum() == 4 and not mask[4:].any()
+    for b in batches[:-1]:
+        assert np.asarray(b[MASK_FIELD]).all()
+
+
+def test_short_policy(scalar_dataset):
+    with make_jax_loader(scalar_dataset.url, batch_size=16, last_batch='short',
+                         fields=['^id$'], shuffle_row_groups=False) as loader:
+        sizes = [len(b['id']) for b in loader]
+    assert sizes == [16] * 6 + [4]
+
+
+def test_shuffle_rows_exactly_once(scalar_dataset):
+    with make_jax_loader(scalar_dataset.url, batch_size=10, shuffle_rows=True,
+                         seed=3, fields=['^id$'], last_batch='short',
+                         shuffle_row_groups=False) as loader:
+        ids = np.concatenate([np.asarray(b['id']) for b in loader])
+    assert sorted(ids.tolist()) == list(range(100))
+    assert ids.tolist() != list(range(100))
+
+
+def test_dtype_policy_casts(scalar_dataset):
+    with make_jax_loader(scalar_dataset.url, batch_size=16,
+                         fields=['^float64$'],
+                         dtypes={'float64': jnp.bfloat16},
+                         shuffle_row_groups=False) as loader:
+        batch = next(iter(loader))
+    assert batch['float64'].dtype == jnp.bfloat16
+
+
+def test_object_column_rejected(synthetic_dataset):
+    with make_jax_loader(synthetic_dataset.url, batch_size=8,
+                         fields=['^id$', '^matrix_nullable$'],
+                         shuffle_row_groups=False) as loader:
+        with pytest.raises(TypeError, match='variable shape'):
+            list(loader)
+
+
+def test_row_reader_rejected(synthetic_dataset):
+    from petastorm_tpu.reader import make_reader
+    with pytest.raises(ValueError, match='batched reader'):
+        make_jax_loader(synthetic_dataset.url, batch_size=8,
+                        reader_factory=make_reader)
+
+
+def test_decoded_image_batches(synthetic_dataset):
+    with make_jax_loader(synthetic_dataset.url, batch_size=8,
+                         fields=['^id$', '^image_png$'],
+                         dtypes={'image_png': jnp.bfloat16},
+                         shuffle_row_groups=False) as loader:
+        batch = next(iter(loader))
+    assert batch['image_png'].shape == (8, 16, 32, 3)
+    assert batch['image_png'].dtype == jnp.bfloat16
+
+
+def test_checkpoint_passthrough(scalar_dataset):
+    with make_jax_loader(scalar_dataset.url, batch_size=16, fields=['^id$'],
+                         shuffle_row_groups=False) as loader:
+        state = loader.state_dict()
+    assert state['epoch'] == 0
+
+
+def test_bad_divisibility_rejected(scalar_dataset):
+    mesh = _mesh((8,), ('data',))
+    with pytest.raises(ValueError, match='divide evenly'):
+        make_jax_loader(scalar_dataset.url, batch_size=12, mesh=mesh,
+                        fields=['^id$'])
+
+
+def test_single_pass_guard(scalar_dataset):
+    loader = make_jax_loader(scalar_dataset.url, batch_size=16, fields=['^id$'])
+    iter(loader)
+    with pytest.raises(RuntimeError, match='single iteration'):
+        iter(loader)
+    loader.stop()
