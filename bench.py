@@ -1,0 +1,81 @@
+"""Round benchmark: hello-world dataset read rate vs the reference baseline.
+
+Replicates the reference's only published absolute number — the
+``petastorm-throughput.py`` hello-world read rate of 709.84 samples/sec with
+3 thread workers (``docs/benchmarks_tutorial.rst:20-21``) — against this
+framework's reader on an equivalent dataset (id + 128-float array + 32x32
+png image per row, mirroring ``examples/hello_world``'s schema shape).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Deliberately host-only (no jax import): the read path is the benchmarked
+surface, and touching an accelerator here could wedge on a busy chip.
+"""
+
+import json
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, '.')
+
+BASELINE_SAMPLES_PER_SEC = 709.84  # reference: docs/benchmarks_tutorial.rst:20
+
+WARMUP_SAMPLES = 300
+MEASURE_SAMPLES = 3000
+
+
+def _build_dataset(url):
+    import numpy as np
+    import pyarrow as pa
+
+    from petastorm_tpu.codecs import (
+        CompressedImageCodec, NdarrayCodec, ScalarCodec,
+    )
+    from petastorm_tpu.etl.dataset_metadata import write_dataset
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    schema = Unischema('HelloWorldSchema', [
+        UnischemaField('id', np.int32, (), ScalarCodec(pa.int32()), False),
+        UnischemaField('array_4d', np.uint8, (128,), NdarrayCodec(), False),
+        UnischemaField('image1', np.uint8, (32, 32, 3),
+                       CompressedImageCodec('png'), False),
+    ])
+    rng = np.random.RandomState(42)
+    rows = [{
+        'id': i,
+        'array_4d': rng.randint(0, 255, (128,), dtype=np.uint8),
+        'image1': rng.randint(0, 255, (32, 32, 3), dtype=np.uint8),
+    } for i in range(1000)]
+    write_dataset(url, schema, rows, rowgroup_size_rows=100, num_files=4)
+
+
+def main():
+    from petastorm_tpu.reader import make_reader
+
+    tmp = tempfile.mkdtemp(prefix='petastorm_tpu_bench_')
+    url = 'file://' + tmp + '/hello_world'
+    try:
+        _build_dataset(url)
+        with make_reader(url, reader_pool_type='thread', workers_count=3,
+                         num_epochs=None, shuffle_row_groups=True) as reader:
+            for _ in range(WARMUP_SAMPLES):
+                next(reader)
+            start = time.monotonic()
+            for _ in range(MEASURE_SAMPLES):
+                next(reader)
+            elapsed = time.monotonic() - start
+        rate = MEASURE_SAMPLES / elapsed
+        print(json.dumps({
+            'metric': 'hello_world_read_rate',
+            'value': round(rate, 2),
+            'unit': 'samples/sec',
+            'vs_baseline': round(rate / BASELINE_SAMPLES_PER_SEC, 3),
+        }))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == '__main__':
+    main()

@@ -61,6 +61,15 @@ def make_jax_loader(dataset_url_or_urls, batch_size, mesh=None, data_axes=None,
         :func:`petastorm_tpu.reader.make_batch_reader`).
     :param reader_kwargs: forwarded to the reader factory (predicates,
         sharding overrides, pool type, ...).
+
+    .. warning:: **Multi-host epochs.** Row-group sharding can hand hosts
+        unequal row counts, so per-host loaders may emit different numbers of
+        batches per epoch. A host that exhausts its shard stops stepping
+        while the others still issue collectives — a pod-wide hang. For
+        multi-host training drive a FIXED number of steps per epoch (e.g.
+        ``steps = global_rows // (batch_size * jax.process_count())``) over
+        an infinite loader (``num_epochs=None``), the standard TPU-pod
+        pattern.
     """
     from petastorm_tpu.reader import make_batch_reader
     factory = reader_factory or make_batch_reader
@@ -175,10 +184,13 @@ class JaxLoader:
         min_after = (self._min_after_retrieve
                      if self._min_after_retrieve is not None
                      else capacity // 2)
-        # extra capacity must absorb one whole row-group on top of capacity;
-        # overridable for datasets with very large row-groups.
+        # Extra capacity absorbs one whole row-group on top of capacity.
+        # It is EAGERLY preallocated per column, so the default stays
+        # proportional to capacity (not a huge constant) — datasets with
+        # row-groups larger than `capacity` rows should pass extra_capacity
+        # explicitly (the overflow error says so).
         extra = (self._extra_capacity if self._extra_capacity is not None
-                 else max(capacity, 100000))
+                 else capacity)
         return BatchedRandomShufflingBuffer(
             capacity, min_after, self._batch_size,
             extra_capacity=extra, seed=self._seed)
@@ -277,9 +289,12 @@ class JaxLoader:
 
     def stop(self):
         self._stop_event.set()
+        # Stop the reader FIRST: it is what a staging thread blocked in
+        # reader.__next__ is actually waiting on; the stop event alone
+        # cannot wake it.
+        self._reader.stop()
         if self._stage_thread is not None:
             self._stage_thread.join(timeout=10)
-        self._reader.stop()
         self._reader.join()
 
     def __enter__(self):

@@ -1,0 +1,12 @@
+"""Reference model families consuming petastorm_tpu loaders.
+
+The reference ships example models (``examples/mnist/``, ``examples/imagenet``)
+as consumers of its readers; here they are first-class, TPU-first: bfloat16
+compute, mesh-sharded parameters, jit-compiled train steps.
+"""
+
+from petastorm_tpu.models.mnist import MnistCNN, mnist_train_step  # noqa: F401
+from petastorm_tpu.models.transformer import (  # noqa: F401
+    TransformerConfig, init_transformer_params, transformer_forward,
+    transformer_train_step,
+)

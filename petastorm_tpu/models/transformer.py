@@ -1,0 +1,179 @@
+"""Decoder-only transformer LM with explicit dp×tp mesh sharding.
+
+The flagship multi-chip consumer of the framework's sequence readout
+(NGram / sequence columns → token batches). Written in pure JAX so every
+parameter's mesh layout is explicit:
+
+* attention QKV and MLP-in are column-sharded over ``'model'`` (heads /
+  hidden split, no communication on the way in);
+* attention-out and MLP-out are row-sharded over ``'model'`` (XLA inserts
+  the psum on the way out — the standard Megatron layout);
+* activations carry ``P('data', None, None)`` constraints so the batch axis
+  stays data-parallel and collectives ride the ICI mesh axes.
+
+bfloat16 matmuls with f32 accumulation (``preferred_element_type``), f32
+softmax/norm statistics.
+"""
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from petastorm_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 256
+    d_model: int = 64
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: int = 256
+    max_seq_len: int = 128
+    dtype: object = jnp.bfloat16
+
+
+def _param_specs(config):
+    """PartitionSpec per parameter (Megatron column/row split)."""
+    block = {
+        'qkv': P(None, MODEL_AXIS),
+        'attn_out': P(MODEL_AXIS, None),
+        'mlp_in': P(None, MODEL_AXIS),
+        'mlp_out': P(MODEL_AXIS, None),
+        'ln1': P(None),
+        'ln2': P(None),
+    }
+    return {
+        'embed': P(None, None),
+        'pos_embed': P(None, None),
+        'blocks': [dict(block) for _ in range(config.n_layers)],
+        'ln_f': P(None),
+        'lm_head': P(None, MODEL_AXIS),
+    }
+
+
+def init_transformer_params(rng, config, mesh=None):
+    """Initialize parameters; with a mesh, each leaf is placed with its
+    tensor-parallel sharding so no later reshard is needed."""
+    c = config
+    keys = jax.random.split(rng, 3 + 4 * c.n_layers)
+    k = iter(range(len(keys)))
+
+    def dense(key, shape, scale):
+        return (jax.random.normal(keys[key], shape, jnp.float32)
+                * scale).astype(jnp.float32)
+
+    params = {
+        'embed': dense(next(k), (c.vocab_size, c.d_model), 0.02),
+        'pos_embed': dense(next(k), (c.max_seq_len, c.d_model), 0.02),
+        'blocks': [],
+        'ln_f': jnp.ones((c.d_model,), jnp.float32),
+        'lm_head': dense(next(k), (c.d_model, c.vocab_size), 0.02),
+    }
+    for _ in range(c.n_layers):
+        params['blocks'].append({
+            'qkv': dense(next(k), (c.d_model, 3 * c.d_model),
+                         c.d_model ** -0.5),
+            'attn_out': dense(next(k), (c.d_model, c.d_model),
+                              c.d_model ** -0.5),
+            'mlp_in': dense(next(k), (c.d_model, c.d_ff), c.d_model ** -0.5),
+            'mlp_out': dense(next(k), (c.d_ff, c.d_model), c.d_ff ** -0.5),
+            'ln1': jnp.ones((c.d_model,), jnp.float32),
+            'ln2': jnp.ones((c.d_model,), jnp.float32),
+        })
+    if mesh is not None:
+        specs = _param_specs(c)
+        params = jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+            params, specs,
+            is_leaf=lambda x: isinstance(x, (jnp.ndarray, np.ndarray)))
+    return params
+
+
+def _rmsnorm(x, gain):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x.astype(jnp.float32) * jax.lax.rsqrt(var + 1e-6) * gain).astype(x.dtype)
+
+
+def _attention(x, qkv_w, out_w, n_heads, dtype):
+    b, s, d = x.shape
+    head_dim = d // n_heads
+    qkv = jnp.einsum('bsd,de->bse', x, qkv_w.astype(dtype),
+                     preferred_element_type=jnp.float32).astype(dtype)
+    q, k_, v = jnp.split(qkv, 3, axis=-1)
+
+    def heads(t):
+        return t.reshape(b, s, n_heads, head_dim).transpose(0, 2, 1, 3)
+
+    q, k_, v = heads(q), heads(k_), heads(v)
+    scores = jnp.einsum('bhqd,bhkd->bhqk', q, k_,
+                        preferred_element_type=jnp.float32)
+    scores = scores / np.sqrt(head_dim)
+    mask = jnp.tril(jnp.ones((s, s), bool))
+    scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
+    ctx = jnp.einsum('bhqk,bhkd->bhqd', probs, v,
+                     preferred_element_type=jnp.float32).astype(dtype)
+    ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, d)
+    return jnp.einsum('bsd,de->bse', ctx, out_w.astype(dtype),
+                      preferred_element_type=jnp.float32).astype(dtype)
+
+
+def transformer_forward(params, tokens, config):
+    """tokens (B, S) int32 → logits (B, S, V) f32."""
+    c = config
+    dtype = c.dtype
+    x = params['embed'][tokens].astype(dtype)
+    x = x + params['pos_embed'][:tokens.shape[1]].astype(dtype)
+    x = _constrain(x)
+    for block in params['blocks']:
+        h = _rmsnorm(x, block['ln1'])
+        x = x + _attention(h, block['qkv'], block['attn_out'], c.n_heads, dtype)
+        x = _constrain(x)
+        h = _rmsnorm(x, block['ln2'])
+        h = jnp.einsum('bsd,df->bsf', h, block['mlp_in'].astype(dtype),
+                       preferred_element_type=jnp.float32)
+        h = jax.nn.gelu(h.astype(jnp.float32)).astype(dtype)
+        x = x + jnp.einsum('bsf,fd->bsd', h, block['mlp_out'].astype(dtype),
+                           preferred_element_type=jnp.float32).astype(dtype)
+        x = _constrain(x)
+    x = _rmsnorm(x, params['ln_f'])
+    return jnp.einsum('bsd,dv->bsv', x, params['lm_head'].astype(dtype),
+                      preferred_element_type=jnp.float32)
+
+
+def _constrain(x):
+    """Keep activations data-parallel on the batch axis when running under a
+    mesh; outside a mesh context this is a no-op."""
+    try:
+        return jax.lax.with_sharding_constraint(
+            x, P(DATA_AXIS, *([None] * (x.ndim - 1))))
+    except (ValueError, NameError, RuntimeError):
+        return x
+
+
+def transformer_loss(params, tokens, config):
+    """Next-token cross-entropy over (B, S) int token batches."""
+    logits = transformer_forward(params, tokens[:, :-1], config)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -ll.mean()
+
+
+def transformer_train_step(config, optimizer):
+    """Jittable ``(params, opt_state, tokens) -> (params, opt_state, loss)``."""
+
+    @partial(jax.jit, static_argnums=())
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(transformer_loss)(params, tokens,
+                                                           config)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return jax.tree_util.tree_map(
+            lambda p, u: p + u, params, updates), opt_state, loss
+
+    return step

@@ -1,0 +1,3 @@
+"""TPU kernels (Pallas) for the hot data-path ops."""
+
+from petastorm_tpu.ops.normalize import normalize_images  # noqa: F401

@@ -1,0 +1,63 @@
+"""Fused uint8→bfloat16 image normalization as a Pallas TPU kernel.
+
+The first device-side op of every image pipeline: ``(x/255 - mean)/std``
+with a dtype cast. Staging images as uint8 and normalizing on device
+quarters the H2D traffic vs shipping f32 — this kernel fuses the cast,
+scale, and normalize into one VMEM pass so the lowering never materializes
+an intermediate f32 image in HBM.
+
+Falls back to plain jnp (which XLA fuses fine on CPU) when not running on
+TPU; the kernel and fallback are numerically identical, which the tests
+assert.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _norm_kernel(x_ref, scale_ref, bias_ref, o_ref):
+    # One batch row per grid step: (1, H, W, C) block in VMEM.
+    x = x_ref[...].astype(jnp.float32)
+    # (x/255 - mean)/std  ==  x * scale + bias  with precomputed
+    # scale = 1/(255*std), bias = -mean/std — one fused multiply-add.
+    o_ref[...] = (x * scale_ref[...] + bias_ref[...]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=('out_dtype', 'interpret'))
+def normalize_images(images, mean, std, out_dtype=jnp.bfloat16,
+                     interpret=False):
+    """Normalize a uint8 NHWC image batch on device.
+
+    :param images: (N, H, W, C) uint8 array.
+    :param mean: per-channel mean in [0, 1], shape (C,).
+    :param std: per-channel std in [0, 1], shape (C,).
+    :param interpret: run the Pallas kernel in interpret mode (testing).
+    """
+    mean = jnp.asarray(mean, jnp.float32)
+    std = jnp.asarray(std, jnp.float32)
+    scale = (1.0 / (255.0 * std)).astype(jnp.float32)
+    bias = (-mean / std).astype(jnp.float32)
+
+    on_tpu = jax.devices()[0].platform == 'tpu'
+    if not (on_tpu or interpret):
+        x = images.astype(jnp.float32)
+        return (x * scale + bias).astype(out_dtype)
+
+    from jax.experimental import pallas as pl
+
+    n, h, w, c = images.shape
+    grid = (n,)
+    return pl.pallas_call(
+        _norm_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, h, w, c), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((c,), lambda i: (0,)),
+            pl.BlockSpec((c,), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((1, h, w, c), lambda i: (i, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, h, w, c), out_dtype),
+        interpret=interpret,
+    )(images, scale, bias)

@@ -1,0 +1,50 @@
+"""Device-mesh helpers: the framework's canonical mesh axes.
+
+Axes convention used across models, the JAX loader, and the graft entry:
+
+* ``'data'``  — batch (data-parallel) axis; the loader shards batches here.
+* ``'model'`` — tensor-parallel axis; models shard weights/heads here.
+
+On a pod this is created once from all devices; in tests from the virtual
+8-device CPU platform.
+"""
+
+import numpy as np
+
+DATA_AXIS = 'data'
+MODEL_AXIS = 'model'
+
+
+def make_mesh(data=None, model=1, devices=None):
+    """A ``jax.sharding.Mesh`` of shape (data, model).
+
+    :param data: data-parallel size (default: all devices / model).
+    :param model: tensor-parallel size.
+    :param devices: explicit device list (default ``jax.devices()``).
+    """
+    import jax
+    from jax.sharding import Mesh
+    devices = list(devices if devices is not None else jax.devices())
+    if data is None:
+        if len(devices) % model:
+            raise ValueError('device count %d not divisible by model=%d'
+                             % (len(devices), model))
+        data = len(devices) // model
+    n = data * model
+    if n > len(devices):
+        raise ValueError('mesh %dx%d needs %d devices, have %d'
+                         % (data, model, n, len(devices)))
+    grid = np.asarray(devices[:n]).reshape(data, model)
+    return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+
+
+def data_sharding(mesh, ndim=1):
+    """NamedSharding that shards axis 0 over 'data', replicating the rest."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    spec = PartitionSpec(DATA_AXIS, *([None] * (ndim - 1)))
+    return NamedSharding(mesh, spec)
+
+
+def replicated(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec
+    return NamedSharding(mesh, PartitionSpec())

@@ -14,6 +14,8 @@ import sys
 
 logger = logging.getLogger(__name__)
 
+_warned_uninitialized = False
+
 
 def _jax_process_info():
     """(process_index, process_count) of an ALREADY-LIVE JAX runtime.
@@ -29,6 +31,15 @@ def _jax_process_info():
         import jax
         from jax._src import xla_bridge
         if not xla_bridge.backends_are_initialized():
+            global _warned_uninitialized
+            if not _warned_uninitialized:
+                _warned_uninitialized = True
+                logger.warning(
+                    'jax is imported but its backend is not initialized '
+                    'yet; shard defaults are OFF for this reader. On a '
+                    'multi-host pod, call jax.distributed.initialize() (or '
+                    'pass cur_shard/shard_count explicitly) BEFORE building '
+                    'readers, or every host will read the full dataset.')
             return None, None
         return jax.process_index(), jax.process_count()
     except Exception:  # noqa: BLE001 - private API drift or init failure

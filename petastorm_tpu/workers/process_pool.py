@@ -168,6 +168,8 @@ class ProcessPool:
             if self._error is not None:
                 raise self._error
             if not self._results_socket.poll(_POLL_INTERVAL_MS):
+                if self._stop_event.is_set():
+                    raise EmptyResultError()
                 if (self._ventilated_items == self._processed_items
                         and (self._ventilator is None or self._ventilator.completed())):
                     raise EmptyResultError()

@@ -92,6 +92,10 @@ class ThreadPool:
             try:
                 result = self._results_queue.get(timeout=_POLL_INTERVAL_S)
             except queue.Empty:
+                if self._stop_event.is_set():
+                    # After stop() in-flight counters can never reconcile;
+                    # a drained queue means no result will ever arrive.
+                    raise EmptyResultError()
                 with self._counter_lock:
                     all_done = (self._ventilated_items == self._processed_items)
                 if all_done and (self._ventilator is None or self._ventilator.completed()):

@@ -82,13 +82,18 @@ class ConcurrentVentilator(Ventilator):
     # -- lifecycle ----------------------------------------------------------
 
     def start(self):
-        if self._thread is not None:
-            raise RuntimeError('Ventilator already started')
-        if not self._items:
-            self._completed = True
-            return
-        self._thread = threading.Thread(target=self._run, daemon=True)
-        self._thread.start()
+        with self._cv:
+            if self._thread is not None:
+                raise RuntimeError('Ventilator already started')
+            if not self._items:
+                self._completed = True
+                return
+            if self._stop_requested:
+                return
+            # Created AND started under the lock so stop() can never observe
+            # a thread object that is not yet joinable.
+            self._thread = threading.Thread(target=self._run, daemon=True)
+            self._thread.start()
 
     def processed_item(self):
         with self._cv:
@@ -102,9 +107,11 @@ class ConcurrentVentilator(Ventilator):
         with self._cv:
             self._stop_requested = True
             self._cv.notify_all()
-        if self._thread is not None:
-            self._thread.join()
-            self._thread = None
+            thread = self._thread
+        if thread is not None:
+            thread.join()
+            with self._cv:
+                self._thread = None
 
     def reset(self):
         """Restart ventilation for the originally requested epoch count.
