@@ -13,12 +13,13 @@ surface, and touching an accelerator here could wedge on a busy chip.
 """
 
 import json
+import os
 import shutil
 import sys
 import tempfile
 import time
 
-sys.path.insert(0, '.')
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 BASELINE_SAMPLES_PER_SEC = 709.84  # reference: docs/benchmarks_tutorial.rst:20
 

@@ -148,12 +148,15 @@ def transformer_forward(params, tokens, config):
 
 def _constrain(x):
     """Keep activations data-parallel on the batch axis when running under a
-    mesh; outside a mesh context this is a no-op."""
-    try:
-        return jax.lax.with_sharding_constraint(
-            x, P(DATA_AXIS, *([None] * (x.ndim - 1))))
-    except (ValueError, NameError, RuntimeError):
+    mesh; outside a mesh context this is a no-op. The no-mesh case is
+    detected explicitly — a real constraint failure must surface, not
+    silently drop the sharding."""
+    from jax._src import mesh as _mesh_lib
+    physical = _mesh_lib.thread_resources.env.physical_mesh
+    if physical.empty or DATA_AXIS not in physical.axis_names:
         return x
+    return jax.lax.with_sharding_constraint(
+        x, P(DATA_AXIS, *([None] * (x.ndim - 1))))
 
 
 def transformer_loss(params, tokens, config):
@@ -168,12 +171,13 @@ def transformer_loss(params, tokens, config):
 def transformer_train_step(config, optimizer):
     """Jittable ``(params, opt_state, tokens) -> (params, opt_state, loss)``."""
 
+    import optax
+
     @partial(jax.jit, static_argnums=())
     def step(params, opt_state, tokens):
         loss, grads = jax.value_and_grad(transformer_loss)(params, tokens,
                                                            config)
         updates, opt_state = optimizer.update(grads, opt_state, params)
-        return jax.tree_util.tree_map(
-            lambda p, u: p + u, params, updates), opt_state, loss
+        return optax.apply_updates(params, updates), opt_state, loss
 
     return step

@@ -1,0 +1,248 @@
+"""PyTorch bridge: petastorm_tpu readers → torch tensor batches.
+
+Re-design of ``petastorm/pytorch.py``. The torch-specific parts keep parity
+— dtype sanitization (``pytorch.py:41-71``), Decimal-tolerant collation
+(``:74-101``), a row ``DataLoader`` and a faster ``BatchedDataLoader`` with
+optional in-memory epoch replay (``:259-407``) — but both loaders sit on the
+framework's shared column-major shuffling buffers (:mod:`petastorm_tpu.buffers`)
+and convert numpy → torch zero-copy at the boundary, instead of maintaining a
+separate torch-tensor buffer implementation.
+"""
+
+import collections.abc
+import decimal
+
+import numpy as np
+import torch
+
+from petastorm_tpu.buffers import (
+    BatchedNoopShufflingBuffer, BatchedRandomShufflingBuffer,
+    NoopShufflingBuffer, RandomShufflingBuffer,
+)
+
+_STRING_MESSAGE = (
+    'Field %r is a string/decimal and has no torch representation; project '
+    'it away (schema_fields/TransformSpec) or convert it in a TransformSpec')
+
+# numpy dtypes torch cannot hold → nearest widening torch-compatible dtype
+# (reference: ``pytorch.py:41-71``).
+_TORCH_PROMOTIONS = {
+    np.dtype(np.uint16): np.int32,
+    np.dtype(np.uint32): np.int64,
+    np.dtype(np.uint64): np.int64,
+}
+
+
+def _sanitize_pytorch_types(row_as_dict):
+    """In-place dtype promotion for values torch rejects; None and strings
+    raise (the reference's contract, ``pytorch.py:65-71``)."""
+    for name, value in row_as_dict.items():
+        if value is None:
+            raise TypeError('Field %r is None: nullable fields must be '
+                            'filled or filtered before torch collation' % name)
+        if isinstance(value, np.ndarray):
+            if value.dtype in _TORCH_PROMOTIONS:
+                row_as_dict[name] = value.astype(_TORCH_PROMOTIONS[value.dtype])
+            elif value.dtype.kind in 'US':
+                raise TypeError(_STRING_MESSAGE % name)
+        elif isinstance(value, np.generic):
+            dt = np.dtype(value.dtype)
+            if dt in _TORCH_PROMOTIONS:
+                row_as_dict[name] = np.asarray(
+                    value, dtype=_TORCH_PROMOTIONS[dt])
+            elif dt.kind in 'US':
+                raise TypeError(_STRING_MESSAGE % name)
+        elif isinstance(value, str):
+            raise TypeError(_STRING_MESSAGE % name)
+
+
+def decimal_friendly_collate(batch):
+    """``torch.utils.data.default_collate`` that passes Decimals through as
+    lists (reference: ``pytorch.py:74-101``)."""
+    if isinstance(batch[0], decimal.Decimal):
+        return list(batch)
+    if isinstance(batch[0], collections.abc.Mapping):
+        return {key: decimal_friendly_collate([d[key] for d in batch])
+                for key in batch[0]}
+    if isinstance(batch[0], tuple) and hasattr(batch[0], '_fields'):
+        return type(batch[0])(*(decimal_friendly_collate(samples)
+                                for samples in zip(*batch)))
+    if isinstance(batch[0], collections.abc.Sequence) and \
+            not isinstance(batch[0], (str, bytes)):
+        return [decimal_friendly_collate(samples)
+                for samples in zip(*batch)]
+    return torch.utils.data.default_collate(batch)
+
+
+class LoaderBase:
+    """Iteration state machine shared by both loaders: a loader is an
+    iterable that restarts its reader on re-iteration (reference:
+    ``pytorch.py:104-129``)."""
+
+    def __init__(self, reader):
+        self.reader = reader
+        self._in_iter = None
+
+    def __iter__(self):
+        if self._in_iter is not None and self._in_iter:
+            raise RuntimeError('Loader is already being iterated')
+        if self._in_iter is not None:
+            self._on_reiterate()
+        self._in_iter = True
+        try:
+            yield from self._iter_impl()
+        finally:
+            self._in_iter = False
+
+    def _on_reiterate(self):
+        self.reader.reset()
+
+    def __len__(self):
+        raise TypeError('Loader length is data-dependent and unknown')
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.reader.stop()
+        self.reader.join()
+
+    def stop(self):
+        self.reader.stop()
+
+    def join(self):
+        self.reader.join()
+
+
+class DataLoader(LoaderBase):
+    """Row-at-a-time loader: rows from ``make_reader`` → collated batches.
+
+    :param reader: a row reader (``make_reader``).
+    :param batch_size: rows per emitted batch.
+    :param collate_fn: batch-of-dicts → tensors
+        (default :func:`decimal_friendly_collate`).
+    :param shuffling_queue_capacity: >0 enables a row-level
+        :class:`RandomShufflingBuffer` of that capacity.
+    """
+
+    def __init__(self, reader, batch_size=1,
+                 collate_fn=decimal_friendly_collate,
+                 shuffling_queue_capacity=0, seed=None):
+        super().__init__(reader)
+        self.batch_size = batch_size
+        self.collate_fn = collate_fn
+        self.shuffling_queue_capacity = shuffling_queue_capacity
+        self._seed = seed
+
+    def _make_buffer(self):
+        if self.shuffling_queue_capacity > 0:
+            return RandomShufflingBuffer(
+                self.shuffling_queue_capacity,
+                min_after_retrieve=self.shuffling_queue_capacity // 2,
+                seed=self._seed)
+        return NoopShufflingBuffer()
+
+    def _iter_impl(self):
+        buf = self._make_buffer()
+        acc = []
+        for row in self.reader:
+            row_dict = row._asdict()
+            _sanitize_pytorch_types(row_dict)
+            buf.add_many([row_dict])
+            while buf.can_retrieve:
+                acc.append(buf.retrieve())
+                if len(acc) == self.batch_size:
+                    yield self.collate_fn(acc)
+                    acc = []
+        buf.finish()
+        while buf.can_retrieve:
+            acc.append(buf.retrieve())
+            if len(acc) == self.batch_size:
+                yield self.collate_fn(acc)
+                acc = []
+        if acc:
+            yield self.collate_fn(acc)
+
+
+class BatchedDataLoader(LoaderBase):
+    """Column-batch loader: ``make_batch_reader`` row-groups → fixed-size
+    torch batches with no per-row python work (reference qualitative claim:
+    'significantly higher throughput', ``README.rst:240``).
+
+    :param transform_fn: ``{name: np.ndarray} → {name: tensor}`` applied per
+        emitted batch (default: zero-copy ``torch.as_tensor`` per column).
+    :param inmemory_cache_all: buffer the whole first epoch in RAM and replay
+        it (reshuffled per epoch when shuffling is on) for later epochs —
+        the reader is read exactly once (reference: ``pytorch.py:344-407``).
+    """
+
+    def __init__(self, reader, batch_size=1, transform_fn=None,
+                 shuffling_queue_capacity=0, seed=None,
+                 inmemory_cache_all=False, keep_fields=None):
+        super().__init__(reader)
+        self.batch_size = batch_size
+        self.shuffling_queue_capacity = shuffling_queue_capacity
+        self._seed = seed
+        self._inmemory_cache_all = inmemory_cache_all
+        self._cache = [] if inmemory_cache_all else None
+        self._cache_complete = False
+        self._keep_fields = keep_fields
+        self._epoch = 0
+        self.transform_fn = transform_fn or self._default_transform
+
+    def _on_reiterate(self):
+        # Replay epochs come from the RAM cache; only touch the reader while
+        # it is still the data source.
+        if not self._cache_complete:
+            self.reader.reset()
+
+    @staticmethod
+    def _default_transform(columns):
+        return {name: torch.as_tensor(arr) for name, arr in columns.items()}
+
+    def _make_buffer(self, epoch):
+        seed = None if self._seed is None else self._seed + epoch
+        if self.shuffling_queue_capacity > 0:
+            return BatchedRandomShufflingBuffer(
+                self.shuffling_queue_capacity,
+                min_after_retrieve=self.shuffling_queue_capacity // 2,
+                batch_size=self.batch_size,
+                extra_capacity=self.shuffling_queue_capacity, seed=seed)
+        return BatchedNoopShufflingBuffer(self.batch_size)
+
+    def _column_chunks(self):
+        """Chunks from the reader (first epoch) or the RAM cache (replay)."""
+        if self._cache_complete:
+            for chunk in self._cache:
+                yield chunk
+            return
+        for batch in self.reader:
+            columns = batch._asdict()
+            if self._keep_fields is not None:
+                keep = set(self._keep_fields)
+                columns = {k: v for k, v in columns.items() if k in keep}
+            for name, arr in columns.items():
+                if isinstance(arr, np.ndarray) and arr.dtype in _TORCH_PROMOTIONS:
+                    columns[name] = arr.astype(_TORCH_PROMOTIONS[arr.dtype])
+                elif isinstance(arr, np.ndarray) and arr.dtype.kind in 'USO':
+                    raise TypeError(_STRING_MESSAGE % name)
+            if self._cache is not None:
+                self._cache.append(columns)
+            yield columns
+        if self._cache is not None:
+            self._cache_complete = True
+
+    def _iter_impl(self):
+        if self._cache is not None and not self._cache_complete:
+            # A partial cache from an interrupted first epoch would replay
+            # duplicated rows; every reader-fed pass rebuilds it from scratch.
+            self._cache = []
+        buf = self._make_buffer(self._epoch)
+        for columns in self._column_chunks():
+            buf.add_many(columns)
+            while buf.can_retrieve:
+                yield self.transform_fn(buf.retrieve())
+        buf.finish()
+        while buf.can_retrieve:
+            yield self.transform_fn(buf.retrieve())
+        self._epoch += 1

@@ -1,0 +1,174 @@
+"""PyTorch bridge tests (reference: ``tests/test_pytorch_dataloader.py``,
+``test_pytorch_utils.py``)."""
+
+from decimal import Decimal
+
+import numpy as np
+import pytest
+import torch
+
+from petastorm_tpu.pytorch import (
+    BatchedDataLoader, DataLoader, _sanitize_pytorch_types,
+    decimal_friendly_collate,
+)
+from petastorm_tpu.reader import make_batch_reader, make_reader
+
+
+class TestSanitize:
+    def test_promotions(self):
+        row = {'a': np.arange(3, dtype=np.uint16),
+               'b': np.arange(3, dtype=np.uint32),
+               'c': np.uint16(7),
+               'd': np.arange(3, dtype=np.float32)}
+        _sanitize_pytorch_types(row)
+        assert row['a'].dtype == np.int32
+        assert row['b'].dtype == np.int64
+        assert np.asarray(row['c']).dtype == np.int64 or \
+            np.asarray(row['c']).dtype == np.int32
+        assert row['d'].dtype == np.float32
+
+    def test_string_rejected(self):
+        with pytest.raises(TypeError, match='no torch representation'):
+            _sanitize_pytorch_types({'s': 'hello'})
+        with pytest.raises(TypeError, match='no torch representation'):
+            _sanitize_pytorch_types({'s': np.array(['a', 'b'])})
+
+    def test_none_rejected(self):
+        with pytest.raises(TypeError, match='None'):
+            _sanitize_pytorch_types({'x': None})
+
+
+class TestCollate:
+    def test_decimals_pass_through(self):
+        out = decimal_friendly_collate([Decimal('1.5'), Decimal('2.5')])
+        assert out == [Decimal('1.5'), Decimal('2.5')]
+
+    def test_dict_with_decimal(self):
+        out = decimal_friendly_collate([
+            {'d': Decimal('1'), 'x': np.float32(1.0)},
+            {'d': Decimal('2'), 'x': np.float32(2.0)},
+        ])
+        assert out['d'] == [Decimal('1'), Decimal('2')]
+        assert torch.is_tensor(out['x']) and out['x'].shape == (2,)
+
+
+_FIELDS = ['^id$', '^id2$', '^matrix_uint16$']
+
+
+class TestDataLoader:
+    def test_batches(self, synthetic_dataset):
+        reader = make_reader(synthetic_dataset.url, schema_fields=_FIELDS,
+                             shuffle_row_groups=False, num_epochs=1)
+        with DataLoader(reader, batch_size=8) as loader:
+            batches = list(loader)
+        # 100 rows → 12 full + 1 partial
+        assert [len(b['id']) for b in batches] == [8] * 12 + [4]
+        assert torch.is_tensor(batches[0]['matrix_uint16'])
+        assert batches[0]['matrix_uint16'].shape == (8, 2, 3)
+        ids = torch.cat([b['id'] for b in batches])
+        assert sorted(ids.tolist()) == list(range(100))
+
+    def test_shuffling_buffer(self, synthetic_dataset):
+        reader = make_reader(synthetic_dataset.url, schema_fields=['^id$'],
+                             shuffle_row_groups=False, num_epochs=1)
+        with DataLoader(reader, batch_size=10,
+                        shuffling_queue_capacity=50, seed=1) as loader:
+            ids = torch.cat([b['id'] for b in loader]).tolist()
+        assert sorted(ids) == list(range(100))
+        assert ids != list(range(100))
+
+    def test_reiteration_resets(self, synthetic_dataset):
+        reader = make_reader(synthetic_dataset.url, schema_fields=['^id$'],
+                             shuffle_row_groups=False, num_epochs=1)
+        with DataLoader(reader, batch_size=25) as loader:
+            first = [b['id'] for b in loader]
+            second = [b['id'] for b in loader]
+        assert len(first) == len(second) == 4
+
+    def test_nested_iteration_rejected(self, synthetic_dataset):
+        reader = make_reader(synthetic_dataset.url, schema_fields=['^id$'],
+                             num_epochs=1)
+        with DataLoader(reader, batch_size=10) as loader:
+            it = iter(loader)
+            next(it)
+            with pytest.raises(RuntimeError, match='already being iterated'):
+                next(iter(loader))
+
+
+class TestBatchedDataLoader:
+    def test_fixed_batches(self, scalar_dataset):
+        reader = make_batch_reader(scalar_dataset.url,
+                                   schema_fields=['^id$', '^float64$'],
+                                   shuffle_row_groups=False, num_epochs=1)
+        with BatchedDataLoader(reader, batch_size=16) as loader:
+            batches = list(loader)
+        assert [len(b['id']) for b in batches] == [16] * 6 + [4]
+        assert torch.is_tensor(batches[0]['float64'])
+        ids = torch.cat([b['id'] for b in batches])
+        assert sorted(ids.tolist()) == list(range(100))
+
+    def test_shuffled_exactly_once(self, scalar_dataset):
+        reader = make_batch_reader(scalar_dataset.url,
+                                   schema_fields=['^id$'],
+                                   shuffle_row_groups=False, num_epochs=1)
+        with BatchedDataLoader(reader, batch_size=10,
+                               shuffling_queue_capacity=64, seed=5) as loader:
+            ids = torch.cat([b['id'] for b in loader]).tolist()
+        assert sorted(ids) == list(range(100))
+        assert ids != list(range(100))
+
+    def test_string_field_rejected(self, scalar_dataset):
+        reader = make_batch_reader(scalar_dataset.url,
+                                   schema_fields=['^id$', '^string$'],
+                                   num_epochs=1)
+        with BatchedDataLoader(reader, batch_size=10) as loader:
+            with pytest.raises(TypeError, match='no torch representation'):
+                list(loader)
+
+    def test_keep_fields(self, scalar_dataset):
+        reader = make_batch_reader(scalar_dataset.url,
+                                   shuffle_row_groups=False, num_epochs=1)
+        with BatchedDataLoader(reader, batch_size=10,
+                               keep_fields=['id', 'float64']) as loader:
+            batch = next(iter(loader))
+        assert set(batch) == {'id', 'float64'}
+
+    def test_inmemory_cache_replay(self, scalar_dataset):
+        reader = make_batch_reader(scalar_dataset.url,
+                                   schema_fields=['^id$'],
+                                   shuffle_row_groups=False, num_epochs=1)
+        with BatchedDataLoader(reader, batch_size=20,
+                               inmemory_cache_all=True) as loader:
+            first = torch.cat([b['id'] for b in loader]).tolist()
+            # second epoch must come from RAM (reader is exhausted and
+            # deliberately NOT reset)
+            second = torch.cat([b['id'] for b in loader]).tolist()
+            third = torch.cat([b['id'] for b in loader]).tolist()
+        assert sorted(first) == list(range(100))
+        assert second == first and third == first
+
+    def test_inmemory_cache_reshuffles_epochs(self, scalar_dataset):
+        reader = make_batch_reader(scalar_dataset.url,
+                                   schema_fields=['^id$'],
+                                   shuffle_row_groups=False, num_epochs=1)
+        with BatchedDataLoader(reader, batch_size=20,
+                               shuffling_queue_capacity=128, seed=0,
+                               inmemory_cache_all=True) as loader:
+            first = torch.cat([b['id'] for b in loader]).tolist()
+            second = torch.cat([b['id'] for b in loader]).tolist()
+        assert sorted(first) == sorted(second) == list(range(100))
+        assert first != second  # per-epoch reshuffle from the cache
+
+    def test_transform_fn(self, scalar_dataset):
+        reader = make_batch_reader(scalar_dataset.url,
+                                   schema_fields=['^float64$'],
+                                   num_epochs=1)
+
+        def to_half(columns):
+            return {k: torch.as_tensor(v).to(torch.float16)
+                    for k, v in columns.items()}
+
+        with BatchedDataLoader(reader, batch_size=10,
+                               transform_fn=to_half) as loader:
+            batch = next(iter(loader))
+        assert batch['float64'].dtype == torch.float16

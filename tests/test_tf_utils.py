@@ -1,0 +1,94 @@
+"""TF bridge tests (reference: ``tests/test_tf_utils.py``,
+``test_tf_dataset.py``)."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip('tensorflow')
+
+from petastorm_tpu.ngram import NGram  # noqa: E402
+from petastorm_tpu.reader import make_batch_reader, make_reader  # noqa: E402
+from petastorm_tpu.tf_utils import make_petastorm_dataset, tf_tensors  # noqa: E402
+
+_FIELDS = ['^id$', '^image_png$', '^decimal$', '^matrix_uint16$']
+
+
+def test_row_dataset(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, schema_fields=_FIELDS,
+                     shuffle_row_groups=False, num_epochs=1) as reader:
+        dataset = make_petastorm_dataset(reader)
+        rows = list(dataset.take(5))
+    expected = {r['id']: r for r in synthetic_dataset.data}
+    for row in rows:
+        rid = int(row.id)
+        np.testing.assert_array_equal(np.asarray(row.image_png),
+                                      expected[rid]['image_png'])
+        # uint16 promoted to int32, decimal to string
+        assert row.matrix_uint16.dtype == tf.int32
+        assert row.decimal.dtype == tf.string
+        assert row.decimal.numpy().decode() == str(expected[rid]['decimal'])
+
+
+def test_row_dataset_static_shapes(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, schema_fields=['^image_png$'],
+                     num_epochs=1) as reader:
+        dataset = make_petastorm_dataset(reader)
+        spec = dataset.element_spec
+    assert tuple(spec.image_png.shape) == (16, 32, 3)
+
+
+def test_batch_dataset_scalar_store(scalar_dataset):
+    with make_batch_reader(scalar_dataset.url, shuffle_row_groups=False,
+                           num_epochs=1) as reader:
+        dataset = make_petastorm_dataset(reader)
+        ids, strings, stamps = [], [], []
+        for el in dataset:
+            ids.extend(el.id.numpy().tolist())
+            strings.extend(s.decode() for s in el.string.numpy())
+            stamps.extend(el.timestamp.numpy().tolist())
+    assert sorted(ids) == list(range(100))
+    assert 'hello_0' in strings
+    # datetimes land as int64 nanoseconds
+    assert all(isinstance(s, int) for s in stamps)
+
+
+def test_rebatching(scalar_dataset):
+    with make_batch_reader(scalar_dataset.url, shuffle_row_groups=False,
+                           num_epochs=1) as reader:
+        dataset = make_petastorm_dataset(reader).unbatch().batch(
+            16, drop_remainder=True)
+        sizes = [len(el.id) for el in dataset]
+    assert sizes == [16] * 6
+
+
+def test_ngram_dataset(synthetic_dataset):
+    ngram = NGram(fields={0: ['^id$'], 1: ['^id$', '^sensor_name$']},
+                  delta_threshold=1, timestamp_field='^id$')
+    with make_reader(synthetic_dataset.url, ngram=ngram,
+                     shuffle_row_groups=False, num_epochs=1) as reader:
+        dataset = make_petastorm_dataset(reader)
+        windows = list(dataset.take(4))
+    for w in windows:
+        assert set(w.keys()) == {0, 1}
+        assert int(w[1].id) == int(w[0].id) + 1
+        assert not hasattr(w[0], 'sensor_name')
+
+
+def test_tf_tensors_shim(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, schema_fields=['^id$'],
+                     shuffle_row_groups=False, num_epochs=1) as reader:
+        row = tf_tensors(reader)
+    assert int(row.id) in range(100)
+
+
+def test_training_loop_consumes_dataset(scalar_dataset):
+    """A tiny keras regression fit over the bridge (smoke)."""
+    with make_batch_reader(scalar_dataset.url, shuffle_row_groups=False,
+                           num_epochs=1) as reader:
+        dataset = (make_petastorm_dataset(reader)
+                   .map(lambda el: (tf.cast(el.id, tf.float32)[:, None],
+                                    tf.cast(el.float64, tf.float32)))
+                   .unbatch().batch(25))
+        model = tf.keras.Sequential([tf.keras.layers.Dense(1)])
+        model.compile(optimizer='sgd', loss='mse')
+        model.fit(dataset, epochs=1, verbose=0)
