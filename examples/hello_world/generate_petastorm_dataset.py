@@ -1,0 +1,48 @@
+"""Hello-world dataset generation (reference:
+``examples/hello_world/petastorm_dataset/generate_petastorm_dataset.py``):
+materialize a tiny 3-field schema (scalar + ndarray + png image) —
+Spark-free, via :class:`DatasetWriter`."""
+
+import argparse
+
+import numpy as np
+import pyarrow as pa
+
+from petastorm_tpu.codecs import (
+    CompressedImageCodec, NdarrayCodec, ScalarCodec,
+)
+from petastorm_tpu.etl.dataset_metadata import write_dataset
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+HelloWorldSchema = Unischema('HelloWorldSchema', [
+    UnischemaField('id', np.int32, (), ScalarCodec(pa.int32()), False),
+    UnischemaField('image1', np.uint8, (128, 256, 3),
+                   CompressedImageCodec('png'), False),
+    UnischemaField('array_4d', np.uint8, (None, 128, 30, None),
+                   NdarrayCodec(), False),
+])
+
+
+def row_generator(x):
+    """Returns a single entry in the generated dataset."""
+    rng = np.random.RandomState(x)
+    return {'id': x,
+            'image1': rng.randint(0, 255, dtype=np.uint8,
+                                  size=(128, 256, 3)),
+            'array_4d': rng.randint(0, 255, dtype=np.uint8,
+                                    size=(4, 128, 30, 3))}
+
+
+def generate_petastorm_dataset(output_url='file:///tmp/hello_world_dataset',
+                               num_rows=10):
+    rows = [row_generator(i) for i in range(num_rows)]
+    write_dataset(output_url, HelloWorldSchema, rows, rowgroup_size_rows=10)
+    print('Dataset written to %s' % output_url)
+
+
+if __name__ == '__main__':
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--output-url',
+                        default='file:///tmp/hello_world_dataset')
+    args = parser.parse_args()
+    generate_petastorm_dataset(args.output_url)

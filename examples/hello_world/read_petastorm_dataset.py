@@ -1,0 +1,50 @@
+"""Hello-world read paths (reference:
+``examples/hello_world/petastorm_dataset/python_hello_world.py`` +
+tf/pytorch variants), all four consumers."""
+
+import argparse
+
+
+def python_hello_world(dataset_url):
+    from petastorm_tpu import make_reader
+    with make_reader(dataset_url) as reader:
+        for row in reader:
+            print(row.id, row.image1.shape, row.array_4d.shape)
+            break
+
+
+def jax_hello_world(dataset_url):
+    from petastorm_tpu.jax import make_jax_loader
+    with make_jax_loader(dataset_url, batch_size=4, fields=['^id$'],
+                         last_batch='short') as loader:
+        batch = next(iter(loader))
+        print('jax ids:', batch['id'])
+
+
+def torch_hello_world(dataset_url):
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.pytorch import DataLoader
+    with DataLoader(make_reader(dataset_url, schema_fields=['^id$']),
+                    batch_size=4) as loader:
+        print('torch ids:', next(iter(loader))['id'])
+
+
+def tf_hello_world(dataset_url):
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.tf_utils import make_petastorm_dataset
+    with make_reader(dataset_url, schema_fields=['^id$']) as reader:
+        dataset = make_petastorm_dataset(reader)
+        for element in dataset.take(1):
+            print('tf id:', int(element.id))
+
+
+if __name__ == '__main__':
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--dataset-url',
+                        default='file:///tmp/hello_world_dataset')
+    parser.add_argument('--consumer', default='python',
+                        choices=['python', 'jax', 'torch', 'tf'])
+    args = parser.parse_args()
+    {'python': python_hello_world, 'jax': jax_hello_world,
+     'torch': torch_hello_world, 'tf': tf_hello_world}[args.consumer](
+        args.dataset_url)

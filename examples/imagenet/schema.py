@@ -1,0 +1,15 @@
+"""ImageNet schema (reference: ``examples/imagenet/schema.py:21``):
+variable-size jpeg/png images + noun id/text labels."""
+
+import numpy as np
+import pyarrow as pa
+
+from petastorm_tpu.codecs import CompressedImageCodec, ScalarCodec
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+ImagenetSchema = Unischema('ImagenetSchema', [
+    UnischemaField('noun_id', np.str_, (), ScalarCodec(pa.string()), False),
+    UnischemaField('text', np.str_, (), ScalarCodec(pa.string()), False),
+    UnischemaField('image', np.uint8, (None, None, 3),
+                   CompressedImageCodec('png'), False),
+])

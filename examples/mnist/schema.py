@@ -1,0 +1,13 @@
+"""MNIST schema (reference: ``examples/mnist/schema.py:21``)."""
+
+import numpy as np
+import pyarrow as pa
+
+from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+MnistSchema = Unischema('MnistSchema', [
+    UnischemaField('idx', np.int64, (), ScalarCodec(pa.int64()), False),
+    UnischemaField('digit', np.int64, (), ScalarCodec(pa.int64()), False),
+    UnischemaField('image', np.uint8, (28, 28), NdarrayCodec(), False),
+])

@@ -1,0 +1,5 @@
+"""Throughput benchmark suite (reference: ``petastorm/benchmark/``)."""
+
+from petastorm_tpu.benchmark.throughput import (  # noqa: F401
+    BenchmarkResult, reader_throughput,
+)

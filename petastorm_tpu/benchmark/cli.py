@@ -1,0 +1,50 @@
+"""Throughput CLI (reference: ``petastorm/benchmark/cli.py:30-107``).
+
+Usage: ``python -m petastorm_tpu.benchmark.cli file:///path/to/dataset``
+"""
+
+import argparse
+import logging
+import sys
+
+
+def build_parser():
+    parser = argparse.ArgumentParser(
+        description='petastorm_tpu reader throughput benchmark')
+    parser.add_argument('dataset_url', help='file:// or remote dataset URL')
+    parser.add_argument('--field-regex', nargs='+', default=None,
+                        help='regex patterns selecting fields to read')
+    parser.add_argument('-w', '--warmup-cycles', type=int, default=200)
+    parser.add_argument('-m', '--measure-cycles', type=int, default=1000)
+    parser.add_argument('-p', '--pool-type', default='thread',
+                        choices=['thread', 'process', 'dummy'])
+    parser.add_argument('-l', '--loaders-count', type=int, default=3)
+    parser.add_argument('-r', '--read-method', default='python',
+                        choices=['python', 'batch', 'jax'])
+    parser.add_argument('--batch-size', type=int, default=128,
+                        help="batch size for read-method 'jax'")
+    parser.add_argument('--no-shuffle', action='store_true')
+    parser.add_argument('--spawn-new-process', action='store_true',
+                        help='measure in a fresh process for clean RSS')
+    parser.add_argument('-v', '--verbose', action='store_true')
+    return parser
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    if args.verbose:
+        logging.basicConfig(level=logging.DEBUG)
+    from petastorm_tpu.benchmark.throughput import reader_throughput
+    result = reader_throughput(
+        args.dataset_url, field_regex=args.field_regex,
+        warmup_cycles=args.warmup_cycles, measure_cycles=args.measure_cycles,
+        pool_type=args.pool_type, loaders_count=args.loaders_count,
+        read_method=args.read_method, batch_size=args.batch_size,
+        shuffle_row_groups=not args.no_shuffle,
+        spawn_new_process=args.spawn_new_process)
+    print(result)
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

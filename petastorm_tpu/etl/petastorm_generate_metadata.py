@@ -1,0 +1,67 @@
+"""Attach/regenerate petastorm_tpu metadata on an existing Parquet store.
+
+Reference: ``petastorm/etl/petastorm_generate_metadata.py:47-161`` — used
+when a dataset was produced without :func:`materialize_dataset` (plain
+pyarrow/Spark write), or its ``_common_metadata`` was lost. The schema comes
+from (in priority order): an explicit ``--unischema-class`` (full qualified
+name, located via pydoc), the existing footer, or arrow-schema inference.
+
+Usage::
+
+    python -m petastorm_tpu.etl.petastorm_generate_metadata \
+        file:///path/to/dataset [--unischema-class mypkg.MySchema]
+"""
+
+import argparse
+import logging
+import sys
+
+logger = logging.getLogger(__name__)
+
+
+def generate_petastorm_metadata(dataset_url, unischema_class=None,
+                                storage_options=None):
+    """Write schema JSON + row-group counts into ``_common_metadata``."""
+    from pydoc import locate
+
+    from petastorm_tpu.errors import MetadataError
+    from petastorm_tpu.etl.dataset_metadata import (
+        ParquetDatasetInfo, _write_dataset_footer, get_schema,
+        infer_or_load_unischema,
+    )
+    from petastorm_tpu.unischema import Unischema
+
+    info = ParquetDatasetInfo(dataset_url, storage_options)
+    if unischema_class:
+        schema = locate(unischema_class)
+        if not isinstance(schema, Unischema):
+            raise ValueError('%r does not resolve to a Unischema instance'
+                             % unischema_class)
+    else:
+        try:
+            schema = get_schema(info)
+            logger.info('Regenerating metadata from the existing footer schema')
+        except MetadataError:
+            schema = infer_or_load_unischema(info)
+            logger.info('No stored schema found; inferred one from the '
+                        'arrow schema (codec-less fields)')
+    _write_dataset_footer(dataset_url, schema, storage_options)
+    return schema
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('dataset_url')
+    parser.add_argument('--unischema-class', default=None,
+                        help='full qualified name of a Unischema instance, '
+                             'e.g. examples.mnist.schema.MnistSchema')
+    parser.add_argument('-v', '--verbose', action='store_true')
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.DEBUG if args.verbose else logging.INFO)
+    generate_petastorm_metadata(args.dataset_url,
+                                unischema_class=args.unischema_class)
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -45,9 +45,16 @@ def get_filesystem_and_path_or_paths(url_or_urls, storage_options=None):
     parsed = [urlparse(u) for u in urls]
     if len({(p.scheme, p.netloc) for p in parsed}) != 1:
         raise ValueError('All dataset URLs must share scheme and netloc: %r' % urls)
-    fs, path0 = fsspec.core.url_to_fs(urls[0], **(storage_options or {}))
-    paths = [path0] + [fsspec.core.url_to_fs(u, **(storage_options or {}))[1]
-                       for u in urls[1:]]
+    if parsed[0].scheme == 'hdfs':
+        # HA nameservice expansion + namenode failover
+        from petastorm_tpu.hdfs import connect_hdfs_url
+        fs, path0 = connect_hdfs_url(urls[0],
+                                     storage_options=storage_options)
+        paths = [path0] + [urlparse(u).path for u in urls[1:]]
+    else:
+        fs, path0 = fsspec.core.url_to_fs(urls[0], **(storage_options or {}))
+        paths = [path0] + [fsspec.core.url_to_fs(u, **(storage_options or {}))[1]
+                           for u in urls[1:]]
     if isinstance(url_or_urls, list):
         return fs, paths
     return fs, paths[0]

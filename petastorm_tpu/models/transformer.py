@@ -146,17 +146,30 @@ def transformer_forward(params, tokens, config):
                       preferred_element_type=jnp.float32)
 
 
+# Mesh detection uses a private jax module; resolve it ONCE at import so an
+# API move degrades visibly here (module keeps working, constraint falls
+# back to try/except) instead of erroring on the forward-pass hot path.
+try:
+    from jax._src.mesh import thread_resources as _thread_resources
+except Exception:  # noqa: BLE001 - private API moved
+    _thread_resources = None
+
+
 def _constrain(x):
     """Keep activations data-parallel on the batch axis when running under a
     mesh; outside a mesh context this is a no-op. The no-mesh case is
-    detected explicitly — a real constraint failure must surface, not
-    silently drop the sharding."""
-    from jax._src import mesh as _mesh_lib
-    physical = _mesh_lib.thread_resources.env.physical_mesh
-    if physical.empty or DATA_AXIS not in physical.axis_names:
+    detected explicitly where possible — a real constraint failure must
+    surface, not silently drop the sharding."""
+    spec = P(DATA_AXIS, *([None] * (x.ndim - 1)))
+    if _thread_resources is not None:
+        physical = _thread_resources.env.physical_mesh
+        if physical.empty or DATA_AXIS not in physical.axis_names:
+            return x
+        return jax.lax.with_sharding_constraint(x, spec)
+    try:
+        return jax.lax.with_sharding_constraint(x, spec)
+    except ValueError:  # no ambient mesh
         return x
-    return jax.lax.with_sharding_constraint(
-        x, P(DATA_AXIS, *([None] * (x.ndim - 1))))
 
 
 def transformer_loss(params, tokens, config):

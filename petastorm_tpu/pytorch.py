@@ -133,17 +133,22 @@ class DataLoader(LoaderBase):
         self.collate_fn = collate_fn
         self.shuffling_queue_capacity = shuffling_queue_capacity
         self._seed = seed
+        self._epoch = 0
 
     def _make_buffer(self):
         if self.shuffling_queue_capacity > 0:
+            # seed offset by epoch: a constant seed would replay the same
+            # "random" order every epoch
+            seed = None if self._seed is None else self._seed + self._epoch
             return RandomShufflingBuffer(
                 self.shuffling_queue_capacity,
                 min_after_retrieve=self.shuffling_queue_capacity // 2,
-                seed=self._seed)
+                seed=seed)
         return NoopShufflingBuffer()
 
     def _iter_impl(self):
         buf = self._make_buffer()
+        self._epoch += 1
         acc = []
         for row in self.reader:
             row_dict = row._asdict()
@@ -211,10 +216,16 @@ class BatchedDataLoader(LoaderBase):
         return BatchedNoopShufflingBuffer(self.batch_size)
 
     def _column_chunks(self):
-        """Chunks from the reader (first epoch) or the RAM cache (replay)."""
+        """Chunks from the reader (first epoch) or the RAM cache (replay).
+
+        Cached arrays are defensively copied in both directions: the default
+        transform is zero-copy ``torch.as_tensor``, so without copies an
+        in-place tensor op (``batch['x'] -= mean``) would silently rewrite
+        the RAM cache and corrupt every later epoch.
+        """
         if self._cache_complete:
             for chunk in self._cache:
-                yield chunk
+                yield {k: v.copy() for k, v in chunk.items()}
             return
         for batch in self.reader:
             columns = batch._asdict()
@@ -227,7 +238,7 @@ class BatchedDataLoader(LoaderBase):
                 elif isinstance(arr, np.ndarray) and arr.dtype.kind in 'USO':
                     raise TypeError(_STRING_MESSAGE % name)
             if self._cache is not None:
-                self._cache.append(columns)
+                self._cache.append({k: v.copy() for k, v in columns.items()})
             yield columns
         if self._cache is not None:
             self._cache_complete = True

@@ -1,0 +1,7 @@
+"""DataFrame → cached-Parquet → loader converters
+(reference: ``petastorm/spark/``)."""
+
+from petastorm_tpu.spark.spark_dataset_converter import (  # noqa: F401
+    DatasetConverter, SparkDatasetConverter, make_dataframe_converter,
+    make_spark_converter,
+)

@@ -1,0 +1,50 @@
+"""Spark RDD helper (reference: ``petastorm/spark_utils.py:23-52``).
+
+Gated on pyspark: builds an RDD of decoded namedtuple rows from a
+materialized dataset — the decode happens on Spark executors.
+"""
+
+
+def dataset_as_rdd(dataset_url, spark_session, schema_fields=None,
+                   storage_options=None):
+    """RDD over a petastorm_tpu dataset, one decoded namedtuple per row."""
+    try:
+        import pyspark  # noqa: F401
+    except ImportError as e:
+        raise ImportError('dataset_as_rdd requires pyspark') from e
+
+    from petastorm_tpu.etl.dataset_metadata import (
+        ParquetDatasetInfo, infer_or_load_unischema, load_row_groups,
+    )
+
+    info = ParquetDatasetInfo(dataset_url, storage_options)
+    schema = infer_or_load_unischema(info)
+    view = schema.create_schema_view(schema_fields) if schema_fields else schema
+    pieces = list(range(len(load_row_groups(info))))
+
+    def read_piece(piece_index):
+        from petastorm_tpu.arrow_worker import RowGroupWorker
+        rows = []
+
+        class _Collect:
+            def __call__(self, batch):
+                for i in range(batch.length):
+                    rows.append(view.make_namedtuple(**batch.row(i)))
+
+        local_info = ParquetDatasetInfo(dataset_url, storage_options)
+        worker = RowGroupWorker(0, _Collect(), {
+            'dataset_info': local_info,
+            'schema': view,
+            'loaded_schema': view,
+            'stored_schema': schema,
+            'transform_spec': None,
+            'cache': None,
+            'ngram': None,
+            'row_groups': load_row_groups(local_info),
+        })
+        worker.process(piece_index)
+        worker.shutdown()
+        return rows
+
+    rdd = spark_session.sparkContext.parallelize(pieces, len(pieces))
+    return rdd.flatMap(read_piece)

@@ -1,0 +1,4 @@
+"""Test/consumer utilities (reference: ``petastorm/test_util/``)."""
+
+from petastorm_tpu.test_util.reader_mock import ReaderMock  # noqa: F401
+from petastorm_tpu.test_util.generator import generate_datapoint  # noqa: F401

@@ -1,0 +1,56 @@
+"""Random datapoint generation from a Unischema
+(reference: ``petastorm/generator.py:21-47``)."""
+
+from decimal import Decimal
+
+import numpy as np
+
+
+def generate_datapoint(schema, rng=None):
+    """One random row dict matching the schema (wildcard dims drawn in
+    [1, 4]; nullable fields are non-null)."""
+    rng = rng or np.random.RandomState()
+    row = {}
+    for field in schema:
+        row[field.name] = _random_value(field, rng)
+    return row
+
+
+def _random_value(field, rng):
+    np_dtype = field.numpy_dtype
+    shape = tuple(d if d is not None else int(rng.randint(1, 5))
+                  for d in field.shape)
+    if np_dtype is Decimal:
+        return Decimal('%d.%02d' % (rng.randint(0, 100), rng.randint(0, 100)))
+    if np_dtype in (np.str_, str):
+        if shape:
+            return np.array([_rand_str(rng) for _ in range(int(np.prod(shape)))],
+                            dtype=np.str_).reshape(shape)
+        return _rand_str(rng)
+    if np_dtype in (np.bytes_, bytes):
+        if shape:
+            return np.array([_rand_str(rng).encode() for _ in range(int(np.prod(shape)))],
+                            dtype=np.bytes_).reshape(shape)
+        return _rand_str(rng).encode()
+    dtype = np.dtype(np_dtype)
+    if dtype.kind == 'b':
+        values = rng.randint(0, 2, shape or ()).astype(bool)
+    elif dtype.kind in 'iu':
+        info = np.iinfo(dtype)
+        values = rng.randint(max(info.min, -1000), min(info.max, 1000),
+                             shape or ()).astype(dtype)
+    elif dtype.kind == 'f':
+        values = rng.rand(*shape).astype(dtype) if shape \
+            else dtype.type(rng.rand())
+    elif dtype.kind == 'M':
+        values = (np.datetime64('2020-01-01')
+                  + np.timedelta64(int(rng.randint(0, 1000)), 'D'))
+    else:
+        raise ValueError('Cannot generate a value for dtype %r' % dtype)
+    if shape == () and isinstance(values, np.ndarray):
+        return values[()]
+    return values
+
+
+def _rand_str(rng):
+    return ''.join(chr(rng.randint(97, 123)) for _ in range(8))

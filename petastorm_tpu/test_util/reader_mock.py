@@ -1,0 +1,76 @@
+"""ReaderMock: a schema-driven fake reader with no I/O
+(reference: ``petastorm/test_util/reader_mock.py:19-82``). Useful for
+testing consumers (loaders, bridges) without a dataset on disk."""
+
+import numpy as np
+
+from petastorm_tpu.test_util.generator import generate_datapoint
+
+
+def schema_data_generator_example(schema, rng):
+    """Default data generator: random values per field."""
+    return generate_datapoint(schema, rng)
+
+
+class ReaderMock:
+    """Infinite iterator of synthetic rows (namedtuples) for a schema.
+
+    :param schema: a :class:`Unischema`.
+    :param schema_data_generator: ``(schema, rng) -> row_dict`` override.
+    """
+
+    def __init__(self, schema, schema_data_generator=None, seed=0,
+                 batched_output=False, batch_size=16):
+        self.schema = schema
+        self.ngram = None
+        self.batched_output = batched_output
+        self.last_row_consumed = False
+        self._batch_size = batch_size
+        self._gen = schema_data_generator or schema_data_generator_example
+        self._rng = np.random.RandomState(seed)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if not self.batched_output:
+            return self.schema.make_namedtuple(**self._gen(self.schema,
+                                                           self._rng))
+        rows = [self._gen(self.schema, self._rng)
+                for _ in range(self._batch_size)]
+        columns = {}
+        for name in self.schema.fields:
+            values = [r[name] for r in rows]
+            first = values[0]
+            if isinstance(first, np.ndarray):
+                columns[name] = (np.stack(values)
+                                 if all(v.shape == first.shape for v in values)
+                                 else _object_array(values))
+            else:
+                columns[name] = np.asarray(values)
+        return self.schema.make_namedtuple(**columns)
+
+    def next(self):
+        return self.__next__()
+
+    def reset(self):
+        pass
+
+    def stop(self):
+        pass
+
+    def join(self):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        pass
+
+
+def _object_array(values):
+    out = np.empty(len(values), dtype=object)
+    for i, v in enumerate(values):
+        out[i] = v
+    return out

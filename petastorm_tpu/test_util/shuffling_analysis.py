@@ -1,0 +1,40 @@
+"""Shuffle-quality analysis (reference:
+``petastorm/test_util/shuffling_analysis.py:30-85``): quantify how well a
+reader decorrelates row order by correlating the emitted id stream against
+the unshuffled order."""
+
+import numpy as np
+
+from petastorm_tpu.codecs import ScalarCodec
+from petastorm_tpu.etl.dataset_metadata import write_dataset
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+
+def generate_shuffle_analysis_dataset(url, num_rows=1000, rowgroup_size=100):
+    """Sequential-id dataset for shuffle analysis."""
+    import pyarrow as pa
+    schema = Unischema('ShuffleAnalysisSchema', [
+        UnischemaField('id', np.int64, (), ScalarCodec(pa.int64()), False),
+    ])
+    rows = [{'id': i} for i in range(num_rows)]
+    write_dataset(url, schema, rows, rowgroup_size_rows=rowgroup_size,
+                  num_files=max(1, num_rows // (rowgroup_size * 4)))
+    return schema
+
+
+def compute_correlation_distribution(url, num_runs=5, reader_factory=None,
+                                     **reader_kwargs):
+    """Mean |Pearson correlation| between each run's emitted id order and
+    the sorted order: ~1 = unshuffled, ~0 = well shuffled."""
+    from petastorm_tpu.reader import make_reader
+    factory = reader_factory or make_reader
+    correlations = []
+    for run in range(num_runs):
+        kwargs = dict(reader_kwargs)
+        kwargs.setdefault('num_epochs', 1)
+        kwargs['seed'] = run
+        with factory(url, **kwargs) as reader:
+            ids = np.asarray([row.id for row in reader])
+        expected = np.arange(len(ids))
+        correlations.append(abs(float(np.corrcoef(ids, expected)[0, 1])))
+    return float(np.mean(correlations))

@@ -61,7 +61,20 @@ def _sanitize_field_tf_types(value):
     return value
 
 
+def _guard_not_exhausted(reader):
+    """No-repeat guard (reference: ``tf_utils.py:367-373``): re-invoking the
+    generator on an exhausted reader would silently yield an empty pass —
+    ``dataset.repeat()`` would then spin forever."""
+    if getattr(reader, 'last_row_consumed', False):
+        raise RuntimeError(
+            'Multiple iterations over make_petastorm_dataset are not '
+            'supported: the underlying reader is exhausted. Use '
+            'num_epochs=None (or a larger num_epochs) on the reader instead '
+            'of dataset.repeat()/re-iteration.')
+
+
 def _row_generator(reader, field_names):
+    _guard_not_exhausted(reader)
     for row in reader:
         row_dict = row._asdict()
         yield tuple(_sanitize_field_tf_types(row_dict[name])
@@ -69,6 +82,7 @@ def _row_generator(reader, field_names):
 
 
 def _batch_generator(reader, field_names):
+    _guard_not_exhausted(reader)
     for batch in reader:
         columns = batch._asdict()
         yield tuple(np.asarray([_sanitize_field_tf_types(v)
@@ -133,6 +147,7 @@ def _make_ngram_dataset(tf, reader):
         for _, f in flat_fields)
 
     def gen():
+        _guard_not_exhausted(reader)
         for window in reader:
             out = []
             for k, field in flat_fields:
@@ -176,5 +191,17 @@ def tf_tensors(reader, shuffling_queue_capacity=0, min_after_dequeue=0):
             dataset = dataset.shuffle(shuffling_queue_capacity,
                                       reshuffle_each_iteration=True)
             del min_after_dequeue  # folded into dataset.shuffle semantics
-        _TF_TENSOR_ITERATORS[reader] = iter(dataset)
-    return next(_TF_TENSOR_ITERATORS[reader])
+        _TF_TENSOR_ITERATORS[reader] = (iter(dataset),
+                                        shuffling_queue_capacity)
+    iterator, cached_capacity = _TF_TENSOR_ITERATORS[reader]
+    if cached_capacity != shuffling_queue_capacity:
+        raise ValueError(
+            'tf_tensors was already called on this reader with '
+            'shuffling_queue_capacity=%d; later calls cannot change it'
+            % cached_capacity)
+    try:
+        return next(iterator)
+    except StopIteration:
+        raise RuntimeError(
+            'tf_tensors: the underlying reader is exhausted (num_epochs '
+            'reached); use num_epochs=None for an endless stream') from None

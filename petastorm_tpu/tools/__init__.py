@@ -1,0 +1,1 @@
+"""Dataset maintenance CLIs (reference: ``petastorm/tools/``)."""

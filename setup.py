@@ -1,0 +1,41 @@
+"""Packaging (reference: ``petastorm/setup.py``).
+
+Console scripts mirror the reference's three CLIs (``setup.py:91-97``) under
+tpu-suffixed names; extras gate the optional consumer stacks.
+"""
+
+from setuptools import find_packages, setup
+
+setup(
+    name='petastorm-tpu',
+    version='0.1.0',
+    description='TPU-native Parquet data access library for deep learning',
+    packages=find_packages(exclude=('tests', 'tests.*', 'examples',
+                                    'examples.*')),
+    python_requires='>=3.9',
+    install_requires=[
+        'numpy',
+        'pyarrow>=4.0.0',
+        'fsspec',
+        'pandas',
+        'dill',
+        'psutil',
+        'pyzmq',
+    ],
+    extras_require={
+        'jax': ['jax', 'flax', 'optax'],
+        'tf': ['tensorflow'],
+        'torch': ['torch'],
+        'opencv': ['opencv-python'],
+        'test': ['pytest'],
+    },
+    entry_points={
+        'console_scripts': [
+            'petastorm-tpu-throughput = petastorm_tpu.benchmark.cli:main',
+            'petastorm-tpu-copy-dataset = petastorm_tpu.tools.copy_dataset:main',
+            'petastorm-tpu-generate-metadata = '
+            'petastorm_tpu.etl.petastorm_generate_metadata:main',
+            'petastorm-tpu-metadata-util = petastorm_tpu.etl.metadata_util:main',
+        ],
+    },
+)

@@ -1,0 +1,166 @@
+"""DataFrame converter, test_util, and examples tests."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from petastorm_tpu.spark import make_dataframe_converter
+from petastorm_tpu.test_util import ReaderMock, generate_datapoint
+from petastorm_tpu.test_util.shuffling_analysis import (
+    compute_correlation_distribution, generate_shuffle_analysis_dataset,
+)
+
+from tests.test_common import TestSchema
+
+
+def _df(n=100):
+    return pd.DataFrame({'id': np.arange(n),
+                         'value': np.arange(n) * 0.5,
+                         'label': np.arange(n) % 3})
+
+
+class TestDataFrameConverter:
+    def test_materialize_and_read(self, tmp_path):
+        converter = make_dataframe_converter(
+            _df(), 'file://' + str(tmp_path / 'cache'))
+        assert len(converter) == 100
+        from petastorm_tpu.reader import make_batch_reader
+        with make_batch_reader(converter.cache_dir_url) as reader:
+            ids = [i for b in reader for i in b.id]
+        assert sorted(ids) == list(range(100))
+        converter.delete()
+
+    def test_cache_hit_same_content(self, tmp_path):
+        parent = 'file://' + str(tmp_path / 'cache')
+        c1 = make_dataframe_converter(_df(), parent)
+        c2 = make_dataframe_converter(_df(), parent)
+        assert c1 is c2
+        c3 = make_dataframe_converter(_df(50), parent)
+        assert c3 is not c1
+        c1.delete()
+        c3.delete()
+
+    def test_torch_loader(self, tmp_path):
+        import torch
+        converter = make_dataframe_converter(
+            _df(), 'file://' + str(tmp_path / 'cache_t'))
+        with converter.make_torch_dataloader(batch_size=25) as loader:
+            sizes = [len(b['id']) for b in loader]
+        assert sizes == [25, 25, 25, 25]
+        converter.delete()
+
+    def test_tf_dataset(self, tmp_path):
+        tf = pytest.importorskip('tensorflow')
+        converter = make_dataframe_converter(
+            _df(), 'file://' + str(tmp_path / 'cache_tf'))
+        with converter.make_tf_dataset(batch_size=20) as dataset:
+            n = sum(len(el.id) for el in dataset)
+        assert n == 100
+        converter.delete()
+
+    def test_jax_loader(self, tmp_path):
+        converter = make_dataframe_converter(
+            _df(), 'file://' + str(tmp_path / 'cache_j'))
+        with converter.make_jax_loader(batch_size=20) as loader:
+            n = sum(len(b['id']) for b in loader)
+        assert n == 100
+        converter.delete()
+
+    def test_delete_removes_files(self, tmp_path):
+        import os
+        converter = make_dataframe_converter(
+            _df(), 'file://' + str(tmp_path / 'cache_d'))
+        path = converter.cache_dir_url[len('file://'):]
+        assert os.path.exists(path)
+        converter.delete()
+        assert not os.path.exists(path)
+
+    def test_spark_converter_gated(self):
+        from petastorm_tpu.spark import make_spark_converter
+        with pytest.raises(ImportError, match='pyspark'):
+            make_spark_converter(object())
+
+
+class TestTestUtil:
+    def test_generate_datapoint_matches_schema(self):
+        rng = np.random.RandomState(0)
+        row = generate_datapoint(TestSchema, rng)
+        assert set(row) == set(TestSchema.fields)
+        assert row['image_png'].shape == (16, 32, 3)
+        assert row['matrix'].dtype == np.float32
+        # wildcard dims drawn as concrete
+        assert row['matrix_nullable'].shape[1] == 14
+
+    def test_reader_mock_rows(self):
+        with ReaderMock(TestSchema, seed=1) as reader:
+            rows = [next(reader) for _ in range(5)]
+        assert all(hasattr(r, 'image_png') for r in rows)
+        assert rows[0].image_png.shape == (16, 32, 3)
+
+    def test_reader_mock_batched(self):
+        with ReaderMock(TestSchema, seed=1, batched_output=True,
+                        batch_size=4) as reader:
+            batch = next(reader)
+        assert batch.image_png.shape == (4, 16, 32, 3)
+
+    def test_reader_mock_feeds_torch_loader(self):
+        from petastorm_tpu.pytorch import DataLoader
+        from petastorm_tpu.unischema import Unischema, UnischemaField
+        schema = Unischema('S', [
+            UnischemaField('x', np.float32, (3,), None, False),
+        ])
+        with DataLoader(ReaderMock(schema, seed=0), batch_size=4) as loader:
+            batch = next(iter(loader))
+        assert batch['x'].shape == (4, 3)
+
+    def test_shuffling_analysis(self, tmp_path):
+        url = 'file://' + str(tmp_path / 'shuffle_ds')
+        generate_shuffle_analysis_dataset(url, num_rows=400,
+                                          rowgroup_size=50)
+        # single worker: pool completion order must not perturb the baseline
+        corr_unshuffled = compute_correlation_distribution(
+            url, num_runs=2, shuffle_row_groups=False, workers_count=1)
+        corr_shuffled = compute_correlation_distribution(
+            url, num_runs=2, shuffle_row_groups=True,
+            shuffle_row_drop_partitions=2)
+        assert corr_unshuffled > 0.95
+        assert corr_shuffled < corr_unshuffled
+
+
+class TestExamples:
+    def test_hello_world_roundtrip(self, tmp_path):
+        from examples.hello_world.generate_petastorm_dataset import (
+            generate_petastorm_dataset,
+        )
+        from petastorm_tpu import make_reader
+        url = 'file://' + str(tmp_path / 'hello')
+        generate_petastorm_dataset(url, num_rows=4)
+        with make_reader(url, shuffle_row_groups=False) as reader:
+            rows = list(reader)
+        assert len(rows) == 4
+        assert rows[0].image1.shape == (128, 256, 3)
+        assert rows[0].array_4d.shape[1:3] == (128, 30)
+
+    def test_mnist_training_learns(self, tmp_path):
+        from examples.mnist.jax_example import (
+            generate_synthetic_mnist, train,
+        )
+        url = 'file://' + str(tmp_path / 'mnist')
+        generate_synthetic_mnist(url, num_rows=512)
+        loss = train(url, batch_size=64, steps=12)
+        assert np.isfinite(loss)
+
+    def test_imagenet_schema_roundtrip(self, tmp_path):
+        from examples.imagenet.schema import ImagenetSchema
+        from petastorm_tpu import make_reader
+        from petastorm_tpu.etl.dataset_metadata import write_dataset
+        rng = np.random.RandomState(0)
+        rows = [{'noun_id': 'n%08d' % i, 'text': 'thing_%d' % i,
+                 'image': rng.randint(0, 255, (32 + i, 48, 3), np.uint8)}
+                for i in range(3)]
+        url = 'file://' + str(tmp_path / 'imagenet')
+        write_dataset(url, ImagenetSchema, rows, rowgroup_size_rows=4)
+        with make_reader(url, shuffle_row_groups=False) as reader:
+            got = sorted(list(reader), key=lambda r: r.noun_id)
+        for row, expected in zip(got, rows):
+            np.testing.assert_array_equal(row.image, expected['image'])

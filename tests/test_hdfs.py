@@ -1,0 +1,117 @@
+"""HDFS HA resolution/failover tests with mocked configuration — no real
+HDFS (reference strategy: ``petastorm/hdfs/tests/test_hdfs_namenode.py``)."""
+
+import os
+
+import pytest
+
+from petastorm_tpu.hdfs import (
+    HdfsConnectError, HdfsConnector, HdfsNamenodeResolver, connect_hdfs_url,
+)
+
+HC = {
+    'fs.defaultFS': 'hdfs://myns/',
+    'dfs.ha.namenodes.myns': 'nn1,nn2',
+    'dfs.namenode.rpc-address.myns.nn1': 'nn-a.example.com:8020',
+    'dfs.namenode.rpc-address.myns.nn2': 'nn-b.example.com:8020',
+}
+
+
+class TestResolver:
+    def test_nameservice_resolution(self):
+        r = HdfsNamenodeResolver(HC)
+        assert r.resolve_hdfs_name_service('myns') == [
+            'nn-a.example.com:8020', 'nn-b.example.com:8020']
+
+    def test_unknown_nameservice_returns_none(self):
+        assert HdfsNamenodeResolver(HC).resolve_hdfs_name_service('other') is None
+
+    def test_missing_rpc_address_raises(self):
+        broken = dict(HC)
+        del broken['dfs.namenode.rpc-address.myns.nn2']
+        with pytest.raises(HdfsConnectError, match='rpc-address'):
+            HdfsNamenodeResolver(broken).resolve_hdfs_name_service('myns')
+
+    def test_default_service(self):
+        ns, namenodes = HdfsNamenodeResolver(HC).resolve_default_hdfs_service()
+        assert ns == 'myns' and len(namenodes) == 2
+
+    def test_default_service_missing(self):
+        with pytest.raises(HdfsConnectError, match='defaultFS'):
+            HdfsNamenodeResolver({}).resolve_default_hdfs_service()
+
+    def test_site_xml_parsing(self, tmp_path, monkeypatch):
+        conf_dir = tmp_path / 'hadoop' / 'etc' / 'hadoop'
+        conf_dir.mkdir(parents=True)
+        (conf_dir / 'hdfs-site.xml').write_text(
+            '<configuration>'
+            '<property><name>dfs.ha.namenodes.x</name><value>a</value></property>'
+            '<property><name>dfs.namenode.rpc-address.x.a</name>'
+            '<value>h1:9000</value></property>'
+            '</configuration>')
+        (conf_dir / 'core-site.xml').write_text(
+            '<configuration><property><name>fs.defaultFS</name>'
+            '<value>hdfs://x/</value></property></configuration>')
+        monkeypatch.setenv('HADOOP_HOME', str(tmp_path / 'hadoop'))
+        for var in ('HADOOP_PREFIX', 'HADOOP_INSTALL'):
+            monkeypatch.delenv(var, raising=False)
+        r = HdfsNamenodeResolver()
+        assert r.resolve_default_hdfs_service() == ('x', ['h1:9000'])
+
+
+class _FakeFS:
+    def __init__(self, host, port):
+        self.host, self.port = host, port
+
+
+def _connector_fn(fail_hosts):
+    def connect(host, port, storage_options):
+        if host in fail_hosts:
+            raise ConnectionError('refused: %s' % host)
+        return _FakeFS(host, port)
+    return connect
+
+
+class TestConnector:
+    def test_first_namenode_wins(self):
+        fs = HdfsConnector.connect(['a:1', 'b:2'],
+                                   connect_fn=_connector_fn(set()))
+        assert (fs.host, fs.port) == ('a', 1)
+
+    def test_failover_to_second(self):
+        fs = HdfsConnector.connect(['a:1', 'b:2'],
+                                   connect_fn=_connector_fn({'a'}))
+        assert (fs.host, fs.port) == ('b', 2)
+
+    def test_all_fail_raises(self):
+        with pytest.raises(HdfsConnectError, match='any namenode'):
+            HdfsConnector.connect(['a:1', 'b:2'],
+                                  connect_fn=_connector_fn({'a', 'b'}))
+
+    def test_max_attempts_bounds_candidates(self):
+        with pytest.raises(HdfsConnectError):
+            HdfsConnector.connect(['a:1', 'b:2', 'c:3'],
+                                  connect_fn=_connector_fn({'a', 'b'}))
+
+
+class TestConnectUrl:
+    def test_nameservice_url(self):
+        fs, path = connect_hdfs_url('hdfs://myns/data/set', HC,
+                                    connect_fn=_connector_fn({'nn-a.example.com'}))
+        assert fs.host == 'nn-b.example.com'
+        assert path == '/data/set'
+
+    def test_direct_host_port(self):
+        fs, path = connect_hdfs_url('hdfs://host:9000/x', HC,
+                                    connect_fn=_connector_fn(set()))
+        assert (fs.host, fs.port) == ('host', 9000)
+
+    def test_default_fs(self):
+        fs, path = connect_hdfs_url('hdfs:///x', HC,
+                                    connect_fn=_connector_fn(set()))
+        assert fs.host == 'nn-a.example.com'
+
+    def test_plain_hostname_fallback(self):
+        fs, _ = connect_hdfs_url('hdfs://plainhost/x', HC,
+                                 connect_fn=_connector_fn(set()))
+        assert (fs.host, fs.port) == ('plainhost', 8020)

@@ -1,0 +1,97 @@
+"""Benchmark + CLI tool tests (reference: ``tests/test_benchmark.py``,
+copy_dataset/metadata_util coverage)."""
+
+import io
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.benchmark.throughput import reader_throughput
+from petastorm_tpu.etl.metadata_util import print_metadata
+from petastorm_tpu.etl.petastorm_generate_metadata import (
+    generate_petastorm_metadata,
+)
+from petastorm_tpu.reader import make_reader
+from petastorm_tpu.tools.copy_dataset import copy_dataset
+
+
+class TestThroughput:
+    def test_python_read_method(self, synthetic_dataset):
+        result = reader_throughput(synthetic_dataset.url,
+                                   field_regex=['^id$'], warmup_cycles=10,
+                                   measure_cycles=30, loaders_count=2)
+        assert result.samples == 30
+        assert result.samples_per_second > 0
+        assert result.memory_rss_mb > 0
+
+    def test_batch_read_method(self, scalar_dataset):
+        result = reader_throughput(scalar_dataset.url, warmup_cycles=10,
+                                   measure_cycles=50, read_method='batch',
+                                   loaders_count=2)
+        assert result.samples >= 50
+
+    def test_jax_read_method(self, scalar_dataset):
+        result = reader_throughput(scalar_dataset.url,
+                                   field_regex=['^id$', '^float64$'],
+                                   warmup_cycles=8, measure_cycles=32,
+                                   read_method='jax', batch_size=8,
+                                   loaders_count=2)
+        assert result.samples >= 32
+
+    def test_cli_smoke(self, synthetic_dataset, capsys):
+        from petastorm_tpu.benchmark.cli import main
+        assert main([synthetic_dataset.url, '--field-regex', '^id$',
+                     '-w', '5', '-m', '10', '-l', '2']) == 0
+        assert 'samples/sec' in capsys.readouterr().out
+
+
+class TestCopyDataset:
+    def test_full_copy(self, synthetic_dataset, tmp_path):
+        target = 'file://' + str(tmp_path / 'copy')
+        n = copy_dataset(synthetic_dataset.url, target,
+                         field_regex=['^id$', '^id2$', '^matrix_uint16$'])
+        assert n == 100
+        with make_reader(target, shuffle_row_groups=False) as reader:
+            rows = list(reader)
+        assert sorted(r.id for r in rows) == list(range(100))
+        assert set(rows[0]._fields) == {'id', 'id2', 'matrix_uint16'}
+        expected = {r['id']: r for r in synthetic_dataset.data}
+        for row in rows[:5]:
+            np.testing.assert_array_equal(row.matrix_uint16,
+                                          expected[row.id]['matrix_uint16'])
+
+    def test_not_null_filter(self, synthetic_dataset, tmp_path):
+        target = 'file://' + str(tmp_path / 'copy_nn')
+        n = copy_dataset(synthetic_dataset.url, target,
+                         field_regex=['^id$', '^matrix_nullable$'],
+                         not_null_fields=['matrix_nullable'])
+        # every 3rd row has a null matrix_nullable
+        expected = sum(1 for r in synthetic_dataset.data
+                       if r['matrix_nullable'] is not None)
+        assert n == expected
+
+
+class TestMetadataTools:
+    def test_print_metadata(self, synthetic_dataset):
+        out = io.StringIO()
+        print_metadata(synthetic_dataset.url, out=out)
+        text = out.getvalue()
+        assert 'Unischema: TestSchema' in text
+        assert 'image_png' in text
+        assert 'Row-groups:' in text
+
+    def test_generate_metadata_on_plain_store(self, scalar_dataset):
+        from petastorm_tpu.etl.dataset_metadata import (
+            ParquetDatasetInfo, get_schema,
+        )
+        schema = generate_petastorm_metadata(scalar_dataset.url)
+        stored = get_schema(ParquetDatasetInfo(scalar_dataset.url))
+        assert set(stored.fields) == set(schema.fields)
+
+    def test_generate_metadata_with_class(self, tmp_path):
+        from tests.test_common import TestSchema, create_test_dataset
+        url = 'file://' + str(tmp_path / 'regen')
+        create_test_dataset(url, range(10), num_files=1)
+        schema = generate_petastorm_metadata(
+            url, unischema_class='tests.test_common.TestSchema')
+        assert set(schema.fields) == set(TestSchema.fields)
