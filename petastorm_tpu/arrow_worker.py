@@ -268,8 +268,11 @@ class RowGroupWorker(WorkerBase):
     @staticmethod
     def _stack(items):
         """Stack per-row values: uniform ndarray shapes → one (n,)+shape array;
-        anything ragged/None-bearing → 1-d object array."""
-        if not items:
+        anything ragged/None-bearing → 1-d object array. A pre-stacked
+        contiguous batch (from the native decode path) passes through."""
+        if isinstance(items, np.ndarray) and items.dtype.kind not in 'OU':
+            return items
+        if not len(items):
             return np.empty(0, dtype=object)
         first = items[0]
         if isinstance(first, np.ndarray) and first.dtype.kind not in 'OU':

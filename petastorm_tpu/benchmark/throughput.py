@@ -149,7 +149,9 @@ def _run_in_subprocess(dataset_url, **kwargs):
         'pickle.dump(result, open(out, "wb"))\n')
     with tempfile.NamedTemporaryFile(suffix='.pkl') as kw_f, \
             tempfile.NamedTemporaryFile(suffix='.pkl') as out_f:
-        pickle.dump(kwargs, open(kw_f.name, 'wb'))
+        pickle.dump(kwargs, kw_f)
+        kw_f.flush()
         subprocess.check_call([sys.executable, '-c', code, dataset_url,
                                kw_f.name, out_f.name])
-        return pickle.load(open(out_f.name, 'rb'))
+        with open(out_f.name, 'rb') as result_f:
+            return pickle.load(result_f)

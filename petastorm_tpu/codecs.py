@@ -62,8 +62,15 @@ class DataframeColumnCodec(metaclass=ABCMeta):
 def decode_batch_with_nulls(unischema_field, values):
     """Batch-decode a column whose cells may be None (nullable fields): null
     cells bypass the codec and stay None, non-null cells go through the
-    codec's vectorized ``decode_batch``. Positions are preserved."""
+    codec's vectorized ``decode_batch``. Positions are preserved.
+
+    Returns either a list (one entry per cell, None preserved) or — on the
+    all-non-null fast path — whatever the codec's ``decode_batch`` returned,
+    which may be a contiguous ``(n,)+shape`` ndarray.
+    """
     non_null_idx = [i for i, v in enumerate(values) if v is not None]
+    if len(non_null_idx) == len(values):
+        return unischema_field.codec.decode_batch(unischema_field, values)
     decoded = unischema_field.codec.decode_batch(
         unischema_field, [values[i] for i in non_null_idx])
     out = [None] * len(values)
@@ -149,6 +156,36 @@ class NdarrayCodec(DataframeColumnCodec):
     def decode(self, unischema_field, encoded):
         arr = np.load(BytesIO(bytes(encoded)), allow_pickle=False)
         return arr
+
+    def decode_batch(self, unischema_field, encoded_iterable):
+        """Fixed-shape numeric fields take the native batched decoder (one C
+        call memcpy-ing all payloads into a preallocated ``(n,)+shape``
+        array); anything else — wildcard dims, strings, or cells the native
+        parser rejects — flows through the per-cell Python path."""
+        cells = list(encoded_iterable)
+        shape = unischema_field.shape
+        if not cells or not shape or any(d is None for d in shape):
+            return super().decode_batch(unischema_field, cells)
+        try:
+            dtype = np.dtype(unischema_field.numpy_dtype)
+        except TypeError:
+            return super().decode_batch(unischema_field, cells)
+        if dtype.kind not in 'iufb':
+            return super().decode_batch(unischema_field, cells)
+        from petastorm_tpu.native import get_native_module
+        native = get_native_module()
+        if native is None:
+            return super().decode_batch(unischema_field, cells)
+        out = np.empty((len(cells),) + shape, dtype=dtype)
+        done = native.decode_npy_batch(cells, out, dtype.str)
+        if done == len(cells):
+            # Return the contiguous batch itself: downstream collation
+            # (arrow_worker._stack) passes it through, avoiding a second
+            # full-batch copy via np.stack.
+            return out
+        rows = list(out[:done])
+        rows.extend(self.decode(unischema_field, c) for c in cells[done:])
+        return rows
 
     def arrow_type(self, unischema_field):
         return pa.binary()

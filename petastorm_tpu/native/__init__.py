@@ -1,0 +1,68 @@
+"""First-party native (C) components for the decode hot path.
+
+``decode_npy_batch`` is built lazily on first import (g++/cc via
+setuptools) and cached next to the source; any build or import failure
+degrades silently to the pure-Python decode path — the native layer is an
+accelerator, never a dependency.
+"""
+
+import logging
+import os
+import sysconfig
+
+logger = logging.getLogger(__name__)
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_native = None
+_build_attempted = False
+
+
+def _find_built_extension():
+    suffix = sysconfig.get_config_var('EXT_SUFFIX') or '.so'
+    path = os.path.join(_HERE, '_npy_batch' + suffix)
+    return path if os.path.exists(path) else None
+
+
+def _build_extension():
+    """One-shot in-tree build of the C extension."""
+    import subprocess
+    import sys
+    script = (
+        "import os\n"
+        "from setuptools import setup, Extension\n"
+        "import numpy as np\n"
+        "os.chdir(%r)\n"
+        "setup(name='_npy_batch', script_args=['build_ext', '--inplace'],\n"
+        "      ext_modules=[Extension('_npy_batch', ['npy_batch.c'],\n"
+        "                             include_dirs=[np.get_include()],\n"
+        "                             extra_compile_args=['-O3'])])\n"
+    ) % _HERE
+    subprocess.run([sys.executable, '-c', script], check=True,
+                   capture_output=True, timeout=120)
+
+
+def get_native_module():
+    """The compiled ``_npy_batch`` module, or None when unavailable."""
+    global _native, _build_attempted
+    if _native is not None:
+        return _native
+    if _build_attempted:
+        return None
+    _build_attempted = True
+    try:
+        if _find_built_extension() is None:
+            _build_extension()
+        import importlib.util
+        path = _find_built_extension()
+        if path is None:
+            return None
+        spec = importlib.util.spec_from_file_location('_npy_batch', path)
+        module = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(module)
+        _native = module
+        logger.debug('Native NPY batch decoder loaded from %s', path)
+    except Exception:  # noqa: BLE001 - native layer is best-effort
+        logger.info('Native NPY decoder unavailable; using the Python '
+                    'decode path', exc_info=True)
+        return None
+    return _native

@@ -204,9 +204,12 @@ def _table_fingerprint(table, parent_url):
     h.update(str(table.schema).encode('utf-8'))
     h.update(str(table.num_rows).encode('utf-8'))
     # hash FULL buffer content: a prefix would collide for tables that
-    # differ only in later rows and silently reuse a stale cached copy
+    # differ only in later rows and silently reuse a stale cached copy.
+    # The chunk offset/length must participate too: zero-copy slices of one
+    # parent share identical buffers and differ only in their view window.
     for column in table.columns:
         for chunk in column.chunks:
+            h.update(b'%d:%d;' % (chunk.offset, len(chunk)))
             for buf in chunk.buffers():
                 if buf is not None:
                     h.update(memoryview(buf))

@@ -40,8 +40,22 @@ class TestDataFrameConverter:
         c1.delete()
         c3.delete()
 
+    def test_zero_copy_slices_not_conflated(self, tmp_path):
+        import pyarrow as pa
+        table = pa.table({'id': list(range(100))})
+        parent = 'file://' + str(tmp_path / 'cache_s')
+        c1 = make_dataframe_converter(table.slice(0, 50), parent)
+        c2 = make_dataframe_converter(table.slice(50, 50), parent)
+        assert c1 is not c2
+        from petastorm_tpu.reader import make_batch_reader
+        with make_batch_reader(c2.cache_dir_url) as reader:
+            ids = sorted(i for b in reader for i in b.id)
+        assert ids == list(range(50, 100))
+        c1.delete()
+        c2.delete()
+
     def test_torch_loader(self, tmp_path):
-        import torch
+        pytest.importorskip('torch')
         converter = make_dataframe_converter(
             _df(), 'file://' + str(tmp_path / 'cache_t'))
         with converter.make_torch_dataloader(batch_size=25) as loader:

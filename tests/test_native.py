@@ -1,0 +1,101 @@
+"""Native batched NPY decoder tests (C extension, with Python fallback
+parity checks)."""
+
+from io import BytesIO
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.codecs import NdarrayCodec
+from petastorm_tpu.native import get_native_module
+from petastorm_tpu.unischema import UnischemaField
+
+
+def _npy(arr):
+    buf = BytesIO()
+    np.save(buf, arr, allow_pickle=False)
+    return buf.getvalue()
+
+
+@pytest.fixture(scope='module')
+def native():
+    module = get_native_module()
+    if module is None:
+        pytest.skip('native extension could not be built')
+    return module
+
+
+class TestNativeDecoder:
+    def test_roundtrip_matches_source(self, native):
+        rng = np.random.RandomState(0)
+        arrs = [rng.rand(4, 6).astype(np.float32) for _ in range(20)]
+        out = np.empty((20, 4, 6), np.float32)
+        assert native.decode_npy_batch([_npy(a) for a in arrs], out, '<f4') == 20
+        for i in range(20):
+            np.testing.assert_array_equal(out[i], arrs[i])
+
+    def test_dtype_variants(self, native):
+        for dtype in (np.int64, np.uint8, np.float64, np.bool_):
+            arr = (np.arange(12) % 2).astype(dtype).reshape(3, 4)
+            out = np.empty((1, 3, 4), dtype)
+            assert native.decode_npy_batch([_npy(arr)], out,
+                                           np.dtype(dtype).str) == 1
+            np.testing.assert_array_equal(out[0], arr)
+
+    def test_stops_at_none(self, native):
+        arr = np.ones((2, 2), np.float32)
+        out = np.empty((3, 2, 2), np.float32)
+        cells = [_npy(arr), None, _npy(arr)]
+        assert native.decode_npy_batch(cells, out, '<f4') == 1
+
+    def test_stops_at_wrong_shape(self, native):
+        good = np.ones((2, 2), np.float32)
+        bad = np.ones((3, 3), np.float32)
+        out = np.empty((2, 2, 2), np.float32)
+        assert native.decode_npy_batch([_npy(good), _npy(bad)], out, '<f4') == 1
+
+    def test_rejects_wrong_dtype(self, native):
+        arr = np.ones((2, 2), np.float64)
+        out = np.empty((1, 2, 2), np.float32)
+        assert native.decode_npy_batch([_npy(arr)], out, '<f4') == 0
+
+    def test_rejects_garbage(self, native):
+        out = np.empty((1, 2, 2), np.float32)
+        assert native.decode_npy_batch([b'not-an-npy'], out, '<f4') == 0
+
+    def test_rejects_fortran_order(self, native):
+        arr = np.asfortranarray(np.arange(6, dtype=np.float32).reshape(2, 3))
+        out = np.empty((1, 2, 3), np.float32)
+        # np.save of a fortran array records fortran_order True
+        assert native.decode_npy_batch([_npy(arr)], out, '<f4') == 0
+
+
+class TestCodecIntegration:
+    def test_codec_batch_equals_per_cell(self):
+        field = UnischemaField('m', np.float32, (5, 7), NdarrayCodec(), False)
+        codec = field.codec
+        rng = np.random.RandomState(1)
+        arrs = [rng.rand(5, 7).astype(np.float32) for _ in range(10)]
+        cells = [codec.encode(field, a) for a in arrs]
+        batch = codec.decode_batch(field, cells)
+        for got, expected in zip(batch, arrs):
+            np.testing.assert_array_equal(got, expected)
+
+    def test_codec_mixed_valid_cells_fall_back(self):
+        field = UnischemaField('m', np.float32, (2, 2), NdarrayCodec(), False)
+        codec = field.codec
+        a = np.ones((2, 2), np.float32)
+        # wildcard-free field but one cell is float64: full parity via fallback
+        weird = BytesIO()
+        np.save(weird, np.ones((2, 2), np.float64), allow_pickle=False)
+        batch = codec.decode_batch(field, [codec.encode(field, a),
+                                           weird.getvalue()])
+        np.testing.assert_array_equal(batch[0], a)
+        assert batch[1].dtype == np.float64
+
+    def test_wildcard_shape_uses_python_path(self):
+        field = UnischemaField('m', np.float32, (None, 3), NdarrayCodec(), False)
+        codec = field.codec
+        arrs = [np.ones((i + 1, 3), np.float32) for i in range(3)]
+        batch = codec.decode_batch(field, [codec.encode(field, a) for a in arrs])
+        assert [b.shape for b in batch] == [(1, 3), (2, 3), (3, 3)]
