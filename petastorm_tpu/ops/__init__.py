@@ -1,3 +1,4 @@
-"""TPU kernels (Pallas) for the hot data-path ops."""
+"""TPU kernels (Pallas) and collective ops for the hot paths."""
 
 from petastorm_tpu.ops.normalize import normalize_images  # noqa: F401
+from petastorm_tpu.ops.ring_attention import ring_attention  # noqa: F401

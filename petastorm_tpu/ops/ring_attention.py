@@ -1,0 +1,123 @@
+"""Ring attention: exact attention over sequences sharded across devices.
+
+Long-context support: when a sequence is too long for one chip's HBM, shard
+it over a mesh axis and compute attention in ``n_shards`` ring steps — each
+step combines the local query block with the currently-held key/value block
+using an online (flash-style) softmax accumulator, then rotates the KV block
+to the next device with ``lax.ppermute`` so compute overlaps the ICI
+transfer. Results are bit-for-bit the same attention as the unsharded
+computation (up to float reassociation).
+
+The reference framework has no model-side parallelism at all (SURVEY.md
+§2.2: sharding stops at row-group assignment); this op is part of the
+framework's TPU-native consumer layer, alongside the dp×tp transformer in
+:mod:`petastorm_tpu.models.transformer`.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+SEQ_AXIS = 'seq'
+
+
+def _online_block(carry, k_blk, v_blk, q, q_pos, kv_pos, causal, scale):
+    """Fold one KV block into the running (output, rowmax, denom) state."""
+    o, m, l = carry
+    # scores: (B, H, Sq, Skv) with f32 accumulation
+    scores = jnp.einsum('bqhd,bkhd->bhqk', q, k_blk,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        mask = q_pos[:, None] >= kv_pos[None, :]
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    m_new = jnp.maximum(m, scores.max(axis=-1))
+    # guard fully-masked rows: exp(-inf - -inf) otherwise
+    safe_m = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    p = jnp.exp(jnp.where(jnp.isneginf(scores), -jnp.inf,
+                          scores - safe_m[..., None]))
+    correction = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - safe_m))
+    l_new = l * correction + p.sum(axis=-1)
+    pv = jnp.einsum('bhqk,bkhd->bqhd', p, v_blk,
+                    preferred_element_type=jnp.float32)
+    o_new = o * correction.transpose(0, 2, 1)[..., None] + pv
+    return o_new, m_new, l_new
+
+
+def _ring_attention_local(q, k, v, axis_name, causal, scale):
+    """Per-device body (runs under shard_map): q/k/v are the LOCAL sequence
+    blocks of shape (B, S_local, H, D)."""
+    n_shards = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    b, s_local, h, _ = q.shape
+
+    q_pos = my_idx * s_local + jnp.arange(s_local)
+    perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
+
+    o = jnp.zeros(q.shape[:3] + (v.shape[-1],), jnp.float32)
+    m = jnp.full((b, h, s_local), -jnp.inf, jnp.float32)
+    l = jnp.zeros((b, h, s_local), jnp.float32)
+
+    def step(t, state):
+        o, m, l, k_blk, v_blk = state
+        kv_owner = (my_idx - t) % n_shards
+        kv_pos = kv_owner * s_local + jnp.arange(s_local)
+        o, m, l = _online_block((o, m, l), k_blk, v_blk, q, q_pos, kv_pos,
+                                causal, scale)
+        # rotate AFTER consuming: block from device j moves to j+1
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return o, m, l, k_blk, v_blk
+
+    o, m, l, _, _ = lax.fori_loop(0, n_shards, step, (o, m, l, k, v))
+    # fully-masked rows (causal, early positions with no visible keys) have
+    # l == 0; emit zeros for them
+    denom = jnp.where(l == 0.0, 1.0, l)
+    out = o / denom.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh, axis_name=SEQ_AXIS, causal=True,
+                   scale=None):
+    """Exact multi-head attention with the sequence axis sharded over
+    ``mesh[axis_name]``.
+
+    :param q, k, v: (B, S, H, D) arrays whose S axis is (or will be) sharded
+        over ``axis_name``; B/H/D are replicated on that axis.
+    :param causal: apply a causal mask over GLOBAL positions.
+    :param scale: score scale (default ``1/sqrt(D)``).
+    :return: (B, S, H, D) attention output, same sharding as ``q``.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    spec = P(None, axis_name, None, None)
+    body = functools.partial(_ring_attention_local, axis_name=axis_name,
+                             causal=causal, scale=scale)
+    try:
+        from jax import shard_map
+        fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    except (ImportError, TypeError):  # older jax: experimental API
+        from jax.experimental.shard_map import shard_map as _shard_map
+        fn = _shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                        out_specs=spec, check_rep=False)
+    return fn(q, k, v)
+
+
+def reference_attention(q, k, v, causal=True, scale=None):
+    """Unsharded attention with identical semantics (test oracle)."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum('bqhd,bkhd->bhqk', q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        s = q.shape[1]
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum('bhqk,bkhd->bqhd', probs, v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
