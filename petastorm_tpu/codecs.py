@@ -177,7 +177,13 @@ class NdarrayCodec(DataframeColumnCodec):
         if native is None:
             return super().decode_batch(unischema_field, cells)
         out = np.empty((len(cells),) + shape, dtype=dtype)
-        done = native.decode_npy_batch(cells, out, dtype.str)
+        # numpy's header writer emits the shape tuple with canonical repr
+        # spacing ("'shape': (2, 3)"), so an exact substring match rejects
+        # any cell whose true shape differs from the declared one even when
+        # the byte counts coincide (e.g. (3,2) vs (2,3)); rejected cells
+        # fall back to the Python path, which preserves the true shape.
+        shape_str = "'shape': %r" % (tuple(int(d) for d in shape),)
+        done = native.decode_npy_batch(cells, out, dtype.str, shape_str)
         if done == len(cells):
             # Return the contiguous batch itself: downstream collation
             # (arrow_worker._stack) passes it through, avoiding a second

@@ -122,6 +122,12 @@ class ParquetDatasetInfo:
     def __setstate__(self, state):
         self.__dict__.update(state)
         self._lock = threading.Lock()
+        # Pickle does not preserve identity of the module-level _UNSET
+        # sentinel, so the unpickled values would fail the `is _UNSET`
+        # checks and the lazy properties would return a meaningless _Unset
+        # instance. Re-point them at this process's sentinel.
+        self._common_metadata = _UNSET
+        self._metadata = _UNSET
 
     @staticmethod
     def _discover_files(fs, root):

@@ -164,6 +164,19 @@ class JaxLoader:
             except queue.Empty:
                 if self._stage_error is not None:
                     raise self._stage_error
+                # stop() may race an in-flight iteration: _put_blocking gives
+                # up on delivering _SENTINEL_END once the stop event is set,
+                # so a consumer blocked here would otherwise spin forever.
+                # Same if next() is called after stop(), or the stage thread
+                # died without managing to enqueue the sentinel.
+                if self._stop_event.is_set():
+                    self._exhausted = True
+                    raise StopIteration
+                if (self._stage_thread is not None
+                        and not self._stage_thread.is_alive()
+                        and self._out_queue.empty()):
+                    self._exhausted = True
+                    raise StopIteration
                 continue
             if item is _SENTINEL_END:
                 self._exhausted = True

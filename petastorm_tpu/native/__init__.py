@@ -18,13 +18,33 @@ _build_attempted = False
 
 
 def _find_built_extension():
+    """Path of a current compiled extension, or None.
+
+    A .so older than its C source is stale (the exported signature may have
+    changed) and is treated as absent so it gets rebuilt.
+    """
     suffix = sysconfig.get_config_var('EXT_SUFFIX') or '.so'
     path = os.path.join(_HERE, '_npy_batch' + suffix)
-    return path if os.path.exists(path) else None
+    if not os.path.exists(path):
+        return None
+    source = os.path.join(_HERE, 'npy_batch.c')
+    try:
+        if os.path.getmtime(path) < os.path.getmtime(source):
+            return None
+    except OSError:
+        # Source missing (pruned install): a .so with no source to compare
+        # against cannot be stale — use it.
+        pass
+    return path
 
 
 def _build_extension():
-    """One-shot in-tree build of the C extension."""
+    """One-shot in-tree build of the C extension.
+
+    Serialized via an exclusive flock so concurrently-spawned pool workers
+    hitting first decode don't race `build_ext --inplace` in the same
+    directory (a racing build can expose a partially-written .so).
+    """
     import subprocess
     import sys
     script = (
@@ -37,8 +57,19 @@ def _build_extension():
         "                             include_dirs=[np.get_include()],\n"
         "                             extra_compile_args=['-O3'])])\n"
     ) % _HERE
-    subprocess.run([sys.executable, '-c', script], check=True,
-                   capture_output=True, timeout=120)
+    lock_path = os.path.join(_HERE, '.build.lock')
+    with open(lock_path, 'w') as lock_file:
+        try:
+            import fcntl
+            fcntl.flock(lock_file.fileno(), fcntl.LOCK_EX)
+        except (ImportError, OSError):
+            # non-POSIX, or a filesystem without lock support (NFS ENOLCK):
+            # accept the (unlikely) build race rather than disable native
+            pass
+        # The winner of the lock builds; losers find a fresh .so here.
+        if _find_built_extension() is None:
+            subprocess.run([sys.executable, '-c', script], check=True,
+                           capture_output=True, timeout=120)
 
 
 def get_native_module():

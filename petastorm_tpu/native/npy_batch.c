@@ -52,11 +52,17 @@ parse_npy_header(const unsigned char *buf, Py_ssize_t len,
     return 0;
 }
 
-/* Verify the header's fortran_order is False and that its descr matches
- * `descr` (e.g. "<f4"); shape is validated by payload size. */
+/* Verify the header's fortran_order is False, that its descr matches
+ * `descr` (e.g. "<f4"), and that its literal shape entry matches
+ * `shape_str` (e.g. "'shape': (2, 3)" — numpy's canonical header repr).
+ * A stored cell whose true shape differs from the declared per-row shape
+ * but has an equal byte count must NOT be memcpy'd into the declared
+ * shape (silent data reinterpretation); shape mismatch routes the cell to
+ * the Python decode path, which preserves the true shape and lets
+ * collation surface the error. */
 static int
 header_compatible(const char *header, Py_ssize_t header_len,
-                  const char *descr)
+                  const char *descr, const char *shape_str)
 {
     /* fortran_order must be False: C-contiguous copy only */
     const char *fo = NULL;
@@ -83,6 +89,8 @@ header_compatible(const char *header, Py_ssize_t header_len,
                 ok = (strstr(tmp, needle) != NULL);
             }
         }
+        if (ok)
+            ok = (strstr(tmp, shape_str) != NULL);
         PyMem_Free(tmp);
         return ok;
     }
@@ -90,7 +98,8 @@ header_compatible(const char *header, Py_ssize_t header_len,
 
 /* decode_npy_batch(cells: sequence of bytes-like or None,
  *                  out: ndarray (n, ...) C-contiguous, writable,
- *                  descr: str like '<f4')
+ *                  descr: str like '<f4',
+ *                  shape_str: str like "'shape': (2, 3)")
  * Returns: number of successfully decoded leading cells. A cell that is
  * None or incompatible stops fast-path decoding at its index (caller
  * finishes those via the Python path). */
@@ -100,11 +109,13 @@ decode_npy_batch(PyObject *self, PyObject *args)
     PyObject *cells;
     PyArrayObject *out;
     const char *descr;
+    const char *shape_str;
     Py_ssize_t n, i;
     Py_ssize_t row_bytes;
     char *out_data;
 
-    if (!PyArg_ParseTuple(args, "OO!s", &cells, &PyArray_Type, &out, &descr))
+    if (!PyArg_ParseTuple(args, "OO!ss", &cells, &PyArray_Type, &out, &descr,
+                          &shape_str))
         return NULL;
     if (!PyArray_IS_C_CONTIGUOUS(out) || !PyArray_ISWRITEABLE(out)) {
         PyErr_SetString(PyExc_ValueError,
@@ -142,7 +153,7 @@ decode_npy_batch(PyObject *self, PyObject *args)
         }
         ok = (parse_npy_header((const unsigned char *)view.buf, view.len,
                                &data_offset, &header, &header_len) == 0)
-             && header_compatible(header, header_len, descr)
+             && header_compatible(header, header_len, descr, shape_str)
              && (view.len - data_offset == row_bytes);
         if (ok) {
             memcpy(out_data + i * row_bytes,

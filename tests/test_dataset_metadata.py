@@ -164,3 +164,16 @@ def test_read_legacy_footer_keys(tmp_path):
     pq.write_metadata(base_schema, str(tmp_path / 'ds' / '_common_metadata'))
     info = ParquetDatasetInfo(url)
     assert len(load_row_groups(info)) == 2
+
+
+def test_dataset_info_pickle_resets_lazy_sentinels(tmp_path):
+    # Pickle does not preserve identity of the module-level _UNSET sentinel;
+    # __setstate__ must re-point the lazy slots so common_metadata re-reads
+    # instead of returning a meaningless unpickled sentinel (ADVICE r1).
+    import pickle
+    url = 'file://' + str(tmp_path / 'ds')
+    write_dataset(url, _tiny_schema(), _tiny_rows(6), rowgroup_size_rows=3)
+    info = pickle.loads(pickle.dumps(ParquetDatasetInfo(url)))
+    meta = info.common_metadata
+    assert meta is not None and UNISCHEMA_KEY in dict(meta.metadata)
+    assert len(load_row_groups(info)) == 2

@@ -137,3 +137,15 @@ def test_single_pass_guard(scalar_dataset):
     with pytest.raises(RuntimeError, match='single iteration'):
         iter(loader)
     loader.stop()
+
+
+def test_next_after_stop_raises_stop_iteration(scalar_dataset):
+    # stop() racing an in-flight iteration can drop the end sentinel; next()
+    # must not busy-wait forever afterwards (ADVICE r1).
+    loader = make_jax_loader(scalar_dataset.url, batch_size=16, fields=['^id$'])
+    it = iter(loader)
+    next(it)
+    loader.stop()
+    with pytest.raises(StopIteration):
+        while True:
+            next(it)

@@ -17,6 +17,10 @@ def _npy(arr):
     return buf.getvalue()
 
 
+def _shape_str(out):
+    return "'shape': %r" % (out.shape[1:],)
+
+
 @pytest.fixture(scope='module')
 def native():
     module = get_native_module()
@@ -30,7 +34,7 @@ class TestNativeDecoder:
         rng = np.random.RandomState(0)
         arrs = [rng.rand(4, 6).astype(np.float32) for _ in range(20)]
         out = np.empty((20, 4, 6), np.float32)
-        assert native.decode_npy_batch([_npy(a) for a in arrs], out, '<f4') == 20
+        assert native.decode_npy_batch([_npy(a) for a in arrs], out, '<f4', _shape_str(out)) == 20
         for i in range(20):
             np.testing.assert_array_equal(out[i], arrs[i])
 
@@ -39,35 +43,48 @@ class TestNativeDecoder:
             arr = (np.arange(12) % 2).astype(dtype).reshape(3, 4)
             out = np.empty((1, 3, 4), dtype)
             assert native.decode_npy_batch([_npy(arr)], out,
-                                           np.dtype(dtype).str) == 1
+                                           np.dtype(dtype).str,
+                                           _shape_str(out)) == 1
             np.testing.assert_array_equal(out[0], arr)
 
     def test_stops_at_none(self, native):
         arr = np.ones((2, 2), np.float32)
         out = np.empty((3, 2, 2), np.float32)
         cells = [_npy(arr), None, _npy(arr)]
-        assert native.decode_npy_batch(cells, out, '<f4') == 1
+        assert native.decode_npy_batch(cells, out, '<f4', _shape_str(out)) == 1
 
     def test_stops_at_wrong_shape(self, native):
         good = np.ones((2, 2), np.float32)
         bad = np.ones((3, 3), np.float32)
         out = np.empty((2, 2, 2), np.float32)
-        assert native.decode_npy_batch([_npy(good), _npy(bad)], out, '<f4') == 1
+        assert native.decode_npy_batch([_npy(good), _npy(bad)], out, '<f4', _shape_str(out)) == 1
 
     def test_rejects_wrong_dtype(self, native):
         arr = np.ones((2, 2), np.float64)
         out = np.empty((1, 2, 2), np.float32)
-        assert native.decode_npy_batch([_npy(arr)], out, '<f4') == 0
+        assert native.decode_npy_batch([_npy(arr)], out, '<f4', _shape_str(out)) == 0
 
     def test_rejects_garbage(self, native):
         out = np.empty((1, 2, 2), np.float32)
-        assert native.decode_npy_batch([b'not-an-npy'], out, '<f4') == 0
+        assert native.decode_npy_batch([b'not-an-npy'], out, '<f4', _shape_str(out)) == 0
 
     def test_rejects_fortran_order(self, native):
         arr = np.asfortranarray(np.arange(6, dtype=np.float32).reshape(2, 3))
         out = np.empty((1, 2, 3), np.float32)
         # np.save of a fortran array records fortran_order True
-        assert native.decode_npy_batch([_npy(arr)], out, '<f4') == 0
+        assert native.decode_npy_batch([_npy(arr)], out, '<f4', _shape_str(out)) == 0
+
+    def test_rejects_transposed_shape_same_bytes(self, native):
+        # (3,2) and (2,3) have equal byte counts; memcpy'ing the former into
+        # the latter would silently reinterpret the data (ADVICE r1, medium).
+        arr = np.arange(6, dtype=np.float32).reshape(3, 2)
+        out = np.empty((1, 2, 3), np.float32)
+        assert native.decode_npy_batch([_npy(arr)], out, '<f4', _shape_str(out)) == 0
+
+    def test_rejects_flat_vs_square_same_bytes(self, native):
+        arr = np.arange(4, dtype=np.float32)  # (4,) vs declared (2, 2)
+        out = np.empty((1, 2, 2), np.float32)
+        assert native.decode_npy_batch([_npy(arr)], out, '<f4', _shape_str(out)) == 0
 
 
 class TestCodecIntegration:
@@ -92,6 +109,18 @@ class TestCodecIntegration:
                                            weird.getvalue()])
         np.testing.assert_array_equal(batch[0], a)
         assert batch[1].dtype == np.float64
+
+    def test_codec_transposed_cell_falls_back_with_true_shape(self):
+        field = UnischemaField('m', np.float32, (2, 3), NdarrayCodec(), False)
+        codec = field.codec
+        good = np.arange(6, dtype=np.float32).reshape(2, 3)
+        transposed = np.arange(6, dtype=np.float32).reshape(3, 2)
+        batch = codec.decode_batch(field, [codec.encode(field, good),
+                                           _npy(transposed)])
+        np.testing.assert_array_equal(batch[0], good)
+        # the mismatched cell must keep its true shape, not be reinterpreted
+        assert batch[1].shape == (3, 2)
+        np.testing.assert_array_equal(batch[1], transposed)
 
     def test_wildcard_shape_uses_python_path(self):
         field = UnischemaField('m', np.float32, (None, 3), NdarrayCodec(), False)
