@@ -350,19 +350,27 @@ def _spark_simple_string_to_arrow(simple):
     raise ValueError('Cannot map spark type %r to arrow' % simple)
 
 
+#: arrow type (by ``str()``) → pyspark type class name. The single source of
+#: truth for the arrow↔spark bridge: used by :func:`arrow_to_spark_type`
+#: (live pyspark instances) and by the footer's reference-compatible schema
+#: export (class names only, no pyspark needed) in ``etl/legacy.py``.
+ARROW_TO_SPARK_TYPE_NAME = {
+    'bool': 'BooleanType', 'int8': 'ByteType', 'int16': 'ShortType',
+    'int32': 'IntegerType', 'int64': 'LongType',
+    'uint8': 'ShortType', 'uint16': 'IntegerType', 'uint32': 'LongType',
+    'halffloat': 'FloatType', 'float': 'FloatType', 'double': 'DoubleType',
+    'string': 'StringType', 'large_string': 'StringType',
+    'binary': 'BinaryType', 'large_binary': 'BinaryType',
+    'date32[day]': 'DateType',
+}
+
+
 def arrow_to_spark_type(arrow_type):
     """Map an arrow DataType to a Spark DataType (requires pyspark)."""
     from pyspark.sql import types as T
-    mapping = {
-        pa.bool_(): T.BooleanType(), pa.int8(): T.ByteType(),
-        pa.int16(): T.ShortType(), pa.int32(): T.IntegerType(),
-        pa.int64(): T.LongType(), pa.uint8(): T.ShortType(),
-        pa.uint16(): T.IntegerType(), pa.uint32(): T.LongType(),
-        pa.float32(): T.FloatType(), pa.float64(): T.DoubleType(),
-        pa.string(): T.StringType(), pa.binary(): T.BinaryType(),
-    }
-    if arrow_type in mapping:
-        return mapping[arrow_type]
+    name = ARROW_TO_SPARK_TYPE_NAME.get(str(arrow_type))
+    if name is not None:
+        return getattr(T, name)()
     if pa.types.is_timestamp(arrow_type):
         return T.TimestampType()
     if pa.types.is_decimal(arrow_type):

@@ -322,9 +322,19 @@ def infer_or_load_unischema(dataset_info):
 def add_to_dataset_metadata(dataset_info, key, value):
     """Merge one ``key: value`` entry into the dataset's ``_common_metadata``.
 
-    Equivalent of ``petastorm/utils.py:88-132`` on the modern pyarrow API:
-    existing entries are preserved; the base schema comes from the existing
-    summary file or the first data file's footer.
+    Equivalent of ``petastorm/utils.py:88-132`` on the modern pyarrow API.
+    """
+    update_dataset_metadata(dataset_info, {key: value})
+
+
+def update_dataset_metadata(dataset_info, entries):
+    """Merge ``entries`` (a dict) into ``_common_metadata`` in ONE write.
+
+    Existing entries are preserved; the base schema comes from the existing
+    summary file or the first data file's footer. A single read-modify-write
+    cycle regardless of how many keys are stamped, so readers racing a
+    writer never observe a partially-stamped footer and remote filesystems
+    pay one round trip.
     """
     cm = dataset_info.common_metadata
     if cm is not None:
@@ -333,8 +343,9 @@ def add_to_dataset_metadata(dataset_info, key, value):
     else:
         base_schema = dataset_info.arrow_schema
         existing = dict(base_schema.metadata or {})
-    existing[key if isinstance(key, bytes) else key.encode()] = (
-        value if isinstance(value, bytes) else value.encode())
+    for key, value in entries.items():
+        existing[key if isinstance(key, bytes) else key.encode()] = (
+            value if isinstance(value, bytes) else value.encode())
     schema = base_schema.with_metadata(existing)
     path = posixpath.join(dataset_info.root_path, '_common_metadata')
     with dataset_info.fs.open(path, 'wb') as f:
@@ -352,13 +363,23 @@ def add_to_dataset_metadata(dataset_info, key, value):
 
 def _write_dataset_footer(dataset_url, schema, storage_options=None):
     info = ParquetDatasetInfo(dataset_url, storage_options)
-    counts = _row_group_counts_from_footers(info, workers=8)
-    add_to_dataset_metadata(info, ROW_GROUPS_PER_FILE_KEY,
-                            json.dumps(counts).encode('utf-8'))
-    # add_to_dataset_metadata invalidated info's cached footer, so the second
-    # merge sees the first key without re-listing the dataset tree.
-    add_to_dataset_metadata(info, UNISCHEMA_KEY,
-                            json.dumps(schema.to_json_dict()).encode('utf-8'))
+    counts_json = json.dumps(
+        _row_group_counts_from_footers(info, workers=8)).encode('utf-8')
+    entries = {
+        ROW_GROUPS_PER_FILE_KEY: counts_json,
+        UNISCHEMA_KEY: json.dumps(schema.to_json_dict()).encode('utf-8'),
+    }
+    # Best-effort write-side interop: also stamp the reference's pickled
+    # schema key (+ its row-group count key) so a genuine petastorm install
+    # can open datasets written by this framework. Codecs with no reference
+    # equivalent (none today) would make this a JSON-only dataset.
+    try:
+        from petastorm_tpu.etl.legacy import pickle_unischema_for_reference
+        entries[LEGACY_UNISCHEMA_KEY] = pickle_unischema_for_reference(schema)
+        entries[LEGACY_ROW_GROUPS_PER_FILE_KEY] = counts_json
+    except MetadataError as e:
+        logger.debug('Not writing reference-compatible schema pickle: %s', e)
+    update_dataset_metadata(info, entries)
 
 
 @contextmanager

@@ -177,3 +177,211 @@ def depickle_legacy_unischema(blob):
     else:
         shim_fields = list(fields)
     return Unischema(name, [_convert_field(f) for f in shim_fields])
+
+
+# ---------------------------------------------------------------------------
+# petastorm_tpu → reference-compatible pickle (write-side interop)
+# ---------------------------------------------------------------------------
+#
+# The reference loads schemas exclusively by unpickling the
+# ``dataset-toolkit.unischema.v1`` footer blob
+# (``petastorm/etl/dataset_metadata.py:356-386``), so for a dataset written by
+# this framework to be readable by a real petastorm install, the footer must
+# carry a pickle whose class references resolve to ``petastorm.unischema.*``,
+# ``petastorm.codecs.*`` and ``pyspark.sql.types.*``. None of those packages
+# are importable here; instead, lookalike classes with the right
+# ``__module__``/``__qualname__`` are registered in ``sys.modules`` for the
+# duration of one ``pickle.dumps`` call. The unpickling side (a genuine
+# petastorm + pyspark install) reconstructs its own real classes from the
+# module paths — instance state is what carries the schema.
+
+import threading
+
+from petastorm_tpu.codecs import ARROW_TO_SPARK_TYPE_NAME
+
+_EXPORT_LOCK = threading.Lock()
+
+
+def _real_modules_if_importable():
+    """Use a genuinely-installed petastorm/pyspark for the export when
+    available: real classes pickle with perfect fidelity AND no sys.modules
+    shadowing is needed (so concurrent pyspark users are never exposed to
+    stub modules)."""
+    import importlib.util
+    try:
+        if (importlib.util.find_spec('petastorm') is None
+                or importlib.util.find_spec('pyspark') is None):
+            return None
+        import petastorm.codecs as pc
+        import petastorm.unischema as pu
+        import pyspark.sql.types as pt
+    except Exception:  # noqa: BLE001 - any breakage falls back to stubs
+        return None
+    ns = {name: getattr(pu, name) for name in ('Unischema', 'UnischemaField')}
+    for name in ('ScalarCodec', 'NdarrayCodec', 'CompressedNdarrayCodec',
+                 'CompressedImageCodec'):
+        ns[name] = getattr(pc, name)
+    for name in set(ARROW_TO_SPARK_TYPE_NAME.values()) | {
+            'DecimalType', 'TimestampType'}:
+        ns[name] = getattr(pt, name)
+    return ns
+
+
+def _install_export_modules():
+    """Create sys.modules entries whose classes pickle under reference names.
+
+    Returns (namespace dict, saved sys.modules entries) — caller must restore.
+    """
+    import sys
+    import types
+
+    mods = {}
+
+    def new_module(name):
+        m = types.ModuleType(name)
+        mods[name] = m
+        return m
+
+    new_module('petastorm')
+    new_module('pyspark')
+    new_module('pyspark.sql')
+    m_uni = new_module('petastorm.unischema')
+    m_cod = new_module('petastorm.codecs')
+    m_spark = new_module('pyspark.sql.types')
+
+    ns = {}
+
+    # The reference's UnischemaField is a NamedTuple of these 5 entries
+    # (``petastorm/unischema.py:50-66``); namedtuple instances pickle as
+    # class(*values), which the real class reconstructs positionally.
+    field_cls = namedtuple('UnischemaField',
+                           ['name', 'numpy_dtype', 'shape', 'codec', 'nullable'])
+    field_cls.__module__ = 'petastorm.unischema'
+    field_cls.__qualname__ = 'UnischemaField'
+    m_uni.UnischemaField = field_cls
+    ns['UnischemaField'] = field_cls
+
+    class Unischema:  # noqa: N801 - must pickle under the reference name
+        pass
+
+    Unischema.__module__ = 'petastorm.unischema'
+    Unischema.__qualname__ = 'Unischema'
+    m_uni.Unischema = Unischema
+    ns['Unischema'] = Unischema
+
+    for codec_name in ('ScalarCodec', 'NdarrayCodec', 'CompressedNdarrayCodec',
+                       'CompressedImageCodec'):
+        cls = type(codec_name, (), {})
+        cls.__module__ = 'petastorm.codecs'
+        cls.__qualname__ = codec_name
+        setattr(m_cod, codec_name, cls)
+        ns[codec_name] = cls
+
+    for type_name in set(ARROW_TO_SPARK_TYPE_NAME.values()) | {
+            'DecimalType', 'TimestampType'}:
+        cls = type(type_name, (), {})
+        cls.__module__ = 'pyspark.sql.types'
+        cls.__qualname__ = type_name
+        setattr(m_spark, type_name, cls)
+        ns[type_name] = cls
+
+    saved = {k: sys.modules.get(k) for k in mods}
+    sys.modules.update(mods)
+    return ns, saved
+
+
+def _restore_modules(saved):
+    import sys
+    for k, v in saved.items():
+        if v is None:
+            sys.modules.pop(k, None)
+        else:
+            sys.modules[k] = v
+
+
+def _export_spark_type(ns, arrow_type, real_ctors):
+    if pa.types.is_decimal(arrow_type):
+        if real_ctors:
+            return ns['DecimalType'](arrow_type.precision, arrow_type.scale)
+        t = ns['DecimalType']()
+        t.precision = arrow_type.precision
+        t.scale = arrow_type.scale
+        t.hasPrecisionInfo = True
+        return t
+    if pa.types.is_timestamp(arrow_type):
+        return ns['TimestampType']()
+    name = ARROW_TO_SPARK_TYPE_NAME.get(str(arrow_type))
+    if name is None:
+        raise MetadataError('No pyspark equivalent for arrow type %s' % arrow_type)
+    return ns[name]()
+
+
+def _export_codec(ns, codec, real_ctors):
+    if codec is None:
+        return None
+    cls_name = type(codec).__name__
+    if cls_name == 'NdarrayCodec':
+        return ns['NdarrayCodec']()
+    if cls_name == 'CompressedNdarrayCodec':
+        return ns['CompressedNdarrayCodec']()
+    if cls_name == 'CompressedImageCodec':
+        if real_ctors:
+            return ns['CompressedImageCodec'](codec.image_codec, codec._quality)
+        out = ns['CompressedImageCodec']()
+        out._image_codec = '.' + codec.image_codec
+        out._quality = codec._quality
+        return out
+    if cls_name == 'ScalarCodec':
+        spark_type = _export_spark_type(ns, codec._arrow_type, real_ctors)
+        if real_ctors:
+            return ns['ScalarCodec'](spark_type)
+        out = ns['ScalarCodec']()
+        out._spark_type = spark_type
+        return out
+    raise MetadataError('Codec %s has no reference equivalent' % cls_name)
+
+
+def _build_export_schema(ns, schema, real_ctors):
+    fields = OrderedDict()
+    for f in schema.fields.values():
+        fields[f.name] = ns['UnischemaField'](
+            f.name, f.numpy_dtype, tuple(f.shape),
+            _export_codec(ns, f.codec, real_ctors), bool(f.nullable))
+    if real_ctors:
+        return ns['Unischema'](schema._name, list(fields.values()))
+    out = ns['Unischema']()
+    out._name = schema._name
+    out._fields = fields
+    for name, field in fields.items():
+        setattr(out, name, field)
+    return out
+
+
+def pickle_unischema_for_reference(schema):
+    """Pickle our Unischema so a genuine petastorm+pyspark install loads it.
+
+    The byte stream references only ``petastorm.unischema``,
+    ``petastorm.codecs``, ``pyspark.sql.types``, numpy and stdlib names —
+    exactly what the reference's own pickles reference — so its
+    ``get_schema`` (``etl/dataset_metadata.py:356-386``) reconstructs a real
+    ``petastorm.unischema.Unischema``. Protocol 2 for maximum back-compat.
+
+    When a genuine petastorm+pyspark install is present, its real classes do
+    the pickling directly. Otherwise lookalike classes are registered in
+    ``sys.modules`` for the duration of one (lock-serialized) ``dumps`` call;
+    an ``import pyspark`` racing that window from another thread could
+    transiently bind a stub module — unavoidable with this technique, and
+    only reachable when pyspark is not installed (so such an import would
+    fail anyway).
+    """
+    real = _real_modules_if_importable()
+    if real is not None:
+        return pickle.dumps(_build_export_schema(real, schema, real_ctors=True),
+                            protocol=2)
+    with _EXPORT_LOCK:
+        ns, saved = _install_export_modules()
+        try:
+            return pickle.dumps(_build_export_schema(ns, schema, real_ctors=False),
+                                protocol=2)
+        finally:
+            _restore_modules(saved)

@@ -1,0 +1,369 @@
+"""On-disk interop with the reference implementation, both directions.
+
+Direction 1 (reference-write → our-read): fixture datasets are materialized
+with the REFERENCE's own ``petastorm/unischema.py`` + ``petastorm/codecs.py``
+(imported from ``/root/reference`` via a path-only package so the reader
+stack's dead dependencies stay out of it), and the reference's **real**
+``Unischema`` instance is pickled into ``_common_metadata`` under its
+``dataset-toolkit.unischema.v1`` key — byte-layout-faithful to what
+``petastorm/etl/dataset_metadata.py:194-205`` writes. We then read the
+dataset through ``make_reader``/``make_batch_reader`` and assert per-codec
+value equality.
+
+Direction 2 (our-write → reference-load): ``DatasetWriter`` datasets stamp a
+reference-compatible pickled schema; unpickling that blob with the
+reference's real classes importable must yield a genuine
+``petastorm.unischema.Unischema`` (what a real petastorm+pyspark install's
+``get_schema``, ``etl/dataset_metadata.py:356-386``, would see), and the
+reference's codecs must decode our encoded cells to the original values.
+
+pyspark itself is not installed here; minimal ``pyspark.sql.types`` stand-in
+classes with the genuine module path play its part on both sides, exactly as
+they appear inside real petastorm pickles.
+"""
+
+import json
+import pickle
+import sys
+import types
+from collections import OrderedDict
+from decimal import Decimal
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from petastorm_tpu import make_batch_reader, make_reader
+from petastorm_tpu.codecs import (
+    CompressedImageCodec, CompressedNdarrayCodec, NdarrayCodec, ScalarCodec,
+)
+from petastorm_tpu.etl.dataset_metadata import (
+    LEGACY_ROW_GROUPS_PER_FILE_KEY, LEGACY_UNISCHEMA_KEY, ParquetDatasetInfo,
+    get_schema_from_dataset_url, write_dataset,
+)
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+REFERENCE_ROOT = '/root/reference/petastorm'
+
+pytestmark = pytest.mark.skipif(
+    not __import__('os').path.isdir(REFERENCE_ROOT),
+    reason='reference petastorm checkout not present')
+
+
+class _RefModules:
+    """The reference's real unischema/codecs + pyspark.sql.types stand-ins."""
+
+    def __init__(self, unischema, codecs, spark_types):
+        self.unischema = unischema
+        self.codecs = codecs
+        self.spark_types = spark_types
+
+
+def _make_spark_types_module():
+    m = types.ModuleType('pyspark.sql.types')
+
+    class DataType:
+        def __eq__(self, other):
+            return type(self) is type(other)
+
+        def __hash__(self):
+            return hash(type(self))
+
+    names = ['BooleanType', 'ByteType', 'ShortType', 'IntegerType', 'LongType',
+             'FloatType', 'DoubleType', 'StringType', 'BinaryType',
+             'TimestampType', 'DateType', 'DecimalType']
+    for name in names:
+        cls = type(name, (DataType,), {})
+        cls.__module__ = 'pyspark.sql.types'
+        cls.__qualname__ = name
+        setattr(m, name, cls)
+    DataType.__module__ = 'pyspark.sql.types'
+    m.DataType = DataType
+    return m
+
+
+@pytest.fixture(scope='module')
+def ref():
+    """Import the reference's real unischema/codecs via a path-only package.
+
+    Registering a synthetic ``petastorm`` package whose ``__path__`` points at
+    the reference tree lets ``petastorm.unischema``/``petastorm.codecs``
+    import as their genuine selves (identical pickle paths) without executing
+    the package ``__init__`` (whose reader imports need long-removed pyarrow
+    APIs). ``pyspark.sql.types`` is a minimal stand-in under the real name.
+    """
+    saved = {k: sys.modules.get(k)
+             for k in ('petastorm', 'petastorm.unischema', 'petastorm.codecs',
+                       'pyspark', 'pyspark.sql', 'pyspark.sql.types')}
+    pkg = types.ModuleType('petastorm')
+    pkg.__path__ = [REFERENCE_ROOT]
+    sys.modules['petastorm'] = pkg
+    sys.modules.pop('petastorm.unischema', None)
+    sys.modules.pop('petastorm.codecs', None)
+    sys.modules['pyspark'] = types.ModuleType('pyspark')
+    sys.modules['pyspark.sql'] = types.ModuleType('pyspark.sql')
+    sys.modules['pyspark.sql.types'] = _make_spark_types_module()
+    try:
+        import petastorm.codecs as ref_codecs
+        import petastorm.unischema as ref_unischema
+        yield _RefModules(ref_unischema, ref_codecs,
+                          sys.modules['pyspark.sql.types'])
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                sys.modules.pop(k, None)
+            else:
+                sys.modules[k] = v
+
+
+# ---------------------------------------------------------------------------
+# Direction 1: reference-write → our-read
+# ---------------------------------------------------------------------------
+
+N_ROWS = 24
+ROWS_PER_FILE = 12
+ROWS_PER_GROUP = 6
+
+
+def _ref_rows(rng):
+    rows = []
+    for i in range(N_ROWS):
+        rows.append({
+            'id': np.int32(i),
+            'name': 'row_%d' % i,
+            'weight': np.float64(i) / 3.0,
+            'vec': rng.rand(8).astype(np.float32),
+            'cvec': rng.rand(4).astype(np.float64),
+            'img': rng.randint(0, 255, (16, 32, 3), np.uint8),
+            'price': Decimal('%d.%02d' % (i, i)),
+            'maybe': None if i % 3 == 0 else np.int32(i * 10),
+        })
+    return rows
+
+
+@pytest.fixture(scope='module')
+def reference_written_dataset(ref, tmp_path_factory):
+    """A dataset laid out exactly as the reference writes it: parquet files
+    whose binary columns hold the reference codecs' encoded bytes, plus a
+    ``_common_metadata`` carrying the reference's real pickled Unischema."""
+    u, c, st = ref.unischema, ref.codecs, ref.spark_types
+    root = tmp_path_factory.mktemp('ref_ds')
+
+    fields = [
+        u.UnischemaField('id', np.int32, (), c.ScalarCodec(st.IntegerType()), False),
+        u.UnischemaField('name', np.str_, (), c.ScalarCodec(st.StringType()), False),
+        u.UnischemaField('weight', np.float64, (), c.ScalarCodec(st.DoubleType()), False),
+        u.UnischemaField('vec', np.float32, (8,), c.NdarrayCodec(), False),
+        u.UnischemaField('cvec', np.float64, (4,), c.CompressedNdarrayCodec(), False),
+        u.UnischemaField('img', np.uint8, (16, 32, 3), c.CompressedImageCodec('png'), False),
+        u.UnischemaField('price', Decimal, (), c.ScalarCodec(_decimal_type(st)), False),
+        u.UnischemaField('maybe', np.int32, (), c.ScalarCodec(st.IntegerType()), True),
+    ]
+    schema = u.Unischema('RefSchema', fields)
+
+    rng = np.random.RandomState(42)
+    rows = _ref_rows(rng)
+    encoded = []
+    for row in rows:
+        enc = {}
+        for f in fields:
+            value = row[f.name]
+            enc[f.name] = (None if value is None
+                           else f.codec.encode(f, value))
+        encoded.append(enc)
+
+    arrow_schema = pa.schema([
+        pa.field('id', pa.int32()),
+        pa.field('name', pa.string()),
+        pa.field('weight', pa.float64()),
+        pa.field('vec', pa.binary()),
+        pa.field('cvec', pa.binary()),
+        pa.field('img', pa.binary()),
+        pa.field('price', pa.decimal128(10, 2)),
+        pa.field('maybe', pa.int32()),
+    ])
+
+    counts = {}
+    for file_idx in range(N_ROWS // ROWS_PER_FILE):
+        chunk = encoded[file_idx * ROWS_PER_FILE:(file_idx + 1) * ROWS_PER_FILE]
+        cols = {name: [r[name] for r in chunk] for name in arrow_schema.names}
+        cols['price'] = [Decimal(str(v)) for v in cols['price']]
+        table = pa.table(
+            {n: pa.array(cols[n], type=arrow_schema.field(n).type)
+             for n in arrow_schema.names}, schema=arrow_schema)
+        fname = 'part-%05d.parquet' % file_idx
+        pq.write_table(table, str(root / fname), row_group_size=ROWS_PER_GROUP)
+        counts[fname] = ROWS_PER_FILE // ROWS_PER_GROUP
+
+    # _common_metadata with the reference's REAL pickled schema, exactly the
+    # keys petastorm/etl/dataset_metadata.py:194-241 stamps.
+    blob = pickle.dumps(schema, protocol=2)
+    meta_schema = arrow_schema.with_metadata({
+        LEGACY_UNISCHEMA_KEY: blob,
+        LEGACY_ROW_GROUPS_PER_FILE_KEY: json.dumps(counts).encode('utf-8'),
+    })
+    pq.write_metadata(meta_schema, str(root / '_common_metadata'))
+    return 'file://' + str(root), rows
+
+
+def _decimal_type(st):
+    t = st.DecimalType()
+    t.precision = 10
+    t.scale = 2
+    t.hasPrecisionInfo = True
+    return t
+
+
+class TestReferenceWrittenDataset:
+    def test_schema_loads(self, reference_written_dataset):
+        url, _ = reference_written_dataset
+        schema = get_schema_from_dataset_url(url)
+        assert list(schema.fields) == ['id', 'name', 'weight', 'vec', 'cvec',
+                                       'img', 'price', 'maybe']
+        assert schema.fields['vec'].shape == (8,)
+        assert isinstance(schema.fields['vec'].codec, NdarrayCodec)
+        assert isinstance(schema.fields['cvec'].codec, CompressedNdarrayCodec)
+        assert isinstance(schema.fields['img'].codec, CompressedImageCodec)
+        assert schema.fields['img'].codec.image_codec == 'png'
+        assert schema.fields['maybe'].nullable
+
+    @pytest.mark.parametrize('pool', ['thread', 'process'])
+    def test_row_reader_values(self, reference_written_dataset, pool):
+        url, rows = reference_written_dataset
+        with make_reader(url, reader_pool_type=pool,
+                         shuffle_row_groups=False) as reader:
+            got = sorted(reader, key=lambda r: r.id)
+        assert len(got) == N_ROWS
+        for out, expected in zip(got, rows):
+            assert out.id == expected['id']
+            assert out.name == expected['name']
+            assert out.weight == pytest.approx(expected['weight'])
+            np.testing.assert_array_equal(out.vec, expected['vec'])
+            np.testing.assert_array_equal(out.cvec, expected['cvec'])
+            np.testing.assert_array_equal(out.img, expected['img'])
+            assert out.price == expected['price']
+            if expected['maybe'] is None:
+                assert out.maybe is None
+            else:
+                assert out.maybe == expected['maybe']
+
+    def test_batch_reader_values(self, reference_written_dataset):
+        url, rows = reference_written_dataset
+        with make_batch_reader(url, shuffle_row_groups=False) as reader:
+            batches = list(reader)
+        ids = np.concatenate([np.asarray(b.id) for b in batches])
+        assert sorted(ids.tolist()) == list(range(N_ROWS))
+        by_id = {}
+        for b in batches:
+            for i in range(len(b.id)):
+                by_id[int(b.id[i])] = {'vec': b.vec[i], 'img': b.img[i]}
+        for expected in rows:
+            np.testing.assert_array_equal(by_id[int(expected['id'])]['vec'],
+                                          expected['vec'])
+            np.testing.assert_array_equal(by_id[int(expected['id'])]['img'],
+                                          expected['img'])
+
+    def test_rowgroup_counts_come_from_legacy_key(self, reference_written_dataset):
+        from petastorm_tpu.etl.dataset_metadata import load_row_groups
+        url, _ = reference_written_dataset
+        rgs = load_row_groups(ParquetDatasetInfo(url))
+        assert len(rgs) == N_ROWS // ROWS_PER_GROUP
+
+
+# ---------------------------------------------------------------------------
+# Direction 2: our-write → reference-load
+# ---------------------------------------------------------------------------
+
+def _our_schema():
+    return Unischema('TpuSchema', [
+        UnischemaField('id', np.int32, (), ScalarCodec(pa.int32()), False),
+        UnischemaField('label', np.str_, (), ScalarCodec(pa.string()), False),
+        UnischemaField('emb', np.float32, (6,), NdarrayCodec(), False),
+        UnischemaField('zipped', np.float64, (3,), CompressedNdarrayCodec(), False),
+        UnischemaField('thumb', np.uint8, (8, 8, 3), CompressedImageCodec('png'), False),
+        UnischemaField('cost', Decimal, (), ScalarCodec(pa.decimal128(12, 3)), False),
+    ])
+
+
+@pytest.fixture(scope='module')
+def our_written_dataset(tmp_path_factory):
+    root = tmp_path_factory.mktemp('tpu_ds')
+    url = 'file://' + str(root)
+    schema = _our_schema()
+    rng = np.random.RandomState(7)
+    rows = [{'id': np.int32(i), 'label': 'L%d' % i,
+             'emb': rng.rand(6).astype(np.float32),
+             'zipped': rng.rand(3).astype(np.float64),
+             'thumb': rng.randint(0, 255, (8, 8, 3), np.uint8),
+             'cost': Decimal('%d.%03d' % (i * 2, i))} for i in range(10)]
+    write_dataset(url, schema, rows, rowgroup_size_rows=5)
+    return url, schema, rows
+
+
+class TestOurDatasetLoadsInReference:
+    def test_footer_carries_reference_pickle_keys(self, our_written_dataset):
+        url, _, _ = our_written_dataset
+        meta = dict(ParquetDatasetInfo(url).common_metadata.metadata)
+        assert LEGACY_UNISCHEMA_KEY in meta
+        counts = json.loads(meta[LEGACY_ROW_GROUPS_PER_FILE_KEY].decode())
+        assert sum(counts.values()) == 2  # 10 rows / 5 per group
+
+    def test_reference_unpickles_a_real_unischema(self, ref, our_written_dataset):
+        url, schema, _ = our_written_dataset
+        blob = dict(ParquetDatasetInfo(url).common_metadata.metadata)[
+            LEGACY_UNISCHEMA_KEY]
+        # With the reference's real modules importable, its get_schema
+        # (etl/dataset_metadata.py:356-386) is a pickle.loads of this blob.
+        loaded = pickle.loads(blob)
+        assert type(loaded) is ref.unischema.Unischema
+        assert loaded._name == 'TpuSchema'
+        assert list(loaded._fields) == list(schema.fields)
+        for name, field in loaded._fields.items():
+            assert type(field) is ref.unischema.UnischemaField
+            ours = schema.fields[name]
+            assert field.shape == tuple(ours.shape)
+            assert field.nullable == ours.nullable
+        assert type(loaded._fields['emb'].codec) is ref.codecs.NdarrayCodec
+        assert type(loaded._fields['zipped'].codec) is ref.codecs.CompressedNdarrayCodec
+        img_codec = loaded._fields['thumb'].codec
+        assert type(img_codec) is ref.codecs.CompressedImageCodec
+        assert img_codec._image_codec == '.png'
+        scalar = loaded._fields['id'].codec
+        assert type(scalar) is ref.codecs.ScalarCodec
+        assert type(scalar._spark_type).__name__ == 'IntegerType'
+        cost = loaded._fields['cost'].codec._spark_type
+        assert (type(cost).__name__, cost.precision, cost.scale) == ('DecimalType', 12, 3)
+
+    def test_reference_codecs_decode_our_cells(self, ref, our_written_dataset):
+        """Byte-level compat: the reference's decode on our stored payloads."""
+        url, schema, rows = our_written_dataset
+        info = ParquetDatasetInfo(url)
+        table = pa.concat_tables([pq.read_table(info.open(p))
+                                  for p in info.file_paths])
+        ids = table.column('id').to_pylist()
+        u, c = ref.unischema, ref.codecs
+        ref_emb = u.UnischemaField('emb', np.float32, (6,), c.NdarrayCodec(), False)
+        ref_zip = u.UnischemaField('zipped', np.float64, (3,), c.CompressedNdarrayCodec(), False)
+        ref_img = u.UnischemaField('thumb', np.uint8, (8, 8, 3), c.CompressedImageCodec('png'), False)
+        for pos, row_id in enumerate(ids):
+            expected = rows[row_id]
+            got_emb = c.NdarrayCodec().decode(ref_emb, table.column('emb')[pos].as_py())
+            np.testing.assert_array_equal(got_emb, expected['emb'])
+            got_zip = c.CompressedNdarrayCodec().decode(ref_zip, table.column('zipped')[pos].as_py())
+            np.testing.assert_array_equal(got_zip, expected['zipped'])
+            got_img = c.CompressedImageCodec('png').decode(ref_img, table.column('thumb')[pos].as_py())
+            np.testing.assert_array_equal(got_img, expected['thumb'])
+
+    def test_round_trip_through_both_schema_paths(self, our_written_dataset):
+        """Our JSON key and the legacy pickle key must describe one schema."""
+        url, schema, _ = our_written_dataset
+        from petastorm_tpu.etl.legacy import depickle_legacy_unischema
+        meta = dict(ParquetDatasetInfo(url).common_metadata.metadata)
+        via_pickle = depickle_legacy_unischema(meta[LEGACY_UNISCHEMA_KEY])
+        loaded = get_schema_from_dataset_url(url)
+        assert list(via_pickle.fields) == list(loaded.fields)
+        for name in loaded.fields:
+            a, b = via_pickle.fields[name], loaded.fields[name]
+            assert (a.shape, a.nullable) == (b.shape, b.nullable)
+            assert type(a.codec).__name__ == type(b.codec).__name__
