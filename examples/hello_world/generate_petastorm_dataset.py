@@ -34,9 +34,15 @@ def row_generator(x):
 
 
 def generate_petastorm_dataset(output_url='file:///tmp/hello_world_dataset',
-                               num_rows=10):
+                               num_rows=10, rowgroup_size_rows=5):
     rows = [row_generator(i) for i in range(num_rows)]
-    write_dataset(output_url, HelloWorldSchema, rows, rowgroup_size_rows=10)
+    write_dataset(output_url, HelloWorldSchema, rows,
+                  rowgroup_size_rows=rowgroup_size_rows)
+    # Index the id column so readers can skip row-groups coarsely
+    # (reference: examples use build_rowgroup_index the same way).
+    from petastorm_tpu.etl.rowgroup_indexers import SingleFieldIndexer
+    from petastorm_tpu.etl.rowgroup_indexing import build_rowgroup_index
+    build_rowgroup_index(output_url, [SingleFieldIndexer('id_index', 'id')])
     print('Dataset written to %s' % output_url)
 
 

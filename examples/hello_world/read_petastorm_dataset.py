@@ -13,6 +13,17 @@ def python_hello_world(dataset_url):
             break
 
 
+def selector_hello_world(dataset_url):
+    """Coarse row-group selection via the footer index (built at generate
+    time): only row-groups containing the requested id values are read."""
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.selectors import SingleIndexSelector
+    selector = SingleIndexSelector('id_index', ['1', '3'])
+    with make_reader(dataset_url, rowgroup_selector=selector,
+                     schema_fields=['^id$']) as reader:
+        print('selected ids:', sorted(row.id for row in reader))
+
+
 def jax_hello_world(dataset_url):
     from petastorm_tpu.jax import make_jax_loader
     with make_jax_loader(dataset_url, batch_size=4, fields=['^id$'],
@@ -43,8 +54,8 @@ if __name__ == '__main__':
     parser.add_argument('--dataset-url',
                         default='file:///tmp/hello_world_dataset')
     parser.add_argument('--consumer', default='python',
-                        choices=['python', 'jax', 'torch', 'tf'])
+                        choices=['python', 'selector', 'jax', 'torch', 'tf'])
     args = parser.parse_args()
-    {'python': python_hello_world, 'jax': jax_hello_world,
-     'torch': torch_hello_world, 'tf': tf_hello_world}[args.consumer](
-        args.dataset_url)
+    {'python': python_hello_world, 'selector': selector_hello_world,
+     'jax': jax_hello_world, 'torch': torch_hello_world,
+     'tf': tf_hello_world}[args.consumer](args.dataset_url)

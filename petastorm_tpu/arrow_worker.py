@@ -105,8 +105,15 @@ class RowGroupWorker(WorkerBase):
             batch.epoch = epoch
         if batch is not None and batch.length > 0:
             if self._ngram is not None:
-                for window in self._ngram.form_ngram(batch, self._schema):
-                    self.publish_func(window)
+                windows = self._ngram.form_ngram(batch, self._schema)
+                for i, window in enumerate(windows):
+                    # Wrapped as a plain dict (picklable across the process
+                    # pool); 'last' lets the consumer mark the whole work
+                    # item consumed for checkpoint/resume accounting.
+                    self.publish_func({'window': window,
+                                       'item_index': item_index,
+                                       'epoch': epoch,
+                                       'last': i == len(windows) - 1})
             else:
                 self.publish_func(batch)
 

@@ -108,9 +108,25 @@ class NGram:
         return [f.name for f in self._fields[timestep]]
 
     def get_schema_at_timestep(self, schema, timestep):
-        names = set(self.get_field_names_at_timestep(timestep))
-        return schema.create_schema_view(
-            [schema.fields[n] for n in schema.fields if n in names])
+        # Memoized per (schema, timestep): the consumer calls this once per
+        # yielded window, and view construction iterates the whole schema.
+        cache = self.__dict__.setdefault('_view_cache', {})
+        key = (id(schema), timestep)
+        view = cache.get(key)
+        if view is None:
+            names = set(self.get_field_names_at_timestep(timestep))
+            view = schema.create_schema_view(
+                [schema.fields[n] for n in schema.fields if n in names])
+            cache[key] = view
+            # hold the schema so its id() stays unique while cached
+            self.__dict__.setdefault('_view_cache_schemas', []).append(schema)
+        return view
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state.pop('_view_cache', None)
+        state.pop('_view_cache_schemas', None)
+        return state
 
     def get_field_names_at_all_timesteps(self):
         """Union of fields over all timesteps plus the timestamp field (the
@@ -124,10 +140,16 @@ class NGram:
     def form_ngram(self, batch, schema):
         """All admitted windows of a decoded column batch.
 
+        Windows are ``{timestep: {field: value}}`` plain dicts — NOT
+        namedtuples — so they cross the process pool's pickle boundary
+        (dynamically-created namedtuple classes don't); the consumer
+        converts via :meth:`make_namedtuple`, mirroring the reference's
+        worker-publishes-dicts design (``py_dict_reader_worker.py:91``).
+
         :param batch: a :class:`~petastorm_tpu.arrow_worker.ColumnBatch` whose
             columns include the timestamp field.
-        :param schema: the loaded :class:`Unischema` (namedtuple source).
-        :return: list of ``{timestep: namedtuple}`` dicts.
+        :param schema: the loaded :class:`Unischema` (field-name source).
+        :return: list of ``{timestep: dict}`` dicts.
         """
         ts_name = self._ts_name()
         ts = np.asarray(batch.columns[ts_name])
@@ -159,15 +181,15 @@ class NGram:
             starts = kept
 
         base = min(self._fields)
-        ts_schemas = {k: self.get_schema_at_timestep(schema, k) for k in self._fields}
+        ts_names = {k: list(self.get_schema_at_timestep(schema, k).fields)
+                    for k in self._fields}
         windows = []
         for i in starts:
             window = {}
             for key in self._fields:
                 offset = int(i) + (key - base)
-                names = ts_schemas[key].fields
-                row = {name: batch.columns[name][offset] for name in names}
-                window[key] = ts_schemas[key].make_namedtuple(**row)
+                window[key] = {name: batch.columns[name][offset]
+                               for name in ts_names[key]}
             windows.append(window)
         return windows
 

@@ -300,10 +300,18 @@ class Reader:
             return self.schema.make_namedtuple(**batch.columns)
         if self.ngram is not None:
             try:
-                return self._pool.get_results()
+                # Workers publish wrapped {timestep: dict} windows (picklable
+                # across the process pool); namedtuple-ization happens here on
+                # the consumer, as in the reference
+                # (py_dict_reader_worker.py:91).
+                wrapped = self._pool.get_results()
             except EmptyResultError:
                 self.last_row_consumed = True
                 raise StopIteration from None
+            if wrapped['last'] and wrapped['epoch'] is not None:
+                self._consumed_by_epoch.setdefault(
+                    wrapped['epoch'], set()).add(wrapped['item_index'])
+            return self.ngram.make_namedtuple(self.schema, wrapped['window'])
         # row-at-a-time view over column batches
         while self._current_batch is None or self._batch_cursor >= self._current_batch.length:
             if self._current_batch is not None:

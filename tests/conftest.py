@@ -41,6 +41,18 @@ def synthetic_dataset(tmp_path_factory):
     url = 'file://' + path
     data = create_test_dataset(url, range(100), num_files=4, rowgroup_size=10)
 
+    # Index it like the reference's fixture does (its test_common.py builds
+    # SingleField + FieldNotNull indexes right after materialization).
+    from petastorm_tpu.etl.rowgroup_indexers import (
+        FieldNotNullIndexer, SingleFieldIndexer,
+    )
+    from petastorm_tpu.etl.rowgroup_indexing import build_rowgroup_index
+    build_rowgroup_index(url, [
+        SingleFieldIndexer('id_index', 'id'),
+        SingleFieldIndexer('partition_index', 'partition_key'),
+        FieldNotNullIndexer('string_arr_not_null', 'string_array_nullable'),
+    ])
+
     class _Dataset:
         pass
 

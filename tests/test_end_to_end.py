@@ -10,7 +10,11 @@ from petastorm_tpu.predicates import in_lambda, in_pseudorandom_split, in_reduce
 from petastorm_tpu.transform import TransformSpec
 from tests.test_common import TestSchema
 
-POOLS = ['thread', 'dummy']
+# Full matrix including the spawned-ZMQ process pool: the reference
+# parametrizes everything over dummy/thread/process
+# (petastorm/tests/test_end_to_end.py:42-58); the process pool crosses a
+# dill/ZMQ serialization boundary, which is exactly where pickling bugs live.
+POOLS = ['thread', 'dummy', 'process']
 
 
 def _fields_by_id(rows):
@@ -158,8 +162,10 @@ def test_num_epochs(synthetic_dataset, pool):
     assert sorted(ids) == sorted(list(range(100)) * 3)
 
 
-def test_reset_after_full_consumption(synthetic_dataset):
-    with make_reader(synthetic_dataset.url, reader_pool_type='dummy') as reader:
+@pytest.mark.parametrize('pool', POOLS)
+def test_reset_after_full_consumption(synthetic_dataset, pool):
+    with make_reader(synthetic_dataset.url, reader_pool_type=pool,
+                     workers_count=2) as reader:
         first = [r.id for r in reader]
         reader.reset()
         second = [r.id for r in reader]
@@ -212,15 +218,19 @@ def test_transform_spec_new_field(synthetic_dataset):
     assert row.id_plus_one == row.id + 1
 
 
-def test_local_disk_cache_round_trip(synthetic_dataset, tmp_path):
-    kwargs = dict(reader_pool_type='dummy', cache_type='local-disk',
+@pytest.mark.parametrize('pool', POOLS)
+def test_local_disk_cache_round_trip(synthetic_dataset, tmp_path, pool):
+    kwargs = dict(reader_pool_type=pool, workers_count=2,
+                  cache_type='local-disk',
                   cache_location=str(tmp_path / 'cache'),
                   cache_size_limit=10 ** 9, shuffle_row_groups=False)
     with make_reader(synthetic_dataset.url, **kwargs) as reader:
         first = [r.id for r in reader]
     with make_reader(synthetic_dataset.url, **kwargs) as reader:
         second = [r.id for r in reader]
-    assert first == second
+    # Multi-worker completion order is nondeterministic; cache correctness is
+    # about content: both passes must yield the complete dataset.
+    assert sorted(first) == sorted(second) == list(range(100))
 
 
 def test_checkpoint_resume_round_trip(synthetic_dataset):
@@ -297,27 +307,7 @@ def test_diagnostics_property(synthetic_dataset):
         assert 'items_ventilated' in reader.diagnostics
 
 
-# -- process pool (spawned workers over ZMQ) --------------------------------
-# Dedicated tests rather than full POOLS parametrization: each spawn costs
-# ~1-2s of interpreter+import startup, so the full matrix would dominate
-# suite runtime without adding coverage.
-
-def test_process_pool_simple_read(synthetic_dataset):
-    with make_reader(synthetic_dataset.url, reader_pool_type='process',
-                     workers_count=2, shuffle_row_groups=False) as reader:
-        rows = list(reader)
-    assert len(rows) == 100
-    expected = _fields_by_id(synthetic_dataset.data)
-    for row in rows[:5]:
-        _check_simple_row(row, expected[row.id])
-
-
-def test_process_pool_batch_reader(scalar_dataset):
-    with make_batch_reader(scalar_dataset.url, reader_pool_type='process',
-                           workers_count=2) as reader:
-        ids = [i for batch in reader for i in batch.id]
-    assert sorted(ids) == list(range(100))
-
+# -- process-pool-specific behaviors (beyond the POOLS matrix above) --------
 
 def test_process_pool_worker_error_propagates(synthetic_dataset):
     from petastorm_tpu.transform import TransformSpec

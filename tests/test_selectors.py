@@ -1,0 +1,207 @@
+"""Row-group selector + footer index subsystem, end to end.
+
+Parity target: the reference's selector coverage
+(``petastorm/tests/test_end_to_end.py:623-729``) and its indexing suite
+(``petastorm/etl/rowgroup_indexing.py:37-158``).
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from petastorm_tpu import make_batch_reader, make_reader
+from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+from petastorm_tpu.errors import MetadataError
+from petastorm_tpu.etl.dataset_metadata import ParquetDatasetInfo, write_dataset
+from petastorm_tpu.etl.rowgroup_indexers import (
+    FieldNotNullIndexer, SingleFieldIndexer,
+)
+from petastorm_tpu.etl.rowgroup_indexing import (
+    build_rowgroup_index, get_row_group_indexes,
+)
+from petastorm_tpu.selectors import (
+    IntersectIndexSelector, SingleIndexSelector, UnionIndexSelector,
+)
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+BlockySchema = Unischema('BlockySchema', [
+    UnischemaField('id', np.int64, (), ScalarCodec(pa.int64()), False),
+    # category is constant within each 5-row row-group -> selectors are exact
+    UnischemaField('category', np.str_, (), ScalarCodec(pa.string()), False),
+    UnischemaField('maybe_vec', np.float32, (2,), NdarrayCodec(), True),
+])
+
+N_ROWS = 30
+ROWGROUP = 5
+
+
+def _blocky_row(i):
+    return {
+        'id': i,
+        'category': 'cat_%d' % (i // ROWGROUP),
+        # an ENTIRE row-group (ids 5..9) is null -> FieldNotNull is exact
+        'maybe_vec': None if 5 <= i < 10 else np.float32([i, i + 0.5]),
+    }
+
+
+@pytest.fixture(scope='module')
+def indexed_dataset(tmp_path_factory):
+    url = 'file://' + str(tmp_path_factory.mktemp('blocky')) + '/ds'
+    rows = [_blocky_row(i) for i in range(N_ROWS)]
+    write_dataset(url, BlockySchema, rows, rowgroup_size_rows=ROWGROUP,
+                  num_files=2)
+    build_rowgroup_index(url, [
+        SingleFieldIndexer('category_index', 'category'),
+        SingleFieldIndexer('id_index', 'id'),
+        FieldNotNullIndexer('vec_not_null', 'maybe_vec'),
+    ])
+    return url, rows
+
+
+def _read_ids(url, selector, factory=make_reader, **kwargs):
+    with factory(url, rowgroup_selector=selector, shuffle_row_groups=False,
+                 **kwargs) as reader:
+        if getattr(reader, 'batched_output', False):
+            out = []
+            for batch in reader:
+                out.extend(int(v) for v in batch.id)
+            return sorted(out)
+        return sorted(int(r.id) for r in reader)
+
+
+class TestIndexBuildAndLoad:
+    def test_round_trip(self, indexed_dataset):
+        url, _ = indexed_dataset
+        indexes = get_row_group_indexes(ParquetDatasetInfo(url))
+        assert set(indexes) == {'category_index', 'id_index', 'vec_not_null'}
+        cat = indexes['category_index']
+        assert sorted(cat.indexed_values) == ['cat_%d' % i for i in range(6)]
+        # one row-group per category by construction
+        assert all(len(cat.get_row_group_indexes(v)) == 1
+                   for v in cat.indexed_values)
+        ids = indexes['id_index']
+        assert len(ids.indexed_values) == N_ROWS
+
+    def test_not_null_excludes_all_null_group(self, indexed_dataset):
+        url, _ = indexed_dataset
+        not_null = get_row_group_indexes(ParquetDatasetInfo(url))['vec_not_null']
+        all_groups = set(
+            get_row_group_indexes(ParquetDatasetInfo(url))['category_index']
+            .get_row_group_indexes('cat_1'))
+        assert not_null.get_row_group_indexes() & all_groups == set()
+        assert len(not_null.get_row_group_indexes()) == N_ROWS // ROWGROUP - 1
+
+    def test_unindexed_field_rejected(self, indexed_dataset):
+        url, _ = indexed_dataset
+        with pytest.raises(ValueError, match='not in schema'):
+            build_rowgroup_index(url, [SingleFieldIndexer('x', 'no_such_field')])
+
+    def test_indexer_merge(self):
+        a = SingleFieldIndexer('m', 'f')
+        b = SingleFieldIndexer('m', 'f')
+        a.build_index([{'f': 'x'}], 0)
+        b.build_index([{'f': 'x'}, {'f': 'y'}], 1)
+        merged = a + b
+        assert merged.get_row_group_indexes('x') == {0, 1}
+        assert merged.get_row_group_indexes('y') == {1}
+        with pytest.raises(ValueError):
+            a + SingleFieldIndexer('m', 'other')
+
+
+class TestSelectors:
+    def test_single_index_selector(self, indexed_dataset):
+        url, _ = indexed_dataset
+        got = _read_ids(url, SingleIndexSelector('category_index', ['cat_2']))
+        assert got == list(range(10, 15))
+
+    def test_single_selector_multiple_values(self, indexed_dataset):
+        url, _ = indexed_dataset
+        got = _read_ids(url, SingleIndexSelector('category_index',
+                                                 ['cat_0', 'cat_5']))
+        assert got == list(range(0, 5)) + list(range(25, 30))
+
+    def test_union_selector(self, indexed_dataset):
+        url, _ = indexed_dataset
+        sel = UnionIndexSelector([
+            SingleIndexSelector('category_index', ['cat_3']),
+            SingleIndexSelector('id_index', ['7']),
+        ])
+        assert _read_ids(url, sel) == list(range(5, 10)) + list(range(15, 20))
+
+    def test_intersect_selector(self, indexed_dataset):
+        url, _ = indexed_dataset
+        sel = IntersectIndexSelector([
+            SingleIndexSelector('category_index', ['cat_1', 'cat_4']),
+            SingleIndexSelector('id_index', ['21']),
+        ])
+        # cat_4 is ids 20..24; only that group also contains id 21
+        assert _read_ids(url, sel) == list(range(20, 25))
+
+    def test_intersect_empty(self, indexed_dataset):
+        url, _ = indexed_dataset
+        sel = IntersectIndexSelector([
+            SingleIndexSelector('category_index', ['cat_0']),
+            SingleIndexSelector('category_index', ['cat_1']),
+        ])
+        from petastorm_tpu.errors import NoDataAvailableError
+        with pytest.raises(NoDataAvailableError):
+            _read_ids(url, sel)
+
+    def test_not_null_selector(self, indexed_dataset):
+        url, _ = indexed_dataset
+        got = _read_ids(url, SingleIndexSelector('vec_not_null', [None]))
+        assert got == list(range(0, 5)) + list(range(10, 30))
+
+    def test_batch_reader_selector(self, indexed_dataset):
+        url, _ = indexed_dataset
+        got = _read_ids(url, SingleIndexSelector('category_index', ['cat_2']),
+                        factory=make_batch_reader)
+        assert got == list(range(10, 15))
+
+    @pytest.mark.parametrize('pool', ['thread', 'process', 'dummy'])
+    def test_selector_over_all_pools(self, indexed_dataset, pool):
+        url, _ = indexed_dataset
+        got = _read_ids(url, SingleIndexSelector('category_index', ['cat_4']),
+                        reader_pool_type=pool)
+        assert got == list(range(20, 25))
+
+    def test_missing_index_name(self, indexed_dataset):
+        url, _ = indexed_dataset
+        with pytest.raises(ValueError, match='no row-group index named'):
+            _read_ids(url, SingleIndexSelector('nope', ['x']))
+
+    def test_dataset_without_index(self, synthetic_dataset, tmp_path):
+        url = 'file://' + str(tmp_path / 'noindex')
+        write_dataset(url, BlockySchema, [_blocky_row(i) for i in range(10)],
+                      rowgroup_size_rows=5)
+        with pytest.raises(MetadataError, match='no row-group index'):
+            _read_ids(url, SingleIndexSelector('category_index', ['cat_0']))
+
+
+class TestSyntheticDatasetSelectors:
+    """Reference-parity: selectors over the canonical indexed fixture
+    (``test_end_to_end.py:623-729`` uses its synthetic dataset the same way)."""
+
+    def test_select_by_id_values(self, synthetic_dataset):
+        indexes = get_row_group_indexes(ParquetDatasetInfo(synthetic_dataset.url))
+        selected = (set(indexes['id_index'].get_row_group_indexes('2'))
+                    | set(indexes['id_index'].get_row_group_indexes('18')))
+        got = _read_ids(synthetic_dataset.url,
+                        SingleIndexSelector('id_index', ['2', '18']),
+                        schema_fields=['^id$'])
+        assert {2, 18} <= set(got)
+        # exactly the rows living in the selected row-groups
+        expected = sorted(
+            int(v) for v in indexes['id_index'].indexed_values
+            if set(indexes['id_index'].get_row_group_indexes(v)) & selected)
+        assert got == expected
+        assert len(got) < 100
+
+    def test_partition_index_is_coarse(self, synthetic_dataset):
+        # partition_key cycles i%5, so every row-group contains every key:
+        # selecting one key still reads the full dataset (row-group
+        # granularity, matching the reference's selector semantics)
+        got = _read_ids(synthetic_dataset.url,
+                        SingleIndexSelector('partition_index', ['p_3']),
+                        schema_fields=['^id$'])
+        assert got == list(range(100))
