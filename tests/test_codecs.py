@@ -151,3 +151,58 @@ def test_reference_byte_compat_npy():
     f = UnischemaField('a', np.int32, (3,))
     encoded = NdarrayCodec().encode(f, np.array([1, 2, 3], dtype=np.int32))
     assert bytes(encoded[:6]) == b'\x93NUMPY'
+
+
+class TestBatchedImageDecode:
+    def _field(self, shape=(16, 32, 3)):
+        from petastorm_tpu.unischema import UnischemaField
+        return UnischemaField('im', np.uint8, shape,
+                              CompressedImageCodec('png'), False)
+
+    def test_dense_batch_matches_per_cell(self):
+        field = self._field()
+        codec = field.codec
+        rng = np.random.RandomState(0)
+        imgs = [rng.randint(0, 255, (16, 32, 3), np.uint8) for _ in range(12)]
+        cells = [codec.encode(field, im) for im in imgs]
+        batch = codec.decode_batch(field, cells)
+        assert isinstance(batch, np.ndarray) and batch.shape == (12, 16, 32, 3)
+        for got, im in zip(batch, imgs):
+            np.testing.assert_array_equal(got, im)
+
+    def test_jpeg_batch_matches_per_cell(self):
+        from petastorm_tpu.unischema import UnischemaField
+        field = UnischemaField('im', np.uint8, (24, 24, 3),
+                               CompressedImageCodec('jpeg', quality=90), False)
+        codec = field.codec
+        rng = np.random.RandomState(1)
+        imgs = [rng.randint(0, 255, (24, 24, 3), np.uint8) for _ in range(8)]
+        cells = [codec.encode(field, im) for im in imgs]
+        batch = codec.decode_batch(field, cells)
+        singles = [codec.decode(field, c) for c in cells]
+        for got, single in zip(batch, singles):
+            np.testing.assert_array_equal(got, single)
+
+    def test_variable_shape_falls_back_to_list(self):
+        field = self._field(shape=(None, None, 3))
+        codec = field.codec
+        rng = np.random.RandomState(2)
+        imgs = [rng.randint(0, 255, (8 + i, 8, 3), np.uint8) for i in range(5)]
+        batch = codec.decode_batch(field, [codec.encode(field, im) for im in imgs])
+        assert isinstance(batch, list)
+        assert [b.shape for b in batch] == [(8 + i, 8, 3) for i in range(5)]
+
+    def test_shape_surprise_falls_back(self):
+        # a stored cell whose decoded shape differs from the declared fixed
+        # shape must come back with its TRUE shape via the fallback path
+        field = self._field(shape=(16, 32, 3))
+        codec = field.codec
+        rng = np.random.RandomState(3)
+        ok = rng.randint(0, 255, (16, 32, 3), np.uint8)
+        odd = rng.randint(0, 255, (4, 4, 3), np.uint8)
+        odd_field = self._field(shape=(4, 4, 3))
+        cells = [codec.encode(field, ok) for _ in range(4)]
+        cells.append(codec.encode(odd_field, odd))
+        batch = codec.decode_batch(field, cells)
+        assert isinstance(batch, list)
+        assert batch[-1].shape == (4, 4, 3)
