@@ -224,6 +224,7 @@ def main():
                     del os.environ['BENCH_JAX_PLATFORM']
                 if 'error' not in cpu_result:
                     extra['%s_device' % prefix] = 'cpu-fallback'
+                    extra['%s_tpu_error' % prefix] = result['error']
                     result = cpu_result
             for k, v in result.items():
                 extra['%s_%s' % (prefix, k)] = (round(v, 1)

@@ -208,6 +208,16 @@ class RowGroupWorker(WorkerBase):
                 field = self._stored_schema.fields.get(name)
                 value = self._typed_partition_value(field, piece.partition_values[name])
                 decoded[name] = np.full(n, value, dtype=object)
+        mask = predicate.do_include_batch({f: decoded[f] for f in pred_fields})
+        if mask is not None:
+            mask = np.asarray(mask, dtype=bool)
+            if mask.shape != (n,):
+                raise ValueError(
+                    'Predicate %s.do_include_batch returned mask of shape %s '
+                    'for %d rows' % (type(predicate).__name__, mask.shape, n))
+            return mask
+        # fallback: per-row loop for predicates without a columnar form
+        # (e.g. in_lambda), matching the reference's evaluation exactly
         mask = np.empty(n, dtype=bool)
         for i in range(n):
             mask[i] = predicate.do_include({f: decoded[f][i] for f in pred_fields})

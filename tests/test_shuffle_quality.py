@@ -1,0 +1,55 @@
+"""Shuffle-quality assertions (reference: ``tests/test_end_to_end.py:329-360``
+``test_stable_pieces_order``/drop-ratio correlation): decorrelation must
+improve monotonically from no-shuffle → row-group shuffle → row-group shuffle
+with row-drop partitioning."""
+
+import pytest
+
+from petastorm_tpu.test_util.shuffling_analysis import (
+    compute_correlation_distribution, generate_shuffle_analysis_dataset,
+)
+
+
+@pytest.fixture(scope='module')
+def shuffle_dataset(tmp_path_factory):
+    url = 'file://' + str(tmp_path_factory.mktemp('shuffle')) + '/ds'
+    generate_shuffle_analysis_dataset(url, num_rows=1000, rowgroup_size=100)
+    return url
+
+
+def test_unshuffled_is_fully_correlated(shuffle_dataset):
+    corr = compute_correlation_distribution(
+        shuffle_dataset, num_runs=2, shuffle_row_groups=False,
+        reader_pool_type='dummy')
+    assert corr > 0.97
+
+
+def test_rowgroup_shuffle_decorrelates(shuffle_dataset):
+    corr = compute_correlation_distribution(
+        shuffle_dataset, num_runs=5, shuffle_row_groups=True,
+        reader_pool_type='dummy')
+    # row order inside each group is still sequential, so correlation drops
+    # but cannot vanish with only 10 row-groups
+    assert corr < 0.6
+
+
+def test_row_drop_partitions_improve_decorrelation(shuffle_dataset):
+    base = compute_correlation_distribution(
+        shuffle_dataset, num_runs=5, shuffle_row_groups=True,
+        reader_pool_type='dummy')
+    dropped = compute_correlation_distribution(
+        shuffle_dataset, num_runs=5, shuffle_row_groups=True,
+        shuffle_row_drop_partitions=5, reader_pool_type='dummy')
+    # each row-group read 5x keeping 1/5 of rows -> finer-grained
+    # interleaving -> measurably better decorrelation (reference asserts the
+    # same direction, test_end_to_end.py:350-360)
+    assert dropped < base
+
+
+def test_row_drop_preserves_exactly_once(shuffle_dataset):
+    from petastorm_tpu.reader import make_reader
+    with make_reader(shuffle_dataset, shuffle_row_groups=True,
+                     shuffle_row_drop_partitions=4,
+                     reader_pool_type='dummy') as reader:
+        ids = sorted(r.id for r in reader)
+    assert ids == list(range(1000))
