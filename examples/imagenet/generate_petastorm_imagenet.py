@@ -1,0 +1,86 @@
+"""Materialize an ImageNet-style Parquet dataset.
+
+Mirror of the reference pipeline
+(``examples/imagenet/generate_petastorm_imagenet.py:1-130``), Spark-free:
+rows come either from a directory tree of real images
+(``<root>/<noun_id>/*.jpg|png``, the ImageNet layout) or from a synthetic
+generator for offline machines, and are written with
+:class:`~petastorm_tpu.etl.dataset_metadata.DatasetWriter` through the
+variable-size ``CompressedImageCodec`` schema.
+
+Run:
+    python -m examples.imagenet.generate_petastorm_imagenet \
+        --output-url file:///tmp/imagenet_petastorm [--images-dir /data/imagenet]
+"""
+
+import argparse
+import os
+
+import numpy as np
+
+from examples.imagenet.schema import ImagenetSchema
+from petastorm_tpu.etl.dataset_metadata import materialize_dataset, DatasetWriter
+
+_SYNSET_WORDS = ['tabby cat', 'golden retriever', 'steam locomotive',
+                 'espresso', 'lighthouse']
+
+
+def _rows_from_directory(images_dir):
+    """Yield schema rows from an ImageNet-layout directory tree."""
+    import cv2
+    for noun_id in sorted(os.listdir(images_dir)):
+        class_dir = os.path.join(images_dir, noun_id)
+        if not os.path.isdir(class_dir):
+            continue
+        for fname in sorted(os.listdir(class_dir)):
+            if not fname.lower().endswith(('.jpg', '.jpeg', '.png')):
+                continue
+            bgr = cv2.imread(os.path.join(class_dir, fname), cv2.IMREAD_COLOR)
+            if bgr is None:
+                continue
+            yield {'noun_id': noun_id,
+                   'text': noun_id.replace('_', ' '),
+                   'image': cv2.cvtColor(bgr, cv2.COLOR_BGR2RGB)}
+
+
+def _synthetic_rows(num_rows, seed=0):
+    """Variable-size synthetic images (offline stand-in for the real tree)."""
+    rng = np.random.RandomState(seed)
+    for i in range(num_rows):
+        cls = i % len(_SYNSET_WORDS)
+        h = int(rng.randint(180, 320))
+        w = int(rng.randint(180, 320))
+        image = (rng.rand(h, w, 3) * 100 + cls * 30).astype(np.uint8)
+        yield {'noun_id': 'n%08d' % cls,
+               'text': _SYNSET_WORDS[cls],
+               'image': image}
+
+
+def generate_petastorm_imagenet(output_url, images_dir=None, num_rows=128,
+                                rowgroup_size_mb=64):
+    rows = (_rows_from_directory(images_dir) if images_dir
+            else _synthetic_rows(num_rows))
+    count = 0
+    with materialize_dataset(output_url, ImagenetSchema):
+        with DatasetWriter(output_url, ImagenetSchema,
+                           rowgroup_size_rows=64,
+                           rowgroup_size_mb=rowgroup_size_mb) as writer:
+            for row in rows:
+                writer.write_row_dict(row)
+                count += 1
+    print('Wrote %d images to %s' % (count, output_url))
+    return count
+
+
+if __name__ == '__main__':
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--output-url',
+                        default='file:///tmp/imagenet_petastorm')
+    parser.add_argument('--images-dir', default=None,
+                        help='ImageNet-layout directory (<root>/<noun_id>/*.jpg);'
+                             ' synthetic images are generated when omitted')
+    parser.add_argument('--num-rows', type=int, default=128,
+                        help='synthetic row count (ignored with --images-dir)')
+    args = parser.parse_args()
+    generate_petastorm_imagenet(args.output_url, args.images_dir,
+                                args.num_rows)
