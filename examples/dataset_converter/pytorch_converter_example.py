@@ -1,0 +1,64 @@
+"""Train a PyTorch model straight from an in-memory DataFrame.
+
+Parity example for the reference's
+``examples/spark_dataset_converter/pytorch_converter_example.py``: the
+converter materializes the frame into a cached Parquet copy once, then
+``make_torch_dataloader`` streams batches from it. The reference's Spark
+DataFrame becomes a pandas DataFrame here (the pyspark flavor,
+``make_spark_converter``, accepts a Spark frame when pyspark is installed).
+
+Run:
+    python -m examples.dataset_converter.pytorch_converter_example
+"""
+
+import argparse
+import tempfile
+
+import numpy as np
+import pandas as pd
+import torch
+
+from petastorm_tpu.spark import make_dataframe_converter
+
+
+def _toy_frame(n=512, seed=0):
+    """Two gaussian blobs: a linearly separable binary problem."""
+    rng = np.random.RandomState(seed)
+    label = rng.randint(0, 2, n)
+    features = rng.randn(n, 4).astype(np.float32) + label[:, None] * 2.0
+    frame = pd.DataFrame(features, columns=['f0', 'f1', 'f2', 'f3'])
+    frame['label'] = label.astype(np.int64)
+    return frame
+
+
+def train(cache_dir=None, batch_size=64, epochs=2, lr=0.1):
+    cache_dir = cache_dir or tempfile.mkdtemp(prefix='converter_cache_')
+    converter = make_dataframe_converter(_toy_frame(),
+                                         'file://' + cache_dir)
+    model = torch.nn.Sequential(torch.nn.Linear(4, 16), torch.nn.ReLU(),
+                                torch.nn.Linear(16, 2))
+    optimizer = torch.optim.SGD(model.parameters(), lr=lr)
+    loss_fn = torch.nn.CrossEntropyLoss()
+
+    loss = torch.zeros(())
+    with converter.make_torch_dataloader(batch_size=batch_size,
+                                         num_epochs=epochs) as loader:
+        for step, batch in enumerate(loader):
+            features = torch.stack(
+                [batch['f%d' % i].float() for i in range(4)], dim=1)
+            optimizer.zero_grad()
+            loss = loss_fn(model(features), batch['label'].long())
+            loss.backward()
+            optimizer.step()
+            if step % 10 == 0:
+                print('step %d loss %.4f' % (step, loss.item()))
+    converter.delete()
+    return float(loss.item())
+
+
+if __name__ == '__main__':
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--cache-dir', default=None)
+    parser.add_argument('--epochs', type=int, default=2)
+    args = parser.parse_args()
+    train(args.cache_dir, epochs=args.epochs)

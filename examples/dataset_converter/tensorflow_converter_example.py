@@ -1,0 +1,59 @@
+"""Train a Keras model straight from an in-memory DataFrame.
+
+Parity example for the reference's
+``examples/spark_dataset_converter/tensorflow_converter_example.py``, using
+the Spark-free pandas flavor of the converter (see the pytorch variant for
+details).
+
+Run:
+    python -m examples.dataset_converter.tensorflow_converter_example
+"""
+
+import argparse
+import tempfile
+
+import numpy as np
+import pandas as pd
+
+from petastorm_tpu.spark import make_dataframe_converter
+
+
+def _toy_frame(n=512, seed=0):
+    rng = np.random.RandomState(seed)
+    label = rng.randint(0, 2, n)
+    features = rng.randn(n, 4).astype(np.float32) + label[:, None] * 2.0
+    frame = pd.DataFrame(features, columns=['f0', 'f1', 'f2', 'f3'])
+    frame['label'] = label.astype(np.int64)
+    return frame
+
+
+def train(cache_dir=None, batch_size=64, steps=16):
+    import tensorflow as tf
+
+    cache_dir = cache_dir or tempfile.mkdtemp(prefix='converter_cache_')
+    converter = make_dataframe_converter(_toy_frame(),
+                                         'file://' + cache_dir)
+    model = tf.keras.Sequential([
+        tf.keras.layers.Dense(16, activation='relu', input_shape=(4,)),
+        tf.keras.layers.Dense(2, activation='softmax'),
+    ])
+    model.compile(optimizer='sgd',
+                  loss='sparse_categorical_crossentropy',
+                  metrics=['accuracy'])
+
+    with converter.make_tf_dataset(batch_size=batch_size,
+                                   num_epochs=None) as dataset:
+        dataset = dataset.map(
+            lambda row: (tf.stack([row.f0, row.f1, row.f2, row.f3], axis=1),
+                         row.label))
+        history = model.fit(dataset, steps_per_epoch=steps, epochs=1,
+                            verbose=2)
+    converter.delete()
+    return history.history['loss'][-1]
+
+
+if __name__ == '__main__':
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--cache-dir', default=None)
+    args = parser.parse_args()
+    train(args.cache_dir)

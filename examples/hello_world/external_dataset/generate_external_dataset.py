@@ -1,0 +1,45 @@
+"""Generate a plain (non-petastorm) Parquet store with pyarrow.
+
+Parity example for the reference's
+``examples/hello_world/external_dataset/generate_external_dataset.py``,
+which uses a Spark ``DataFrame.write.parquet`` — here plain pyarrow writes
+the same shape of data. Such stores have no Unischema footer; they are read
+through ``make_batch_reader`` with an inferred schema.
+
+Run:
+    python -m examples.hello_world.external_dataset.generate_external_dataset \
+        --output-url file:///tmp/external_dataset
+"""
+
+import argparse
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from petastorm_tpu.fs import get_filesystem_and_path_or_paths
+
+
+def generate_external_dataset(output_url='file:///tmp/external_dataset',
+                              num_rows=100, rows_per_file=25):
+    """Write plain parquet files of (id, value1, value2) rows."""
+    fs, path = get_filesystem_and_path_or_paths(output_url)
+    fs.makedirs(path, exist_ok=True)
+    rng = np.random.RandomState(0)
+    for start in range(0, num_rows, rows_per_file):
+        ids = np.arange(start, min(start + rows_per_file, num_rows))
+        table = pa.table({
+            'id': ids.astype(np.int64),
+            'value1': rng.randint(0, 255, len(ids)).astype(np.int32),
+            'value2': rng.rand(len(ids)).astype(np.float64),
+        })
+        with fs.open('%s/part-%05d.parquet' % (path, start), 'wb') as f:
+            pq.write_table(table, f)
+    print('External dataset written to %s' % output_url)
+
+
+if __name__ == '__main__':
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--output-url', default='file:///tmp/external_dataset')
+    args = parser.parse_args()
+    generate_external_dataset(args.output_url)

@@ -1,0 +1,23 @@
+"""Read a plain Parquet store in pure Python via ``make_batch_reader``.
+
+Parity example for the reference's
+``examples/hello_world/external_dataset/python_hello_world.py``.
+"""
+
+import argparse
+
+from petastorm_tpu.reader import make_batch_reader
+
+
+def python_hello_world(dataset_url='file:///tmp/external_dataset'):
+    with make_batch_reader(dataset_url) as reader:
+        for batch in reader:
+            print('batch of %d rows; first id: %d'
+                  % (len(batch.id), batch.id[0]))
+
+
+if __name__ == '__main__':
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--dataset-url', default='file:///tmp/external_dataset')
+    args = parser.parse_args()
+    python_hello_world(args.dataset_url)

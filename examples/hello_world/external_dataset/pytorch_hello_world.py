@@ -1,0 +1,25 @@
+"""Consume a plain Parquet store from PyTorch via ``BatchedDataLoader``.
+
+Parity example for the reference's
+``examples/hello_world/external_dataset/pytorch_hello_world.py``.
+"""
+
+import argparse
+
+from petastorm_tpu.pytorch import BatchedDataLoader
+from petastorm_tpu.reader import make_batch_reader
+
+
+def pytorch_hello_world(dataset_url='file:///tmp/external_dataset'):
+    with BatchedDataLoader(make_batch_reader(dataset_url),
+                           batch_size=16) as loader:
+        for batch in loader:
+            print('id batch: %s' % batch['id'][:5])
+            break
+
+
+if __name__ == '__main__':
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--dataset-url', default='file:///tmp/external_dataset')
+    args = parser.parse_args()
+    pytorch_hello_world(args.dataset_url)

@@ -1,0 +1,25 @@
+"""Consume a plain Parquet store from TensorFlow via
+``make_petastorm_dataset``.
+
+Parity example for the reference's
+``examples/hello_world/external_dataset/tensorflow_hello_world.py``.
+"""
+
+import argparse
+
+from petastorm_tpu.reader import make_batch_reader
+from petastorm_tpu.tf_utils import make_petastorm_dataset
+
+
+def tensorflow_hello_world(dataset_url='file:///tmp/external_dataset'):
+    with make_batch_reader(dataset_url) as reader:
+        dataset = make_petastorm_dataset(reader)
+        for tensor in dataset.take(1):
+            print('first batch ids: %s' % tensor.id.numpy()[:5])
+
+
+if __name__ == '__main__':
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--dataset-url', default='file:///tmp/external_dataset')
+    args = parser.parse_args()
+    tensorflow_hello_world(args.dataset_url)

@@ -31,10 +31,11 @@ def _resize_transform(size=224):
         ]
         return frame
 
+    # strings can't live in device HBM: select only the dense image column
     return TransformSpec(
         resize_rows,
         edit_fields=[('image', np.uint8, (size, size, 3), False)],
-        selected_fields=['noun_id', 'image'])
+        selected_fields=['image'])
 
 
 def read_imagenet(dataset_url, batch_size=16, batches=4, size=224):

@@ -1,0 +1,96 @@
+"""MNIST training with PyTorch from a petastorm-format Parquet dataset.
+
+Parity example for the reference's ``examples/mnist/pytorch_example.py``:
+``make_reader`` streams decoded rows, :class:`petastorm_tpu.pytorch.DataLoader`
+batches/collates them into torch tensors, and a small CNN trains on CPU.
+Use :mod:`examples.mnist.jax_example` for the TPU-native flagship path.
+
+Run:
+    python -m examples.mnist.pytorch_example --generate \
+        --dataset-url file:///tmp/mnist_petastorm
+"""
+
+import argparse
+
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+
+class Net(nn.Module):
+    """Small MNIST CNN (same shape as the reference example's model)."""
+
+    def __init__(self):
+        super().__init__()
+        self.conv1 = nn.Conv2d(1, 10, kernel_size=5)
+        self.conv2 = nn.Conv2d(10, 20, kernel_size=5)
+        self.fc1 = nn.Linear(320, 50)
+        self.fc2 = nn.Linear(50, 10)
+
+    def forward(self, x):
+        x = F.relu(F.max_pool2d(self.conv1(x), 2))
+        x = F.relu(F.max_pool2d(self.conv2(x), 2))
+        x = x.view(-1, 320)
+        x = F.relu(self.fc1(x))
+        return F.log_softmax(self.fc2(x), dim=1)
+
+
+def _batches(dataset_url, batch_size, epochs, shuffle_buffer):
+    from petastorm_tpu.pytorch import DataLoader
+    from petastorm_tpu.reader import make_reader
+
+    reader = make_reader(dataset_url, num_epochs=epochs,
+                         schema_fields=['^digit$', '^image$'])
+    return DataLoader(reader, batch_size=batch_size,
+                      shuffling_queue_capacity=shuffle_buffer)
+
+
+def train(dataset_url, batch_size=32, epochs=1, lr=0.01, momentum=0.5,
+          log_interval=20, shuffle_buffer=256):
+    model = Net()
+    optimizer = torch.optim.SGD(model.parameters(), lr=lr, momentum=momentum)
+
+    model.train()
+    step = 0
+    loss = torch.zeros(())
+    with _batches(dataset_url, batch_size, epochs, shuffle_buffer) as loader:
+        for batch in loader:
+            images = batch['image'].float().unsqueeze(1) / 255.0
+            images = (images - 0.1307) / 0.3081
+            labels = batch['digit'].long()
+            optimizer.zero_grad()
+            loss = F.nll_loss(model(images), labels)
+            loss.backward()
+            optimizer.step()
+            if step % log_interval == 0:
+                print('step %d loss %.4f' % (step, loss.item()))
+            step += 1
+    return float(loss.item())
+
+
+def evaluate(dataset_url, model, batch_size=64):
+    model.eval()
+    correct = total = 0
+    with torch.no_grad():
+        with _batches(dataset_url, batch_size, 1, 0) as loader:
+            for batch in loader:
+                images = batch['image'].float().unsqueeze(1) / 255.0
+                images = (images - 0.1307) / 0.3081
+                pred = model(images).argmax(dim=1)
+                correct += int((pred == batch['digit'].long()).sum())
+                total += len(pred)
+    return correct / max(total, 1)
+
+
+if __name__ == '__main__':
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--dataset-url', default='file:///tmp/mnist_petastorm')
+    parser.add_argument('--generate', action='store_true',
+                        help='write a synthetic MNIST dataset first')
+    parser.add_argument('--batch-size', type=int, default=32)
+    parser.add_argument('--epochs', type=int, default=1)
+    args = parser.parse_args()
+    if args.generate:
+        from examples.mnist.jax_example import generate_synthetic_mnist
+        generate_synthetic_mnist(args.dataset_url)
+    train(args.dataset_url, batch_size=args.batch_size, epochs=args.epochs)

@@ -1,0 +1,57 @@
+"""MNIST training with TensorFlow/Keras from a petastorm-format dataset.
+
+Parity example for the reference's ``examples/mnist/tf_example.py``:
+``make_reader`` streams decoded rows, ``make_petastorm_dataset`` exposes them
+as a ``tf.data.Dataset``, and a small Keras model trains on it.
+
+Run:
+    python -m examples.mnist.tf_example --generate \
+        --dataset-url file:///tmp/mnist_petastorm
+"""
+
+import argparse
+
+
+def train(dataset_url, batch_size=32, epochs=1, steps_per_epoch=None):
+    import tensorflow as tf
+
+    from petastorm_tpu.reader import make_reader
+    from petastorm_tpu.tf_utils import make_petastorm_dataset
+
+    with make_reader(dataset_url, num_epochs=epochs,
+                     schema_fields=['^digit$', '^image$']) as reader:
+        dataset = make_petastorm_dataset(reader)
+        dataset = dataset.map(
+            lambda row: ((tf.cast(row.image, tf.float32) / 255.0 - 0.1307)
+                         / 0.3081, row.digit))
+        dataset = dataset.batch(batch_size)
+
+        model = tf.keras.Sequential([
+            tf.keras.layers.Reshape((28, 28, 1), input_shape=(28, 28)),
+            tf.keras.layers.Conv2D(10, 5, activation='relu'),
+            tf.keras.layers.MaxPool2D(),
+            tf.keras.layers.Flatten(),
+            tf.keras.layers.Dense(50, activation='relu'),
+            tf.keras.layers.Dense(10, activation='softmax'),
+        ])
+        model.compile(
+            optimizer='sgd',
+            loss='sparse_categorical_crossentropy',
+            metrics=['accuracy'])
+        history = model.fit(dataset, epochs=1,
+                            steps_per_epoch=steps_per_epoch, verbose=2)
+    return history.history['loss'][-1]
+
+
+if __name__ == '__main__':
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--dataset-url', default='file:///tmp/mnist_petastorm')
+    parser.add_argument('--generate', action='store_true',
+                        help='write a synthetic MNIST dataset first')
+    parser.add_argument('--batch-size', type=int, default=32)
+    parser.add_argument('--epochs', type=int, default=1)
+    args = parser.parse_args()
+    if args.generate:
+        from examples.mnist.jax_example import generate_synthetic_mnist
+        generate_synthetic_mnist(args.dataset_url)
+    train(args.dataset_url, batch_size=args.batch_size, epochs=args.epochs)
