@@ -27,7 +27,10 @@ setup(
         'tf': ['tensorflow'],
         'torch': ['torch'],
         'opencv': ['opencv-python'],
+        'spark': ['pyspark>=3.0.0'],
         'test': ['pytest'],
+        # run tests/test_spark_integration.py's integration class too:
+        'test-spark': ['pytest', 'pyspark>=3.0.0'],
     },
     entry_points={
         'console_scripts': [

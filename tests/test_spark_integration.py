@@ -1,0 +1,134 @@
+"""Spark surface tests: CLI flag plumbing (always), import gating (when
+pyspark is absent), and real pyspark integration (``importorskip``-gated,
+mirroring the reference's ``tests/test_spark_dataset_converter.py``).
+
+This environment ships no pyspark, so the integration class skips here; the
+gating class asserts the pyspark-requiring entry points fail loudly with
+actionable guidance instead of deep inside a Spark call.
+"""
+
+import argparse
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.tools.spark_session_cli import (
+    add_configure_spark_arguments, configure_spark, parse_session_config,
+)
+
+try:
+    import pyspark  # noqa: F401
+    HAS_PYSPARK = True
+except ImportError:
+    HAS_PYSPARK = False
+
+
+class _StubBuilder:
+    """Duck-typed SparkSession.Builder recording applied settings."""
+
+    def __init__(self):
+        self.configs = {}
+        self.master_url = None
+
+    def config(self, key, value):
+        self.configs[key] = value
+        return self
+
+    def master(self, url):
+        self.master_url = url
+        return self
+
+
+class TestSparkSessionCli:
+    def _parse(self, argv):
+        parser = argparse.ArgumentParser()
+        add_configure_spark_arguments(parser)
+        return parser.parse_args(argv)
+
+    def test_flags_applied_to_builder(self):
+        args = self._parse(['--master', 'local[2]',
+                            '--spark-session-config',
+                            'spark.executor.cores=2',
+                            'spark.executor.memory=10g'])
+        builder = configure_spark(_StubBuilder(), args)
+        assert builder.master_url == 'local[2]'
+        assert builder.configs == {'spark.executor.cores': '2',
+                                   'spark.executor.memory': '10g'}
+
+    def test_defaults_are_noop(self):
+        builder = configure_spark(_StubBuilder(), self._parse([]))
+        assert builder.master_url is None and builder.configs == {}
+
+    def test_missing_arguments_rejected(self):
+        with pytest.raises(RuntimeError, match='add_configure_spark_arguments'):
+            configure_spark(_StubBuilder(), argparse.Namespace())
+
+    @pytest.mark.parametrize('bad', ['noequals', '=value', 'key='])
+    def test_malformed_config_pair_rejected(self, bad):
+        with pytest.raises(ValueError, match='key=value'):
+            parse_session_config([bad])
+
+    def test_value_may_contain_equals(self):
+        assert parse_session_config(['k=a=b']) == {'k': 'a=b'}
+
+
+@pytest.mark.skipif(HAS_PYSPARK, reason='gating only observable sans pyspark')
+class TestPysparkAbsenceGating:
+    def test_make_spark_converter_guides_to_dataframe_converter(self):
+        from petastorm_tpu.spark import make_spark_converter
+        with pytest.raises(ImportError, match='make_dataframe_converter'):
+            make_spark_converter(object())
+
+    def test_dataset_as_rdd_requires_pyspark(self):
+        from petastorm_tpu.spark_utils import dataset_as_rdd
+        with pytest.raises(ImportError, match='pyspark'):
+            dataset_as_rdd('file:///tmp/nope', None)
+
+
+@pytest.mark.skipif(not HAS_PYSPARK, reason='pyspark not installed')
+class TestPysparkIntegration:
+    """Executes only where pyspark is installed (the reference's CI shape)."""
+
+    @pytest.fixture(scope='class')
+    def spark(self):
+        from pyspark.sql import SparkSession
+        session = (SparkSession.builder.master('local[2]')
+                   .appName('petastorm_tpu-tests').getOrCreate())
+        yield session
+        session.stop()
+
+    def test_materialize_with_spark_write(self, spark, tmp_path):
+        from petastorm_tpu import make_batch_reader
+        from petastorm_tpu.etl.dataset_metadata import materialize_dataset
+        from petastorm_tpu.unischema import Unischema, UnischemaField
+        import pyarrow as pa
+        from petastorm_tpu.codecs import ScalarCodec
+
+        schema = Unischema('S', [
+            UnischemaField('id', np.int64, (), ScalarCodec(pa.int64()), False),
+        ])
+        url = 'file://' + str(tmp_path / 'spark_ds')
+        with materialize_dataset(url, schema, row_group_size_mb=1,
+                                 spark=spark):
+            spark.range(100).write.parquet(url[len('file://'):])
+        with make_batch_reader(url) as reader:
+            total = sum(len(b.id) for b in reader)
+        assert total == 100
+
+    def test_make_spark_converter_roundtrip(self, spark, tmp_path):
+        from petastorm_tpu.spark import make_spark_converter
+        df = spark.range(64).selectExpr('id', 'id * 2 as doubled')
+        converter = make_spark_converter(
+            df, parent_cache_dir_url='file://' + str(tmp_path / 'cache'))
+        assert len(converter) == 64
+        with converter.make_torch_dataloader(batch_size=16) as loader:
+            batch = next(iter(loader))
+        assert len(batch['id']) == 16
+        converter.delete()
+
+    def test_dataset_as_rdd(self, spark, synthetic_dataset):
+        from petastorm_tpu.spark_utils import dataset_as_rdd
+        rdd = dataset_as_rdd(synthetic_dataset.url, spark,
+                             schema_fields=['^id$'])
+        ids = sorted(row.id for row in rdd.collect())
+        assert ids == sorted(d['id'] for d in synthetic_dataset.data)
