@@ -69,7 +69,12 @@ def make_jax_loader(dataset_url_or_urls, batch_size, mesh=None, data_axes=None,
         multi-host training drive a FIXED number of steps per epoch (e.g.
         ``steps = global_rows // (batch_size * jax.process_count())``) over
         an infinite loader (``num_epochs=None``), the standard TPU-pod
-        pattern.
+        pattern — :meth:`JaxLoader.iter_steps` packages exactly that.
+
+    A fully consumed loader may be iterated again: re-iteration resets the
+    reader and replays the dataset, matching the torch loaders' ergonomics.
+    Replays reshuffle at whatever levels shuffling is enabled —
+    ``shuffle_row_groups`` (reader, on by default) and/or ``shuffle_rows``.
     """
     from petastorm_tpu.reader import make_batch_reader
     factory = reader_factory or make_batch_reader
@@ -120,6 +125,7 @@ class JaxLoader:
         self._stop_event = threading.Event()
         self._stage_error = None
         self._exhausted = False
+        self._epoch = 0
 
     # -- sharding ------------------------------------------------------------
 
@@ -144,9 +150,27 @@ class JaxLoader:
 
     def __iter__(self):
         if self._stage_thread is not None:
-            raise RuntimeError('JaxLoader supports a single iteration pass; '
-                               'construct a new loader (or use num_epochs) '
-                               'for more')
+            if self._stop_event.is_set():
+                raise RuntimeError('JaxLoader was stopped; construct a new '
+                                   'loader to iterate again')
+            # Error check precedes the in-progress check: an error surfaced
+            # through the empty-queue path leaves _exhausted False, and
+            # "already being iterated" would be unactionable (thread is dead).
+            if self._stage_error is not None:
+                raise RuntimeError('JaxLoader cannot restart after a staging '
+                                   'error') from self._stage_error
+            if not self._exhausted:
+                raise RuntimeError('JaxLoader is already being iterated; '
+                                   'finish or stop() the current pass first')
+            # The consumer can observe the end sentinel a beat before the
+            # stage thread finishes its teardown; it is exiting, so join
+            # rather than misreading aliveness as an in-progress pass.
+            self._stage_thread.join(timeout=10)
+            # Epoch replay: restart the (fully consumed) reader and stage a
+            # fresh pass — same ergonomics as the torch loaders' re-iteration.
+            self._reader.reset()
+            self._exhausted = False
+            self._epoch += 1
         self._out_queue = queue.Queue(maxsize=self._prefetch)
         self._stage_thread = threading.Thread(target=self._stage_loop,
                                               daemon=True)
@@ -185,6 +209,48 @@ class JaxLoader:
                 raise StopIteration
             return item
 
+    def iter_steps(self, num_steps):
+        """Yield exactly ``num_steps`` batches, continuing across calls.
+
+        The multi-host-safe epoch idiom (see the warning on
+        :func:`make_jax_loader`): over an infinite loader
+        (``num_epochs=None``), every host steps the same fixed count per
+        "epoch" regardless of shard imbalance, so collectives stay aligned::
+
+            steps = global_rows // (batch_size * jax.process_count())
+            for epoch in range(epochs):
+                for batch in loader.iter_steps(steps):
+                    ...
+
+        Raises :class:`RuntimeError` if the loader runs dry before
+        ``num_steps`` (finite ``num_epochs`` with too little data) — on a
+        pod that would mean a silent divergence of step counts across hosts.
+        """
+        if self._out_queue is None or self._exhausted:
+            iter(self)  # start — or replay, matching plain iteration
+        for step in range(num_steps):
+            try:
+                yield next(self)
+                continue
+            except StopIteration:
+                pass
+            # A prior call may have consumed the pass exactly to its end,
+            # leaving the end sentinel unobserved (_exhausted was False
+            # until just now). That is an epoch boundary, not running dry:
+            # replay and retry, consistent with a fresh iter_steps call.
+            if (step == 0 and not self._stop_event.is_set()
+                    and self._stage_error is None):
+                iter(self)
+                try:
+                    yield next(self)
+                    continue
+                except StopIteration:
+                    pass
+            raise RuntimeError(
+                'loader exhausted after %d of %d steps; use '
+                'num_epochs=None so fixed-step epochs never run dry'
+                % (step, num_steps)) from None
+
     # -- staging pipeline (background thread) --------------------------------
 
     def _make_buffer(self):
@@ -204,9 +270,12 @@ class JaxLoader:
         # explicitly (the overflow error says so).
         extra = (self._extra_capacity if self._extra_capacity is not None
                  else capacity)
+        # seed offset by epoch: replay must not repeat epoch 0's order
+        seed = (None if self._seed is None
+                else (self._seed + self._epoch) % (2 ** 32))
         return BatchedRandomShufflingBuffer(
             capacity, min_after, self._batch_size,
-            extra_capacity=extra, seed=self._seed)
+            extra_capacity=extra, seed=seed)
 
     def _stage_loop(self):
         try:

@@ -17,6 +17,10 @@ logger = logging.getLogger(__name__)
 
 _VENTILATION_INTERVAL_S = 0.01
 
+# Seed advance per reset() sweep; far larger than any realistic epoch count
+# so `seed + epoch` ranges of successive sweeps never collide.
+_RESET_SEED_STRIDE = 0x9E3779B1
+
 
 class Ventilator(metaclass=ABCMeta):
     """Base class for ventilators (reference: ``ventilator.py:26-52``)."""
@@ -128,6 +132,13 @@ class ConcurrentVentilator(Ventilator):
         self._stop_requested = False
         self._cursor = 0
         self._epoch = 0
+        # Epoch numbering restarts at 0 (the reader's resume math depends on
+        # it), so advance the seed instead: without this, every reset sweep
+        # would replay the first sweep's "random" row-group orders verbatim.
+        # Deterministic, so multi-host readers that reset in lockstep still
+        # agree arithmetically, and state_dict()'s captured seed reproduces
+        # the order on resume.
+        self._seed = (self._seed + _RESET_SEED_STRIDE) % (2 ** 32)
         self._in_flight = 0
         self._iterations_remaining = self._initial_iterations
         self.start()

@@ -131,12 +131,96 @@ def test_bad_divisibility_rejected(scalar_dataset):
                         fields=['^id$'])
 
 
-def test_single_pass_guard(scalar_dataset):
+def test_concurrent_iteration_guard(scalar_dataset):
     loader = make_jax_loader(scalar_dataset.url, batch_size=16, fields=['^id$'])
     iter(loader)
-    with pytest.raises(RuntimeError, match='single iteration'):
+    with pytest.raises(RuntimeError, match='already being iterated'):
         iter(loader)
     loader.stop()
+
+
+def test_reiteration_replays_epochs(scalar_dataset):
+    with make_jax_loader(scalar_dataset.url, batch_size=10, fields=['^id$'],
+                         last_batch='short', shuffle_rows=True,
+                         seed=3) as loader:
+        first = np.concatenate([np.asarray(b['id']) for b in loader])
+        second = np.concatenate([np.asarray(b['id']) for b in loader])
+    # same multiset of rows each epoch...
+    assert sorted(first.tolist()) == sorted(second.tolist())
+    assert len(first) == 100
+    # ...but the replay is reshuffled, not a verbatim repeat
+    assert first.tolist() != second.tolist()
+
+
+def test_reiteration_after_stop_rejected(scalar_dataset):
+    loader = make_jax_loader(scalar_dataset.url, batch_size=16, fields=['^id$'])
+    list(loader)
+    loader.stop()
+    with pytest.raises(RuntimeError, match='stopped'):
+        iter(loader)
+
+
+def test_reiteration_after_midpass_stop_rejected(scalar_dataset):
+    loader = make_jax_loader(scalar_dataset.url, batch_size=16, fields=['^id$'])
+    it = iter(loader)
+    next(it)
+    loader.stop()
+    # must not claim the pass is still in progress — it was stopped
+    with pytest.raises(RuntimeError, match='stopped'):
+        iter(loader)
+
+
+def test_reiteration_reshuffles_row_groups(scalar_dataset):
+    # default shuffle_row_groups=True, no row-level shuffle: replay order
+    # still differs because the ventilator reseeds per reset sweep
+    with make_jax_loader(scalar_dataset.url, batch_size=10, fields=['^id$'],
+                         last_batch='short', seed=0) as loader:
+        first = np.concatenate([np.asarray(b['id']) for b in loader])
+        second = np.concatenate([np.asarray(b['id']) for b in loader])
+    assert sorted(first.tolist()) == sorted(second.tolist())
+    assert first.tolist() != second.tolist()
+
+
+def test_iter_steps_replays_after_exhaustion(scalar_dataset):
+    with make_jax_loader(scalar_dataset.url, batch_size=16, fields=['^id$'],
+                         num_epochs=1) as loader:
+        assert len(list(loader)) == 6
+        # exhausted finite loader: iter_steps replays like plain iteration
+        assert len(list(loader.iter_steps(4))) == 4
+
+
+def test_iter_steps_exact_epoch_boundary_replays(scalar_dataset):
+    # a call that consumes the finite pass exactly to its end leaves the end
+    # sentinel unobserved; the next call must replay, not claim 'ran dry'
+    with make_jax_loader(scalar_dataset.url, batch_size=16, fields=['^id$'],
+                         num_epochs=1) as loader:
+        assert len(list(loader.iter_steps(6))) == 6
+        assert len(list(loader.iter_steps(6))) == 6
+
+
+def test_huge_seed_replay_does_not_crash(scalar_dataset):
+    with make_jax_loader(scalar_dataset.url, batch_size=16, fields=['^id$'],
+                         shuffle_rows=True, seed=2 ** 32 - 1) as loader:
+        assert len(list(loader)) == 6
+        assert len(list(loader)) == 6
+
+
+def test_iter_steps_fixed_count_spans_epochs(scalar_dataset):
+    # 100 rows / batch 16 = 6 full batches per sweep; 8 steps must keep
+    # going into the next epoch without running dry (num_epochs=None).
+    with make_jax_loader(scalar_dataset.url, batch_size=16, fields=['^id$'],
+                         num_epochs=None) as loader:
+        got = list(loader.iter_steps(8))
+        assert len(got) == 8
+        # continues where it left off on the next call
+        assert len(list(loader.iter_steps(3))) == 3
+
+
+def test_iter_steps_running_dry_raises(scalar_dataset):
+    with make_jax_loader(scalar_dataset.url, batch_size=16, fields=['^id$'],
+                         num_epochs=1) as loader:
+        with pytest.raises(RuntimeError, match='num_epochs=None'):
+            list(loader.iter_steps(7))
 
 
 def test_next_after_stop_raises_stop_iteration(scalar_dataset):

@@ -203,6 +203,33 @@ def test_ventilator_reset_reruns_epochs():
     assert len(got) == 20
 
 
+def test_ventilator_reset_reshuffles_item_order():
+    def run_sweep(vent, sink):
+        while not vent.completed():
+            time.sleep(0.005)
+            for _ in range(len(sink)):
+                vent.processed_item()
+
+    sweeps = []
+    sink = []
+    vent = ConcurrentVentilator(lambda value: sink.append(value),
+                                [{'value': i} for i in range(32)],
+                                iterations=1, randomize_item_order=True,
+                                random_seed=5)
+    vent.start()
+    run_sweep(vent, sink)
+    sweeps.append(list(sink))
+    for _ in range(2):
+        sink.clear()
+        vent.reset()
+        run_sweep(vent, sink)
+        sweeps.append(list(sink))
+    for sweep in sweeps:
+        assert sorted(sweep) == list(range(32))
+    # each reset sweep draws a fresh permutation, not a verbatim replay
+    assert sweeps[0] != sweeps[1] and sweeps[1] != sweeps[2]
+
+
 def test_thread_pool_requires_stop_before_join():
     pool = ThreadPool(1)
     pool.start(IdentityWorker)
