@@ -160,8 +160,31 @@ class JaxLoader:
                 raise RuntimeError('JaxLoader cannot restart after a staging '
                                    'error') from self._stage_error
             if not self._exhausted:
-                raise RuntimeError('JaxLoader is already being iterated; '
-                                   'finish or stop() the current pass first')
+                # Either a pass is genuinely in progress, or it ended with
+                # the sentinel unobserved (iter_steps consuming exactly to
+                # the boundary). A finished stage thread joins immediately;
+                # an in-progress one is blocked producing and times out.
+                self._stage_thread.join(timeout=1)
+                if self._stage_thread.is_alive():
+                    raise RuntimeError('JaxLoader is already being iterated; '
+                                       'finish or stop() the current pass '
+                                       'first')
+                pending = []
+                try:
+                    while True:
+                        pending.append(self._out_queue.get_nowait())
+                except queue.Empty:
+                    pass
+                if pending == [_SENTINEL_END]:
+                    self._exhausted = True  # boundary case: pass is complete
+                else:
+                    # real batches remain unconsumed — no concurrent
+                    # producer (thread is dead), so putting them back fits
+                    for item in pending:
+                        self._out_queue.put_nowait(item)
+                    raise RuntimeError('JaxLoader is already being iterated; '
+                                       'finish or stop() the current pass '
+                                       'first')
             # The consumer can observe the end sentinel a beat before the
             # stage thread finishes its teardown; it is exiting, so join
             # rather than misreading aliveness as an in-progress pass.
@@ -246,6 +269,9 @@ class JaxLoader:
                     continue
                 except StopIteration:
                     pass
+            if self._stop_event.is_set():
+                raise RuntimeError(
+                    'loader was stopped after %d of %d steps' % (step, num_steps))
             raise RuntimeError(
                 'loader exhausted after %d of %d steps; use '
                 'num_epochs=None so fixed-step epochs never run dry'

@@ -72,6 +72,10 @@ class ConcurrentVentilator(Ventilator):
         self._iterations_remaining = iterations
         self._max_queue_size = max_ventilation_queue_size or max(1, len(self._items))
         self._randomize = randomize_item_order
+        # None = nondeterministic: draw once so the epoch/reset arithmetic
+        # (`seed + epoch`, reset stride) always has an int to work with.
+        if random_seed is None:
+            random_seed = int(np.random.randint(0, 2 ** 32))
         self._seed = random_seed
 
         self._epoch = 0

@@ -1,0 +1,65 @@
+"""Source hygiene enforced with the stdlib (flake8/mypy aren't on the TPU
+image; `setup.cfg`/`mypy.ini` configure them for CI — this keeps the cheap
+invariants locally enforced)."""
+
+import ast
+import glob
+import os
+import tokenize
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SOURCES = sorted(
+    glob.glob(os.path.join(REPO, 'petastorm_tpu', '**', '*.py'),
+              recursive=True)
+    + glob.glob(os.path.join(REPO, 'examples', '**', '*.py'), recursive=True)
+    + glob.glob(os.path.join(REPO, 'tests', '*.py'))
+    + [os.path.join(REPO, p) for p in ('setup.py', 'bench.py',
+                                       '__graft_entry__.py')])
+
+MAX_LINE = 120
+
+
+def _read(path):
+    with tokenize.open(path) as f:  # honors coding declarations
+        return f.read()
+
+
+def test_sources_found():
+    assert len(SOURCES) > 60
+
+
+def test_all_sources_parse():
+    for path in SOURCES:
+        ast.parse(_read(path), filename=path)
+
+
+def test_no_tabs_no_overlong_lines():
+    offenders = []
+    for path in SOURCES:
+        for lineno, line in enumerate(_read(path).splitlines(), 1):
+            if '\t' in line:
+                offenders.append('%s:%d: tab' % (path, lineno))
+            if len(line) > MAX_LINE:
+                offenders.append('%s:%d: %d chars' % (path, lineno, len(line)))
+    assert not offenders, '\n'.join(offenders)
+
+
+def test_no_print_in_library_code():
+    """Library modules log; only CLIs/examples/tools/benchmarks print."""
+    allowed = ('tools', 'benchmark', 'etl%smetadata_util' % os.sep,
+               'etl%spetastorm_generate_metadata' % os.sep, 'test_util')
+    offenders = []
+    for path in SOURCES:
+        rel = os.path.relpath(path, REPO)
+        if not rel.startswith('petastorm_tpu'):
+            continue
+        if any(a in rel for a in allowed):
+            continue
+        tree = ast.parse(_read(path), filename=path)
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == 'print'):
+                offenders.append('%s:%d' % (rel, node.lineno))
+    assert not offenders, 'print() in library code: %s' % offenders

@@ -198,6 +198,34 @@ def test_iter_steps_exact_epoch_boundary_replays(scalar_dataset):
         assert len(list(loader.iter_steps(6))) == 6
 
 
+def test_plain_iter_after_exact_boundary_iter_steps(scalar_dataset):
+    # iter_steps to the exact end leaves the sentinel unobserved; a plain
+    # for-loop afterwards (e.g. an eval sweep) must replay, not error
+    with make_jax_loader(scalar_dataset.url, batch_size=16, fields=['^id$'],
+                         num_epochs=1) as loader:
+        assert len(list(loader.iter_steps(6))) == 6
+        assert len(list(loader)) == 6
+
+
+def test_none_seed_replay(scalar_dataset):
+    # seed=None (nondeterministic) must survive shuffled reads and resets
+    with make_jax_loader(scalar_dataset.url, batch_size=16, fields=['^id$'],
+                         seed=None, shuffle_rows=True,
+                         shuffle_row_groups=True) as loader:
+        assert len(list(loader)) == 6
+        assert len(list(loader)) == 6
+
+
+def test_iter_steps_stop_reports_stopped(scalar_dataset):
+    loader = make_jax_loader(scalar_dataset.url, batch_size=16,
+                             fields=['^id$'], num_epochs=None)
+    steps = loader.iter_steps(10)
+    next(steps)
+    loader.stop()
+    with pytest.raises(RuntimeError, match='stopped'):
+        list(steps)
+
+
 def test_huge_seed_replay_does_not_crash(scalar_dataset):
     with make_jax_loader(scalar_dataset.url, batch_size=16, fields=['^id$'],
                          shuffle_rows=True, seed=2 ** 32 - 1) as loader:
