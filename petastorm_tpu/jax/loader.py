@@ -125,6 +125,7 @@ class JaxLoader:
         self._stop_event = threading.Event()
         self._stage_error = None
         self._exhausted = False
+        self._draining = False
         self._epoch = 0
 
     # -- sharding ------------------------------------------------------------
@@ -169,22 +170,29 @@ class JaxLoader:
                     raise RuntimeError('JaxLoader is already being iterated; '
                                        'finish or stop() the current pass '
                                        'first')
-                pending = []
+                # _draining keeps a concurrently blocked consumer from
+                # misreading the momentarily empty queue as exhaustion
+                # (it would silently lose the batches we put back below)
+                self._draining = True
                 try:
-                    while True:
-                        pending.append(self._out_queue.get_nowait())
-                except queue.Empty:
-                    pass
-                if pending == [_SENTINEL_END]:
-                    self._exhausted = True  # boundary case: pass is complete
-                else:
-                    # real batches remain unconsumed — no concurrent
-                    # producer (thread is dead), so putting them back fits
-                    for item in pending:
-                        self._out_queue.put_nowait(item)
-                    raise RuntimeError('JaxLoader is already being iterated; '
-                                       'finish or stop() the current pass '
-                                       'first')
+                    pending = []
+                    try:
+                        while True:
+                            pending.append(self._out_queue.get_nowait())
+                    except queue.Empty:
+                        pass
+                    if pending == [_SENTINEL_END]:
+                        self._exhausted = True  # boundary: pass is complete
+                    else:
+                        # real batches remain unconsumed — no concurrent
+                        # producer (thread is dead), so putting them back fits
+                        for item in pending:
+                            self._out_queue.put_nowait(item)
+                        raise RuntimeError('JaxLoader is already being '
+                                           'iterated; finish or stop() the '
+                                           'current pass first')
+                finally:
+                    self._draining = False
             # The consumer can observe the end sentinel a beat before the
             # stage thread finishes its teardown; it is exiting, so join
             # rather than misreading aliveness as an in-progress pass.
@@ -221,7 +229,8 @@ class JaxLoader:
                     raise StopIteration
                 if (self._stage_thread is not None
                         and not self._stage_thread.is_alive()
-                        and self._out_queue.empty()):
+                        and self._out_queue.empty()
+                        and not self._draining):
                     self._exhausted = True
                     raise StopIteration
                 continue

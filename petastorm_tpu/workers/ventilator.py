@@ -75,7 +75,7 @@ class ConcurrentVentilator(Ventilator):
         # None = nondeterministic: draw once so the epoch/reset arithmetic
         # (`seed + epoch`, reset stride) always has an int to work with.
         if random_seed is None:
-            random_seed = int(np.random.randint(0, 2 ** 32))
+            random_seed = int(np.random.randint(0, 2 ** 32, dtype=np.uint32))
         self._seed = random_seed
 
         self._epoch = 0

@@ -307,6 +307,102 @@ def test_diagnostics_property(synthetic_dataset):
         assert 'items_ventilated' in reader.diagnostics
 
 
+def test_url_with_added_slashes(synthetic_dataset):
+    # reference: test_simple_read_with_added_slashes (:285)
+    with make_reader(synthetic_dataset.url + '///',
+                     reader_pool_type='dummy') as reader:
+        assert len(list(reader)) == 100
+
+
+def test_stable_pieces_order_without_shuffle(synthetic_dataset):
+    # reference: test_stable_pieces_order (:495) — two unshuffled readers
+    # emit identical row order
+    orders = []
+    for _ in range(2):
+        with make_reader(synthetic_dataset.url, shuffle_row_groups=False,
+                         reader_pool_type='dummy',
+                         schema_fields=['^id$']) as reader:
+            orders.append([r.id for r in reader])
+    assert orders[0] == orders[1]
+
+
+def test_persisted_codec_wins_over_user_instance(synthetic_dataset):
+    # reference: test_use_persisted_codec_and_not_provided_by_user (:528) —
+    # a schema_fields UnischemaField carrying a different codec is matched by
+    # name; the dataset's stored codec decodes the data
+    from petastorm_tpu.codecs import CompressedNdarrayCodec
+    from petastorm_tpu.unischema import UnischemaField
+    doctored = UnischemaField('matrix', np.float64, (32, 16, 3),
+                              CompressedNdarrayCodec(), False)
+    with make_reader(synthetic_dataset.url,
+                     schema_fields=[doctored, '^id$'],
+                     reader_pool_type='dummy') as reader:
+        row = next(reader)
+    expected = _fields_by_id(synthetic_dataset.data)
+    np.testing.assert_array_equal(row.matrix, expected[row.id]['matrix'])
+
+
+@pytest.mark.parametrize('pool', ['dummy', 'process'])
+def test_transform_with_predicate(synthetic_dataset, pool):
+    # reference: test_transform_function_with_predicate (:165) — predicate
+    # narrows rows first, transform then edits the surviving frame
+    def double_id2(frame):
+        frame['id2'] = frame['id2'] * 2
+        return frame
+
+    with make_reader(synthetic_dataset.url,
+                     predicate=in_lambda(['id'], lambda v: v['id'] % 2 == 0),
+                     transform_spec=TransformSpec(double_id2),
+                     schema_fields=['^id$', '^id2$'],
+                     reader_pool_type=pool, workers_count=2) as reader:
+        rows = list(reader)
+    assert rows and all(r.id % 2 == 0 for r in rows)
+    expected = _fields_by_id(synthetic_dataset.data)
+    for r in rows:
+        assert r.id2 == expected[r.id]['id2'] * 2
+
+
+def test_multithreaded_consumers(synthetic_dataset):
+    # reference: test_multithreaded_reads (:803) — several consumer threads
+    # share one reader; union of consumed ids is exactly the dataset
+    import threading
+    seen = []
+    lock = threading.Lock()
+    with make_reader(synthetic_dataset.url, reader_pool_type='thread',
+                     workers_count=2, schema_fields=['^id$']) as reader:
+        def consume():
+            while True:
+                try:
+                    row = next(reader)
+                except StopIteration:
+                    return
+                with lock:
+                    seen.append(row.id)
+
+        threads = [threading.Thread(target=consume) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    assert sorted(seen) == list(range(100))
+
+
+def test_invalid_num_epochs_rejected(synthetic_dataset):
+    # reference: test_num_epochs_value_error (:609)
+    for bad in (0, -1):
+        with pytest.raises(ValueError):
+            make_reader(synthetic_dataset.url, num_epochs=bad,
+                        reader_pool_type='dummy')
+
+
+def test_read_after_context_exit_raises(synthetic_dataset):
+    # reference: test_should_fail_if_reading_out_of_context_manager (:815)
+    with make_reader(synthetic_dataset.url, reader_pool_type='dummy') as reader:
+        next(reader)
+    with pytest.raises(RuntimeError):
+        next(reader)
+
+
 # -- process-pool-specific behaviors (beyond the POOLS matrix above) --------
 
 def test_process_pool_worker_error_propagates(synthetic_dataset):

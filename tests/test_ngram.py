@@ -200,6 +200,99 @@ class TestNGramEndToEnd:
         assert len(seen) == len(set(seen))
 
 
+def _write_ts_dataset(tmp_path, ts_values, name='ts_ds'):
+    from petastorm_tpu.etl.dataset_metadata import write_dataset
+    url = 'file://' + str(tmp_path / name)
+    rows = [{'ts': int(t), 'value': i, 'other': i * 0.5}
+            for i, t in enumerate(ts_values)]
+    write_dataset(url, TsSchema, rows, rowgroup_size_rows=len(rows))
+    return url
+
+
+@pytest.mark.parametrize('pool_type', ['dummy', 'thread', 'process'])
+class TestNGramDeltaThresholdEndToEnd:
+    """Sparse-timestamp datasets through a real reader (reference:
+    ``test_ngram_end_to_end.py:332-440``)."""
+
+    GAPPY = [0, 3, 8, 10, 11, 20, 23]
+
+    def test_large_threshold_admits_all(self, tmp_path, pool_type):
+        url = _write_ts_dataset(tmp_path, self.GAPPY)
+        ngram = NGram(fields={0: ['^ts$'], 1: ['^ts$', '^value$']},
+                      delta_threshold=100, timestamp_field='^ts$')
+        with make_reader(url, ngram=ngram, shuffle_row_groups=False,
+                         reader_pool_type=pool_type) as reader:
+            windows = list(reader)
+        assert len(windows) == len(self.GAPPY) - 1
+
+    def test_threshold_rejects_gaps(self, tmp_path, pool_type):
+        url = _write_ts_dataset(tmp_path, self.GAPPY)
+        ngram = NGram(fields={0: ['^ts$'], 1: ['^ts$', '^value$']},
+                      delta_threshold=4, timestamp_field='^ts$')
+        with make_reader(url, ngram=ngram, shuffle_row_groups=False,
+                         reader_pool_type=pool_type) as reader:
+            starts = sorted(w[0].ts for w in reader)
+        # admitted pairs: (0,3), (8,10), (10,11), (20,23)
+        assert starts == [0, 8, 10, 20]
+
+    def test_small_threshold_over_stride_yields_nothing(self, tmp_path,
+                                                        pool_type):
+        url = _write_ts_dataset(tmp_path, list(range(0, 100, 5)))
+        ngram = NGram(fields={0: ['^ts$'], 1: ['^ts$']},
+                      delta_threshold=2, timestamp_field='^ts$')
+        with make_reader(url, ngram=ngram, shuffle_row_groups=False,
+                         reader_pool_type=pool_type) as reader:
+            assert list(reader) == []
+
+
+def test_ngram_length_one(synthetic_dataset):
+    # reference: test_ngram_length_1 (:495) — degenerate window = plain rows
+    ngram = NGram(fields={0: ['^id$']}, delta_threshold=1,
+                  timestamp_field='^id$')
+    with make_reader(synthetic_dataset.url, ngram=ngram,
+                     shuffle_row_groups=False) as reader:
+        windows = list(reader)
+    assert len(windows) == 100
+    assert sorted(w[0].id for w in windows) == list(range(100))
+
+
+@pytest.mark.parametrize('pool_type', ['dummy', 'thread'])
+def test_ngram_field_order_irrelevant(tmp_path, pool_type):
+    # reference: test_shuffled_fields (:521) — permuted field lists and
+    # unordered timestep keys must produce identical windows
+    url = _write_ts_dataset(tmp_path, list(range(12)))
+    a = NGram(fields={1: ['^value$', '^ts$', '^other$'], 0: ['^ts$']},
+              delta_threshold=1, timestamp_field='^ts$')
+    b = NGram(fields={0: ['^ts$'], 1: ['^other$', '^ts$', '^value$']},
+              delta_threshold=1, timestamp_field='^ts$')
+    results = []
+    for ngram in (a, b):
+        with make_reader(url, ngram=ngram, shuffle_row_groups=False,
+                         reader_pool_type=pool_type) as reader:
+            results.append([(w[0].ts, w[1].ts, w[1].value, w[1].other)
+                            for w in reader])
+    assert results[0] == results[1] and len(results[0]) == 11
+
+
+def test_ngram_tf_dataset_longer_window(synthetic_dataset):
+    # reference: test_ngram_basic_longer_tf (:228) — 3-step windows through
+    # the tf.data bridge keep per-timestep schemas and consecutive ids
+    tf = pytest.importorskip('tensorflow')
+    from petastorm_tpu.tf_utils import make_petastorm_dataset
+    ngram = NGram(fields={0: ['^id$'], 1: ['^id$', '^id2$'], 2: ['^id$']},
+                  delta_threshold=1, timestamp_field='^id$')
+    with make_reader(synthetic_dataset.url, ngram=ngram,
+                     shuffle_row_groups=False) as reader:
+        dataset = make_petastorm_dataset(reader)
+        seen = 0
+        for window in dataset.take(20):
+            assert int(window[1].id) == int(window[0].id) + 1
+            assert int(window[2].id) == int(window[0].id) + 2
+            assert set(window[1]._fields) == {'id', 'id2'}
+            seen += 1
+    assert seen == 20
+
+
 def test_non_overlap_with_row_drop_rejected(synthetic_dataset):
     ngram = NGram(fields={0: ['^id$'], 1: ['^id$']}, delta_threshold=1,
                   timestamp_field='^id$', timestamp_overlap=False)

@@ -153,6 +153,107 @@ def test_dict_to_encoded_row_validates_and_encodes():
     assert row['value'] is None
 
 
+def test_field_name_colliding_with_schema_attribute_rejected():
+    # reference: test_field_name_conflict_with_unischema_attribute (:293)
+    with pytest.raises(ValueError, match='collides'):
+        Unischema('S', [UnischemaField('fields', np.int64, (),
+                                       ScalarCodec(pa.int64()), False)])
+
+
+def test_create_schema_view_no_regex_match_gives_empty_view():
+    # reference: test_create_schema_view_no_field_matches_regex (:276)
+    view = _schema().create_schema_view(['does_not_exist.*'])
+    assert len(view) == 0
+
+
+def test_create_schema_view_mixed_with_duplicates():
+    # regex + explicit field naming the same column yields it once
+    # (reference: ..._regex_and_unischema_fields_with_duplicates :266)
+    s = _schema()
+    view = s.create_schema_view(['id.*', s.id, s.value])
+    assert list(view.fields) == ['id', 'value']
+
+
+def test_create_schema_view_substitutes_own_fields():
+    # a stale instance (different codec) is matched by name and replaced by
+    # this schema's own field (reference rationale, unischema.py:221-236)
+    s = _schema()
+    stale = UnischemaField('image', np.uint8, (16, 32, 3),
+                           CompressedImageCodec('jpeg', quality=10), False)
+    view = s.create_schema_view([stale])
+    assert view.image.codec.image_codec == 'png'
+
+
+def test_namedtuple_more_than_255_fields():
+    # the reference ships namedtuple_gt_255_fields.py for py<3.7; document
+    # that modern Python needs no shim by exercising 300 fields for real
+    fields = [UnischemaField('f%03d' % i, np.int64, (),
+                             ScalarCodec(pa.int64()), False)
+              for i in range(300)]
+    s = Unischema('Wide', fields)
+    row = s.make_namedtuple(**{f.name: i for i, f in enumerate(s)})
+    assert row.f000 == 0 and row.f299 == 299
+    assert len(row) == 300
+
+
+def test_from_arrow_schema_with_partition_columns():
+    # reference: test_arrow_schema_convertion_with_{string,int}_partitions
+    arrow = pa.schema([pa.field('v', pa.float64())])
+    s = Unischema.from_arrow_schema(arrow, partition_columns=['part'])
+    assert s.part.numpy_dtype == np.str_ and s.part.shape == ()
+
+
+def test_from_arrow_schema_nested_list_skipped_or_raises():
+    # reference: test_arrow_schema_arrow_1644_list_of_list (:417) +
+    # test_arrow_schema_convertion_fail (:393)
+    arrow = pa.schema([pa.field('ok', pa.int32()),
+                       pa.field('nested', pa.list_(pa.list_(pa.int32())))])
+    s = Unischema.from_arrow_schema(arrow)
+    assert list(s.fields) == ['ok']
+    with pytest.raises(ValueError, match='[Nn]ested'):
+        Unischema.from_arrow_schema(arrow, omit_unsupported_fields=False)
+
+
+def test_from_arrow_schema_list_of_struct_skipped():
+    # reference: test_arrow_schema_arrow_1644_list_of_struct (:404)
+    arrow = pa.schema([
+        pa.field('ok', pa.int64()),
+        pa.field('structs', pa.list_(pa.struct([('a', pa.int32())]))),
+    ])
+    s = Unischema.from_arrow_schema(arrow)
+    assert list(s.fields) == ['ok']
+
+
+def test_encoded_row_rejects_unknown_and_wrong_shape():
+    # reference: test_dict_to_spark_row_field_validation_* (:107-150)
+    s = _schema()
+    base = {'id': 1, 'value': 2.0,
+            'image': np.zeros((16, 32, 3), np.uint8),
+            'matrix': np.zeros((5, 4), np.float32)}
+    with pytest.raises(ValueError, match='not in schema'):
+        dict_to_encoded_row(s, dict(base, bogus=1))
+    with pytest.raises(TypeError, match='dict'):
+        dict_to_encoded_row(s, [('id', 1)])
+    with pytest.raises(ValueError, match='not nullable'):
+        dict_to_encoded_row(s, dict(base, id=None))
+    # nullable None passes through un-encoded
+    assert dict_to_encoded_row(s, dict(base, value=None))['value'] is None
+    with pytest.raises(ValueError):
+        dict_to_encoded_row(s, dict(base, image=np.zeros((8, 8, 3), np.uint8)))
+
+
+def test_codecless_multidim_field_rejected_on_encode():
+    s = Unischema('S', [UnischemaField('m', np.float32, (2, 2), None, False)])
+    with pytest.raises(ValueError, match='codec'):
+        dict_to_encoded_row(s, {'m': np.zeros((2, 2), np.float32)})
+
+
+def test_codecless_1d_field_roundtrips_as_list():
+    s = Unischema('S', [UnischemaField('v', np.float32, (None,), None, False)])
+    encoded = dict_to_encoded_row(s, {'v': np.arange(3, dtype=np.float32)})
+    assert encoded['v'] == [0.0, 1.0, 2.0]
+
+
 def test_insert_explicit_nulls():
     s = Unischema('S', [
         UnischemaField('req', np.int32, (), None, False),
