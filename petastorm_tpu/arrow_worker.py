@@ -88,9 +88,11 @@ class RowGroupWorker(WorkerBase):
 
     def process(self, piece_index, worker_predicate=None,
                 shuffle_row_drop_partition=(0, 1), item_index=None, epoch=None):
+        from petastorm_tpu.filters import FiltersPredicate
         piece = self._row_groups[piece_index]
         if self._cache is not None and not isinstance(self._cache, NullCache) \
-                and worker_predicate is None:
+                and (worker_predicate is None
+                     or isinstance(worker_predicate, FiltersPredicate)):
             cache_key = self._cache_key(piece, worker_predicate,
                                         shuffle_row_drop_partition)
             batch = self._cache.get(
@@ -128,14 +130,20 @@ class RowGroupWorker(WorkerBase):
     # -- internals ----------------------------------------------------------
 
     def _cache_key(self, piece, worker_predicate, drop_partition):
-        # Reader rejects cache+predicate up front, so the predicate never
-        # needs to participate in the key (which would require a stable,
-        # content-addressed predicate identity).
-        assert worker_predicate is None
+        # Reader rejects cache + arbitrary predicates up front (no stable
+        # content identity to key on). DNF filters ARE content-addressable —
+        # plain tuples — so they participate in the key instead.
+        from petastorm_tpu.filters import FiltersPredicate
+        filter_part = ''
+        if worker_predicate is not None:
+            assert isinstance(worker_predicate, FiltersPredicate)
+            filter_part = ':f%s' % hashlib.md5(
+                repr(worker_predicate.clauses).encode('utf-8')).hexdigest()
         url_hash = hashlib.md5(
             str(self._dataset_info.url).encode('utf-8')).hexdigest()
-        return '%s:%s:rg%d:%s' % (url_hash, self._dataset_info.relpath(piece.path),
-                                  piece.row_group, drop_partition)
+        return '%s:%s:rg%d:%s%s' % (url_hash,
+                                    self._dataset_info.relpath(piece.path),
+                                    piece.row_group, drop_partition, filter_part)
 
     def _parquet_file(self, path):
         if path not in self._parquet_files:

@@ -1,0 +1,280 @@
+"""PyArrow-style DNF ``filters`` support for the reader factories.
+
+Parity surface for the reference's ``filters`` kwarg
+(``petastorm/reader.py:73,125``: "Standard PyArrow filters", passed to the
+legacy ``pq.ParquetDataset`` where they prune partition directories only).
+This implementation goes further, TPU-first in spirit — skip I/O instead of
+doing it:
+
+* **Row-group pruning before any read**: each clause is tested against hive
+  partition values (exact) and the parquet footer's per-row-group column
+  statistics (min/max range checks) — row-groups that provably cannot match
+  are never ventilated, so their bytes are never fetched or decoded.
+* **Exact row filtering on the workers**: surviving row-groups still pass
+  through a columnar predicate (``do_include_batch`` masks, no per-row
+  Python), so — unlike the reference — ``filters`` are exact at row level,
+  not just partition level.
+
+Filter format (the pyarrow DNF convention): a list of ``(column, op, value)``
+tuples (ANDed), or a list of such lists (OR of AND-clauses). Supported ops:
+``= == != < > <= >= in not in``.
+"""
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from petastorm_tpu.predicates import PredicateBase
+
+_OPS = ('=', '==', '!=', '<', '>', '<=', '>=', 'in', 'not in')
+
+
+def normalize_filters(filters):
+    """Validate and normalize to DNF: a list of AND-clauses (each a list of
+    ``(column, op, value)`` tuples). Returns None for empty input."""
+    if not filters:
+        return None
+    if all(isinstance(t, (tuple, list)) and len(t) == 3
+           and isinstance(t[1], str) for t in filters):
+        clauses = [list(map(tuple, filters))]
+    else:
+        clauses = [list(map(tuple, clause)) for clause in filters]
+    for clause in clauses:
+        if not clause:
+            raise ValueError('Empty AND-clause in filters')
+        for term in clause:
+            if not (isinstance(term, tuple) and len(term) == 3):
+                raise ValueError('Filter terms must be (column, op, value) '
+                                 'tuples, got %r' % (term,))
+            col, op, _ = term
+            if not isinstance(col, str):
+                raise ValueError('Filter column must be a string, got %r' % (col,))
+            if op not in _OPS:
+                raise ValueError('Unsupported filter op %r (supported: %s)'
+                                 % (op, ', '.join(_OPS)))
+    return clauses
+
+
+def _eval_term(op, actual, value):
+    if op in ('=', '=='):
+        return actual == value
+    if op == '!=':
+        return actual != value
+    if op == '<':
+        return actual < value
+    if op == '>':
+        return actual > value
+    if op == '<=':
+        return actual <= value
+    if op == '>=':
+        return actual >= value
+    if op == 'in':
+        return actual in value
+    if op == 'not in':
+        return actual not in value
+    raise AssertionError(op)
+
+
+def _eval_term_columnar(op, col, value):
+    """Vectorized term over a column; ``col`` is ndarray or list."""
+    if op in ('in', 'not in'):
+        values = set(value)
+        mask = np.fromiter((v in values for v in col), dtype=bool,
+                           count=len(col))
+        return ~mask if op == 'not in' else mask
+    arr = col if isinstance(col, np.ndarray) else np.asarray(col, dtype=object)
+    if op in ('=', '=='):
+        return arr == value
+    if op == '!=':
+        return arr != value
+    if op == '<':
+        return arr < value
+    if op == '>':
+        return arr > value
+    if op == '<=':
+        return arr <= value
+    return arr >= value
+
+
+class FiltersPredicate(PredicateBase):
+    """DNF filters as a composable predicate with a columnar fast path."""
+
+    def __init__(self, filters):
+        clauses = normalize_filters(filters)
+        if clauses is None:
+            raise ValueError('filters must be non-empty')
+        self._clauses = clauses
+        self._fields = {term[0] for clause in clauses for term in clause}
+
+    @property
+    def clauses(self):
+        return self._clauses
+
+    def get_fields(self):
+        return set(self._fields)
+
+    def do_include(self, values):
+        return any(all(_eval_term(op, values[col], v) for col, op, v in clause)
+                   for clause in self._clauses)
+
+    def do_include_batch(self, columns):
+        n = len(next(iter(columns.values())))
+        mask = np.zeros(n, dtype=bool)
+        for clause in self._clauses:
+            clause_mask = np.ones(n, dtype=bool)
+            for col, op, value in clause:
+                clause_mask &= np.asarray(
+                    _eval_term_columnar(op, columns[col], value), dtype=bool)
+                if not clause_mask.any():
+                    break
+            mask |= clause_mask
+            if mask.all():
+                break
+        return mask
+
+
+# ---------------------------------------------------------------------------
+# Row-group pruning
+# ---------------------------------------------------------------------------
+
+def _term_maybe_matches(term, partition_values, typed_partition, stats):
+    """Conservative per-row-group test: False only when the row-group
+    provably contains no matching row."""
+    col, op, value = term
+    if col in partition_values:
+        try:
+            return bool(_eval_term(op, typed_partition(col), value))
+        except TypeError:
+            return True  # incomparable types: keep, the worker decides
+    st = (stats or {}).get(col)
+    if st is None:
+        return True  # no statistics: cannot exclude
+    lo, hi, has_nulls = st
+    try:
+        if op in ('=', '=='):
+            return bool(lo <= value <= hi) or has_nulls
+        if op == '!=':
+            return not (lo == hi == value) or has_nulls
+        if op == '<':
+            return bool(lo < value) or has_nulls
+        if op == '>':
+            return bool(hi > value) or has_nulls
+        if op == '<=':
+            return bool(lo <= value) or has_nulls
+        if op == '>=':
+            return bool(hi >= value) or has_nulls
+        if op == 'in':
+            return any(lo <= v <= hi for v in value) or has_nulls
+        # 'not in': excluded only when the whole range is one excluded value
+        return not (lo == hi and lo in set(value)) or has_nulls
+    except TypeError:
+        return True  # incomparable types (e.g. str filter on int stats)
+
+
+class _StatsIndex:
+    """Per-file parquet footer statistics, fetched lazily and in parallel.
+
+    One footer read per *file* (not per row-group); row-groups of files whose
+    footers fail to load are conservatively kept.
+    """
+
+    def __init__(self, dataset_info, columns, workers=8):
+        self._info = dataset_info
+        self._columns = set(columns)
+        self._per_file = {}
+        self._lock = threading.Lock()
+        self._workers = workers
+
+    def prefetch(self, paths):
+        todo = sorted(set(paths) - set(self._per_file))
+        if not todo:
+            return
+        with ThreadPoolExecutor(max_workers=min(self._workers, len(todo))) as ex:
+            for path, stats in zip(todo, ex.map(self._load_file, todo)):
+                with self._lock:
+                    self._per_file[path] = stats
+
+    def _load_file(self, path):
+        import pyarrow.parquet as pq
+        try:
+            with self._info.fs.open(path, 'rb') as f:
+                meta = pq.ParquetFile(f).metadata
+            out = []
+            for rg in range(meta.num_row_groups):
+                row_group = meta.row_group(rg)
+                cols = {}
+                for ci in range(row_group.num_columns):
+                    col = row_group.column(ci)
+                    name = col.path_in_schema.split('.')[0]
+                    if name not in self._columns:
+                        continue
+                    st = col.statistics
+                    if st is None or not st.has_min_max:
+                        continue
+                    has_nulls = bool(st.null_count) if st.has_null_count else True
+                    cols[name] = (st.min, st.max, has_nulls)
+                out.append(cols)
+            return out
+        except Exception:  # noqa: BLE001 - conservative: keep the file
+            return None
+
+    def get(self, path, row_group):
+        stats = self._per_file.get(path)
+        if stats is None or row_group >= len(stats):
+            return None
+        return stats[row_group]
+
+
+def prune_row_group_indices(dataset_info, pieces, piece_indices, clauses,
+                            stored_schema=None):
+    """Drop row-group indices that provably cannot satisfy the filters.
+
+    Two passes, cheapest first: hive partition values prune with zero I/O;
+    parquet footer statistics are then fetched (one footer per file, in
+    parallel) only for the surviving pieces, and only when a filtered
+    column actually lives in the files.
+    """
+    from petastorm_tpu.arrow_worker import typed_partition_value
+
+    def typed_for(piece):
+        def typed(col):
+            field = (stored_schema.fields.get(col)
+                     if stored_schema is not None else None)
+            return typed_partition_value(field, piece.partition_values[col])
+        return typed
+
+    def keep(piece, stats):
+        return any(
+            all(_term_maybe_matches(t, piece.partition_values,
+                                    typed_for(piece), stats)
+                for t in clause)
+            for clause in clauses)
+
+    # pass 1: partition values only (stats=None keeps every file-column term)
+    survivors = [i for i in piece_indices if keep(pieces[i], None)]
+
+    needs_stats = any(
+        t[0] not in pieces[i].partition_values
+        for i in survivors for clause in clauses for t in clause)
+    if not needs_stats:
+        return survivors
+
+    # pass 2: footer statistics for the survivors
+    filter_columns = {t[0] for clause in clauses for t in clause}
+    index = _StatsIndex(dataset_info, filter_columns)
+    index.prefetch([pieces[i].path for i in survivors])
+    return [i for i in survivors
+            if keep(pieces[i], index.get(pieces[i].path,
+                                         pieces[i].row_group))]
+
+
+def describe_clauses(clauses):
+    """Human-readable filter rendering for error messages."""
+    return ' OR '.join(
+        '(' + ' AND '.join('%s %s %r' % t for t in clause) + ')'
+        for clause in clauses)
+
+
+__all__ = ['FiltersPredicate', 'normalize_filters',
+           'prune_row_group_indices', 'describe_clauses']

@@ -125,7 +125,7 @@ class JaxLoader:
         self._stop_event = threading.Event()
         self._stage_error = None
         self._exhausted = False
-        self._draining = False
+        self._drain_lock = threading.Lock()
         self._epoch = 0
 
     # -- sharding ------------------------------------------------------------
@@ -170,11 +170,11 @@ class JaxLoader:
                     raise RuntimeError('JaxLoader is already being iterated; '
                                        'finish or stop() the current pass '
                                        'first')
-                # _draining keeps a concurrently blocked consumer from
-                # misreading the momentarily empty queue as exhaustion
-                # (it would silently lose the batches we put back below)
-                self._draining = True
-                try:
+                # The lock makes drain + put-back atomic w.r.t. a consumer's
+                # exhaustion check in __next__: without it, a concurrently
+                # blocked consumer could observe the momentarily empty queue
+                # and falsely exhaust, losing the batches we put back below.
+                with self._drain_lock:
                     pending = []
                     try:
                         while True:
@@ -188,11 +188,10 @@ class JaxLoader:
                         # producer (thread is dead), so putting them back fits
                         for item in pending:
                             self._out_queue.put_nowait(item)
-                        raise RuntimeError('JaxLoader is already being '
-                                           'iterated; finish or stop() the '
-                                           'current pass first')
-                finally:
-                    self._draining = False
+                if not self._exhausted:
+                    raise RuntimeError('JaxLoader is already being iterated; '
+                                       'finish or stop() the current pass '
+                                       'first')
             # The consumer can observe the end sentinel a beat before the
             # stage thread finishes its teardown; it is exiting, so join
             # rather than misreading aliveness as an in-progress pass.
@@ -227,12 +226,12 @@ class JaxLoader:
                 if self._stop_event.is_set():
                     self._exhausted = True
                     raise StopIteration
-                if (self._stage_thread is not None
-                        and not self._stage_thread.is_alive()
-                        and self._out_queue.empty()
-                        and not self._draining):
-                    self._exhausted = True
-                    raise StopIteration
+                with self._drain_lock:
+                    if (self._stage_thread is not None
+                            and not self._stage_thread.is_alive()
+                            and self._out_queue.empty()):
+                        self._exhausted = True
+                        raise StopIteration
                 continue
             if item is _SENTINEL_END:
                 self._exhausted = True

@@ -42,12 +42,18 @@ def make_reader(dataset_url, schema_fields=None, reader_pool_type='thread',
                 rowgroup_selector=None, num_epochs=1, cur_shard=None,
                 shard_count=None, seed=0, cache_type='null', cache_location=None,
                 cache_size_limit=None, cache_row_size_estimate=None,
-                transform_spec=None, ngram=None, storage_options=None):
+                transform_spec=None, ngram=None, filters=None,
+                storage_options=None):
     """Reader over a petastorm_tpu/petastorm materialized dataset, iterating
     rows as namedtuples with all codecs decoded.
 
     Parity: ``petastorm/reader.py:61-196``. Use :func:`make_batch_reader` for
     plain Parquet stores or column-batch output.
+
+    :param filters: pyarrow-style DNF filters (``[(col, op, value), ...]`` or
+        an OR-list of such AND-lists). Row-groups that provably cannot match
+        (hive partition values + parquet min/max statistics) are skipped
+        without any I/O; surviving rows are filtered exactly on the workers.
     """
     info = ParquetDatasetInfo(dataset_url, storage_options)
     try:
@@ -68,7 +74,8 @@ def make_reader(dataset_url, schema_fields=None, reader_pool_type='thread',
                   shard_count=shard_count, seed=seed,
                   cache=_make_cache(cache_type, cache_location, cache_size_limit,
                                     cache_row_size_estimate),
-                  transform_spec=transform_spec, ngram=ngram, batched_output=False)
+                  transform_spec=transform_spec, ngram=ngram, filters=filters,
+                  batched_output=False)
 
 
 def make_batch_reader(dataset_url_or_urls, schema_fields=None,
@@ -79,11 +86,12 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None,
                       shard_count=None, seed=0, cache_type='null',
                       cache_location=None, cache_size_limit=None,
                       cache_row_size_estimate=None, transform_spec=None,
-                      storage_options=None):
+                      filters=None, storage_options=None):
     """Reader yielding whole row-groups as namedtuples of column arrays.
 
     Works on any Parquet store, petastorm metadata or not
-    (parity: ``petastorm/reader.py:198-328``).
+    (parity: ``petastorm/reader.py:198-328``). ``filters`` as in
+    :func:`make_reader`.
     """
     info = ParquetDatasetInfo(dataset_url_or_urls, storage_options)
     return Reader(info, schema_fields=schema_fields,
@@ -96,7 +104,8 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None,
                   shard_count=shard_count, seed=seed,
                   cache=_make_cache(cache_type, cache_location, cache_size_limit,
                                     cache_row_size_estimate),
-                  transform_spec=transform_spec, ngram=None, batched_output=True)
+                  transform_spec=transform_spec, ngram=None, filters=filters,
+                  batched_output=True)
 
 
 def _make_cache(cache_type, location, size_limit, row_size_estimate):
@@ -136,7 +145,7 @@ class Reader:
                  shuffle_row_drop_partitions=1, predicate=None,
                  rowgroup_selector=None, num_epochs=1, cur_shard=None,
                  shard_count=None, seed=0, cache=None, transform_spec=None,
-                 ngram=None, batched_output=True):
+                 ngram=None, filters=None, batched_output=True):
         self.dataset_info = dataset_info
         self.batched_output = batched_output and ngram is None
         self.ngram = ngram
@@ -149,9 +158,21 @@ class Reader:
                 not isinstance(cache, NullCache):
             # A cached row-group must be predicate-independent; predicates
             # have no stable content identity to key on (reference forbids
-            # the combination too, ``reader.py:416-418``).
+            # the combination too, ``reader.py:416-418``). DNF `filters` ARE
+            # cacheable (stable tuple identity) and stay allowed below.
             raise RuntimeError('Local cache is not supported together with '
                                'predicates')
+
+        self._filter_clauses = None
+        if filters:
+            from petastorm_tpu.filters import FiltersPredicate
+            filters_predicate = FiltersPredicate(filters)
+            self._filter_clauses = filters_predicate.clauses
+            if predicate is not None:
+                from petastorm_tpu.predicates import in_reduce
+                predicate = in_reduce([predicate, filters_predicate], all)
+            else:
+                predicate = filters_predicate
 
         # (1) schema
         self.stored_schema = infer_or_load_unischema(dataset_info)
@@ -175,14 +196,24 @@ class Reader:
         all_pieces = load_row_groups(dataset_info)
         self._row_groups = all_pieces
         piece_indices = list(range(len(all_pieces)))
+        if self._filter_clauses is not None:
+            from petastorm_tpu.filters import prune_row_group_indices
+            piece_indices = prune_row_group_indices(
+                dataset_info, all_pieces, piece_indices, self._filter_clauses,
+                stored_schema=self.stored_schema)
         piece_indices, worker_predicate = self._apply_predicate_pushdown(
             piece_indices, predicate)
         piece_indices = self._apply_selector(piece_indices, rowgroup_selector)
         piece_indices = self._apply_sharding(piece_indices, cur_shard, shard_count)
         if not piece_indices:
+            detail = 'check shard/predicate/selector configuration'
+            if self._filter_clauses is not None:
+                from petastorm_tpu.filters import describe_clauses
+                detail = 'filters %s matched no row-groups' % describe_clauses(
+                    self._filter_clauses)
             raise NoDataAvailableError(
-                'No row-groups left to read for this reader (dataset %s): '
-                'check shard/predicate/selector configuration' % dataset_info.url)
+                'No row-groups left to read for this reader (dataset %s): %s'
+                % (dataset_info.url, detail))
         self._piece_indices = piece_indices
 
         # (4) ventilator items
