@@ -42,15 +42,16 @@ def train(dataset_url, batch_size=64, steps=50, learning_rate=0.05):
     with make_jax_loader(dataset_url, batch_size=batch_size, mesh=mesh,
                          fields=['^digit$', '^image$'], num_epochs=None,
                          shuffle_rows=True, seed=0) as loader:
-        it = iter(loader)
-        batch = next(it)
+        batch = next(iter(loader))
         images = normalize_images(batch['image'][..., None],
                                   mean=[0.1307], std=[0.3081])
         params = model.init(jax.random.PRNGKey(0), images)
         opt_state = optimizer.init(params)
         step = jax.jit(mnist_train_step(model, optimizer))
         with mesh:
-            for i in range(steps):
+            # iter_steps: the fixed-step idiom — every host takes the same
+            # number of steps per epoch regardless of shard imbalance
+            for i, batch in enumerate(loader.iter_steps(steps)):
                 images = normalize_images(batch['image'][..., None],
                                           mean=[0.1307], std=[0.3081])
                 params, opt_state, loss = step(params, opt_state,
@@ -58,7 +59,6 @@ def train(dataset_url, batch_size=64, steps=50, learning_rate=0.05):
                                                batch['digit'])
                 if i % 10 == 0:
                     print('step %d loss %.4f' % (i, float(loss)))
-                batch = next(it)
     return float(loss)
 
 

@@ -90,7 +90,11 @@ class RowGroupWorker(WorkerBase):
                 shuffle_row_drop_partition=(0, 1), item_index=None, epoch=None):
         from petastorm_tpu.filters import FiltersPredicate
         piece = self._row_groups[piece_index]
+        # Cache only content with a stable identity: arbitrary predicates
+        # and TransformSpec callables have none (their output is baked into
+        # the cached batch), so those readers load fresh every time.
         if self._cache is not None and not isinstance(self._cache, NullCache) \
+                and self._transform_spec is None \
                 and (worker_predicate is None
                      or isinstance(worker_predicate, FiltersPredicate)):
             cache_key = self._cache_key(piece, worker_predicate,
@@ -139,11 +143,18 @@ class RowGroupWorker(WorkerBase):
             assert isinstance(worker_predicate, FiltersPredicate)
             filter_part = ':f%s' % hashlib.md5(
                 repr(worker_predicate.clauses).encode('utf-8')).hexdigest()
+        # The loaded column set is part of the content: readers with
+        # different schema_fields sharing a cache dir must not serve each
+        # other truncated batches.
+        columns_hash = hashlib.md5(
+            ','.join(sorted(self._needed_stored_fields()))
+            .encode('utf-8')).hexdigest()[:12]
         url_hash = hashlib.md5(
             str(self._dataset_info.url).encode('utf-8')).hexdigest()
-        return '%s:%s:rg%d:%s%s' % (url_hash,
-                                    self._dataset_info.relpath(piece.path),
-                                    piece.row_group, drop_partition, filter_part)
+        return '%s:%s:rg%d:%s:c%s%s' % (url_hash,
+                                        self._dataset_info.relpath(piece.path),
+                                        piece.row_group, drop_partition,
+                                        columns_hash, filter_part)
 
     def _parquet_file(self, path):
         if path not in self._parquet_files:

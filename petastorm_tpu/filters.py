@@ -30,33 +30,52 @@ from petastorm_tpu.predicates import PredicateBase
 _OPS = ('=', '==', '!=', '<', '>', '<=', '>=', 'in', 'not in')
 
 
+def _is_term(t):
+    return (isinstance(t, (tuple, list)) and len(t) == 3
+            and isinstance(t[0], str) and isinstance(t[1], str))
+
+
 def normalize_filters(filters):
     """Validate and normalize to DNF: a list of AND-clauses (each a list of
     ``(column, op, value)`` tuples). Returns None for empty input."""
     if not filters:
         return None
-    if all(isinstance(t, (tuple, list)) and len(t) == 3
-           and isinstance(t[1], str) for t in filters):
+    if all(_is_term(t) for t in filters):
         clauses = [list(map(tuple, filters))]
-    else:
-        clauses = [list(map(tuple, clause)) for clause in filters]
-    for clause in clauses:
-        if not clause:
-            raise ValueError('Empty AND-clause in filters')
-        for term in clause:
-            if not (isinstance(term, tuple) and len(term) == 3):
+    elif all(isinstance(c, (tuple, list)) and not _is_term(c)
+             for c in filters):
+        clauses = []
+        for clause in filters:
+            if not clause:
+                raise ValueError('Empty AND-clause in filters')
+            bad = [t for t in clause if not _is_term(t)]
+            if bad:
                 raise ValueError('Filter terms must be (column, op, value) '
-                                 'tuples, got %r' % (term,))
-            col, op, _ = term
-            if not isinstance(col, str):
-                raise ValueError('Filter column must be a string, got %r' % (col,))
+                                 'tuples with string column/op, got %r'
+                                 % (bad[0],))
+            clauses.append(list(map(tuple, clause)))
+    else:
+        raise ValueError(
+            'filters must be a flat list of (column, op, value) tuples OR a '
+            'list of such lists (DNF); got a mix: %r' % (filters,))
+    for clause in clauses:
+        for col, op, value in clause:
             if op not in _OPS:
                 raise ValueError('Unsupported filter op %r (supported: %s)'
                                  % (op, ', '.join(_OPS)))
+            if op in ('in', 'not in'):
+                if isinstance(value, (str, bytes)) or not hasattr(
+                        value, '__iter__'):
+                    raise ValueError(
+                        "%r value for %r must be a non-string collection "
+                        '(got %r); for a single value use %r'
+                        % (op, col, value, '=' if op == 'in' else '!='))
     return clauses
 
 
 def _eval_term(op, actual, value):
+    if actual is None:
+        return False  # pyarrow DNF semantics: nulls never match any term
     if op in ('=', '=='):
         return actual == value
     if op == '!=':
@@ -77,13 +96,29 @@ def _eval_term(op, actual, value):
 
 
 def _eval_term_columnar(op, col, value):
-    """Vectorized term over a column; ``col`` is ndarray or list."""
-    if op in ('in', 'not in'):
-        values = set(value)
-        mask = np.fromiter((v in values for v in col), dtype=bool,
-                           count=len(col))
-        return ~mask if op == 'not in' else mask
+    """Vectorized term over a column; ``col`` is ndarray or list.
+    Nulls (None cells in object columns) never match, per pyarrow DNF."""
     arr = col if isinstance(col, np.ndarray) else np.asarray(col, dtype=object)
+    if op in ('in', 'not in'):
+        if arr.dtype.kind in 'iufb':
+            # same dtype-guarded np.isin fast path as predicates.in_set
+            values_arr = np.asarray(list(value))
+            if values_arr.dtype.kind in 'iufb':
+                mask = np.isin(arr, values_arr)
+                return ~mask if op == 'not in' else mask
+        values = set(value)
+        mask = np.fromiter(
+            (v is not None and v in values for v in arr),
+            dtype=bool, count=len(arr))
+        if op == 'not in':
+            valid = np.fromiter((v is not None for v in arr),
+                                dtype=bool, count=len(arr))
+            return valid & ~mask
+        return mask
+    if arr.dtype == object:
+        return np.fromiter(
+            (_eval_term(op, v, value) for v in arr), dtype=bool,
+            count=len(arr))
     if op in ('=', '=='):
         return arr == value
     if op == '!=':

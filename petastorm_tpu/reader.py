@@ -196,18 +196,20 @@ class Reader:
         all_pieces = load_row_groups(dataset_info)
         self._row_groups = all_pieces
         piece_indices = list(range(len(all_pieces)))
+        filters_emptied = False
         if self._filter_clauses is not None:
             from petastorm_tpu.filters import prune_row_group_indices
             piece_indices = prune_row_group_indices(
                 dataset_info, all_pieces, piece_indices, self._filter_clauses,
                 stored_schema=self.stored_schema)
+            filters_emptied = not piece_indices
         piece_indices, worker_predicate = self._apply_predicate_pushdown(
             piece_indices, predicate)
         piece_indices = self._apply_selector(piece_indices, rowgroup_selector)
         piece_indices = self._apply_sharding(piece_indices, cur_shard, shard_count)
         if not piece_indices:
             detail = 'check shard/predicate/selector configuration'
-            if self._filter_clauses is not None:
+            if filters_emptied:
                 from petastorm_tpu.filters import describe_clauses
                 detail = 'filters %s matched no row-groups' % describe_clauses(
                     self._filter_clauses)

@@ -29,6 +29,9 @@ class TestNormalize:
         [('a', '=')],                # not a 3-tuple
         [[('a', '=', 1)], []],       # empty AND clause
         [(1, '=', 1)],               # non-string column
+        [('a', '=', 1), [('b', '=', 2)]],   # mixed flat/nested
+        [('a', 'in', 'p_2')],        # scalar string for 'in'
+        [('a', 'not in', 5)],        # non-iterable for 'not in'
     ])
     def test_invalid_rejected(self, bad):
         with pytest.raises(ValueError):
@@ -55,6 +58,25 @@ class TestFiltersPredicate:
     def test_fields(self):
         pred = FiltersPredicate([[('a', '=', 1)], [('b', '<', 2)]])
         assert pred.get_fields() == {'a', 'b'}
+
+    @pytest.mark.parametrize('filters,expected', [
+        ([('x', '<', 2)], [True, True, False]),
+        ([('x', '>=', 1)], [False, True, False]),
+        ([('x', '!=', 1)], [True, False, False]),
+        ([('x', 'in', (0, 1))], [True, True, False]),
+        ([('x', 'not in', (0,))], [False, True, False]),
+    ])
+    def test_nulls_never_match(self, filters, expected):
+        # pyarrow DNF semantics: null cells are excluded, never an error
+        pred = FiltersPredicate(filters)
+        columns = {'x': np.array([0, 1, None], dtype=object)}
+        assert pred.do_include_batch(columns).tolist() == expected
+        assert [pred.do_include({'x': v}) for v in columns['x']] == expected
+
+    def test_numeric_in_uses_isin(self):
+        pred = FiltersPredicate([('x', 'in', (2, 4))])
+        mask = pred.do_include_batch({'x': np.arange(6)})
+        assert mask.tolist() == [False, False, True, False, True, False]
 
 
 @pytest.fixture(scope='module')
@@ -153,6 +175,28 @@ class TestEndToEnd:
             with make_reader(partitioned_url,
                              filters=[('partition_key', '<', 5)]) as reader:
                 list(reader)
+
+    def test_cache_keys_by_column_set(self, partitioned_url, tmp_path):
+        # a cache dir shared by readers with different projections must not
+        # serve truncated batches across them
+        kwargs = dict(cache_type='local-disk',
+                      cache_location=str(tmp_path / 'cache'),
+                      cache_size_limit=10 ** 8, shuffle_row_groups=False)
+        with make_reader(partitioned_url, schema_fields=['^id$'],
+                         **kwargs) as reader:
+            assert len(list(reader)) == 100
+        with make_reader(partitioned_url, **kwargs) as reader:
+            row = next(reader)
+        assert row.image_png is not None and row.matrix is not None
+
+    def test_selector_blame_not_filters(self, synthetic_dataset):
+        # an empty read caused by the selector must not be blamed on filters
+        from petastorm_tpu.selectors import SingleIndexSelector
+        with pytest.raises(NoDataAvailableError,
+                           match='shard/predicate/selector'):
+            make_reader(synthetic_dataset.url, filters=[('id', '>=', 0)],
+                        rowgroup_selector=SingleIndexSelector(
+                            'id_index', ['no_such_value']))
 
     def test_in_filter(self, partitioned_url):
         with make_reader(partitioned_url,

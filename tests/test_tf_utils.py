@@ -74,6 +74,74 @@ def test_ngram_dataset(synthetic_dataset):
         assert not hasattr(w[0], 'sensor_name')
 
 
+def test_sanitize_tf_types_unit():
+    # reference: test_sanitize_field_tf_types (:72) + decimal/datetime cases
+    import datetime
+    from decimal import Decimal
+    from petastorm_tpu.tf_utils import _sanitize_field_tf_types
+    assert _sanitize_field_tf_types(Decimal('1.25')) == '1.25'
+    ns = _sanitize_field_tf_types(datetime.date(2020, 1, 2))
+    assert ns == np.datetime64('2020-01-02').astype('datetime64[ns]').astype(np.int64)
+    arr = np.array([Decimal('1.5'), Decimal('2.5')], dtype=object)
+    assert _sanitize_field_tf_types(arr).tolist() == ['1.5', '2.5']
+    dt64 = np.array(['2020-01-01', '2020-01-02'], dtype='datetime64[D]')
+    out = _sanitize_field_tf_types(dt64)
+    assert out.dtype == np.int64
+    with pytest.raises(RuntimeError, match='Null'):
+        _sanitize_field_tf_types(None)
+
+
+def test_tf_dtype_map_promotions():
+    # reference: test_uint16_promotion_to_int32 (:108) and the dtype map
+    import tensorflow as tf
+    from decimal import Decimal
+    from petastorm_tpu.tf_utils import _tf_dtype
+    from petastorm_tpu.unischema import UnischemaField
+
+    def dtype_of(np_dtype):
+        return _tf_dtype(tf, UnischemaField('f', np_dtype, (), None, False))
+
+    assert dtype_of(np.uint16) == tf.int32
+    assert dtype_of(np.uint32) == tf.int64
+    assert dtype_of(np.str_) == tf.string
+    assert dtype_of(Decimal) == tf.string
+    assert dtype_of(np.dtype('datetime64[ns]')) == tf.int64
+    assert dtype_of(np.float32) == tf.float32
+
+
+def test_dataset_reiteration_guard(synthetic_dataset):
+    # reference: the no-repeat guard (tf_utils.py:367-373)
+    import tensorflow as tf
+    with make_reader(synthetic_dataset.url, num_epochs=1,
+                     schema_fields=['^id$']) as reader:
+        dataset = make_petastorm_dataset(reader)
+        assert sum(1 for _ in dataset) == 100
+        with pytest.raises(tf.errors.OpError, match='Multiple iterations'):
+            for _ in dataset:
+                pass
+
+
+def test_batch_dataset_decimal_column(tmp_path):
+    # decimal columns must reach TF as strings through the batched bridge
+    from decimal import Decimal
+    import pyarrow as pa
+    from petastorm_tpu.codecs import ScalarCodec
+    from petastorm_tpu.etl.dataset_metadata import write_dataset
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+    schema = Unischema('Dec', [
+        UnischemaField('id', np.int64, (), ScalarCodec(pa.int64()), False),
+        UnischemaField('price', Decimal, (),
+                       ScalarCodec(pa.decimal128(10, 2)), False),
+    ])
+    url = 'file://' + str(tmp_path / 'dec')
+    write_dataset(url, schema, [{'id': i, 'price': Decimal('3.14')}
+                                for i in range(8)], rowgroup_size_rows=4)
+    with make_reader(url, shuffle_row_groups=False) as reader:
+        dataset = make_petastorm_dataset(reader)
+        row = next(iter(dataset))
+    assert row.price.numpy() == b'3.14'
+
+
 def test_tf_tensors_shim(synthetic_dataset):
     with make_reader(synthetic_dataset.url, schema_fields=['^id$'],
                      shuffle_row_groups=False, num_epochs=1) as reader:
