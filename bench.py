@@ -138,6 +138,92 @@ def _measure_batch(url, warmup_rows, measure_rows, bytes_per_row=0):
     return rate, rate * bytes_per_row / 2 ** 20
 
 
+_TFDATA_SNIPPET = r'''
+import json, os, sys, time
+os.environ.setdefault('TF_CPP_MIN_LOG_LEVEL', '3')
+import numpy as np
+import tensorflow as tf
+tfrecord_path, warmup, measure = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+
+def parse(example):
+    feat = tf.io.parse_single_example(example, {
+        'image': tf.io.FixedLenFeature([], tf.string),
+        'noun_id': tf.io.FixedLenFeature([], tf.string),
+    })
+    return tf.io.decode_jpeg(feat['image'], channels=3)
+
+dataset = (tf.data.TFRecordDataset(tfrecord_path)
+           .repeat()
+           .map(parse, num_parallel_calls=tf.data.AUTOTUNE)
+           .batch(64)
+           .prefetch(tf.data.AUTOTUNE))
+it = iter(dataset)
+seen = 0
+while seen < warmup:
+    seen += int(next(it).shape[0])
+seen = 0
+start = time.monotonic()
+while seen < measure:
+    seen += int(next(it).shape[0])
+elapsed = time.monotonic() - start
+print(json.dumps({"rows_per_sec": seen / elapsed}))
+'''
+
+
+def _build_tfrecord(url, timeout=240):
+    """Re-encode the parquet dataset's jpeg cells into a TFRecord file.
+    Returns the path, or an error string."""
+    code = r'''
+import glob, sys
+import pyarrow.parquet as pq
+import tensorflow as tf
+out, pattern = sys.argv[1], sys.argv[2]
+with tf.io.TFRecordWriter(out) as writer:
+    for path in sorted(glob.glob(pattern)):
+        table = pq.read_table(path, columns=['noun_id', 'image'])
+        for nid, img in zip(table.column('noun_id').to_pylist(),
+                            table.column('image').to_pylist()):
+            ex = tf.train.Example(features=tf.train.Features(feature={
+                'noun_id': tf.train.Feature(
+                    bytes_list=tf.train.BytesList(value=[nid.encode()])),
+                'image': tf.train.Feature(
+                    bytes_list=tf.train.BytesList(value=[bytes(img)])),
+            }))
+            writer.write(ex.SerializeToString())
+'''
+    root = url[len('file://'):]
+    tfrecord_path = root + '.tfrecord'
+    try:
+        build = subprocess.run(
+            [sys.executable, '-c', code, tfrecord_path, root + '/*.parquet'],
+            capture_output=True, timeout=timeout, text=True)
+    except subprocess.TimeoutExpired:
+        return None, 'tfrecord build timeout'
+    if build.returncode != 0:
+        return None, ('tfrecord build: %s'
+                      % (build.stderr or '').strip()[-200:])
+    return tfrecord_path, None
+
+
+def _measure_tfdata(tfrecord_path, warmup, measure, timeout=240):
+    """BASELINE.json north star: the same jpeg bytes through a
+    tf.data+TFRecord input pipeline, for a like-for-like rows/sec ratio.
+    Runs in a subprocess so TF's runtime never pollutes this process."""
+    try:
+        run = subprocess.run(
+            [sys.executable, '-c', _TFDATA_SNIPPET, tfrecord_path,
+             str(warmup), str(measure)],
+            capture_output=True, timeout=timeout, text=True)
+    except subprocess.TimeoutExpired:
+        return {'error': 'timeout'}
+    if run.returncode != 0:
+        return {'error': (run.stderr or 'failed').strip()[-200:]}
+    try:
+        return json.loads(run.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return {'error': 'unparseable output'}
+
+
 _JAX_SNIPPET = r'''
 import json, os, sys, time
 sys.path.insert(0, %(repo)r)
@@ -206,9 +292,11 @@ def main():
         extra['hello_world_batch_rows_per_sec'] = round(batch_rate, 1)
 
         img_bytes = int(np.prod(IMAGENET_SHAPE))
-        img_rate, img_mb = _measure_batch(imagenet_url, IMAGENET_ROWS // 2,
-                                          IMAGENET_ROWS * 4,
-                                          bytes_per_row=img_bytes)
+        # best of 2: the shared box is noisy and this is the north-star rate
+        img_rate, img_mb = max(
+            (_measure_batch(imagenet_url, IMAGENET_ROWS // 2,
+                            IMAGENET_ROWS * 4, bytes_per_row=img_bytes)
+             for _ in range(2)), key=lambda pair: pair[0])
         extra['imagenet_batch_rows_per_sec'] = round(img_rate, 1)
         extra['imagenet_decoded_mb_per_sec'] = round(img_mb, 1)
 
@@ -234,6 +322,25 @@ def main():
                     ['^id$', '^array_4d$', '^image1$'])
         jax_metrics('imagenet_jax', imagenet_url, 64, IMAGENET_ROWS // 2,
                     IMAGENET_ROWS * 3, ['^image$'])
+
+        # North star (BASELINE.json): ratio vs a tf.data+TFRecord pipeline
+        # decoding the SAME jpeg bytes on the same machine. Target >= 0.9.
+        # Best of 2 for the same noise reason as above.
+        tfrecord_path, build_error = _build_tfrecord(imagenet_url)
+        if build_error:
+            extra['tfdata_imagenet_error'] = build_error
+        else:
+            runs = [_measure_tfdata(tfrecord_path, IMAGENET_ROWS // 2,
+                                    IMAGENET_ROWS * 4) for _ in range(2)]
+            os.unlink(tfrecord_path)
+            ok_runs = [r for r in runs if 'rows_per_sec' in r]
+            if ok_runs:
+                best = max(r['rows_per_sec'] for r in ok_runs)
+                extra['tfdata_imagenet_rows_per_sec'] = round(best, 1)
+                extra['vs_tfdata'] = round(img_rate / best, 3)
+            else:
+                extra['tfdata_imagenet_error'] = runs[-1].get('error',
+                                                              'unknown')
 
         print(json.dumps({
             'metric': 'hello_world_read_rate',

@@ -19,10 +19,46 @@ import numpy as np
 import pyarrow.parquet as pq
 
 from petastorm_tpu.cache import NullCache
-from petastorm_tpu.codecs import decode_batch_with_nulls
+from petastorm_tpu.codecs import CompressedImageCodec, decode_batch_with_nulls
 from petastorm_tpu.workers.worker_base import WorkerBase
 
 _ALL_ROWS = slice(None)
+
+
+def _binary_cell_views(arrow_col):
+    """Zero-copy ``np.uint8`` views over a binary column's cells.
+
+    Returns None when the column is not binary-typed (caller falls back to
+    ``to_pylist``). Null cells become None. The views alias the arrow data
+    buffer, so they are only valid while the source column is alive — the
+    decode loop consumes them immediately within ``_load_rowgroup``.
+    """
+    import pyarrow as pa
+    chunks = (arrow_col.chunks if isinstance(arrow_col, pa.ChunkedArray)
+              else [arrow_col])
+    cells = []
+    for chunk in chunks:
+        if pa.types.is_large_binary(chunk.type):
+            offsets_dtype = np.int64
+        elif pa.types.is_binary(chunk.type):
+            offsets_dtype = np.int32
+        else:
+            return None
+        if chunk.null_count:
+            cells.extend(
+                np.frombuffer(v.as_buffer(), np.uint8) if v.is_valid else None
+                for v in chunk)
+            continue
+        buffers = chunk.buffers()
+        offsets = np.frombuffer(buffers[1], dtype=offsets_dtype,
+                                count=chunk.offset + len(chunk) + 1)
+        offsets = offsets[chunk.offset:]
+        data = buffers[2]
+        cells.extend(
+            np.frombuffer(data, np.uint8, offsets[i + 1] - offsets[i],
+                          offsets[i])
+            for i in range(len(chunk)))
+    return cells
 
 
 def typed_partition_value(field, value):
@@ -277,10 +313,16 @@ class RowGroupWorker(WorkerBase):
         arrays.
         """
         field = self._loaded_schema.fields.get(name) or self._stored_schema.fields.get(name)
-        values = arrow_col.to_pylist()
-        if field is None or field.codec is None:
-            return self._collate_plain(field, arrow_col, values)
-        return self._stack(decode_batch_with_nulls(field, values))
+        if field is not None and field.codec is not None:
+            if isinstance(field.codec, CompressedImageCodec):
+                # image cells go to cv2 untouched: zero-copy views over the
+                # arrow data buffer instead of a per-cell bytes copy
+                cells = _binary_cell_views(arrow_col)
+                if cells is not None:
+                    return self._stack(decode_batch_with_nulls(field, cells))
+            return self._stack(decode_batch_with_nulls(
+                field, arrow_col.to_pylist()))
+        return self._collate_plain(field, arrow_col, arrow_col.to_pylist())
 
     def _collate_plain(self, field, arrow_col, values):
         """Codec-less columns (plain parquet / make_batch_reader path)."""

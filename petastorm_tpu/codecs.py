@@ -166,10 +166,49 @@ class CompressedImageCodec(DataframeColumnCodec):
             raise RuntimeError('cv2.imencode failed for field %r' % unischema_field.name)
         return bytearray(encoded)
 
+    @staticmethod
+    def _as_uint8(encoded):
+        """Cell bytes as a uint8 array, zero-copy for buffer views."""
+        if isinstance(encoded, np.ndarray) and encoded.dtype == np.uint8:
+            return encoded
+        return np.frombuffer(bytes(encoded), dtype=np.uint8)
+
+    @staticmethod
+    def _is_3_channel(raw):
+        """Header sniff: True only when the stored image provably has 3
+        color components. Guards the direct-RGB decode fast path — forcing
+        RGB onto a grayscale cell would silently colorize it instead of
+        surfacing the shape mismatch."""
+        if len(raw) < 26:
+            return False
+        head = raw[:4].tobytes()
+        if head.startswith(b'\x89PNG'):
+            # IHDR color type 2 = RGB triple; bit depth must be 8 — 16-bit
+            # PNGs downscale by >>8 under forced-RGB decode but cast mod-256
+            # under decode(), a silent value divergence
+            return raw[25] == 2 and raw[24] == 8
+        if head.startswith(b'\xff\xd8'):  # JPEG: scan for an SOF marker
+            i = 2
+            n = len(raw)
+            while i + 9 < n:
+                if raw[i] != 0xFF:
+                    return False
+                marker = int(raw[i + 1])
+                if marker in (0xD8, 0x01) or 0xD0 <= marker <= 0xD7:
+                    i += 2
+                    continue
+                if 0xC0 <= marker <= 0xCF and marker not in (0xC4, 0xC8, 0xCC):
+                    # precision must be 8 bits; component count 3
+                    return int(raw[i + 4]) == 8 and int(raw[i + 9]) == 3
+                seg_len = (int(raw[i + 2]) << 8) | int(raw[i + 3])
+                if seg_len < 2:
+                    return False
+                i += 2 + seg_len
+        return False
+
     def decode(self, unischema_field, encoded):
         import cv2
-        raw = np.frombuffer(bytes(encoded), dtype=np.uint8)
-        image = cv2.imdecode(raw, cv2.IMREAD_UNCHANGED)
+        image = cv2.imdecode(self._as_uint8(encoded), cv2.IMREAD_UNCHANGED)
         if image is None:
             raise ValueError('cv2.imdecode failed for field %r' % unischema_field.name)
         if image.ndim == 3 and image.shape[2] in (3, 4):
@@ -183,7 +222,24 @@ class CompressedImageCodec(DataframeColumnCodec):
         cvtColor writes into ``dst`` (no intermediate copy). Raises on any
         shape/decode surprise so the caller can fall back."""
         import cv2
-        raw = np.frombuffer(bytes(encoded), dtype=np.uint8)
+        raw = self._as_uint8(encoded)
+        if (dst.ndim == 3 and dst.shape[2] == 3 and dst.dtype == np.uint8
+                and hasattr(cv2, 'IMREAD_COLOR_RGB')
+                and self._is_3_channel(raw)):
+            # decode straight to RGB: saves the whole-image BGR→RGB pass
+            # (bit-identical; the flag exists since OpenCV 4.10). EXIF
+            # orientation must be ignored — decode()'s IMREAD_UNCHANGED
+            # never applies it, and a silently rotated batch would diverge.
+            image = cv2.imdecode(
+                raw, cv2.IMREAD_COLOR_RGB | cv2.IMREAD_IGNORE_ORIENTATION)
+            if image is None:
+                raise ValueError('cv2.imdecode failed for field %r'
+                                 % unischema_field.name)
+            if image.shape != dst.shape:
+                raise ValueError('decoded shape %s != declared %s'
+                                 % (image.shape, dst.shape))
+            dst[...] = image
+            return
         image = cv2.imdecode(raw, cv2.IMREAD_UNCHANGED)
         if image is None:
             raise ValueError('cv2.imdecode failed for field %r' % unischema_field.name)

@@ -1,0 +1,107 @@
+"""LocalDiskCache unit tests (reference: ``tests/test_disk_cache.py``)."""
+
+import os
+import pickle
+import threading
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.cache import LocalDiskCache, NullCache
+
+
+def test_null_cache_always_computes():
+    calls = []
+    cache = NullCache()
+    assert cache.get('k', lambda: calls.append(1) or 42) == 42
+    assert cache.get('k', lambda: calls.append(1) or 42) == 42
+    assert len(calls) == 2
+
+
+class TestLocalDiskCache:
+    def test_get_or_compute(self, tmp_path):
+        cache = LocalDiskCache(str(tmp_path / 'c'), 10 ** 6)
+        calls = []
+
+        def fill():
+            calls.append(1)
+            return {'a': np.arange(5)}
+
+        first = cache.get('key1', fill)
+        second = cache.get('key1', fill)
+        np.testing.assert_array_equal(first['a'], second['a'])
+        assert len(calls) == 1  # second call served from disk
+
+    def test_distinct_keys(self, tmp_path):
+        cache = LocalDiskCache(str(tmp_path / 'c'), 10 ** 6)
+        assert cache.get('a', lambda: 1) == 1
+        assert cache.get('b', lambda: 2) == 2
+        assert cache.get('a', lambda: 99) == 1
+
+    def test_persistence_across_instances(self, tmp_path):
+        path = str(tmp_path / 'c')
+        LocalDiskCache(path, 10 ** 6).get('k', lambda: 'value')
+        fresh = LocalDiskCache(path, 10 ** 6)
+        assert fresh.get('k', lambda: 'MISS') == 'value'
+
+    def test_size_limit_evicts_lru(self, tmp_path):
+        cache = LocalDiskCache(str(tmp_path / 'c'), size_limit_bytes=50_000)
+        payload = np.zeros(10_000, dtype=np.uint8)  # ~10KB pickled
+        for i in range(10):
+            cache.get('k%d' % i, lambda: payload)
+        # total would be ~100KB; eviction must bring it under the cap
+        total = sum(os.path.getsize(os.path.join(root, f))
+                    for root, _, files in os.walk(str(tmp_path / 'c'))
+                    for f in files)
+        assert total <= 50_000
+
+    def test_corrupt_entry_recomputed(self, tmp_path):
+        cache = LocalDiskCache(str(tmp_path / 'c'), 10 ** 6)
+        cache.get('k', lambda: 'good')
+        entry = cache._entry_path('k')
+        with open(entry, 'wb') as f:
+            f.write(b'not a pickle')
+        assert cache.get('k', lambda: 'recomputed') == 'recomputed'
+
+    def test_weird_keys_are_safe(self, tmp_path):
+        cache = LocalDiskCache(str(tmp_path / 'c'), 10 ** 6)
+        for key in ('a/b/../c', 'x' * 500, 'sp ace\n', "k'\"", ''):
+            assert cache.get(key, lambda k=key: 'v:' + str(k)) == 'v:' + key
+        # nothing escaped the cache root
+        root = os.path.realpath(str(tmp_path / 'c'))
+        for dirpath, _, files in os.walk(root):
+            assert os.path.realpath(dirpath).startswith(root)
+
+    def test_pickles_across_process_boundary(self, tmp_path):
+        cache = LocalDiskCache(str(tmp_path / 'c'), 10 ** 6)
+        cache.get('k', lambda: 1)
+        clone = pickle.loads(pickle.dumps(cache))
+        assert clone.get('k', lambda: 'MISS') == 1
+
+    def test_concurrent_get_same_key(self, tmp_path):
+        cache = LocalDiskCache(str(tmp_path / 'c'), 10 ** 6)
+        results = []
+
+        def reader():
+            results.append(cache.get('k', lambda: np.arange(100).tolist()))
+
+        threads = [threading.Thread(target=reader) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert all(r == list(range(100)) for r in results)
+
+    def test_cleanup_flag(self, tmp_path):
+        path = str(tmp_path / 'c')
+        cache = LocalDiskCache(path, 10 ** 6, cleanup=True)
+        cache.get('k', lambda: 1)
+        cache.cleanup()
+        assert not os.path.exists(path)
+
+    def test_cleanup_default_keeps(self, tmp_path):
+        path = str(tmp_path / 'c')
+        cache = LocalDiskCache(path, 10 ** 6)
+        cache.get('k', lambda: 1)
+        cache.cleanup()
+        assert os.path.exists(path)

@@ -206,3 +206,148 @@ class TestBatchedImageDecode:
         batch = codec.decode_batch(field, cells)
         assert isinstance(batch, list)
         assert batch[-1].shape == (4, 4, 3)
+
+
+class TestBinaryCellViews:
+    """Zero-copy arrow binary cell extraction feeding the image decode."""
+
+    def _views(self, arr):
+        from petastorm_tpu.arrow_worker import _binary_cell_views
+        return _binary_cell_views(arr)
+
+    def test_plain_binary_round_trip(self):
+        import pyarrow as pa
+        payloads = [b'abc', b'', b'xyzw']
+        cells = self._views(pa.chunked_array([pa.array(payloads,
+                                                       type=pa.binary())]))
+        assert [bytes(c) for c in cells] == payloads
+        assert all(c.dtype == np.uint8 for c in cells)
+
+    def test_nulls_preserved(self):
+        import pyarrow as pa
+        cells = self._views(pa.array([b'abc', None, b'de'], type=pa.binary()))
+        assert bytes(cells[0]) == b'abc' and cells[1] is None
+        assert bytes(cells[2]) == b'de'
+
+    def test_sliced_array_offsets(self):
+        import pyarrow as pa
+        arr = pa.array([b'aa', b'bb', b'cc', b'dd'], type=pa.binary())
+        cells = self._views(arr.slice(1, 2))
+        assert [bytes(c) for c in cells] == [b'bb', b'cc']
+
+    def test_large_binary(self):
+        import pyarrow as pa
+        arr = pa.array([b'abc', b'defg'], type=pa.large_binary())
+        cells = self._views(arr)
+        assert [bytes(c) for c in cells] == [b'abc', b'defg']
+
+    def test_non_binary_returns_none(self):
+        import pyarrow as pa
+        assert self._views(pa.array([1, 2, 3])) is None
+
+    def test_image_decode_from_views(self):
+        import pyarrow as pa
+        from petastorm_tpu.codecs import CompressedImageCodec
+        from petastorm_tpu.unischema import UnischemaField
+        field = UnischemaField('im', np.uint8, (8, 6, 3),
+                               CompressedImageCodec('png'), False)
+        rng = np.random.RandomState(0)
+        images = [rng.randint(0, 255, (8, 6, 3), np.uint8) for _ in range(5)]
+        encoded = [bytes(field.codec.encode(field, im)) for im in images]
+        cells = self._views(pa.array(encoded, type=pa.binary()))
+        batch = field.codec.decode_batch(field, cells)
+        for got, want in zip(batch, images):
+            np.testing.assert_array_equal(got, want)
+
+
+class TestDirectRgbDecode:
+    def _field(self, shape, fmt='png'):
+        from petastorm_tpu.codecs import CompressedImageCodec
+        from petastorm_tpu.unischema import UnischemaField
+        return UnischemaField('im', np.uint8, shape,
+                             CompressedImageCodec(fmt), False)
+
+    def test_header_sniff(self):
+        import cv2
+        from petastorm_tpu.codecs import CompressedImageCodec
+        rgb = np.random.RandomState(0).randint(0, 255, (10, 12, 3), np.uint8)
+        gray = rgb[:, :, 0]
+        sniff = CompressedImageCodec._is_3_channel
+        for ext in ('.png', '.jpeg'):
+            ok, enc3 = cv2.imencode(ext, rgb)
+            ok, enc1 = cv2.imencode(ext, gray)
+            assert sniff(np.frombuffer(enc3.tobytes(), np.uint8)), ext
+            assert not sniff(np.frombuffer(enc1.tobytes(), np.uint8)), ext
+        assert not sniff(np.frombuffer(b'garbage' * 10, np.uint8))
+
+    @pytest.mark.parametrize('fmt', ['png', 'jpeg'])
+    def test_batch_matches_single_decode(self, fmt):
+        # the direct-RGB fast path must be bit-identical to decode()
+        field = self._field((20, 24, 3), fmt)
+        rng = np.random.RandomState(1)
+        images = [rng.randint(0, 255, (20, 24, 3), np.uint8)
+                  for _ in range(6)]
+        cells = [field.codec.encode(field, im) for im in images]
+        batch = field.codec.decode_batch(field, cells)
+        for got, cell in zip(batch, cells):
+            np.testing.assert_array_equal(
+                got, field.codec.decode(field, cell))
+
+    def test_grayscale_cell_in_rgb_field_keeps_true_shape(self):
+        # a foreign-written grayscale cell must surface with its TRUE shape
+        # through the fallback, never silently colorized to 3 channels
+        import cv2
+        field = self._field((10, 12, 3))
+        rgb_field = self._field((10, 12, 3))
+        rng = np.random.RandomState(2)
+        cells = [rgb_field.codec.encode(
+            rgb_field, rng.randint(0, 255, (10, 12, 3), np.uint8))
+            for _ in range(4)]
+        ok, gray = cv2.imencode('.png',
+                                rng.randint(0, 255, (10, 12), np.uint8))
+        cells.append(bytearray(gray.tobytes()))
+        batch = field.codec.decode_batch(field, cells)
+        assert isinstance(batch, list)
+        assert batch[-1].shape == (10, 12)
+
+    def test_16bit_png_cell_matches_row_decode(self):
+        # 16-bit RGB PNG sniffs as NOT eligible for the fast path; batched
+        # and row decode must produce identical values (mod-256 cast)
+        import cv2
+        field = self._field((6, 8, 3))
+        rng = np.random.RandomState(3)
+        deep = rng.randint(0, 2 ** 16, (6, 8, 3)).astype(np.uint16)
+        ok, enc = cv2.imencode('.png', deep)
+        assert ok
+        cell = np.frombuffer(enc.tobytes(), np.uint8)
+        from petastorm_tpu.codecs import CompressedImageCodec
+        assert not CompressedImageCodec._is_3_channel(cell)
+        batch = field.codec.decode_batch(field, [cell] * 3)
+        single = field.codec.decode(field, cell)
+        for got in batch:
+            np.testing.assert_array_equal(got, single)
+
+    def test_exif_oriented_jpeg_not_rotated(self):
+        # EXIF Orientation must be IGNORED on the fast path, exactly like
+        # decode()'s IMREAD_UNCHANGED
+        import cv2
+        field = self._field((10, 10, 3), 'jpeg')
+        rng = np.random.RandomState(4)
+        img = rng.randint(0, 255, (10, 10, 3), np.uint8)
+        ok, enc = cv2.imencode('.jpeg', img)
+        raw = enc.tobytes()
+        # splice an APP1 Exif segment with Orientation=3 after SOI
+        tiff = (b'II*\x00\x08\x00\x00\x00'          # TIFF header, IFD @8
+                b'\x01\x00'                          # 1 entry
+                b'\x12\x01\x03\x00\x01\x00\x00\x00\x03\x00\x00\x00'
+                b'\x00\x00\x00\x00')                 # next IFD = 0
+        exif_payload = b'Exif\x00\x00' + tiff
+        app1 = b'\xff\xe1' + (len(exif_payload) + 2).to_bytes(2, 'big') \
+            + exif_payload
+        tagged = np.frombuffer(raw[:2] + app1 + raw[2:], np.uint8)
+        from petastorm_tpu.codecs import CompressedImageCodec
+        assert CompressedImageCodec._is_3_channel(tagged)
+        batch = field.codec.decode_batch(field, [tagged] * 4)
+        single = field.codec.decode(field, tagged)
+        for got in batch:
+            np.testing.assert_array_equal(got, single)
