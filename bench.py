@@ -109,7 +109,9 @@ def _build_imagenet_like(url):
 
 def _measure_rows(url):
     from petastorm_tpu.reader import make_reader
-    with make_reader(url, reader_pool_type='thread', workers_count=3,
+    # workers_count=None auto-sizes to the host (the reference's published
+    # number used 3 workers on its own box; ours adapts the same way)
+    with make_reader(url, reader_pool_type='thread',
                      num_epochs=None, shuffle_row_groups=True) as reader:
         for _ in range(WARMUP_SAMPLES):
             next(reader)
@@ -122,7 +124,7 @@ def _measure_rows(url):
 def _measure_batch(url, warmup_rows, measure_rows, bytes_per_row=0):
     """Batched column reader: rows/sec (and decoded MB/s when sized)."""
     from petastorm_tpu.reader import make_batch_reader
-    with make_batch_reader(url, reader_pool_type='thread', workers_count=3,
+    with make_batch_reader(url, reader_pool_type='thread',
                            num_epochs=None, shuffle_row_groups=True) as reader:
         seen = 0
         while seen < warmup_rows:
@@ -170,6 +172,22 @@ print(json.dumps({"rows_per_sec": seen / elapsed}))
 '''
 
 
+def _run_json_subprocess(argv, timeout):
+    """Run a measurement subprocess; parse its last stdout line as JSON.
+    Errors come back as {'error': ...} so the benchmark never dies here."""
+    try:
+        out = subprocess.run(argv, capture_output=True, timeout=timeout,
+                             text=True)
+    except subprocess.TimeoutExpired:
+        return {'error': 'timeout'}
+    if out.returncode != 0:
+        return {'error': (out.stderr or 'failed').strip()[-300:]}
+    try:
+        return json.loads(out.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return {'error': 'unparseable output'}
+
+
 def _build_tfrecord(url, timeout=240):
     """Re-encode the parquet dataset's jpeg cells into a TFRecord file.
     Returns the path, or an error string."""
@@ -209,19 +227,9 @@ def _measure_tfdata(tfrecord_path, warmup, measure, timeout=240):
     """BASELINE.json north star: the same jpeg bytes through a
     tf.data+TFRecord input pipeline, for a like-for-like rows/sec ratio.
     Runs in a subprocess so TF's runtime never pollutes this process."""
-    try:
-        run = subprocess.run(
-            [sys.executable, '-c', _TFDATA_SNIPPET, tfrecord_path,
-             str(warmup), str(measure)],
-            capture_output=True, timeout=timeout, text=True)
-    except subprocess.TimeoutExpired:
-        return {'error': 'timeout'}
-    if run.returncode != 0:
-        return {'error': (run.stderr or 'failed').strip()[-200:]}
-    try:
-        return json.loads(run.stdout.strip().splitlines()[-1])
-    except (ValueError, IndexError):
-        return {'error': 'unparseable output'}
+    return _run_json_subprocess(
+        [sys.executable, '-c', _TFDATA_SNIPPET, tfrecord_path,
+         str(warmup), str(measure)], timeout)
 
 
 _JAX_SNIPPET = r'''
@@ -234,7 +242,7 @@ if os.environ.get('BENCH_JAX_PLATFORM'):
 from petastorm_tpu.jax import make_jax_loader
 url, batch_size, warmup, measure, fields = %(url)r, %(batch)d, %(warmup)d, %(measure)d, %(fields)r
 with make_jax_loader(url, batch_size=batch_size, fields=fields,
-                     num_epochs=None, workers_count=3,
+                     num_epochs=None,
                      shuffle_row_groups=True) as loader:
     it = iter(loader)
     seen = 0
@@ -262,17 +270,7 @@ def _measure_jax(url, batch_size, warmup, measure, fields, timeout=150):
         'repo': os.path.dirname(os.path.abspath(__file__)), 'url': url,
         'batch': batch_size, 'warmup': warmup, 'measure': measure,
         'fields': fields}
-    try:
-        out = subprocess.run([sys.executable, '-c', code],
-                             capture_output=True, timeout=timeout, text=True)
-    except subprocess.TimeoutExpired:
-        return {'error': 'timeout'}
-    if out.returncode != 0:
-        return {'error': (out.stderr or 'failed').strip()[-300:]}
-    try:
-        return json.loads(out.stdout.strip().splitlines()[-1])
-    except (ValueError, IndexError):
-        return {'error': 'unparseable output'}
+    return _run_json_subprocess([sys.executable, '-c', code], timeout)
 
 
 def main():

@@ -429,8 +429,9 @@ class DatasetWriter:
 
     def __init__(self, dataset_url, schema, rowgroup_size_rows=1000,
                  partition_by=(), file_prefix='part', storage_options=None,
-                 rowgroup_size_mb=None):
+                 rowgroup_size_mb=None, compression='auto'):
         self.schema = schema
+        self._compression = compression
         self.rowgroup_size_rows = rowgroup_size_rows
         self.rowgroup_size_bytes = (rowgroup_size_mb * 1024 * 1024
                                     if rowgroup_size_mb else None)
@@ -452,6 +453,33 @@ class DatasetWriter:
                   for f in self.schema if f.name not in self.partition_by]
         return pa.schema(fields)
 
+    def _resolve_compression(self):
+        """``'auto'`` → per-column: NONE for codec-compressed cells (jpeg,
+        png, npz are incompressible — snappy would burn CPU on both the
+        write and every read for ~0% size win), SNAPPY elsewhere. Any other
+        value passes through to pyarrow unchanged."""
+        if self._compression != 'auto':
+            return self._compression
+        from petastorm_tpu.codecs import (
+            CompressedImageCodec, CompressedNdarrayCodec,
+        )
+        per_column = {}
+        for f in self.schema:
+            if f.name in self.partition_by:
+                continue
+            # pyarrow matches parquet COLUMN PATHS, not field names: a
+            # list-typed column's leaf is '<name>.list.element' and a plain
+            # '<name>' key would silently fall to dict-mode's UNCOMPRESSED
+            storage = f.arrow_storage_type()
+            if pa.types.is_list(storage) or pa.types.is_large_list(storage):
+                key = f.name + '.list.element'
+            else:
+                key = f.name
+            incompressible = isinstance(
+                f.codec, (CompressedImageCodec, CompressedNdarrayCodec))
+            per_column[key] = 'NONE' if incompressible else 'SNAPPY'
+        return per_column
+
     def _partition_dir(self, row):
         segments = []
         for key in self.partition_by:
@@ -467,7 +495,10 @@ class DatasetWriter:
             path = posixpath.join(directory, '%s-%05d.parquet' % (self._file_prefix, self._file_seq))
             self._file_seq += 1
             sink = self.fs.open(path, 'wb')
-            self._writers[part_dir] = (pq.ParquetWriter(sink, self._arrow_schema), sink)
+            self._writers[part_dir] = (
+                pq.ParquetWriter(sink, self._arrow_schema,
+                                 compression=self._resolve_compression()),
+                sink)
             self._buffers[part_dir] = []
         return self._writers[part_dir][0]
 

@@ -37,7 +37,7 @@ _VENTILATE_EXTRA_ROWGROUPS = 2
 
 
 def make_reader(dataset_url, schema_fields=None, reader_pool_type='thread',
-                workers_count=4, results_queue_size=50, shuffle_row_groups=True,
+                workers_count=None, results_queue_size=50, shuffle_row_groups=True,
                 shuffle_row_drop_partitions=1, predicate=None,
                 rowgroup_selector=None, num_epochs=1, cur_shard=None,
                 shard_count=None, seed=0, cache_type='null', cache_location=None,
@@ -79,7 +79,7 @@ def make_reader(dataset_url, schema_fields=None, reader_pool_type='thread',
 
 
 def make_batch_reader(dataset_url_or_urls, schema_fields=None,
-                      reader_pool_type='thread', workers_count=4,
+                      reader_pool_type='thread', workers_count=None,
                       results_queue_size=50, shuffle_row_groups=True,
                       shuffle_row_drop_partitions=1, predicate=None,
                       rowgroup_selector=None, num_epochs=1, cur_shard=None,
@@ -120,6 +120,12 @@ def _make_cache(cache_type, location, size_limit, row_size_estimate):
 
 
 def _make_pool(reader_pool_type, workers_count, results_queue_size):
+    if workers_count is None:
+        # Auto-size to the host: decode is CPU-bound (cv2/numpy release the
+        # GIL but still need a core each), so extra workers on a small box
+        # only thrash. 4 matches the previous fixed default on TPU VMs.
+        import os
+        workers_count = max(1, min(4, os.cpu_count() or 1))
     if reader_pool_type == 'thread':
         return ThreadPool(workers_count, results_queue_size)
     if reader_pool_type == 'process':
@@ -141,7 +147,7 @@ class Reader:
     """
 
     def __init__(self, dataset_info, schema_fields=None, reader_pool_type='thread',
-                 workers_count=4, results_queue_size=50, shuffle_row_groups=True,
+                 workers_count=None, results_queue_size=50, shuffle_row_groups=True,
                  shuffle_row_drop_partitions=1, predicate=None,
                  rowgroup_selector=None, num_epochs=1, cur_shard=None,
                  shard_count=None, seed=0, cache=None, transform_spec=None,

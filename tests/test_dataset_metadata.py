@@ -177,3 +177,57 @@ def test_dataset_info_pickle_resets_lazy_sentinels(tmp_path):
     meta = info.common_metadata
     assert meta is not None and UNISCHEMA_KEY in dict(meta.metadata)
     assert len(load_row_groups(info)) == 2
+
+
+def test_auto_compression_per_column(tmp_path):
+    # jpeg/npz cells are stored UNCOMPRESSED (snappy would burn CPU for ~0%
+    # size win on both write and every read); plain columns stay SNAPPY
+    import glob
+    import pyarrow.parquet as pq
+    from petastorm_tpu.codecs import (
+        CompressedImageCodec, CompressedNdarrayCodec, ScalarCodec,
+    )
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+    schema = Unischema('C', [
+        UnischemaField('id', np.int64, (), ScalarCodec(pa.int64()), False),
+        UnischemaField('image', np.uint8, (8, 8, 3),
+                       CompressedImageCodec('jpeg'), False),
+        UnischemaField('blob', np.float32, (4,),
+                       CompressedNdarrayCodec(), False),
+        UnischemaField('vec', np.float32, (4,), None, False),
+    ])
+    rng = np.random.RandomState(0)
+    url = 'file://' + str(tmp_path / 'ds')
+    write_dataset(url, schema, [
+        {'id': i, 'image': rng.randint(0, 255, (8, 8, 3), np.uint8),
+         'blob': rng.rand(4).astype(np.float32),
+         'vec': rng.rand(4).astype(np.float32)} for i in range(6)],
+        rowgroup_size_rows=3)
+    meta = pq.ParquetFile(
+        glob.glob(str(tmp_path / 'ds' / '*.parquet'))[0]).metadata
+    comp = {meta.row_group(0).column(i).path_in_schema:
+            meta.row_group(0).column(i).compression
+            for i in range(meta.row_group(0).num_columns)}
+    assert comp['image'] == 'UNCOMPRESSED'
+    assert comp['blob'] == 'UNCOMPRESSED'
+    assert comp['id'] == 'SNAPPY'
+    # list-typed columns are addressed by their parquet leaf path
+    assert comp['vec.list.element'] == 'SNAPPY'
+
+
+def test_explicit_compression_passthrough(tmp_path):
+    import glob
+    import pyarrow.parquet as pq
+    from petastorm_tpu.codecs import ScalarCodec
+    from petastorm_tpu.etl.dataset_metadata import DatasetWriter
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+    schema = Unischema('C', [
+        UnischemaField('id', np.int64, (), ScalarCodec(pa.int64()), False),
+    ])
+    url = 'file://' + str(tmp_path / 'ds')
+    with DatasetWriter(url, schema, rowgroup_size_rows=4,
+                       compression='NONE') as writer:
+        writer.write_row_dicts([{'id': i} for i in range(4)])
+    meta = pq.ParquetFile(
+        glob.glob(str(tmp_path / 'ds' / '*.parquet'))[0]).metadata
+    assert meta.row_group(0).column(0).compression == 'UNCOMPRESSED'
