@@ -172,16 +172,24 @@ print(json.dumps({"rows_per_sec": seen / elapsed}))
 '''
 
 
-def _run_json_subprocess(argv, timeout):
-    """Run a measurement subprocess; parse its last stdout line as JSON.
-    Errors come back as {'error': ...} so the benchmark never dies here."""
+def _run_subprocess(argv, timeout):
+    """Run a helper subprocess → ``(completed_process, None)`` on success or
+    ``(None, error_string)``; the benchmark never dies on helper failures."""
     try:
         out = subprocess.run(argv, capture_output=True, timeout=timeout,
                              text=True)
     except subprocess.TimeoutExpired:
-        return {'error': 'timeout'}
+        return None, 'timeout'
     if out.returncode != 0:
-        return {'error': (out.stderr or 'failed').strip()[-300:]}
+        return None, (out.stderr or 'failed').strip()[-300:]
+    return out, None
+
+
+def _run_json_subprocess(argv, timeout):
+    """Run a measurement subprocess; parse its last stdout line as JSON."""
+    out, error = _run_subprocess(argv, timeout)
+    if error is not None:
+        return {'error': error}
     try:
         return json.loads(out.stdout.strip().splitlines()[-1])
     except (ValueError, IndexError):
@@ -211,15 +219,11 @@ with tf.io.TFRecordWriter(out) as writer:
 '''
     root = url[len('file://'):]
     tfrecord_path = root + '.tfrecord'
-    try:
-        build = subprocess.run(
-            [sys.executable, '-c', code, tfrecord_path, root + '/*.parquet'],
-            capture_output=True, timeout=timeout, text=True)
-    except subprocess.TimeoutExpired:
-        return None, 'tfrecord build timeout'
-    if build.returncode != 0:
-        return None, ('tfrecord build: %s'
-                      % (build.stderr or '').strip()[-200:])
+    _, error = _run_subprocess(
+        [sys.executable, '-c', code, tfrecord_path, root + '/*.parquet'],
+        timeout)
+    if error is not None:
+        return None, 'tfrecord build: %s' % error
     return tfrecord_path, None
 
 

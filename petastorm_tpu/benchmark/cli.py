@@ -18,7 +18,8 @@ def build_parser():
     parser.add_argument('-m', '--measure-cycles', type=int, default=1000)
     parser.add_argument('-p', '--pool-type', default='thread',
                         choices=['thread', 'process', 'dummy'])
-    parser.add_argument('-l', '--loaders-count', type=int, default=3)
+    parser.add_argument('-l', '--loaders-count', type=int, default=None,
+                        help='decode workers; default auto-sizes to the host')
     parser.add_argument('-r', '--read-method', default='python',
                         choices=['python', 'batch', 'jax'])
     parser.add_argument('--batch-size', type=int, default=128,

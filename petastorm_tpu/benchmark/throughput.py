@@ -31,7 +31,7 @@ class BenchmarkResult:
 
 def reader_throughput(dataset_url, field_regex=None, warmup_cycles=200,
                       measure_cycles=1000, pool_type='thread',
-                      loaders_count=3, read_method='python',
+                      loaders_count=None, read_method='python',
                       shuffle_row_groups=True, batch_size=128,
                       spawn_new_process=False):
     """Measure read throughput of a dataset.
