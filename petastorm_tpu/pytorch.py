@@ -185,6 +185,14 @@ class BatchedDataLoader(LoaderBase):
                  shuffling_queue_capacity=0, seed=None,
                  inmemory_cache_all=False, keep_fields=None):
         super().__init__(reader)
+        if inmemory_cache_all and getattr(reader, 'num_epochs', None) != 1:
+            # A multi-epoch (or infinite) reader would fill the cache with
+            # duplicated rows — epoch replay comes from RAM, not the reader
+            # (reference guard: ``pytorch.py:344-353``). Fails CLOSED: a
+            # reader that doesn't declare num_epochs is treated as unknown
+            # and rejected.
+            raise ValueError('inmemory_cache_all requires a reader with '
+                             'num_epochs=1; further epochs replay from RAM')
         self.batch_size = batch_size
         self.shuffling_queue_capacity = shuffling_queue_capacity
         self._seed = seed

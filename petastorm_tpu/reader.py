@@ -413,6 +413,11 @@ class Reader:
         self.join()
 
     @property
+    def num_epochs(self):
+        """Requested epoch count (None = infinite)."""
+        return self._num_epochs
+
+    @property
     def diagnostics(self):
         return self._pool.diagnostics
 

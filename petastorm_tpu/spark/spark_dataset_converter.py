@@ -81,9 +81,17 @@ class DatasetConverter:
                 reader = make_batch_reader(converter.cache_dir_url,
                                            num_epochs=num_epochs,
                                            **reader_kwargs)
-                self._loader = BatchedDataLoader(reader,
-                                                 batch_size=batch_size,
-                                                 **(loader_kwargs or {}))
+                try:
+                    self._loader = BatchedDataLoader(reader,
+                                                     batch_size=batch_size,
+                                                     **(loader_kwargs or {}))
+                except Exception:
+                    # loader construction failed: __exit__ will never run,
+                    # so the live reader (pool already started) must be
+                    # stopped here or its workers leak
+                    reader.stop()
+                    reader.join()
+                    raise
                 return self._loader
 
             def __exit__(self, exc_type, exc_val, exc_tb):

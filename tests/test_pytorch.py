@@ -43,6 +43,27 @@ class TestCollate:
         out = decimal_friendly_collate([Decimal('1.5'), Decimal('2.5')])
         assert out == [Decimal('1.5'), Decimal('2.5')]
 
+    def test_empty_dict_input(self):
+        # reference: test_decimal_friendly_collate_empty_input (:95)
+        assert decimal_friendly_collate([dict()]) == dict()
+
+    def test_decimal_in_tuple(self):
+        # reference: ..._has_decimals_in_tuple (:140)
+        out = decimal_friendly_collate([(Decimal('1'), np.float32(1.0)),
+                                        (Decimal('2'), np.float32(2.0))])
+        assert out[0] == [Decimal('1'), Decimal('2')]
+        assert torch.is_tensor(out[1])
+
+    @pytest.mark.parametrize('np_dtype', [
+        np.float32, np.float64, np.int16, np.int32, np.int64, np.uint8,
+    ])
+    def test_torch_tensorable_dtypes(self, np_dtype):
+        # reference: test_torch_tensorable_types (:101)
+        row = {'x': np.arange(4, dtype=np_dtype)}
+        _sanitize_pytorch_types(row)
+        batch = decimal_friendly_collate([row, row])
+        assert torch.is_tensor(batch['x']) and batch['x'].shape == (2, 4)
+
     def test_dict_with_decimal(self):
         out = decimal_friendly_collate([
             {'d': Decimal('1'), 'x': np.float32(1.0)},
@@ -158,6 +179,36 @@ class TestBatchedDataLoader:
             second = torch.cat([b['id'] for b in loader]).tolist()
         assert sorted(first) == sorted(second) == list(range(100))
         assert first != second  # per-epoch reshuffle from the cache
+
+    def test_inmemory_cache_multi_epoch_reader_rejected(self, scalar_dataset):
+        # reference: test_mem_cache_reader_num_epochs_error (:214)
+        for bad_epochs in (2, None):
+            reader = make_batch_reader(scalar_dataset.url,
+                                       schema_fields=['^id$'],
+                                       num_epochs=bad_epochs)
+            try:
+                with pytest.raises(ValueError, match='num_epochs=1'):
+                    BatchedDataLoader(reader, batch_size=10,
+                                      inmemory_cache_all=True)
+            finally:
+                reader.stop()
+                reader.join()
+
+    def test_abandoned_first_epoch_cannot_silently_replay(self,
+                                                          scalar_dataset):
+        # abandoning the caching pass mid-epoch must NOT leave a truncated
+        # cache that later replays as if complete: re-iteration surfaces the
+        # reader's reset-mid-epoch error instead
+        reader = make_batch_reader(scalar_dataset.url,
+                                   schema_fields=['^id$'],
+                                   shuffle_row_groups=False, num_epochs=1)
+        with BatchedDataLoader(reader, batch_size=10,
+                               inmemory_cache_all=True) as loader:
+            it = iter(loader)
+            next(it)
+            it.close()  # explicit abandonment mid-epoch
+            with pytest.raises(NotImplementedError, match='middle'):
+                list(loader)
 
     def test_transform_fn(self, scalar_dataset):
         reader = make_batch_reader(scalar_dataset.url,
