@@ -78,3 +78,50 @@ def test_deterministic_with_seed(synthetic_dataset):
             mix = WeightedSamplingReader([a, b], [0.5, 0.5], seed=7)
             ids_runs.append([next(mix).id for _ in range(50)])
     assert ids_runs[0] == ids_runs[1]
+
+
+def test_degenerate_probability_selects_single_reader(synthetic_dataset):
+    # reference: test_select_only_one_of_readers (:52)
+    marker = {'count': 0}
+
+    class _Marking:
+        def __init__(self, reader):
+            self._reader = reader
+
+        def __getattr__(self, name):
+            return getattr(self._reader, name)
+
+        def __next__(self):
+            marker['count'] += 1
+            return next(self._reader)
+
+    with _reader(synthetic_dataset.url) as a, \
+            _reader(synthetic_dataset.url) as b:
+        mix = WeightedSamplingReader([a, _Marking(b)], [1.0, 0.0], seed=1)
+        for _ in range(50):
+            next(mix)
+    assert marker['count'] == 0
+
+
+def test_tf_dataset_over_mix(synthetic_dataset):
+    # reference: test_with_tf_data_api (:172)
+    pytest.importorskip('tensorflow')
+    from petastorm_tpu.tf_utils import make_petastorm_dataset
+    with _reader(synthetic_dataset.url, schema_fields=['^id$']) as a, \
+            _reader(synthetic_dataset.url, schema_fields=['^id$']) as b:
+        mix = WeightedSamplingReader([a, b], [0.5, 0.5], seed=2)
+        dataset = make_petastorm_dataset(mix)
+        ids = [int(row.id) for row in dataset.take(20)]
+    assert len(ids) == 20 and all(0 <= i < 100 for i in ids)
+
+
+def test_torch_loader_over_mix(synthetic_dataset):
+    # reference: test_with_torch_api (:203)
+    pytest.importorskip('torch')
+    from petastorm_tpu.pytorch import DataLoader
+    with _reader(synthetic_dataset.url, schema_fields=['^id$']) as a, \
+            _reader(synthetic_dataset.url, schema_fields=['^id$']) as b:
+        mix = WeightedSamplingReader([a, b], [0.3, 0.7], seed=3)
+        loader = DataLoader(mix, batch_size=10)
+        batch = next(iter(loader))
+    assert len(batch['id']) == 10

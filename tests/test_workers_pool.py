@@ -230,6 +230,56 @@ def test_ventilator_reset_reshuffles_item_order():
     assert sweeps[0] != sweeps[1] and sweeps[1] != sweeps[2]
 
 
+class TestExecInNewProcess:
+    """Spawn-not-fork helper (reference:
+    ``workers_pool/exec_in_new_process.py:26-48``)."""
+
+    def test_runs_function_in_fresh_interpreter(self, tmp_path):
+        from petastorm_tpu.workers.exec_in_new_process import (
+            exec_in_new_process,
+        )
+        out = str(tmp_path / 'out.txt')
+
+        def write_marker(path, value):
+            import os
+            with open(path, 'w') as f:
+                f.write('%s:%d' % (value, os.getpid()))
+
+        proc = exec_in_new_process(write_marker, out, value='hello')
+        assert proc.wait(timeout=60) == 0
+        value, pid = open(out).read().split(':')
+        assert value == 'hello'
+        assert int(pid) != __import__('os').getpid()  # genuinely new process
+
+    def test_exit_code_propagates(self):
+        from petastorm_tpu.workers.exec_in_new_process import (
+            exec_in_new_process,
+        )
+
+        def boom():
+            raise SystemExit(3)
+
+        assert exec_in_new_process(boom).wait(timeout=60) == 3
+
+    def test_child_forced_onto_cpu_platform(self, tmp_path, monkeypatch):
+        # decode workers must never grab the TPU chip the trainer owns —
+        # even when the PARENT runs with JAX_PLATFORMS=tpu
+        from petastorm_tpu.workers.exec_in_new_process import (
+            exec_in_new_process,
+        )
+        monkeypatch.setenv('JAX_PLATFORMS', 'tpu')
+        out = str(tmp_path / 'platform.txt')
+
+        def report(path):
+            import os
+            with open(path, 'w') as f:
+                f.write(os.environ.get('JAX_PLATFORMS', ''))
+
+        proc = exec_in_new_process(report, out)
+        assert proc.wait(timeout=60) == 0
+        assert open(out).read() == 'cpu'
+
+
 def test_thread_pool_requires_stop_before_join():
     pool = ThreadPool(1)
     pool.start(IdentityWorker)
