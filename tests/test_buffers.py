@@ -184,3 +184,62 @@ class TestBatchedRandom:
                 assert len(arr) == rid + 1
             rows += len(b['id'])
         assert rows == 6
+
+
+class TestRandomizedOpSequences:
+    """Long random interleavings of add/retrieve/finish must preserve the
+    exactly-once invariant (reference: ``test_shuffling_buffer.py:223`` —
+    test_longer_random_sequence_of_queue_ops)."""
+
+    @pytest.mark.parametrize('capacity,min_after', [(20, 10), (64, 1),
+                                                    (7, 7)])
+    def test_row_buffer_invariants(self, capacity, min_after):
+        rng = np.random.RandomState(capacity)
+        buf = RandomShufflingBuffer(capacity, min_after_retrieve=min_after,
+                                    seed=1)
+        fed, got = [], []
+        next_item = 0
+        for _ in range(2000):
+            if buf.can_add and rng.rand() < 0.55:
+                chunk = [next_item + i for i in range(int(rng.randint(1, 4)))]
+                next_item += len(chunk)
+                buf.add_many(chunk)
+                fed.extend(chunk)
+            elif buf.can_retrieve:
+                got.append(buf.retrieve())
+            assert buf.size <= capacity + 3  # bounded by capacity + chunk
+        buf.finish()
+        while buf.can_retrieve:
+            got.append(buf.retrieve())
+        assert sorted(got) == fed
+
+    @pytest.mark.parametrize('batch_size', [1, 5, 16])
+    def test_batched_buffer_invariants(self, batch_size):
+        rng = np.random.RandomState(batch_size)
+        buf = BatchedRandomShufflingBuffer(
+            64, min_after_retrieve=8, batch_size=batch_size,
+            extra_capacity=64, seed=2)
+        next_row = 0
+        fed = 0
+        out_ids = []
+        for _ in range(500):
+            if buf.can_add and rng.rand() < 0.55:
+                n = int(rng.randint(1, 20))
+                ids = np.arange(next_row, next_row + n)
+                buf.add_many({'id': ids, 'sq': ids ** 2})
+                next_row += n
+                fed += n
+            elif buf.can_retrieve:
+                batch = buf.retrieve()
+                assert len(batch['id']) == batch_size
+                # row alignment: columns must stay paired under shuffling
+                np.testing.assert_array_equal(batch['sq'],
+                                              batch['id'] ** 2)
+                out_ids.extend(batch['id'].tolist())
+        buf.finish()
+        while buf.can_retrieve:
+            batch = buf.retrieve()
+            np.testing.assert_array_equal(batch['sq'], batch['id'] ** 2)
+            out_ids.extend(batch['id'].tolist())
+        # exactly-once, in full: finish() + drain must emit every fed row
+        assert sorted(out_ids) == list(range(fed))

@@ -22,30 +22,30 @@ def test_basic_iteration(synthetic_dataset):
             assert hasattr(next(mix), 'id')
 
 
+class _SpyReader:
+    """Delegating reader wrapper invoking ``on_next`` per drawn row."""
+
+    def __init__(self, reader, on_next):
+        self._reader = reader
+        self._on_next = on_next
+
+    def __getattr__(self, name):
+        return getattr(self._reader, name)
+
+    def __next__(self):
+        self._on_next()
+        return next(self._reader)
+
+
 def test_choice_distribution(synthetic_dataset):
-    class _Counting:
-        def __init__(self, reader, bucket, counts):
-            self._reader = reader
-            self._bucket = bucket
-            self._counts = counts
-            self.schema = reader.schema
-            self.batched_output = reader.batched_output
-            self.ngram = reader.ngram
-
-        def __next__(self):
-            self._counts[self._bucket] += 1
-            return next(self._reader)
-
-        def stop(self):
-            self._reader.stop()
-
-        def join(self):
-            self._reader.join()
-
     counts = [0, 0]
+
+    def count(bucket):
+        return lambda: counts.__setitem__(bucket, counts[bucket] + 1)
+
     with _reader(synthetic_dataset.url) as a, _reader(synthetic_dataset.url) as b:
         mix = WeightedSamplingReader(
-            [_Counting(a, 0, counts), _Counting(b, 1, counts)],
+            [_SpyReader(a, count(0)), _SpyReader(b, count(1))],
             [0.75, 0.25], seed=42)
         for _ in range(1000):
             next(mix)
@@ -84,20 +84,13 @@ def test_degenerate_probability_selects_single_reader(synthetic_dataset):
     # reference: test_select_only_one_of_readers (:52)
     marker = {'count': 0}
 
-    class _Marking:
-        def __init__(self, reader):
-            self._reader = reader
-
-        def __getattr__(self, name):
-            return getattr(self._reader, name)
-
-        def __next__(self):
-            marker['count'] += 1
-            return next(self._reader)
+    def mark():
+        marker['count'] += 1
 
     with _reader(synthetic_dataset.url) as a, \
             _reader(synthetic_dataset.url) as b:
-        mix = WeightedSamplingReader([a, _Marking(b)], [1.0, 0.0], seed=1)
+        mix = WeightedSamplingReader([a, _SpyReader(b, mark)],
+                                     [1.0, 0.0], seed=1)
         for _ in range(50):
             next(mix)
     assert marker['count'] == 0
