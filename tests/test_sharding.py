@@ -1,0 +1,51 @@
+"""Pod-aware shard defaults (``petastorm_tpu/parallel/sharding.py``).
+
+The reader-level modulo assignment is covered in test_end_to_end; this
+covers the default-resolution rules and the live-backend gate."""
+
+import pytest
+
+from petastorm_tpu.parallel import sharding
+from petastorm_tpu.parallel.sharding import default_shard_info
+
+
+def test_explicit_values_validated():
+    assert default_shard_info(2, 4) == (2, 4)
+    with pytest.raises(ValueError, match='together'):
+        default_shard_info(1, None)
+    with pytest.raises(ValueError, match='together'):
+        default_shard_info(None, 4)
+    with pytest.raises(ValueError, match='must be in'):
+        default_shard_info(4, 4)
+    with pytest.raises(ValueError, match='must be in'):
+        default_shard_info(-1, 4)
+
+
+def test_single_process_backend_gives_no_sharding():
+    # conftest initialized the (single-process) CPU backend: process_count
+    # is 1, so reads stay unsharded
+    assert default_shard_info(None, None) == (None, None)
+
+
+def test_multi_process_runtime_defaults_shard(monkeypatch):
+    monkeypatch.setattr(sharding, '_jax_process_info', lambda: (3, 8))
+    assert default_shard_info(None, None) == (3, 8)
+    # explicit values always win over the runtime defaults
+    assert default_shard_info(0, 2) == (0, 2)
+
+
+def test_uninitialized_backend_never_initializes(monkeypatch):
+    # the gate must consult the live-backend check, not force one up
+    calls = []
+
+    class _Bridge:
+        @staticmethod
+        def backends_are_initialized():
+            calls.append(1)
+            return False
+
+    import jax._src.xla_bridge as xb
+    monkeypatch.setattr(xb, 'backends_are_initialized',
+                        _Bridge.backends_are_initialized)
+    assert sharding._jax_process_info() == (None, None)
+    assert calls  # the gate was actually consulted

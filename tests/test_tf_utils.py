@@ -142,6 +142,28 @@ def test_batch_dataset_decimal_column(tmp_path):
     assert row.price.numpy() == b'3.14'
 
 
+def test_tf_tensors_shuffling_queue(synthetic_dataset):
+    # reference: test_shuffling_queue (:210) — with a shuffle queue the rows
+    # arrive decorrelated; the full multiset is preserved
+    # dummy pool: the unshuffled baseline is strictly ordered, so only the
+    # tf-side shuffle queue can decorrelate the stream
+    with make_reader(synthetic_dataset.url, schema_fields=['^id$'],
+                     shuffle_row_groups=False, reader_pool_type='dummy',
+                     num_epochs=1) as reader:
+        ids = [int(tf_tensors(reader, shuffling_queue_capacity=50).id)
+               for _ in range(100)]
+    assert sorted(ids) == list(range(100))
+    assert ids != list(range(100))
+
+
+def test_tf_tensors_capacity_change_rejected(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, schema_fields=['^id$'],
+                     num_epochs=None) as reader:
+        tf_tensors(reader, shuffling_queue_capacity=10)
+        with pytest.raises(ValueError, match='cannot change'):
+            tf_tensors(reader, shuffling_queue_capacity=20)
+
+
 def test_tf_tensors_shim(synthetic_dataset):
     with make_reader(synthetic_dataset.url, schema_fields=['^id$'],
                      shuffle_row_groups=False, num_epochs=1) as reader:
