@@ -77,7 +77,7 @@ def typed_partition_value(field, value):
     if dtype.kind in 'iuf':
         try:
             return dtype.type(value)
-        except (TypeError, ValueError):
+        except (TypeError, ValueError, OverflowError):
             return value
     if dtype.kind == 'b':
         return value in (True, 'true', 'True', '1', 1)

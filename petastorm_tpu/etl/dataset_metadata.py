@@ -24,6 +24,7 @@ from concurrent.futures import ThreadPoolExecutor
 from contextlib import contextmanager
 from urllib.parse import quote, unquote
 
+import numpy as np
 import pyarrow as pa
 import pyarrow.parquet as pq
 
@@ -311,8 +312,38 @@ def infer_or_load_unischema(dataset_info):
     except MetadataError:
         logger.info('Dataset %s has no petastorm metadata; inferring schema from '
                     'the parquet footer', dataset_info.url)
-        return Unischema.from_arrow_schema(dataset_info.arrow_schema,
-                                           partition_columns=dataset_info.partition_keys)
+        return Unischema.from_arrow_schema(
+            dataset_info.arrow_schema,
+            partition_columns=dataset_info.partition_keys,
+            partition_types=_infer_partition_types(dataset_info))
+
+
+def _infer_partition_types(dataset_info):
+    """Numpy dtype per hive partition key, inferred from observed values.
+
+    Hive paths carry values as strings; like Spark's partition discovery,
+    all-integer values become int64 and all-float values float64, so typed
+    data (and predicates/filters over it) round-trip instead of degrading
+    to path strings.
+    """
+    observed = {}
+    for path in dataset_info.file_paths:
+        for key, value in dataset_info.partition_values_for(path).items():
+            observed.setdefault(key, set()).add(value)
+
+    def dtype_of(values):
+        # cast with the TARGET numpy dtype so inference can never promise a
+        # type the read path's conversion would then overflow on
+        for dtype in (np.int64, np.float64):
+            try:
+                for v in values:
+                    dtype(v)
+                return dtype
+            except (TypeError, ValueError, OverflowError):
+                continue
+        return np.str_
+
+    return {key: dtype_of(values) for key, values in observed.items()}
 
 
 # ---------------------------------------------------------------------------

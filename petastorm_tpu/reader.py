@@ -191,6 +191,13 @@ class Reader:
                                   if fields else self.stored_schema)
         elif schema_fields is not None:
             self.loaded_schema = self.stored_schema.create_schema_view(schema_fields)
+            if schema_fields and not len(self.loaded_schema):
+                # all patterns missed: reading zero columns is never what
+                # the user meant (reference raises the same way,
+                # ``py_dict_reader_worker``'s EmptyResultError path)
+                raise ValueError(
+                    'No fields matching the criteria %r in schema %s'
+                    % (schema_fields, list(self.stored_schema.fields)))
         else:
             self.loaded_schema = self.stored_schema
         if transform_spec is not None:

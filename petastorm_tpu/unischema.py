@@ -357,7 +357,8 @@ class Unischema:
 
     @classmethod
     def from_arrow_schema(cls, arrow_schema, omit_unsupported_fields=True,
-                          partition_columns=(), name='inferred'):
+                          partition_columns=(), partition_types=None,
+                          name='inferred'):
         """Infer a Unischema from a plain (non-petastorm) arrow schema.
 
         list<primitive> columns become 1-d wildcard arrays; nested
@@ -385,7 +386,8 @@ class Unischema:
                     raise
         for part in partition_columns:
             if part not in {f.name for f in fields}:
-                fields.append(UnischemaField(part, np.str_, (), None, False))
+                dtype = (partition_types or {}).get(part, np.str_)
+                fields.append(UnischemaField(part, dtype, (), None, False))
         return cls(name, fields)
 
 

@@ -1,0 +1,111 @@
+"""Plain (non-petastorm) Parquet store reading
+(reference: ``tests/test_parquet_reader.py``, 209 LoC)."""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from petastorm_tpu import make_batch_reader
+
+
+def test_many_columns_store(tmp_path):
+    # reference: test_many_columns_non_petastorm_dataset (:83) — wide
+    # schemas must survive inference, reading, and namedtuple creation
+    n_cols = 300
+    table = pa.table({'col_%03d' % i: np.arange(20) + i
+                      for i in range(n_cols)})
+    pq.write_table(table, str(tmp_path / 'wide.parquet'))
+    url = 'file://' + str(tmp_path)
+    with make_batch_reader(url, shuffle_row_groups=False) as reader:
+        batch = next(reader)
+    assert len(batch._fields) == n_cols
+    np.testing.assert_array_equal(batch.col_299, np.arange(20) + 299)
+
+
+def test_partitioned_field_is_not_queried(tmp_path):
+    # reference: test_partitioned_field_is_not_queried (:93) — projecting
+    # away the hive partition column must not break row-group discovery
+    for part_dir, start in (('id_div=0', 0), ('id_div=1', 10)):
+        (tmp_path / part_dir).mkdir()
+        table = pa.table({'string': ['s_%d' % i
+                                     for i in range(start, start + 10)]})
+        pq.write_table(table, str(tmp_path / part_dir / 'part-0.parquet'))
+    url = 'file://' + str(tmp_path)
+    with make_batch_reader(url, schema_fields=['^string$'],
+                           shuffle_row_groups=False) as reader:
+        rows = [s for batch in reader for s in batch.string]
+        fields = None
+        with make_batch_reader(url, schema_fields=['^string$']) as r2:
+            fields = next(r2)._fields
+    assert sorted(rows) == sorted('s_%d' % i for i in range(20))
+    assert fields == ('string',)
+
+
+def test_asymmetric_parquet_pieces(tmp_path):
+    # reference: test_asymetric_parquet_pieces (:105) — files with
+    # DIFFERENT row-group counts must be enumerated and read completely
+    sizes = [7, 40, 91]
+    start = 0
+    for file_idx, n in enumerate(sizes):
+        table = pa.table({'id': np.arange(start, start + n)})
+        pq.write_table(table, str(tmp_path / ('part-%d.parquet' % file_idx)),
+                       row_group_size=13)
+        start += n
+    counts = {pq.ParquetFile(str(tmp_path / ('part-%d.parquet' % i)))
+              .metadata.num_row_groups for i in range(len(sizes))}
+    assert len(counts) > 1  # genuinely asymmetric
+    url = 'file://' + str(tmp_path)
+    with make_batch_reader(url, shuffle_row_groups=False) as reader:
+        ids = [i for b in reader for i in b.id]
+    assert sorted(ids) == list(range(sum(sizes)))
+
+
+def test_out_of_int64_range_partition_never_overflows(tmp_path):
+    # inference must never promise int64 for values the conversion would
+    # overflow on; like Spark's discovery ladder (long → double → string),
+    # a beyond-int64 integer lands on float64 instead of crashing the read
+    huge = 99999999999999999999999
+    for value in (1, huge):
+        d = tmp_path / ('uid=%d' % value)
+        d.mkdir()
+        pq.write_table(pa.table({'x': np.arange(3)}),
+                       str(d / 'part-0.parquet'))
+    url = 'file://' + str(tmp_path)
+    with make_batch_reader(url, shuffle_row_groups=False) as reader:
+        values = {float(v) for b in reader for v in b.uid}
+    assert values == {1.0, float(huge)}
+
+
+def test_mixed_valid_and_invalid_column_names(scalar_dataset):
+    # reference: test_invalid_and_valid_column_names (:141) — the unmatched
+    # pattern is silently dropped, only the valid column comes back
+    with make_batch_reader(scalar_dataset.url,
+                           schema_fields=['^id$', '^no_such_column$'],
+                           shuffle_row_groups=False) as reader:
+        batch = next(reader)
+    assert batch._fields == ('id',)
+
+
+def test_all_invalid_column_names_raise(scalar_dataset):
+    # reference: test_invalid_column_name (:129)
+    with pytest.raises(ValueError, match='No fields matching'):
+        make_batch_reader(scalar_dataset.url,
+                          schema_fields=['^no_such_column$'])
+
+
+def test_int_partition_values_are_typed(tmp_path):
+    # reference: test_string_partition parametrization (:201) — integer
+    # hive partition values come back typed, not as path strings
+    for value in (0, 1):
+        d = tmp_path / ('num=%d' % value)
+        d.mkdir()
+        pq.write_table(pa.table({'x': np.arange(5) + value * 5}),
+                       str(d / 'part-0.parquet'))
+    url = 'file://' + str(tmp_path)
+    with make_batch_reader(url, shuffle_row_groups=False) as reader:
+        batches = list(reader)
+    nums = np.concatenate([np.asarray(b.num) for b in batches])
+    assert set(nums.tolist()) == {0, 1}
+    assert nums.dtype.kind in 'iu' or all(isinstance(v, (int, np.integer))
+                                          for v in nums)
