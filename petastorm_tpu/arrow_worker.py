@@ -77,8 +77,15 @@ def typed_partition_value(field, value):
     if dtype.kind in 'iuf':
         try:
             return dtype.type(value)
-        except (TypeError, ValueError, OverflowError):
+        except (TypeError, ValueError):
             return value
+        except OverflowError as e:
+            # only reachable with an EXPLICITLY declared dtype (inference
+            # bounds-checks); silently returning the string would make
+            # predicates mismatch quietly — fail loud and early instead
+            raise ValueError(
+                'Hive partition value %r of field %r does not fit its '
+                'declared dtype %s' % (value, field.name, dtype)) from e
     if dtype.kind == 'b':
         return value in (True, 'true', 'True', '1', 1)
     return value

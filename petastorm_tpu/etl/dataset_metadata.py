@@ -312,10 +312,13 @@ def infer_or_load_unischema(dataset_info):
     except MetadataError:
         logger.info('Dataset %s has no petastorm metadata; inferring schema from '
                     'the parquet footer', dataset_info.url)
+        # one pass over the paths serves both the key list (dict order =
+        # first-seen order, same as partition_keys) and the type inference
+        partition_types = _infer_partition_types(dataset_info)
         return Unischema.from_arrow_schema(
             dataset_info.arrow_schema,
-            partition_columns=dataset_info.partition_keys,
-            partition_types=_infer_partition_types(dataset_info))
+            partition_columns=list(partition_types),
+            partition_types=partition_types)
 
 
 def _infer_partition_types(dataset_info):

@@ -1,0 +1,82 @@
+"""Read the CHECKED-IN reference-written dataset (tests/data/reference_written).
+
+The reference pins datasets produced by old petastorm versions
+(``tests/data/legacy/``, read by ``test_reading_legacy_datasets.py:1-60``);
+this is the same durability guarantee here: the fixture was generated once
+by the reference's own ``unischema``/``codecs`` modules (see
+``tests/test_interop.py``'s ``reference_written_dataset``), committed as
+binary, and must keep decoding byte-for-byte forever — with no dependency
+on the reference checkout being mounted.
+"""
+
+import os
+from decimal import Decimal
+
+import numpy as np
+import pytest
+
+from petastorm_tpu import make_batch_reader, make_reader
+from petastorm_tpu.etl.dataset_metadata import get_schema_from_dataset_url
+
+PINNED_URL = 'file://' + os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), 'data', 'reference_written')
+
+N_ROWS = 24  # matches tests/test_interop.py's fixture constants
+
+
+def _expected_rows():
+    """Regenerate the values the fixture was built from (RandomState(42) —
+    identical stream on every platform/numpy version for these draws)."""
+    rng = np.random.RandomState(42)
+    rows = []
+    for i in range(N_ROWS):
+        rows.append({
+            'id': np.int32(i),
+            'name': 'row_%d' % i,
+            'weight': np.float64(i) / 3.0,
+            'vec': rng.rand(8).astype(np.float32),
+            'cvec': rng.rand(4).astype(np.float64),
+            'img': rng.randint(0, 255, (16, 32, 3), np.uint8),
+            'price': Decimal('%d.%02d' % (i, i)),
+            'maybe': None if i % 3 == 0 else np.int32(i * 10),
+        })
+    return {r['id']: r for r in rows}
+
+
+def test_pinned_schema_loads_via_depickler():
+    schema = get_schema_from_dataset_url(PINNED_URL)
+    assert set(schema.fields) == {'id', 'name', 'weight', 'vec', 'cvec',
+                                  'img', 'price', 'maybe'}
+    assert schema.img.shape == (16, 32, 3)
+    assert schema.maybe.nullable
+
+
+@pytest.mark.parametrize('pool', ['dummy', 'thread'])
+def test_pinned_rows_decode_exactly(pool):
+    expected = _expected_rows()
+    with make_reader(PINNED_URL, shuffle_row_groups=False,
+                     reader_pool_type=pool) as reader:
+        rows = list(reader)
+    assert len(rows) == N_ROWS
+    for row in rows:
+        want = expected[row.id]
+        assert row.name == want['name']
+        assert row.weight == want['weight']
+        np.testing.assert_array_equal(row.vec, want['vec'])
+        np.testing.assert_array_equal(row.cvec, want['cvec'])
+        np.testing.assert_array_equal(row.img, want['img'])
+        assert row.price == want['price']
+        if want['maybe'] is None:
+            assert row.maybe is None
+        else:
+            assert row.maybe == want['maybe']
+
+
+def test_pinned_batch_reader():
+    expected = _expected_rows()
+    with make_batch_reader(PINNED_URL, shuffle_row_groups=False,
+                           schema_fields=['^id$', '^img$']) as reader:
+        for batch in reader:
+            for i in range(len(batch.id)):
+                np.testing.assert_array_equal(
+                    batch.img[i], expected[batch.id[i]]['img'])
