@@ -130,10 +130,19 @@ class ThreadPool:
         for worker in self._workers:
             worker.shutdown()
         if self._profiling_enabled and self._profiles:
-            stats = Stats(self._profiles[0])
-            for p in self._profiles[1:]:
-                stats.add(p)
-            stats.sort_stats('cumulative').print_stats()
+            # a worker that never got an item has an EMPTY profile, and
+            # pstats refuses to construct from one — merge only non-empty
+            stats = None
+            for p in self._profiles:
+                p.create_stats()
+                if not p.stats:
+                    continue
+                if stats is None:
+                    stats = Stats(p)
+                else:
+                    stats.add(p)
+            if stats is not None:
+                stats.sort_stats('cumulative').print_stats()
 
     @property
     def diagnostics(self):

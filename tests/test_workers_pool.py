@@ -230,6 +230,42 @@ def test_ventilator_reset_reshuffles_item_order():
     assert sweeps[0] != sweeps[1] and sweeps[1] != sweeps[2]
 
 
+def test_thread_pool_profiling_with_idle_workers(capsys):
+    # 4 workers, ONE item: at least three profiles are guaranteed empty —
+    # join() must merge the non-empty one instead of crashing in pstats
+    pool = ThreadPool(4, profiling_enabled=True)
+    pool.start(IdentityWorker)
+    pool.ventilate(7)
+    assert pool.get_results() == 7
+    pool.stop()
+    pool.join()
+    assert 'function calls' in capsys.readouterr().out
+
+
+def test_thread_pool_profiling_no_items_no_crash(capsys):
+    # all profiles empty: nothing to print, nothing to crash on
+    pool = ThreadPool(2, profiling_enabled=True)
+    pool.start(IdentityWorker)
+    pool.stop()
+    pool.join()
+    assert 'function calls' not in capsys.readouterr().out
+
+
+def test_thread_pool_profiling_prints_stats(capsys):
+    # opt-in per-worker cProfile merged and dumped at join
+    # (reference: thread_pool.py:48-49,190-198 / SURVEY §5.1)
+    pool = ThreadPool(2, profiling_enabled=True)
+    pool.start(IdentityWorker)
+    for i in range(10):
+        pool.ventilate(i)
+    got = [pool.get_results() for _ in range(10)]
+    pool.stop()
+    pool.join()
+    assert sorted(got) == list(range(10))
+    out = capsys.readouterr().out
+    assert 'cumulative' in out and 'function calls' in out
+
+
 class TestExecInNewProcess:
     """Spawn-not-fork helper (reference:
     ``workers_pool/exec_in_new_process.py:26-48``)."""
