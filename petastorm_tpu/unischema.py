@@ -19,9 +19,7 @@ but the implementation differs where TPU ingest wants it to:
   (reference: ``_NamedtupleCache``, ``unischema.py:88``).
 """
 
-import copy
 import re
-import sys
 from collections import OrderedDict, namedtuple
 from decimal import Decimal
 
