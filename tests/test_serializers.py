@@ -25,3 +25,44 @@ def test_arrow_table_roundtrip():
                       'y': pa.array(['a', 'b', 'c'])})
     out = s.deserialize(s.serialize(table))
     assert out.equals(table)
+
+
+def test_pickle_roundtrip_object_columns_and_nulls():
+    # ragged/object columns (variable-shape fields) must survive the
+    # process-pool boundary intact, Nones included
+    s = PickleSerializer()
+    ragged = np.empty(3, dtype=object)
+    ragged[0] = np.arange(4)
+    ragged[1] = None
+    ragged[2] = np.ones((2, 2))
+    batch = ColumnBatch({'r': ragged}, 3)
+    out = s.deserialize(s.serialize(batch))
+    np.testing.assert_array_equal(out.columns['r'][0], np.arange(4))
+    assert out.columns['r'][1] is None
+    np.testing.assert_array_equal(out.columns['r'][2], np.ones((2, 2)))
+
+
+def test_pickle_large_array_roundtrip():
+    s = PickleSerializer()
+    big = np.arange(1 << 16, dtype=np.uint8)
+    payload = s.serialize(ColumnBatch({'big': big}, len(big)))
+    out = s.deserialize(payload)
+    np.testing.assert_array_equal(out.columns['big'], big)
+
+
+def test_arrow_roundtrip_binary_and_nulls():
+    s = ArrowTableSerializer()
+    table = pa.table({
+        'blob': pa.array([b'\x00\xff' * 100, None, b''], pa.binary()),
+        'f': pa.array([1.5, None, 3.0], pa.float64()),
+    })
+    out = s.deserialize(s.serialize(table))
+    assert out.equals(table)
+
+
+def test_arrow_roundtrip_preserves_chunking_content():
+    s = ArrowTableSerializer()
+    chunked = pa.chunked_array([pa.array([1, 2]), pa.array([3, 4, 5])])
+    table = pa.table({'x': chunked})
+    out = s.deserialize(s.serialize(table))
+    assert out.column('x').to_pylist() == [1, 2, 3, 4, 5]
