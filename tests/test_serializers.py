@@ -42,14 +42,6 @@ def test_pickle_roundtrip_object_columns_and_nulls():
     np.testing.assert_array_equal(out.columns['r'][2], np.ones((2, 2)))
 
 
-def test_pickle_large_array_roundtrip():
-    s = PickleSerializer()
-    big = np.arange(1 << 16, dtype=np.uint8)
-    payload = s.serialize(ColumnBatch({'big': big}, len(big)))
-    out = s.deserialize(payload)
-    np.testing.assert_array_equal(out.columns['big'], big)
-
-
 def test_arrow_roundtrip_binary_and_nulls():
     s = ArrowTableSerializer()
     table = pa.table({
