@@ -127,6 +127,7 @@ class JaxLoader:
         self._exhausted = False
         self._drain_lock = threading.Lock()
         self._epoch = 0
+        self._produce_done = threading.Event()
 
     # -- sharding ------------------------------------------------------------
 
@@ -154,44 +155,55 @@ class JaxLoader:
             if self._stop_event.is_set():
                 raise RuntimeError('JaxLoader was stopped; construct a new '
                                    'loader to iterate again')
-            # Error check precedes the in-progress check: an error surfaced
-            # through the empty-queue path leaves _exhausted False, and
-            # "already being iterated" would be unactionable (thread is dead).
+            if not self._exhausted:
+                # The pass may have ended with its sentinel unobserved and
+                # still in flight — the NORMAL state right after consuming
+                # exactly to the boundary (iter_steps, or a drop-tail
+                # batch): the producer is only now unblocking to enqueue
+                # the sentinel. Wait for the pass state to settle: either a
+                # real batch lands (mid-pass → resume) or the producer
+                # finishes (_produce_done is set after the sentinel put, so
+                # observing it means the queue holds the complete tail).
+                # The lock keeps drain + put-back atomic w.r.t. a
+                # consumer's exhaustion check in __next__.
+                while True:
+                    with self._drain_lock:
+                        if (self._produce_done.is_set()
+                                or not self._stage_thread.is_alive()):
+                            pending = []
+                            try:
+                                while True:
+                                    pending.append(
+                                        self._out_queue.get_nowait())
+                            except queue.Empty:
+                                pass
+                            if pending == [_SENTINEL_END]:
+                                self._exhausted = True  # boundary: complete
+                            else:
+                                for item in pending:
+                                    self._out_queue.put_nowait(item)
+                            break
+                    if not self._out_queue.empty():
+                        # A just-put sentinel can precede its done-flag by
+                        # an instruction; give the flag a beat before
+                        # concluding these are real mid-pass batches.
+                        if not self._produce_done.wait(0.01):
+                            break  # real batches staged: resume below
+                        continue  # done after all: take the drain branch
+                    if self._stop_event.is_set():
+                        break
+                    self._produce_done.wait(0.05)
+                if not self._exhausted:
+                    # Same pass resumes: ``iter(it) is it`` per the iterator
+                    # protocol, so peek-then-loop (``next(loader)`` then
+                    # ``for b in loader``) and ``for b in iter(loader)``
+                    # both work. A staging error, if any, surfaces in
+                    # __next__ where every consumption style sees it
+                    # deterministically.
+                    return self
             if self._stage_error is not None:
                 raise RuntimeError('JaxLoader cannot restart after a staging '
                                    'error') from self._stage_error
-            if not self._exhausted:
-                # Either a pass is genuinely in progress, or it ended with
-                # the sentinel unobserved (iter_steps consuming exactly to
-                # the boundary). A finished stage thread joins immediately;
-                # an in-progress one is blocked producing and times out.
-                self._stage_thread.join(timeout=1)
-                if self._stage_thread.is_alive():
-                    raise RuntimeError('JaxLoader is already being iterated; '
-                                       'finish or stop() the current pass '
-                                       'first')
-                # The lock makes drain + put-back atomic w.r.t. a consumer's
-                # exhaustion check in __next__: without it, a concurrently
-                # blocked consumer could observe the momentarily empty queue
-                # and falsely exhaust, losing the batches we put back below.
-                with self._drain_lock:
-                    pending = []
-                    try:
-                        while True:
-                            pending.append(self._out_queue.get_nowait())
-                    except queue.Empty:
-                        pass
-                    if pending == [_SENTINEL_END]:
-                        self._exhausted = True  # boundary: pass is complete
-                    else:
-                        # real batches remain unconsumed — no concurrent
-                        # producer (thread is dead), so putting them back fits
-                        for item in pending:
-                            self._out_queue.put_nowait(item)
-                if not self._exhausted:
-                    raise RuntimeError('JaxLoader is already being iterated; '
-                                       'finish or stop() the current pass '
-                                       'first')
             # The consumer can observe the end sentinel a beat before the
             # stage thread finishes its teardown; it is exiting, so join
             # rather than misreading aliveness as an in-progress pass.
@@ -201,6 +213,9 @@ class JaxLoader:
             self._reader.reset()
             self._exhausted = False
             self._epoch += 1
+        # fresh event per pass: a predecessor thread in teardown may still
+        # set the previous pass's event after this point
+        self._produce_done = threading.Event()
         self._out_queue = queue.Queue(maxsize=self._prefetch)
         self._stage_thread = threading.Thread(target=self._stage_loop,
                                               daemon=True)
@@ -331,7 +346,13 @@ class JaxLoader:
         except Exception as e:  # noqa: BLE001 - surfaced to consumer
             self._stage_error = e
         finally:
+            # put happens-before set: once _produce_done is observable the
+            # sentinel is already in the queue (or the put gave up because
+            # stop() was requested, which __next__ handles separately).
+            # No lock here — holding _drain_lock across a blocking put
+            # deadlocks against __iter__'s probe when the queue is full.
             self._put_blocking(_SENTINEL_END)
+            self._produce_done.set()
 
     def _emit(self, host_batch):
         n = len(next(iter(host_batch.values())))

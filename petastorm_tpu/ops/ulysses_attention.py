@@ -1,0 +1,98 @@
+"""Ulysses-style all-to-all sequence parallelism for attention.
+
+The second of the framework's two long-context strategies (the other is
+:mod:`petastorm_tpu.ops.ring_attention`):
+
+* **Ring**: keep the sequence sharded, rotate KV blocks device-to-device
+  with ``ppermute``; communication is O(S/N) per step overlapping compute.
+* **Ulysses** (this module): two ``all_to_all`` collectives re-partition the
+  tensors from sequence-sharded to *head*-sharded, so every device computes
+  exact attention over the FULL sequence for its subset of heads, then a
+  second pair of ``all_to_all``s restores sequence sharding.
+
+Ulysses wins when heads are plentiful and the per-device sequence block is
+small (fewer collective launches, one big MXU-friendly attention per
+device); ring wins when ``n_heads < n_devices`` or HBM cannot hold the full
+S×S score block. Both are exact — the choice is a performance decision,
+so both are verified against the same oracle
+(:func:`petastorm_tpu.ops.ring_attention.reference_attention`).
+
+The reference framework has no model-side parallelism (SURVEY.md §2.2);
+this op belongs to the TPU-native consumer layer the reference delegates to
+Horovod-era trainers.
+"""
+
+import functools
+
+import jax.numpy as jnp
+from jax import lax
+
+from petastorm_tpu.ops.ring_attention import SEQ_AXIS
+
+
+def _ulysses_local(q, k, v, axis_name, causal, scale):
+    """Per-device body under shard_map.
+
+    Local inputs are (B, S/N, H, D). ``all_to_all`` splits the head axis N
+    ways and gathers the sequence axis, yielding (B, S, H/N, D); plain
+    attention runs on the full sequence; the inverse collective restores
+    (B, S/N, H, D).
+    """
+    # seq-sharded -> head-sharded: split heads (axis 2), concat seq (axis 1)
+    qh = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    kh = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    vh = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    scores = jnp.einsum('bqhd,bkhd->bhqk', qh, kh,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        s = qh.shape[1]
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    # f32 softmax statistics AND f32 probabilities through the PV product,
+    # exactly like ring_attention's online accumulator — the two strategies
+    # must be numerically interchangeable, not just oracle-close
+    probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
+    probs = probs / probs.sum(axis=-1, keepdims=True)
+    out = jnp.einsum('bhqk,bkhd->bqhd', probs, vh,
+                     preferred_element_type=jnp.float32).astype(q.dtype)
+
+    # head-sharded -> seq-sharded: split seq (axis 1), concat heads (axis 2)
+    return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
+
+
+def ulysses_attention(q, k, v, mesh, axis_name=SEQ_AXIS, causal=True,
+                      scale=None):
+    """Exact multi-head attention with the sequence axis sharded over
+    ``mesh[axis_name]``, computed head-parallel via all-to-all.
+
+    :param q, k, v: (B, S, H, D) arrays whose S axis is (or will be)
+        sharded over ``axis_name``. Requires ``H % mesh.shape[axis_name]
+        == 0`` (each device takes a head subset).
+    :param causal: apply a causal mask over global positions.
+    :param scale: score scale (default ``1/sqrt(D)``).
+    :return: (B, S, H, D) attention output, same sharding as ``q``.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    n = mesh.shape[axis_name]
+    if q.shape[2] % n:
+        raise ValueError(
+            'ulysses_attention needs n_heads %% n_devices == 0 (got %d heads '
+            'over %d devices on axis %r); use ring_attention instead'
+            % (q.shape[2], n, axis_name))
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    spec = P(None, axis_name, None, None)
+    body = functools.partial(_ulysses_local, axis_name=axis_name,
+                             causal=causal, scale=scale)
+    try:
+        from jax import shard_map
+        fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    except (ImportError, TypeError):  # older jax: experimental API
+        from jax.experimental.shard_map import shard_map as _shard_map
+        fn = _shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                        out_specs=spec, check_rep=False)
+    return fn(q, k, v)

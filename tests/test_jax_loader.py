@@ -131,12 +131,28 @@ def test_bad_divisibility_rejected(scalar_dataset):
                         fields=['^id$'])
 
 
-def test_concurrent_iteration_guard(scalar_dataset):
-    loader = make_jax_loader(scalar_dataset.url, batch_size=16, fields=['^id$'])
-    iter(loader)
-    with pytest.raises(RuntimeError, match='already being iterated'):
-        iter(loader)
+def test_mid_pass_iter_resumes_same_pass(scalar_dataset):
+    # iter() follows the iterator protocol: while a pass is in progress it
+    # returns self and resumes (it does NOT restart or raise), so
+    # peek-then-loop consumes each row exactly once
+    loader = make_jax_loader(scalar_dataset.url, batch_size=10,
+                             fields=['^id$'], last_batch='short')
+    assert iter(iter(loader)) is loader
+    first = np.asarray(next(loader)['id'])
+    rest = [np.asarray(b['id']) for b in loader]
+    ids = np.concatenate([first] + rest)
+    assert len(ids) == 100
+    assert len(set(ids.tolist())) == 100
     loader.stop()
+
+
+def test_for_loop_over_iter_result(scalar_dataset):
+    # regression: `for b in iter(loader)` must work (list/for call __iter__
+    # on the iterator object itself)
+    with make_jax_loader(scalar_dataset.url, batch_size=20, fields=['^id$'],
+                         last_batch='short') as loader:
+        batches = list(iter(loader))
+    assert sum(len(np.asarray(b['id'])) for b in batches) == 100
 
 
 def test_reiteration_replays_epochs(scalar_dataset):
@@ -196,6 +212,33 @@ def test_iter_steps_exact_epoch_boundary_replays(scalar_dataset):
                          num_epochs=1) as loader:
         assert len(list(loader.iter_steps(6))) == 6
         assert len(list(loader.iter_steps(6))) == 6
+
+
+def test_iter_while_producer_blocked_on_full_queue(scalar_dataset):
+    # regression (r2 review): with the queue full and the sentinel still
+    # unsent, the producer must never hold the drain lock across its
+    # blocking put — iter() would deadlock against the probe. Consume most
+    # of the pass, leave the producer wedged behind a full queue, then
+    # resume with a plain for-loop; guarded by a watchdog thread so a
+    # regression fails the test instead of hanging the suite.
+    import threading
+
+    result = {}
+
+    def run():
+        with make_jax_loader(scalar_dataset.url, batch_size=10,
+                             fields=['^id$'], last_batch='short',
+                             num_epochs=1, prefetch=2) as loader:
+            head = list(loader.iter_steps(8))
+            tail = list(loader)
+            result['rows'] = (sum(len(np.asarray(b['id'])) for b in head)
+                              + sum(len(np.asarray(b['id'])) for b in tail))
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(timeout=60)
+    assert not t.is_alive(), 'iter() deadlocked against the stage producer'
+    assert result['rows'] == 100
 
 
 def test_plain_iter_after_exact_boundary_iter_steps(scalar_dataset):

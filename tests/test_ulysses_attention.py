@@ -1,0 +1,119 @@
+"""Ulysses (all-to-all) sequence-parallel attention vs the shared oracle."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from petastorm_tpu.ops.ring_attention import (
+    reference_attention, ring_attention,
+)
+from petastorm_tpu.ops.ulysses_attention import ulysses_attention
+
+
+def _mesh(n):
+    return Mesh(np.asarray(jax.devices()[:n]), ('seq',))
+
+
+def _qkv(b=2, s=32, h=8, d=16, seed=0, dtype=jnp.float32):
+    rng = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rng.randn(b, s, h, d).astype(np.float32), dtype)
+    return mk(), mk(), mk()
+
+
+def _shard(mesh, *arrays):
+    spec = NamedSharding(mesh, P(None, 'seq', None, None))
+    return tuple(jax.device_put(x, spec) for x in arrays)
+
+
+@pytest.mark.parametrize('n_shards', [2, 4, 8])
+@pytest.mark.parametrize('causal', [True, False])
+def test_matches_reference(n_shards, causal):
+    mesh = _mesh(n_shards)
+    q, k, v = _qkv()
+    expected = reference_attention(q, k, v, causal=causal)
+    qs, ks, vs = _shard(mesh, q, k, v)
+    with mesh:
+        got = ulysses_attention(qs, ks, vs, mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize('dtype', [jnp.float32, jnp.bfloat16])
+def test_matches_ring_attention(dtype):
+    # the two sequence-parallel strategies must agree with each other, not
+    # just with the oracle: same math, different collectives — including in
+    # bf16, where both keep f32 softmax probs through the PV product
+    mesh = _mesh(4)
+    q, k, v = _qkv(seed=3, dtype=dtype)
+    qs, ks, vs = _shard(mesh, q, k, v)
+    with mesh:
+        ring = ring_attention(qs, ks, vs, mesh, causal=True)
+        uly = ulysses_attention(qs, ks, vs, mesh, causal=True)
+    tol = 2e-5 if dtype == jnp.float32 else 4e-3
+    np.testing.assert_allclose(np.asarray(uly, np.float32),
+                               np.asarray(ring, np.float32),
+                               atol=tol, rtol=tol)
+
+
+def test_output_stays_sequence_sharded():
+    mesh = _mesh(4)
+    q, k, v = _qkv()
+    qs, ks, vs = _shard(mesh, q, k, v)
+    with mesh:
+        got = ulysses_attention(qs, ks, vs, mesh)
+    assert got.sharding.spec == P(None, 'seq', None, None)
+    assert {sh.data.shape for sh in got.addressable_shards} == {(2, 8, 8, 16)}
+
+
+def test_rejects_indivisible_heads():
+    mesh = _mesh(4)
+    q, k, v = _qkv(h=6)  # 6 heads over 4 devices
+    with pytest.raises(ValueError, match='ring_attention instead'):
+        ulysses_attention(q, k, v, mesh)
+
+
+def test_bfloat16_inputs():
+    mesh = _mesh(4)
+    q, k, v = _qkv(dtype=jnp.bfloat16)
+    expected = reference_attention(q, k, v)
+    qs, ks, vs = _shard(mesh, q, k, v)
+    with mesh:
+        got = ulysses_attention(qs, ks, vs, mesh)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(expected, np.float32),
+                               atol=3e-2, rtol=3e-2)
+
+
+@pytest.mark.parametrize('causal', [True, False])
+def test_gradients_match_reference(causal):
+    mesh = _mesh(4)
+    q, k, v = _qkv(s=16)
+
+    def uly_loss(q, k, v):
+        return jnp.sum(ulysses_attention(q, k, v, mesh, causal=causal) ** 2)
+
+    def oracle_loss(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, causal=causal) ** 2)
+
+    qs, ks, vs = _shard(mesh, q, k, v)
+    with mesh:
+        uly_grads = jax.grad(uly_loss, argnums=(0, 1, 2))(qs, ks, vs)
+    oracle_grads = jax.grad(oracle_loss, argnums=(0, 1, 2))(q, k, v)
+    for got, want in zip(uly_grads, oracle_grads):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=3e-5, rtol=3e-5)
+
+
+def test_jit_compiles():
+    mesh = _mesh(8)
+    q, k, v = _qkv(s=64)
+    qs, ks, vs = _shard(mesh, q, k, v)
+    with mesh:
+        got = jax.jit(lambda a, b, c: ulysses_attention(a, b, c, mesh))(
+            qs, ks, vs)
+    assert got.shape == q.shape
+    assert np.isfinite(np.asarray(got)).all()
