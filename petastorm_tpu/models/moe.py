@@ -1,0 +1,154 @@
+"""Switch-style mixture-of-experts layer with expert parallelism.
+
+Expert parallelism (EP) the TPU-native way: expert weights carry a leading
+``(n_experts, ...)`` axis sharded over the mesh's ``'expert'`` axis, and
+token routing is expressed as dense one-hot dispatch/combine einsums (the
+GShard formulation). XLA then lowers the dispatch to the expert all-to-all
+on its own — no hand-written collective, static shapes throughout (the
+capacity bound makes routing jit-compatible: every expert processes exactly
+``capacity`` token slots, overflow tokens are dropped and pass through on
+the residual).
+
+Top-1 (Switch) routing with the standard auxiliary load-balancing loss
+``E * Σ_e f_e · p_e`` (fraction of tokens routed to e × mean router prob
+of e), which is minimized at uniform routing.
+
+The reference framework has no model layer at all (SURVEY.md §0: it is an
+input pipeline); this module is part of the consumer layer that turns the
+framework's batches into sharded training steps, alongside
+:mod:`petastorm_tpu.models.transformer`.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from petastorm_tpu.parallel.mesh import EXPERT_AXIS
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    d_model: int = 64
+    d_ff: int = 256
+    n_experts: int = 4
+    capacity_factor: float = 1.25
+    dtype: object = jnp.bfloat16
+
+
+def moe_param_specs(config, axis=EXPERT_AXIS):
+    """PartitionSpec per parameter: experts shard over ``axis``, the router
+    is replicated (every token scores every expert)."""
+    return {
+        'router': P(None, None),
+        'w_in': P(axis, None, None),
+        'w_out': P(axis, None, None),
+    }
+
+
+def init_moe_params(rng, config, mesh=None, axis=EXPERT_AXIS):
+    c = config
+    k_r, k_i, k_o = jax.random.split(rng, 3)
+    params = {
+        'router': (jax.random.normal(k_r, (c.d_model, c.n_experts),
+                                     jnp.float32) * c.d_model ** -0.5),
+        'w_in': (jax.random.normal(k_i, (c.n_experts, c.d_model, c.d_ff),
+                                   jnp.float32) * c.d_model ** -0.5),
+        'w_out': (jax.random.normal(k_o, (c.n_experts, c.d_ff, c.d_model),
+                                    jnp.float32) * c.d_ff ** -0.5),
+    }
+    if mesh is not None:
+        specs = moe_param_specs(c, axis=axis)
+        params = {name: jax.device_put(value,
+                                       NamedSharding(mesh, specs[name]))
+                  for name, value in params.items()}
+    return params
+
+
+def expert_capacity(n_tokens, n_experts, capacity_factor):
+    """Static per-expert token budget (ceil of the uniform share x factor)."""
+    return max(1, int(np.ceil(n_tokens / n_experts * capacity_factor)))
+
+
+def moe_forward(params, x, config, capacity=None):
+    """Apply the MoE layer.
+
+    :param x: (..., d_model) activations; leading axes are flattened into a
+        token axis for routing and restored on return.
+    :param capacity: per-expert token slots (default from
+        :func:`expert_capacity`). Must be static under jit.
+    :return: (y, aux_loss) — y shaped like ``x``; aux_loss the scalar f32
+        Switch load-balancing loss.
+    """
+    c = config
+    lead_shape = x.shape[:-1]
+    tokens = x.reshape(-1, c.d_model)
+    n_tokens = tokens.shape[0]
+    if capacity is None:
+        capacity = expert_capacity(n_tokens, c.n_experts, c.capacity_factor)
+
+    # --- routing (f32 throughout: router decisions must not flip in bf16)
+    logits = jnp.einsum('td,de->te', tokens.astype(jnp.float32),
+                        params['router'].astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)                     # (T, E)
+    expert_idx = jnp.argmax(probs, axis=-1)                     # (T,)
+    gate = jnp.take_along_axis(probs, expert_idx[:, None], axis=1)[:, 0]
+
+    onehot = jax.nn.one_hot(expert_idx, c.n_experts, dtype=jnp.float32)
+    # position of each token within its expert's queue (0-based)
+    position = jnp.cumsum(onehot, axis=0) * onehot - onehot     # (T, E)
+    position = position.sum(axis=-1).astype(jnp.int32)          # (T,)
+    keep = position < capacity
+    gate = gate * keep
+
+    # Switch aux loss: E * sum_e (fraction routed to e) * (mean prob of e).
+    # Computed BEFORE the capacity drop — it penalizes the router's intent.
+    fraction = onehot.mean(axis=0)
+    mean_prob = probs.mean(axis=0)
+    aux_loss = c.n_experts * jnp.sum(fraction * mean_prob)
+
+    # --- dense dispatch/combine (GShard): (T, E, C) one-hots
+    slot = jax.nn.one_hot(jnp.where(keep, position, capacity),
+                          capacity, dtype=jnp.float32)          # (T, C)
+    dispatch = onehot[:, :, None] * slot[:, None, :] * keep[:, None, None]
+    combine = dispatch * gate[:, None, None]
+
+    # --- expert compute: everything below carries the leading E axis, so
+    # sharding 'expert' on the params makes XLA place each expert's matmul
+    # on its own mesh slice and insert the dispatch all-to-all
+    dtype = c.dtype
+    expert_in = jnp.einsum('tec,td->ecd', dispatch.astype(dtype),
+                           tokens.astype(dtype),
+                           preferred_element_type=jnp.float32).astype(dtype)
+    h = jnp.einsum('ecd,edf->ecf', expert_in, params['w_in'].astype(dtype),
+                   preferred_element_type=jnp.float32)
+    h = jax.nn.gelu(h).astype(dtype)
+    expert_out = jnp.einsum('ecf,efd->ecd', h, params['w_out'].astype(dtype),
+                            preferred_element_type=jnp.float32)
+    y = jnp.einsum('tec,ecd->td', combine.astype(jnp.float32), expert_out,
+                   preferred_element_type=jnp.float32)
+    return y.reshape(lead_shape + (c.d_model,)).astype(x.dtype), aux_loss
+
+
+def dense_oracle(params, x, config):
+    """Unsharded, loop-based semantics oracle for tests: every token goes to
+    its argmax expert with NO capacity bound; gate-weighted expert MLP."""
+    c = config
+    lead_shape = x.shape[:-1]
+    tokens = np.asarray(x, np.float32).reshape(-1, c.d_model)
+    router = np.asarray(params['router'], np.float32)
+    w_in = np.asarray(params['w_in'], np.float32)
+    w_out = np.asarray(params['w_out'], np.float32)
+
+    logits = tokens @ router
+    e_x = np.exp(logits - logits.max(axis=-1, keepdims=True))
+    probs = e_x / e_x.sum(axis=-1, keepdims=True)
+    out = np.zeros_like(tokens)
+    for t in range(tokens.shape[0]):
+        e = int(np.argmax(probs[t]))
+        h = tokens[t] @ w_in[e]
+        h = np.asarray(jax.nn.gelu(jnp.asarray(h, jnp.float32)))
+        out[t] = probs[t, e] * (h @ w_out[e])
+    return out.reshape(lead_shape + (c.d_model,))

@@ -35,18 +35,40 @@ class TransformerConfig:
     d_ff: int = 256
     max_seq_len: int = 128
     dtype: object = jnp.bfloat16
+    # expert parallelism: n_experts > 0 swaps every block's dense FFN for a
+    # Switch-MoE layer (petastorm_tpu.models.moe) with experts sharded over
+    # ``expert_axis``; the Switch aux loss joins the train loss weighted by
+    # ``moe_aux_weight``.
+    n_experts: int = 0
+    capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
+    expert_axis: str = 'expert'
+
+    def moe_config(self):
+        from petastorm_tpu.models.moe import MoEConfig
+        return MoEConfig(d_model=self.d_model, d_ff=self.d_ff,
+                         n_experts=self.n_experts,
+                         capacity_factor=self.capacity_factor,
+                         dtype=self.dtype)
 
 
 def _param_specs(config):
-    """PartitionSpec per parameter (Megatron column/row split)."""
+    """PartitionSpec per parameter (Megatron column/row split; MoE blocks
+    shard experts over the config's expert axis instead of splitting the
+    FFN over 'model')."""
     block = {
         'qkv': P(None, MODEL_AXIS),
         'attn_out': P(MODEL_AXIS, None),
-        'mlp_in': P(None, MODEL_AXIS),
-        'mlp_out': P(MODEL_AXIS, None),
         'ln1': P(None),
         'ln2': P(None),
     }
+    if config.n_experts > 0:
+        from petastorm_tpu.models.moe import moe_param_specs
+        block['moe'] = moe_param_specs(config.moe_config(),
+                                       axis=config.expert_axis)
+    else:
+        block['mlp_in'] = P(None, MODEL_AXIS)
+        block['mlp_out'] = P(MODEL_AXIS, None)
     return {
         'embed': P(None, None),
         'pos_embed': P(None, None),
@@ -60,7 +82,8 @@ def init_transformer_params(rng, config, mesh=None):
     """Initialize parameters; with a mesh, each leaf is placed with its
     tensor-parallel sharding so no later reshard is needed."""
     c = config
-    keys = jax.random.split(rng, 3 + 4 * c.n_layers)
+    keys_per_layer = 3 if c.n_experts > 0 else 4
+    keys = jax.random.split(rng, 3 + keys_per_layer * c.n_layers)
     k = iter(range(len(keys)))
 
     def dense(key, shape, scale):
@@ -75,23 +98,41 @@ def init_transformer_params(rng, config, mesh=None):
         'lm_head': dense(next(k), (c.d_model, c.vocab_size), 0.02),
     }
     for _ in range(c.n_layers):
-        params['blocks'].append({
+        block = {
             'qkv': dense(next(k), (c.d_model, 3 * c.d_model),
                          c.d_model ** -0.5),
             'attn_out': dense(next(k), (c.d_model, c.d_model),
                               c.d_model ** -0.5),
-            'mlp_in': dense(next(k), (c.d_model, c.d_ff), c.d_model ** -0.5),
-            'mlp_out': dense(next(k), (c.d_ff, c.d_model), c.d_ff ** -0.5),
             'ln1': jnp.ones((c.d_model,), jnp.float32),
             'ln2': jnp.ones((c.d_model,), jnp.float32),
-        })
+        }
+        if c.n_experts > 0:
+            from petastorm_tpu.models.moe import init_moe_params
+            block['moe'] = init_moe_params(keys[next(k)], c.moe_config())
+        else:
+            block['mlp_in'] = dense(next(k), (c.d_model, c.d_ff),
+                                    c.d_model ** -0.5)
+            block['mlp_out'] = dense(next(k), (c.d_ff, c.d_model),
+                                     c.d_ff ** -0.5)
+        params['blocks'].append(block)
     if mesh is not None:
         specs = _param_specs(c)
         params = jax.tree_util.tree_map(
-            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+            lambda x, s: jax.device_put(
+                x, NamedSharding(mesh, _restrict_spec_to_mesh(s, mesh))),
             params, specs,
             is_leaf=lambda x: isinstance(x, (jnp.ndarray, np.ndarray)))
     return params
+
+
+def _restrict_spec_to_mesh(spec, mesh):
+    """Replicate over any spec axis the mesh does not have: the same model
+    runs dp×tp, dp×ep, or dp-only depending on which axes the mesh names
+    (e.g. on a ('data','expert') mesh the Megatron 'model' splits become
+    replication, and experts still shard)."""
+    from jax.sharding import PartitionSpec
+    return PartitionSpec(
+        *(axis if axis in mesh.axis_names else None for axis in spec))
 
 
 def _rmsnorm(x, gain):
@@ -123,10 +164,14 @@ def _attention(x, qkv_w, out_w, n_heads, dtype):
                       preferred_element_type=jnp.float32).astype(dtype)
 
 
-def transformer_forward(params, tokens, config):
-    """tokens (B, S) int32 → logits (B, S, V) f32."""
+def transformer_forward_with_aux(params, tokens, config):
+    """tokens (B, S) int32 → (logits (B, S, V) f32, scalar aux loss).
+
+    The aux term is the summed Switch load-balancing loss over MoE blocks
+    (0.0 for a dense model)."""
     c = config
     dtype = c.dtype
+    aux_total = jnp.zeros((), jnp.float32)
     x = params['embed'][tokens].astype(dtype)
     x = x + params['pos_embed'][:tokens.shape[1]].astype(dtype)
     x = _constrain(x)
@@ -135,15 +180,28 @@ def transformer_forward(params, tokens, config):
         x = x + _attention(h, block['qkv'], block['attn_out'], c.n_heads, dtype)
         x = _constrain(x)
         h = _rmsnorm(x, block['ln2'])
-        h = jnp.einsum('bsd,df->bsf', h, block['mlp_in'].astype(dtype),
-                       preferred_element_type=jnp.float32)
-        h = jax.nn.gelu(h.astype(jnp.float32)).astype(dtype)
-        x = x + jnp.einsum('bsf,fd->bsd', h, block['mlp_out'].astype(dtype),
-                           preferred_element_type=jnp.float32).astype(dtype)
+        if c.n_experts > 0:
+            from petastorm_tpu.models.moe import moe_forward
+            ffn_out, aux = moe_forward(block['moe'], h, c.moe_config())
+            aux_total = aux_total + aux
+            x = x + ffn_out.astype(dtype)
+        else:
+            h = jnp.einsum('bsd,df->bsf', h, block['mlp_in'].astype(dtype),
+                           preferred_element_type=jnp.float32)
+            h = jax.nn.gelu(h.astype(jnp.float32)).astype(dtype)
+            x = x + jnp.einsum('bsf,fd->bsd', h,
+                               block['mlp_out'].astype(dtype),
+                               preferred_element_type=jnp.float32).astype(dtype)
         x = _constrain(x)
     x = _rmsnorm(x, params['ln_f'])
-    return jnp.einsum('bsd,dv->bsv', x, params['lm_head'].astype(dtype),
-                      preferred_element_type=jnp.float32)
+    logits = jnp.einsum('bsd,dv->bsv', x, params['lm_head'].astype(dtype),
+                        preferred_element_type=jnp.float32)
+    return logits, aux_total
+
+
+def transformer_forward(params, tokens, config):
+    """tokens (B, S) int32 → logits (B, S, V) f32."""
+    return transformer_forward_with_aux(params, tokens, config)[0]
 
 
 # Mesh detection uses a private jax module; resolve it ONCE at import so an
@@ -173,12 +231,13 @@ def _constrain(x):
 
 
 def transformer_loss(params, tokens, config):
-    """Next-token cross-entropy over (B, S) int token batches."""
-    logits = transformer_forward(params, tokens[:, :-1], config)
+    """Next-token cross-entropy over (B, S) int token batches (+ weighted
+    Switch aux loss for MoE configs)."""
+    logits, aux = transformer_forward_with_aux(params, tokens[:, :-1], config)
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return -ll.mean()
+    return -ll.mean() + config.moe_aux_weight * aux
 
 
 def transformer_train_step(config, optimizer):

@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-SEQ_AXIS = 'seq'
+from petastorm_tpu.parallel.mesh import SEQ_AXIS  # canonical axis name
 
 
 def _online_block(carry, k_blk, v_blk, q, q_pos, kv_pos, causal, scale):

@@ -1,9 +1,13 @@
 """Device-mesh helpers: the framework's canonical mesh axes.
 
-Axes convention used across models, the JAX loader, and the graft entry:
+Axes convention used across models, ops, the JAX loader, and the graft
+entry:
 
-* ``'data'``  — batch (data-parallel) axis; the loader shards batches here.
-* ``'model'`` — tensor-parallel axis; models shard weights/heads here.
+* ``'data'``   — batch (data-parallel) axis; the loader shards batches here.
+* ``'model'``  — tensor-parallel axis; models shard weights/heads here.
+* ``'expert'`` — expert-parallel axis; MoE layers shard experts here.
+* ``'pipe'``   — pipeline-parallel axis; stages shard layer stacks here.
+* ``'seq'``    — sequence/context-parallel axis (ring / Ulysses attention).
 
 On a pod this is created once from all devices; in tests from the virtual
 8-device CPU platform.
@@ -13,6 +17,9 @@ import numpy as np
 
 DATA_AXIS = 'data'
 MODEL_AXIS = 'model'
+EXPERT_AXIS = 'expert'
+PIPE_AXIS = 'pipe'
+SEQ_AXIS = 'seq'
 
 
 def make_mesh(data=None, model=1, devices=None):
@@ -36,6 +43,43 @@ def make_mesh(data=None, model=1, devices=None):
                          % (data, model, n, len(devices)))
     grid = np.asarray(devices[:n]).reshape(data, model)
     return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+
+
+def make_named_mesh(axes, devices=None):
+    """A ``jax.sharding.Mesh`` with arbitrary named axes.
+
+    :param axes: ordered ``{axis_name: size}`` mapping (e.g.
+        ``{'data': 2, 'pipe': 2, 'model': 2}``). One axis may be ``None``
+        to absorb the remaining devices.
+    :param devices: explicit device list (default ``jax.devices()``).
+    """
+    import jax
+    from jax.sharding import Mesh
+    devices = list(devices if devices is not None else jax.devices())
+    names = list(axes)
+    sizes = list(axes.values())
+    wild = [i for i, s in enumerate(sizes) if s is None]
+    if len(wild) > 1:
+        raise ValueError('at most one axis size may be None; got %r' % (axes,))
+    fixed = 1
+    for s in sizes:
+        fixed *= (s if s is not None else 1)
+    if wild:
+        if len(devices) % fixed:
+            raise ValueError('device count %d not divisible by fixed axes '
+                             'product %d (%r)' % (len(devices), fixed, axes))
+        sizes[wild[0]] = len(devices) // fixed
+        fixed = len(devices)
+    if fixed != len(devices):
+        # never silently drop chips: a typo'd axis size halving the pod is
+        # far worse than this error; pass one axis as None to auto-fill, or
+        # slice the device list explicitly
+        raise ValueError('mesh %r covers %d devices but %d were provided; '
+                         'use a None axis size to absorb the remainder or '
+                         'pass an explicit devices= slice'
+                         % (axes, fixed, len(devices)))
+    grid = np.asarray(devices).reshape(sizes)
+    return Mesh(grid, tuple(names))
 
 
 def data_sharding(mesh, ndim=1):

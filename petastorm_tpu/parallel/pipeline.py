@@ -1,0 +1,137 @@
+"""Pipeline parallelism: GPipe-style microbatched stage execution.
+
+Layers are stacked on a leading ``(n_stages, ...)`` axis sharded over the
+mesh's ``'pipe'`` axis, so each device holds exactly one stage's weights.
+Execution runs ``n_microbatches + n_stages - 1`` ticks of a collective
+pipeline: every tick, each device applies its stage to the activation it
+holds, then rotates the result to the next stage with ``lax.ppermute``
+(compute overlaps the ICI hop). Stage 0 feeds fresh microbatches during
+the first ``n_microbatches`` ticks; the last stage emits finished
+microbatches from tick ``n_stages - 1`` on.
+
+The whole schedule is a ``lax.scan`` (single trace, reverse-differentiable:
+``jax.grad`` through the pipeline yields exactly the sequential model's
+gradients — ``ppermute``'s transpose is the reverse rotation), with static
+shapes throughout, so XLA sees one compact loop instead of an unrolled
+schedule.
+
+The reference framework has no model execution layer (SURVEY.md §0); this
+is part of the TPU-native consumer layer, alongside tensor parallelism in
+:mod:`petastorm_tpu.models.transformer`, expert parallelism in
+:mod:`petastorm_tpu.models.moe`, and sequence parallelism in
+:mod:`petastorm_tpu.ops.ring_attention` / ``ulysses_attention``.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from petastorm_tpu.parallel.mesh import PIPE_AXIS
+
+
+def shard_stage_params(stage_params, mesh, axis_name=PIPE_AXIS):
+    """Place a stacked-stage parameter pytree so each leaf's leading
+    (stage) axis is sharded over ``axis_name``: one stage per mesh slice."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def place(leaf):
+        spec = P(axis_name, *([None] * (jnp.ndim(leaf) - 1)))
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(place, stage_params)
+
+
+def _to_varying(x, axis_name):
+    """Mark a replicated value as device-varying over ``axis_name``.
+
+    Under ``check_vma=True`` this is what makes the pipeline's transpose
+    CORRECT for the input cotangent: ``pcast(to='varying')`` transposes to
+    a psum over the axis, so ``jax.grad`` w.r.t. the (replicated) batch
+    sums each stage's contribution exactly once. Without it (legacy
+    ``check_rep=False`` mode) input gradients through shard_map's
+    replicated in_specs are silently wrong.
+    """
+    if hasattr(lax, 'pcast'):
+        return lax.pcast(x, axis_name, to='varying')
+    return lax.pvary(x, (axis_name,))
+
+
+def _pipeline_local(stage_params, x, stage_fn, axis_name, n_stages,
+                    n_microbatches):
+    """Per-device body under shard_map: ``stage_params`` leaves have a
+    leading stage axis of local size 1; ``x`` is the full (replicated)
+    batch."""
+    stage = lax.axis_index(axis_name)
+    params_local = jax.tree_util.tree_map(lambda p: p[0], stage_params)
+
+    mb = x.shape[0] // n_microbatches
+    feed = x.reshape((n_microbatches, mb) + x.shape[1:])
+    # warmup/drain padding: ticks past the feed carry zeros into stage 0
+    pad = jnp.zeros((n_stages - 1,) + feed.shape[1:], x.dtype)
+    feed = _to_varying(jnp.concatenate([feed, pad], axis=0), axis_name)
+    perm = [(i, i + 1) for i in range(n_stages - 1)]
+
+    def tick(act, x_t):
+        x_in = jnp.where(stage == 0, x_t, act)
+        y = stage_fn(params_local, x_in)
+        emit = jnp.where(stage == n_stages - 1, y, jnp.zeros_like(y))
+        act_next = lax.ppermute(y, axis_name, perm) if perm else y
+        return act_next, emit
+
+    _, emits = lax.scan(tick, jnp.zeros_like(feed[0]), feed)
+    outs = emits[n_stages - 1:]                 # (M, mb, ...) on last stage
+    outs = lax.psum(outs, axis_name)            # replicate to every stage
+    return outs.reshape(x.shape)
+
+
+def pipeline_apply(stage_fn, stage_params, x, mesh, axis_name=PIPE_AXIS,
+                   n_microbatches=None):
+    """Apply ``n_stages`` sequential stages to ``x`` with the stage stack
+    sharded over ``mesh[axis_name]``.
+
+    :param stage_fn: ``(params_slice, microbatch) -> microbatch`` — one
+        stage's computation; output shape must equal input shape (the
+        activation rotates through homogeneous pipeline slots).
+    :param stage_params: pytree whose leaves carry a leading
+        ``n_stages`` axis (use :func:`shard_stage_params` to place it).
+    :param x: (batch, ...) input, replicated over the pipe axis.
+    :param n_microbatches: pipeline chunking (default ``n_stages``; more
+        microbatches → less bubble, smaller per-tick matmuls). Must divide
+        the batch.
+    :return: (batch, ...) output, replicated over the pipe axis — equal to
+        sequentially applying the stages.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    n_stages = mesh.shape[axis_name]
+    if n_microbatches is None:
+        n_microbatches = n_stages
+    if x.shape[0] % n_microbatches:
+        raise ValueError('batch %d not divisible into %d microbatches'
+                         % (x.shape[0], n_microbatches))
+
+    param_specs = jax.tree_util.tree_map(
+        lambda p: P(axis_name, *([None] * (jnp.ndim(p) - 1))), stage_params)
+    body = functools.partial(_pipeline_local, stage_fn=stage_fn,
+                             axis_name=axis_name, n_stages=n_stages,
+                             n_microbatches=n_microbatches)
+    # check_vma=True (replication tracked soundly) is REQUIRED here: the
+    # batch enters replicated, and only the varying-manual-axes machinery
+    # transposes that correctly (see _to_varying). No check_rep=False
+    # fallback — on a jax too old for it, wrong input gradients would be
+    # silent, which is strictly worse than an ImportError.
+    from jax import shard_map
+    fn = shard_map(body, mesh=mesh, in_specs=(param_specs, P()),
+                   out_specs=P(), check_vma=True)
+    return fn(stage_params, x)
+
+
+def reference_pipeline(stage_fn, stage_params, x):
+    """Sequential oracle: apply each stage in order on the full batch."""
+    n_stages = jax.tree_util.tree_leaves(stage_params)[0].shape[0]
+    for s in range(n_stages):
+        params_s = jax.tree_util.tree_map(lambda p: p[s], stage_params)
+        x = stage_fn(params_s, x)
+    return x
