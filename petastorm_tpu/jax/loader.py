@@ -22,6 +22,7 @@ Design notes (SURVEY.md §7.1/§7.2 step 4):
 import logging
 import queue
 import threading
+import time
 
 import numpy as np
 
@@ -162,10 +163,11 @@ class JaxLoader:
                 # batch): the producer is only now unblocking to enqueue
                 # the sentinel. Wait for the pass state to settle: either a
                 # real batch lands (mid-pass → resume) or the producer
-                # finishes (_produce_done is set after the sentinel put, so
-                # observing it means the queue holds the complete tail).
-                # The lock keeps drain + put-back atomic w.r.t. a
-                # consumer's exhaustion check in __next__.
+                # finishes. _produce_done is set BEFORE the sentinel put,
+                # so sentinel-visible implies done-visible: "queue
+                # non-empty while done is unset" deterministically means
+                # real batches. The lock keeps drain + put-back atomic
+                # w.r.t. a consumer's exhaustion check in __next__.
                 while True:
                     with self._drain_lock:
                         if (self._produce_done.is_set()
@@ -179,20 +181,31 @@ class JaxLoader:
                                 pass
                             if pending == [_SENTINEL_END]:
                                 self._exhausted = True  # boundary: complete
-                            else:
+                                break
+                            if pending:
+                                # unconsumed tail (possibly incl. a
+                                # trailing sentinel): resume consuming it
                                 for item in pending:
                                     self._out_queue.put_nowait(item)
-                            break
-                    if not self._out_queue.empty():
-                        # A just-put sentinel can precede its done-flag by
-                        # an instruction; give the flag a beat before
-                        # concluding these are real mid-pass batches.
-                        if not self._produce_done.wait(0.01):
-                            break  # real batches staged: resume below
-                        continue  # done after all: take the drain branch
+                                break
+                            if not self._stage_thread.is_alive():
+                                # dead without a sentinel (put gave up or
+                                # died): __next__ surfaces stop/error
+                                break
+                            # done set, sentinel put in flight: retry
+                        elif not self._out_queue.empty():
+                            # done was unset just above and sentinel puts
+                            # strictly follow the done flag, so re-check
+                            # before trusting the queue contents
+                            if not self._produce_done.is_set():
+                                break  # real batches staged: resume below
+                            continue  # take the drain branch next round
                     if self._stop_event.is_set():
                         break
-                    self._produce_done.wait(0.05)
+                    if self._produce_done.is_set():
+                        time.sleep(0.001)  # sentinel put in flight: yield
+                    else:
+                        self._produce_done.wait(0.05)
                 if not self._exhausted:
                     # Same pass resumes: ``iter(it) is it`` per the iterator
                     # protocol, so peek-then-loop (``next(loader)`` then
@@ -346,13 +359,15 @@ class JaxLoader:
         except Exception as e:  # noqa: BLE001 - surfaced to consumer
             self._stage_error = e
         finally:
-            # put happens-before set: once _produce_done is observable the
-            # sentinel is already in the queue (or the put gave up because
-            # stop() was requested, which __next__ handles separately).
-            # No lock here — holding _drain_lock across a blocking put
-            # deadlocks against __iter__'s probe when the queue is full.
-            self._put_blocking(_SENTINEL_END)
+            # set happens-before put: a sentinel can only be OBSERVED in
+            # the queue after _produce_done is visible, which is what lets
+            # __iter__'s probe distinguish "real mid-pass batches" from "a
+            # just-landed sentinel" deterministically (no timing
+            # heuristics). No lock here — holding _drain_lock across a
+            # blocking put deadlocks against __iter__'s probe when the
+            # queue is full.
             self._produce_done.set()
+            self._put_blocking(_SENTINEL_END)
 
     def _emit(self, host_batch):
         n = len(next(iter(host_batch.values())))

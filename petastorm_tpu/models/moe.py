@@ -97,9 +97,12 @@ def moe_forward(params, x, config, capacity=None):
     gate = jnp.take_along_axis(probs, expert_idx[:, None], axis=1)[:, 0]
 
     onehot = jax.nn.one_hot(expert_idx, c.n_experts, dtype=jnp.float32)
-    # position of each token within its expert's queue (0-based)
-    position = jnp.cumsum(onehot, axis=0) * onehot - onehot     # (T, E)
-    position = position.sum(axis=-1).astype(jnp.int32)          # (T,)
+    # position of each token within its expert's queue (0-based). Integer
+    # cumsum: an f32 running count loses exactness past 2^24 tokens per
+    # expert (pod-scale batches), silently merging capacity slots.
+    ionehot = onehot.astype(jnp.int32)
+    position = jnp.cumsum(ionehot, axis=0) * ionehot - ionehot  # (T, E)
+    position = position.sum(axis=-1)                            # (T,)
     keep = position < capacity
     gate = gate * keep
 

@@ -43,6 +43,12 @@ class TransformerConfig:
     capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
     expert_axis: str = 'expert'
+    # sequence/context parallelism: a mesh axis name (e.g. 'seq') shards
+    # the sequence dimension of every activation; attention then runs
+    # through ring_attention (exact, global causal mask) so no single chip
+    # ever holds the full sequence. Requires passing the mesh to
+    # transformer_train_step/forward.
+    seq_axis: str = None
 
     def moe_config(self):
         from petastorm_tpu.models.moe import MoEConfig
@@ -140,45 +146,67 @@ def _rmsnorm(x, gain):
     return (x.astype(jnp.float32) * jax.lax.rsqrt(var + 1e-6) * gain).astype(x.dtype)
 
 
-def _attention(x, qkv_w, out_w, n_heads, dtype):
+def _attention(x, qkv_w, out_w, n_heads, dtype, seq_axis=None, mesh=None):
     b, s, d = x.shape
     head_dim = d // n_heads
     qkv = jnp.einsum('bsd,de->bse', x, qkv_w.astype(dtype),
                      preferred_element_type=jnp.float32).astype(dtype)
     q, k_, v = jnp.split(qkv, 3, axis=-1)
 
-    def heads(t):
-        return t.reshape(b, s, n_heads, head_dim).transpose(0, 2, 1, 3)
+    if seq_axis is not None and mesh is not None:
+        # sequence parallel: attention is the ONLY cross-token op, so it is
+        # the only place the seq sharding needs special handling — ring
+        # attention applies the causal mask over GLOBAL positions while the
+        # S axis stays sharded over `seq_axis`
+        from petastorm_tpu.ops.ring_attention import ring_attention
+        batch_axis = DATA_AXIS if DATA_AXIS in mesh.axis_names else None
+        bshd = (b, s, n_heads, head_dim)
+        ctx = ring_attention(q.reshape(bshd), k_.reshape(bshd),
+                             v.reshape(bshd), mesh, axis_name=seq_axis,
+                             causal=True, batch_axis=batch_axis)
+        ctx = ctx.reshape(b, s, d)
+    else:
+        def heads(t):
+            return t.reshape(b, s, n_heads, head_dim).transpose(0, 2, 1, 3)
 
-    q, k_, v = heads(q), heads(k_), heads(v)
-    scores = jnp.einsum('bhqd,bhkd->bhqk', q, k_,
-                        preferred_element_type=jnp.float32)
-    scores = scores / np.sqrt(head_dim)
-    mask = jnp.tril(jnp.ones((s, s), bool))
-    scores = jnp.where(mask, scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
-    ctx = jnp.einsum('bhqk,bhkd->bhqd', probs, v,
-                     preferred_element_type=jnp.float32).astype(dtype)
-    ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, d)
+        q, k_, v = heads(q), heads(k_), heads(v)
+        scores = jnp.einsum('bhqd,bhkd->bhqk', q, k_,
+                            preferred_element_type=jnp.float32)
+        scores = scores / np.sqrt(head_dim)
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        scores = jnp.where(mask, scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
+        ctx = jnp.einsum('bhqk,bhkd->bhqd', probs, v,
+                         preferred_element_type=jnp.float32).astype(dtype)
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, d)
     return jnp.einsum('bsd,de->bse', ctx, out_w.astype(dtype),
                       preferred_element_type=jnp.float32).astype(dtype)
 
 
-def transformer_forward_with_aux(params, tokens, config):
+def transformer_forward_with_aux(params, tokens, config, mesh=None):
     """tokens (B, S) int32 → (logits (B, S, V) f32, scalar aux loss).
 
     The aux term is the summed Switch load-balancing loss over MoE blocks
-    (0.0 for a dense model)."""
+    (0.0 for a dense model). ``mesh`` is required for sequence-parallel
+    configs (``config.seq_axis``) so attention can run the ring collective;
+    other parallelism axes need no mesh argument (constraints find the
+    ambient mesh)."""
     c = config
     dtype = c.dtype
+    seq = c.seq_axis
+    if seq is not None and mesh is None:
+        raise ValueError('config.seq_axis=%r needs the mesh passed to the '
+                         'forward/train step (ring attention runs a '
+                         'collective over that axis)' % (seq,))
     aux_total = jnp.zeros((), jnp.float32)
     x = params['embed'][tokens].astype(dtype)
     x = x + params['pos_embed'][:tokens.shape[1]].astype(dtype)
-    x = _constrain(x)
+    x = _constrain(x, seq)
     for block in params['blocks']:
         h = _rmsnorm(x, block['ln1'])
-        x = x + _attention(h, block['qkv'], block['attn_out'], c.n_heads, dtype)
-        x = _constrain(x)
+        x = x + _attention(h, block['qkv'], block['attn_out'], c.n_heads,
+                           dtype, seq_axis=seq, mesh=mesh)
+        x = _constrain(x, seq)
         h = _rmsnorm(x, block['ln2'])
         if c.n_experts > 0:
             from petastorm_tpu.models.moe import moe_forward
@@ -192,16 +220,16 @@ def transformer_forward_with_aux(params, tokens, config):
             x = x + jnp.einsum('bsf,fd->bsd', h,
                                block['mlp_out'].astype(dtype),
                                preferred_element_type=jnp.float32).astype(dtype)
-        x = _constrain(x)
+        x = _constrain(x, seq)
     x = _rmsnorm(x, params['ln_f'])
     logits = jnp.einsum('bsd,dv->bsv', x, params['lm_head'].astype(dtype),
                         preferred_element_type=jnp.float32)
     return logits, aux_total
 
 
-def transformer_forward(params, tokens, config):
+def transformer_forward(params, tokens, config, mesh=None):
     """tokens (B, S) int32 → logits (B, S, V) f32."""
-    return transformer_forward_with_aux(params, tokens, config)[0]
+    return transformer_forward_with_aux(params, tokens, config, mesh=mesh)[0]
 
 
 # Mesh detection uses a private jax module; resolve it ONCE at import so an
@@ -213,42 +241,59 @@ except Exception:  # noqa: BLE001 - private API moved
     _thread_resources = None
 
 
-def _constrain(x):
-    """Keep activations data-parallel on the batch axis when running under a
-    mesh; outside a mesh context this is a no-op. The no-mesh case is
-    detected explicitly where possible — a real constraint failure must
+def _constrain(x, seq_axis=None):
+    """Keep activations data-parallel on the batch axis — and, for
+    sequence-parallel configs, sequence-sharded on dim 1 — when running
+    under a mesh; outside a mesh context this is a no-op. The no-mesh case
+    is detected explicitly where possible — a real constraint failure must
     surface, not silently drop the sharding."""
-    spec = P(DATA_AXIS, *([None] * (x.ndim - 1)))
+
+    def build_spec(available_axes):
+        dims = [DATA_AXIS if DATA_AXIS in available_axes else None]
+        if x.ndim > 1:
+            dims.append(seq_axis if seq_axis in available_axes else None)
+        dims.extend([None] * (x.ndim - len(dims)))
+        if all(d is None for d in dims):
+            return None
+        return P(*dims)
+
     if _thread_resources is not None:
         physical = _thread_resources.env.physical_mesh
-        if physical.empty or DATA_AXIS not in physical.axis_names:
+        if physical.empty:
+            return x
+        spec = build_spec(physical.axis_names)
+        if spec is None:
             return x
         return jax.lax.with_sharding_constraint(x, spec)
+    spec = build_spec((DATA_AXIS, seq_axis))
     try:
         return jax.lax.with_sharding_constraint(x, spec)
     except ValueError:  # no ambient mesh
         return x
 
 
-def transformer_loss(params, tokens, config):
+def transformer_loss(params, tokens, config, mesh=None):
     """Next-token cross-entropy over (B, S) int token batches (+ weighted
     Switch aux loss for MoE configs)."""
-    logits, aux = transformer_forward_with_aux(params, tokens[:, :-1], config)
+    logits, aux = transformer_forward_with_aux(params, tokens[:, :-1], config,
+                                               mesh=mesh)
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return -ll.mean() + config.moe_aux_weight * aux
 
 
-def transformer_train_step(config, optimizer):
-    """Jittable ``(params, opt_state, tokens) -> (params, opt_state, loss)``."""
+def transformer_train_step(config, optimizer, mesh=None):
+    """Jittable ``(params, opt_state, tokens) -> (params, opt_state, loss)``.
+
+    ``mesh`` is required for sequence-parallel configs (``seq_axis``)."""
 
     import optax
 
     @partial(jax.jit, static_argnums=())
     def step(params, opt_state, tokens):
         loss, grads = jax.value_and_grad(transformer_loss)(params, tokens,
-                                                           config)
+                                                           config, mesh)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 

@@ -79,7 +79,7 @@ def _ring_attention_local(q, k, v, axis_name, causal, scale):
 
 
 def ring_attention(q, k, v, mesh, axis_name=SEQ_AXIS, causal=True,
-                   scale=None):
+                   scale=None, batch_axis=None):
     """Exact multi-head attention with the sequence axis sharded over
     ``mesh[axis_name]``.
 
@@ -87,23 +87,22 @@ def ring_attention(q, k, v, mesh, axis_name=SEQ_AXIS, causal=True,
         over ``axis_name``; B/H/D are replicated on that axis.
     :param causal: apply a causal mask over GLOBAL positions.
     :param scale: score scale (default ``1/sqrt(D)``).
+    :param batch_axis: optional mesh axis the batch dim is sharded over
+        (combined data x seq meshes); keeps B sharded instead of gathered.
+        The ring only communicates over ``axis_name``, so batch sharding
+        is transparent to the algorithm.
     :return: (B, S, H, D) attention output, same sharding as ``q``.
     """
     from jax.sharding import PartitionSpec as P
 
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    spec = P(None, axis_name, None, None)
+    spec = P(batch_axis, axis_name, None, None)
     body = functools.partial(_ring_attention_local, axis_name=axis_name,
                              causal=causal, scale=scale)
-    try:
-        from jax import shard_map
-        fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
-                       out_specs=spec, check_vma=False)
-    except (ImportError, TypeError):  # older jax: experimental API
-        from jax.experimental.shard_map import shard_map as _shard_map
-        fn = _shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
-                        out_specs=spec, check_rep=False)
+    from petastorm_tpu.parallel.mesh import manual_shard_map
+    fn = manual_shard_map(body, mesh, in_specs=(spec, spec, spec),
+                          out_specs=spec)
     return fn(q, k, v)
 
 

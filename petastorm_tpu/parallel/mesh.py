@@ -82,6 +82,24 @@ def make_named_mesh(axes, devices=None):
     return Mesh(grid, tuple(names))
 
 
+def manual_shard_map(body, mesh, in_specs, out_specs,
+                     check_replication=False):
+    """Version-portable ``shard_map``: the modern ``jax.shard_map``
+    (``check_vma``) with fallback to the experimental API (``check_rep``).
+    The single home for this shim — ring/Ulysses attention build on it.
+    (The pipeline executor deliberately does NOT: it requires the sound
+    ``check_vma=True`` transpose and must fail loudly on an older jax.)
+    """
+    try:
+        from jax import shard_map
+        return shard_map(body, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=check_replication)
+    except (ImportError, TypeError):  # older jax: experimental API
+        from jax.experimental.shard_map import shard_map as _shard_map
+        return _shard_map(body, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=check_replication)
+
+
 def data_sharding(mesh, ndim=1):
     """NamedSharding that shards axis 0 over 'data', replicating the rest."""
     from jax.sharding import NamedSharding, PartitionSpec

@@ -127,6 +127,17 @@ class TestLmExample:
         loss = pretrain(url, batch_size=8, steps=6)
         assert np.isfinite(loss)
 
+    def test_long_context_seq_parallel_pretrain(self, tmp_path):
+        # the full long-context path: packed rows → data x seq mesh → ring
+        # attention inside the train step (tiny shapes for CI speed)
+        from examples.lm.long_context_example import pretrain_long_context
+        from examples.lm.pretrain_example import generate_c4_like
+        url = 'file://' + str(tmp_path / 'c4_long')
+        generate_c4_like(url, num_docs=128)
+        loss = pretrain_long_context(url, batch_size=4, steps=4, seq_len=64,
+                                     seq_shards=4)
+        assert np.isfinite(loss)
+
 
 class TestImagenetExamples:
     def test_generate_and_jax_read(self, tmp_path):

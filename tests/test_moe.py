@@ -62,11 +62,9 @@ def test_aux_loss_uniform_routing_is_one():
 def test_aux_loss_penalizes_collapse():
     # a router that sends everything to expert 0 maxes the loss toward E
     config, params, x = _setup()
-    biased = np.zeros(params['router'].shape, np.float32)
-    biased[:, 0] = 0.0
-    router = jnp.asarray(biased)
     # saturate prob on expert 0 via a large constant column
-    router = router.at[:, 0].set(10.0 / config.d_model)
+    router = jnp.zeros(params['router'].shape,
+                       jnp.float32).at[:, 0].set(10.0 / config.d_model)
     x_pos = jnp.abs(x) + 0.1  # positive activations: logits[:,0] >> others
     _, aux_collapsed = moe_forward(dict(params, router=router), x_pos, config)
     params_uniform = dict(params, router=jnp.zeros_like(params['router']))
