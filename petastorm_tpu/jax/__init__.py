@@ -2,3 +2,11 @@
 equivalent of the reference's tf/torch consumer layers)."""
 
 from petastorm_tpu.jax.loader import JaxLoader, MASK_FIELD, make_jax_loader  # noqa: F401
+
+
+def __getattr__(name):
+    # TrainCheckpointer imports orbax; keep that off the base import path
+    if name == 'TrainCheckpointer':
+        from petastorm_tpu.jax.checkpoint import TrainCheckpointer
+        return TrainCheckpointer
+    raise AttributeError(name)

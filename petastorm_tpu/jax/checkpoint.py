@@ -1,0 +1,143 @@
+"""Joint training + data-position checkpointing (orbax-backed).
+
+The reference has NO checkpoint/resume story for its readers — an epoch can
+only restart from scratch (SURVEY.md §5.4). This framework's readers carry
+row-group-granular iteration state (``state_dict``/``load_state_dict``);
+this module pairs that state with the model's train state in one atomic,
+step-indexed orbax checkpoint, so a preempted TPU pod resumes BOTH
+consistently:
+
+* the model resumes from the exact step,
+* the loader resumes from the same point in the same epoch with the same
+  shuffle seed (at-least-once row-group semantics — in-flight row-groups
+  are re-read, none are lost).
+
+Usage::
+
+    ckpt = TrainCheckpointer('/tmp/run1')
+    step = ckpt.restore_loader(loader)           # no-op on a fresh run
+    state = ckpt.restore_state(state_template)   # or template on fresh run
+    for batch in loader.iter_steps(...):
+        state, loss = train_step(state, batch)
+        step += 1
+        if step % 100 == 0:
+            ckpt.save(step, state, loader)
+
+On a multi-host pod every process must call ``save`` (orbax coordinates the
+write). Each host's reader holds a DIFFERENT row-group shard, so loader
+states are allgathered and stored keyed by process index — on restore every
+host picks its own entry (orbax's JSON handler alone would persist only the
+primary host's state, silently giving every host shard 0's position).
+"""
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+_STATE_KEY = 'train_state'
+_LOADER_KEY = 'loader_state'
+
+
+def _gather_per_process(state):
+    """``{str(process_index): state}`` with every host's entry present on
+    every host (JSON round-trip over a padded uint8 allgather)."""
+    import jax
+    if jax.process_count() == 1:
+        return {'0': state}
+    import json
+
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    payload = np.frombuffer(json.dumps(state).encode('utf-8'), np.uint8)
+    lengths = np.asarray(
+        multihost_utils.process_allgather(
+            np.asarray([payload.size], np.int64))).reshape(-1)
+    padded = np.zeros(int(lengths.max()), np.uint8)
+    padded[:payload.size] = payload
+    gathered = np.asarray(multihost_utils.process_allgather(padded))
+    return {str(idx): json.loads(bytes(gathered[idx][:int(lengths[idx])])
+                                 .decode('utf-8'))
+            for idx in range(len(lengths))}
+
+
+class TrainCheckpointer:
+    """Step-indexed checkpoints of (train-state pytree, loader position).
+
+    :param directory: checkpoint root (created if missing). Local paths or
+        any orbax-supported store (``gs://...``).
+    :param max_to_keep: retained checkpoints (older ones pruned).
+    """
+
+    def __init__(self, directory, max_to_keep=3):
+        import orbax.checkpoint as ocp
+        self._ocp = ocp
+        self._manager = ocp.CheckpointManager(
+            directory,
+            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep,
+                                                 create=True))
+
+    @property
+    def latest_step(self):
+        """Most recent checkpointed step, or None on a fresh run."""
+        return self._manager.latest_step()
+
+    def save(self, step, train_state, loader=None, force=False):
+        """Write one checkpoint: the train-state pytree plus (optionally)
+        the loader/reader's iteration state.
+
+        ``loader`` may be a JaxLoader, a Reader, or any object with
+        ``state_dict()`` — or None to checkpoint the model only.
+        """
+        ocp = self._ocp
+        composite = {_STATE_KEY: ocp.args.StandardSave(train_state)}
+        if loader is not None:
+            composite[_LOADER_KEY] = ocp.args.JsonSave(
+                _gather_per_process(loader.state_dict()))
+        saved = self._manager.save(step, args=ocp.args.Composite(**composite),
+                                   force=force)
+        self._manager.wait_until_finished()
+        return saved
+
+    def restore_state(self, train_state_template, step=None):
+        """The checkpointed train state (shapes/dtypes/shardings from the
+        template), or the template itself on a fresh run."""
+        step = self.latest_step if step is None else step
+        if step is None:
+            return train_state_template
+        ocp = self._ocp
+        restored = self._manager.restore(
+            step, args=ocp.args.Composite(**{
+                _STATE_KEY: ocp.args.StandardRestore(train_state_template)}))
+        return restored[_STATE_KEY]
+
+    def restore_loader(self, loader, step=None):
+        """Reposition ``loader`` to the checkpointed data position (must be
+        called before iteration starts). Returns the restored step, or 0 on
+        a fresh run (or when the checkpoint carried no loader state)."""
+        step = self.latest_step if step is None else step
+        if step is None:
+            return 0
+        ocp = self._ocp
+        import jax
+        try:
+            restored = self._manager.restore(
+                step, args=ocp.args.Composite(**{
+                    _LOADER_KEY: ocp.args.JsonRestore()}))
+            loader_state = restored[_LOADER_KEY][str(jax.process_index())]
+        except (KeyError, FileNotFoundError) as e:
+            logger.warning('checkpoint step %s has no loader state for this '
+                           'process (%s); data position starts fresh',
+                           step, e)
+            return step
+        loader.load_state_dict(loader_state)
+        return step
+
+    def close(self):
+        self._manager.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.close()

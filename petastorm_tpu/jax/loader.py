@@ -29,9 +29,14 @@ import numpy as np
 logger = logging.getLogger(__name__)
 
 _SENTINEL_END = object()
+_NO_ITEM = object()
 
 #: name of the validity-mask column added under ``last_batch='pad'``
 MASK_FIELD = 'valid_mask'
+# hidden per-row provenance column riding through the staging buffers; maps
+# each row back to the reader pull (row-group) it came from so checkpoints
+# can be delivery-accurate. Added after the reader, stripped before device.
+_PULL_FIELD = '__petastorm_tpu_pull__'
 
 
 def make_jax_loader(dataset_url_or_urls, batch_size, mesh=None, data_axes=None,
@@ -127,8 +132,25 @@ class JaxLoader:
         self._stage_error = None
         self._exhausted = False
         self._drain_lock = threading.Lock()
+        # items drained out of the queue by __iter__'s boundary probe that
+        # turned out to be real batches; __next__ serves these FIRST.
+        # Putting them BACK into the bounded queue instead would race the
+        # producer's possibly-still-blocked sentinel put (overflow, or the
+        # sentinel ordered ahead of real batches).
+        self._leftovers = []
         self._epoch = 0
         self._produce_done = threading.Event()
+        # delivery-accurate checkpoint provenance (see state_dict): the
+        # reader marks a row-group consumed when the STAGE thread pulls it,
+        # but rows can sit in the shuffling buffer / prefetch queue long
+        # after — so we track per-pull delivered-row counts and only count
+        # a row-group once every row reached the consumer.
+        self._prov_lock = threading.Lock()
+        self._pull_info = {}        # pull_id -> [epoch, item_index, n_rows]
+        self._pull_delivered = {}   # pull_id -> rows delivered to consumer
+        self._delivered_by_epoch = {}   # epoch -> {item_index, ...}
+        self._next_pull_id = 0
+        self._uses_provenance = hasattr(reader, 'next_batch_info')
 
     # -- sharding ------------------------------------------------------------
 
@@ -172,7 +194,8 @@ class JaxLoader:
                     with self._drain_lock:
                         if (self._produce_done.is_set()
                                 or not self._stage_thread.is_alive()):
-                            pending = []
+                            pending = list(self._leftovers)
+                            self._leftovers = []
                             try:
                                 while True:
                                     pending.append(
@@ -184,16 +207,16 @@ class JaxLoader:
                                 break
                             if pending:
                                 # unconsumed tail (possibly incl. a
-                                # trailing sentinel): resume consuming it
-                                for item in pending:
-                                    self._out_queue.put_nowait(item)
+                                # trailing sentinel): park it for __next__
+                                # to serve ahead of the queue
+                                self._leftovers = pending
                                 break
                             if not self._stage_thread.is_alive():
                                 # dead without a sentinel (put gave up or
                                 # died): __next__ surfaces stop/error
                                 break
                             # done set, sentinel put in flight: retry
-                        elif not self._out_queue.empty():
+                        elif self._leftovers or not self._out_queue.empty():
                             # done was unset just above and sentinel puts
                             # strictly follow the done flag, so re-check
                             # before trusting the queue contents
@@ -226,6 +249,14 @@ class JaxLoader:
             self._reader.reset()
             self._exhausted = False
             self._epoch += 1
+            # reset() restarts the reader's epoch numbering from 0; stale
+            # provenance would corrupt the delivery-accurate checkpoint
+            with self._prov_lock:
+                self._pull_info.clear()
+                self._pull_delivered.clear()
+                self._delivered_by_epoch = {}
+            with self._drain_lock:
+                self._leftovers = []  # exhausted implies empty; belt+braces
         # fresh event per pass: a predecessor thread in teardown may still
         # set the previous pass's event after this point
         self._produce_done = threading.Event()
@@ -241,32 +272,60 @@ class JaxLoader:
         if self._exhausted:
             raise StopIteration
         while True:
-            try:
-                item = self._out_queue.get(timeout=0.1)
-            except queue.Empty:
-                if self._stage_error is not None:
-                    raise self._stage_error
-                # stop() may race an in-flight iteration: _put_blocking gives
-                # up on delivering _SENTINEL_END once the stop event is set,
-                # so a consumer blocked here would otherwise spin forever.
-                # Same if next() is called after stop(), or the stage thread
-                # died without managing to enqueue the sentinel.
-                if self._stop_event.is_set():
-                    self._exhausted = True
-                    raise StopIteration
-                with self._drain_lock:
-                    if (self._stage_thread is not None
-                            and not self._stage_thread.is_alive()
-                            and self._out_queue.empty()):
+            with self._drain_lock:
+                item = (self._leftovers.pop(0) if self._leftovers
+                        else _NO_ITEM)
+            if item is _NO_ITEM:
+                try:
+                    item = self._out_queue.get(timeout=0.1)
+                except queue.Empty:
+                    if self._stage_error is not None:
+                        raise self._stage_error
+                    # stop() may race an in-flight iteration: _put_blocking
+                    # gives up on delivering _SENTINEL_END once the stop
+                    # event is set, so a consumer blocked here would
+                    # otherwise spin forever. Same if next() is called
+                    # after stop(), or the stage thread died without
+                    # managing to enqueue the sentinel.
+                    if self._stop_event.is_set():
                         self._exhausted = True
                         raise StopIteration
-                continue
+                    with self._drain_lock:
+                        if (self._stage_thread is not None
+                                and not self._stage_thread.is_alive()
+                                and not self._leftovers
+                                and self._out_queue.empty()):
+                            self._exhausted = True
+                            raise StopIteration
+                    continue
             if item is _SENTINEL_END:
                 self._exhausted = True
                 if self._stage_error is not None:
                     raise self._stage_error
                 raise StopIteration
-            return item
+            batch, pull_counts = item
+            if pull_counts:
+                self._record_delivery(pull_counts)
+            return batch
+
+    def _record_delivery(self, pull_counts):
+        """Credit delivered rows to their pulls; a pull whose every row has
+        reached the consumer marks its row-group delivered-for-checkpoint."""
+        with self._prov_lock:
+            for pull_id, n in pull_counts.items():
+                info = self._pull_info.get(pull_id)
+                if info is None:
+                    continue  # stale (pre-replay) sidecar
+                seen = self._pull_delivered.get(pull_id, 0) + n
+                if seen >= info[2]:
+                    epoch, item_index, _ = info
+                    if epoch is not None:
+                        self._delivered_by_epoch.setdefault(
+                            epoch, set()).add(item_index)
+                    del self._pull_info[pull_id]
+                    self._pull_delivered.pop(pull_id, None)
+                else:
+                    self._pull_delivered[pull_id] = seen
 
     def iter_steps(self, num_steps):
         """Yield exactly ``num_steps`` batches, continuing across calls.
@@ -339,12 +398,32 @@ class JaxLoader:
             capacity, min_after, self._batch_size,
             extra_capacity=extra, seed=seed)
 
+    def _pull_batches(self):
+        """Yield column dicts from the reader, tagging each row with its
+        pull id when the reader exposes provenance (next_batch_info)."""
+        if not self._uses_provenance:
+            for batch in self._reader:
+                yield dict(batch._asdict() if hasattr(batch, '_asdict')
+                           else batch)
+            return
+        while True:
+            try:
+                columns, item_index, epoch = self._reader.next_batch_info()
+            except StopIteration:
+                return
+            n = len(next(iter(columns.values()))) if columns else 0
+            with self._prov_lock:
+                pull_id = self._next_pull_id
+                self._next_pull_id += 1
+                self._pull_info[pull_id] = (epoch, item_index, n)
+            columns[_PULL_FIELD] = np.full(n, pull_id, np.int64)
+            yield columns
+
     def _stage_loop(self):
         try:
             buf = self._make_buffer()
-            for batch in self._reader:
-                columns = batch._asdict() if hasattr(batch, '_asdict') else batch
-                buf.add_many(dict(columns))
+            for columns in self._pull_batches():
+                buf.add_many(columns)
                 while buf.can_retrieve:
                     self._emit(buf.retrieve())
                     if self._stop_event.is_set():
@@ -370,17 +449,25 @@ class JaxLoader:
             self._put_blocking(_SENTINEL_END)
 
     def _emit(self, host_batch):
+        host_batch = dict(host_batch)
+        pull_col = host_batch.pop(_PULL_FIELD, None)
         n = len(next(iter(host_batch.values())))
         if n < self._batch_size:
             if self._last_batch == 'drop':
-                return
+                return  # dropped rows: their pulls stay incomplete (sound)
             if self._last_batch == 'pad':
                 host_batch = self._pad(host_batch, n)
             # 'short': ship as-is
         elif self._last_batch == 'pad':
-            host_batch = dict(host_batch)
             host_batch[MASK_FIELD] = np.ones(n, dtype=bool)
-        self._put_blocking(self._to_device(host_batch))
+        if pull_col is None:
+            pull_counts = None
+        else:
+            ids, counts = np.unique(np.asarray(pull_col), return_counts=True)
+            pull_counts = dict(zip(ids.tolist(), counts.tolist()))
+        # provenance rides the queue as a sidecar: rows count as delivered
+        # only when the consumer actually receives this item in __next__
+        self._put_blocking((self._to_device(host_batch), pull_counts))
 
     def _pad(self, host_batch, n):
         out = {}
@@ -432,12 +519,29 @@ class JaxLoader:
         return self._reader
 
     def state_dict(self):
-        """Checkpoint passthrough (row-group granular, at-least-once; see
-        :meth:`petastorm_tpu.reader.Reader.state_dict`)."""
+        """Row-group-granular, at-least-once checkpoint of the DATA
+        POSITION AS DELIVERED to the consumer.
+
+        Unlike the raw reader's ``state_dict`` (which marks a row-group
+        consumed when the staging thread pulls it), this counts a
+        row-group only once every one of its rows has left the shuffling
+        buffer and prefetch queue and reached ``__next__`` — rows still
+        in flight are re-read on resume, never skipped.
+        """
+        if self._uses_provenance:
+            with self._prov_lock:
+                delivered = {epoch: set(items) for epoch, items
+                             in self._delivered_by_epoch.items()}
+            return self._reader.resume_state_from(delivered)
         return self._reader.state_dict()
 
     def load_state_dict(self, state):
         self._reader.load_state_dict(state)
+        # mirror the reader: later checkpoints must account the restored
+        # position (earlier epochs complete, resume epoch partly consumed)
+        with self._prov_lock:
+            self._delivered_by_epoch = \
+                self._reader.consumption_record_for_resume(state)
 
     def stop(self):
         self._stop_event.set()

@@ -337,13 +337,8 @@ class Reader:
             raise RuntimeError('Trying to read a sample from a stopped reader')
         self._ensure_started()
         if self.batched_output:
-            try:
-                batch = self._pool.get_results()
-            except EmptyResultError:
-                self.last_row_consumed = True
-                raise StopIteration from None
-            self._mark_consumed(batch)
-            return self.schema.make_namedtuple(**batch.columns)
+            columns, _, _ = self.next_batch_info()
+            return self.schema.make_namedtuple(**columns)
         if self.ngram is not None:
             try:
                 # Workers publish wrapped {timestep: dict} windows (picklable
@@ -377,6 +372,35 @@ class Reader:
         item_index = getattr(batch, 'item_index', None)
         if item_index is not None and batch.epoch is not None:
             self._consumed_by_epoch.setdefault(batch.epoch, set()).add(item_index)
+
+    def next_batch_info(self):
+        """``(columns_dict, item_index, epoch)`` for one row-group batch.
+
+        The provenance-carrying flavor of ``__next__`` (batched readers
+        only): consumers that buffer rows downstream — the JaxLoader's
+        staging pipeline — need to know WHICH row-group each batch came
+        from so their checkpoints mark a row-group consumed only once all
+        its rows were actually delivered, not merely pulled into a buffer
+        (see :meth:`resume_state_from`). Raises StopIteration at the end
+        like ``__next__``.
+        """
+        if not self.batched_output:
+            raise TypeError('next_batch_info requires a batched reader')
+        if self._stopped:
+            raise RuntimeError('Trying to read a sample from a stopped reader')
+        self._ensure_started()
+        try:
+            batch = self._pool.get_results()
+        except EmptyResultError:
+            self.last_row_consumed = True
+            raise StopIteration from None
+        self._mark_consumed(batch)
+        # same projection make_namedtuple applies on the __next__ path
+        # (schema fields only) — otherwise transform side-products would
+        # leak into downstream staging
+        columns = {name: batch.columns[name] for name in self.schema.fields
+                   if name in batch.columns}
+        return columns, batch.item_index, batch.epoch
 
     def next(self):
         return self.__next__()
@@ -439,18 +463,32 @@ class Reader:
         in that epoch; row-groups in flight (or consumed in a *later* epoch
         due to pipelining across the epoch boundary) are re-read.
         """
+        return self.resume_state_from(self._consumed_by_epoch)
+
+    def resume_state_from(self, consumed_by_epoch):
+        """A ``state_dict``-shaped resume point built from an EXTERNAL
+        ``{epoch: {item_index, ...}}`` consumption record — used by
+        downstream buffering consumers (JaxLoader) whose notion of
+        "consumed" is delivery to the user, which lags this reader's."""
         vent_seed = self._ventilator.state_dict()['seed']
-        epochs_seen = sorted(self._consumed_by_epoch)
+        epochs_seen = sorted(consumed_by_epoch)
         if not epochs_seen:
             resume_epoch, consumed = 0, []
         else:
-            incomplete = [e for e in epochs_seen
-                          if len(self._consumed_by_epoch[e]) < self._num_items]
-            if incomplete:
-                resume_epoch = incomplete[0]
-                consumed = sorted(self._consumed_by_epoch[resume_epoch])
-            else:
+            # Walk epochs from 0 (NOT just the epochs present in the
+            # record): a delivery-order record can contain epoch 1 while
+            # epoch 0 still has undelivered row-groups in flight — an
+            # absent epoch is maximally incomplete, and skipping it would
+            # lose its rows on resume.
+            resume_epoch = None
+            for e in range(epochs_seen[-1] + 1):
+                if len(consumed_by_epoch.get(e, ())) < self._num_items:
+                    resume_epoch = e
+                    break
+            if resume_epoch is None:
                 resume_epoch, consumed = epochs_seen[-1] + 1, []
+            else:
+                consumed = sorted(consumed_by_epoch.get(resume_epoch, ()))
         if self._num_epochs is None:
             iterations_remaining = None
         else:
@@ -475,3 +513,22 @@ class Reader:
             'iterations_remaining': state['iterations_remaining'],
         })
         self._ventilator.exclude_from_next_epoch(state['consumed_items'])
+        # Seed the consumption record to match the restored position: a
+        # LATER checkpoint must see epochs before the resume epoch as
+        # complete and the resume epoch's pre-restore items as consumed —
+        # without this, a checkpoint taken after a restore rewinds to
+        # epoch 0 (those epochs would look "absent" to resume_state_from),
+        # and the resume epoch could never read complete (the excluded
+        # items are never re-delivered).
+        self._consumed_by_epoch = self.consumption_record_for_resume(state)
+
+    def consumption_record_for_resume(self, state):
+        """``{epoch: {item_index}}`` as of the restored position in
+        ``state``: every epoch before the resume epoch complete, the resume
+        epoch holding its already-consumed items. Shared with the
+        JaxLoader's delivery-accurate record, which must be seeded the same
+        way on restore."""
+        record = {e: set(range(self._num_items))
+                  for e in range(state['epoch'])}
+        record[state['epoch']] = set(state['consumed_items'])
+        return record

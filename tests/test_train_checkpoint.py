@@ -1,0 +1,181 @@
+"""TrainCheckpointer: joint train-state + loader-position resume."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from petastorm_tpu.jax import TrainCheckpointer, make_jax_loader
+
+
+def _state(seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        'params': {'w': jnp.asarray(rng.randn(4, 4).astype(np.float32)),
+                   'b': jnp.asarray(rng.randn(4).astype(np.float32))},
+        'step_count': jnp.asarray(7, jnp.int32),
+    }
+
+
+def test_fresh_run_returns_template_and_step_zero(tmp_path, scalar_dataset):
+    with TrainCheckpointer(str(tmp_path / 'ckpt')) as ckpt:
+        assert ckpt.latest_step is None
+        template = _state()
+        restored = ckpt.restore_state(template)
+        assert restored is template
+        with make_jax_loader(scalar_dataset.url, batch_size=16,
+                             fields=['^id$']) as loader:
+            assert ckpt.restore_loader(loader) == 0
+
+
+def test_train_state_round_trips(tmp_path):
+    want = _state(seed=3)
+    with TrainCheckpointer(str(tmp_path / 'ckpt')) as ckpt:
+        ckpt.save(5, want)
+        assert ckpt.latest_step == 5
+    with TrainCheckpointer(str(tmp_path / 'ckpt')) as ckpt:
+        got = ckpt.restore_state(jax.tree_util.tree_map(jnp.zeros_like, want))
+    for name in ('w', 'b'):
+        np.testing.assert_array_equal(np.asarray(got['params'][name]),
+                                      np.asarray(want['params'][name]))
+    assert int(got['step_count']) == 7
+
+
+def test_loader_resume_covers_remaining_rows(tmp_path, scalar_dataset):
+    # consume part of an epoch, checkpoint, resume in a NEW loader: the
+    # union of rows seen must cover the dataset (at-least-once semantics)
+    seen_before = []
+    with make_jax_loader(scalar_dataset.url, batch_size=10, fields=['^id$'],
+                         num_epochs=1, shuffle_row_groups=True,
+                         seed=11, last_batch='short') as loader:
+        it = iter(loader)
+        for _ in range(3):
+            seen_before.extend(np.asarray(next(it)['id']).tolist())
+        with TrainCheckpointer(str(tmp_path / 'ckpt')) as ckpt:
+            ckpt.save(3, _state(), loader)
+
+    seen_after = []
+    with make_jax_loader(scalar_dataset.url, batch_size=10, fields=['^id$'],
+                         num_epochs=1, shuffle_row_groups=True,
+                         seed=11, last_batch='short') as loader:
+        with TrainCheckpointer(str(tmp_path / 'ckpt')) as ckpt:
+            assert ckpt.restore_loader(loader) == 3
+        for batch in loader:
+            seen_after.extend(np.asarray(batch['id']).tolist())
+
+    assert set(seen_before) | set(seen_after) == set(range(100))
+    # the resumed pass must NOT re-read everything: fully-consumed
+    # row-groups are skipped
+    assert len(seen_after) < 100
+
+
+def test_loader_resume_with_shuffle_buffer(tmp_path, scalar_dataset):
+    # the shuffling buffer holds rows long after the reader pulled their
+    # row-group — the exact case the delivery-accurate provenance exists
+    # for: rows still buffered at checkpoint time must be re-read
+    seen_before = []
+    with make_jax_loader(scalar_dataset.url, batch_size=8, fields=['^id$'],
+                         num_epochs=1, shuffle_rows=True,
+                         shuffling_queue_capacity=48, seed=5,
+                         last_batch='short') as loader:
+        it = iter(loader)
+        for _ in range(4):
+            seen_before.extend(np.asarray(next(it)['id']).tolist())
+        with TrainCheckpointer(str(tmp_path / 'ckpt')) as ckpt:
+            ckpt.save(4, _state(), loader)
+
+    seen_after = []
+    with make_jax_loader(scalar_dataset.url, batch_size=8, fields=['^id$'],
+                         num_epochs=1, shuffle_rows=True,
+                         shuffling_queue_capacity=48, seed=5,
+                         last_batch='short') as loader:
+        with TrainCheckpointer(str(tmp_path / 'ckpt')) as ckpt:
+            ckpt.restore_loader(loader)
+        for batch in loader:
+            seen_after.extend(np.asarray(batch['id']).tolist())
+
+    assert set(seen_before) | set(seen_after) == set(range(100))
+
+
+def test_model_only_checkpoint_leaves_loader_fresh(tmp_path, scalar_dataset):
+    with TrainCheckpointer(str(tmp_path / 'ckpt')) as ckpt:
+        ckpt.save(2, _state())  # no loader
+    with make_jax_loader(scalar_dataset.url, batch_size=20, fields=['^id$'],
+                         num_epochs=1, last_batch='short') as loader:
+        with TrainCheckpointer(str(tmp_path / 'ckpt')) as ckpt:
+            assert ckpt.restore_loader(loader) == 2  # step, but fresh data
+        rows = sum(len(np.asarray(b['id'])) for b in loader)
+    assert rows == 100
+
+
+def test_resume_math_treats_absent_epoch_as_incomplete(scalar_dataset):
+    # delivery-order records can contain epoch 1 while epoch 0 still has
+    # undelivered row-groups (shuffle buffer pipelining across the epoch
+    # boundary); resume must restart at the ABSENT epoch 0, not skip to 1
+    from petastorm_tpu import make_batch_reader
+    with make_batch_reader(scalar_dataset.url, num_epochs=3) as reader:
+        all_items = set(range(reader._num_items))
+        state = reader.resume_state_from({1: set(all_items)})
+    assert state['epoch'] == 0
+    assert state['consumed_items'] == []
+    assert state['iterations_remaining'] == 3
+
+
+def test_checkpoint_after_restore_does_not_rewind(scalar_dataset):
+    # regression (r2 review): run into epoch 1, checkpoint, restore in a
+    # fresh loader, consume a little, checkpoint AGAIN — the second
+    # checkpoint must continue from the restored position, not rewind to
+    # epoch 0 (restored loaders have no delivery record for the epochs
+    # they skipped; the record is seeded from the restored state instead)
+    def fresh_loader():
+        return make_jax_loader(scalar_dataset.url, batch_size=10,
+                               fields=['^id$'], num_epochs=3,
+                               last_batch='short')
+
+    with fresh_loader() as loader:
+        it = iter(loader)
+        for _ in range(13):  # 100 rows/epoch: 130 rows = into epoch 1
+            next(it)
+        state1 = loader.state_dict()
+    assert state1['epoch'] == 1
+    assert state1['iterations_remaining'] == 2
+
+    with fresh_loader() as loader:
+        loader.load_state_dict(state1)
+        # checkpoint immediately after restore: identical position
+        state_same = loader.state_dict()
+        assert state_same['epoch'] == 1
+        assert sorted(state_same['consumed_items']) == \
+            sorted(state1['consumed_items'])
+        it = iter(loader)
+        rows = 0
+        while rows < 60:  # finish epoch 1's remainder, start epoch 2
+            rows += len(np.asarray(next(it)['id']))
+        state2 = loader.state_dict()
+    assert state2['epoch'] >= 1
+    # progress is monotone: same-or-later epoch, and within the same epoch
+    # at least as many row-groups consumed
+    assert (state2['epoch'], len(state2['consumed_items'])) >= \
+        (state1['epoch'], len(state1['consumed_items']))
+    assert state2['iterations_remaining'] <= 2
+
+
+def test_max_to_keep_prunes(tmp_path):
+    with TrainCheckpointer(str(tmp_path / 'ckpt'), max_to_keep=2) as ckpt:
+        for step in (1, 2, 3):
+            ckpt.save(step, _state())
+        assert ckpt.latest_step == 3
+        steps = set(ckpt._manager.all_steps())
+    assert steps == {2, 3}
+
+
+def test_restore_specific_step(tmp_path):
+    with TrainCheckpointer(str(tmp_path / 'ckpt'), max_to_keep=5) as ckpt:
+        a, b = _state(seed=1), _state(seed=2)
+        ckpt.save(1, a)
+        ckpt.save(2, b)
+        template = jax.tree_util.tree_map(jnp.zeros_like, a)
+        got = ckpt.restore_state(template, step=1)
+    np.testing.assert_array_equal(np.asarray(got['params']['w']),
+                                  np.asarray(a['params']['w']))
