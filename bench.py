@@ -121,11 +121,27 @@ def _measure_rows(url):
         return MEASURE_SAMPLES / (time.monotonic() - start)
 
 
-def _measure_batch(url, warmup_rows, measure_rows, bytes_per_row=0):
+def _measure_lm_tokens(tmp, seq_len=128, warmup_rows=64, measure_rows=2048):
+    """BASELINE config 5: variable-length token docs packed to fixed
+    ``seq_len`` rows on the decode workers — packed tokens/sec."""
+    from examples.lm.pretrain_example import (
+        generate_c4_like, packing_transform,
+    )
+
+    url = 'file://' + tmp + '/c4_like'
+    generate_c4_like(url, num_docs=2048)
+    rate, _ = _measure_batch(url, warmup_rows, measure_rows,
+                             transform_spec=packing_transform(seq_len))
+    return rate * seq_len
+
+
+def _measure_batch(url, warmup_rows, measure_rows, bytes_per_row=0,
+                   transform_spec=None):
     """Batched column reader: rows/sec (and decoded MB/s when sized)."""
     from petastorm_tpu.reader import make_batch_reader
     with make_batch_reader(url, reader_pool_type='thread',
-                           num_epochs=None, shuffle_row_groups=True) as reader:
+                           num_epochs=None, shuffle_row_groups=True,
+                           transform_spec=transform_spec) as reader:
         seen = 0
         while seen < warmup_rows:
             batch = next(reader)
@@ -292,6 +308,8 @@ def main():
 
         batch_rate, _ = _measure_batch(hello_url, 1000, 8000)
         extra['hello_world_batch_rows_per_sec'] = round(batch_rate, 1)
+
+        extra['lm_packed_tokens_per_sec'] = round(_measure_lm_tokens(tmp), 1)
 
         img_bytes = int(np.prod(IMAGENET_SHAPE))
         # best of 2: the shared box is noisy and this is the north-star rate

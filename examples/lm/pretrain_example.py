@@ -78,7 +78,12 @@ def packing_transform(seq_len=SEQ_LEN):
 
 
 def pretrain(dataset_url, batch_size=16, steps=20, learning_rate=1e-2,
-             model_axis=1, seq_len=SEQ_LEN):
+             model_axis=1, seq_len=SEQ_LEN, checkpoint_dir=None,
+             checkpoint_every=10):
+    """Train; with ``checkpoint_dir``, periodically checkpoint model AND
+    data position together (TrainCheckpointer) and resume from the latest
+    checkpoint on restart — rows in flight at save time are re-read, rows
+    already trained on are not repeated (at-least-once row-groups)."""
     import jax
     import optax
 
@@ -92,20 +97,58 @@ def pretrain(dataset_url, batch_size=16, steps=20, learning_rate=1e-2,
     config = TransformerConfig(max_seq_len=seq_len)
     params = init_transformer_params(jax.random.PRNGKey(0), config, mesh=mesh)
     optimizer = optax.adam(learning_rate)
-    opt_state = optimizer.init(params)
+    # Align every optimizer-state leaf with the mesh's device set:
+    # params-shaped leaves (adam mu/nu) inherit the params sharding through
+    # init, but independent scalars (step count) land on one device — and a
+    # checkpoint restore commits arrays exactly per this template, where a
+    # mixed device set would make the train step reject its arguments.
+    from jax.sharding import NamedSharding, PartitionSpec
+    mesh_devices = set(mesh.devices.flat)
+
+    def on_mesh(x):
+        if (hasattr(x, 'sharding')
+                and set(x.sharding.device_set) != mesh_devices):
+            return jax.device_put(
+                x, NamedSharding(mesh, PartitionSpec(*([None] * x.ndim))))
+        return x
+
+    opt_state = jax.tree_util.tree_map(on_mesh, optimizer.init(params))
     step = transformer_train_step(config, optimizer)
 
+    ckpt = None
+    start_step = 0
+    if checkpoint_dir is not None:
+        from petastorm_tpu.jax import TrainCheckpointer
+        ckpt = TrainCheckpointer(checkpoint_dir)
+
     loss = None
-    with make_jax_loader(dataset_url, batch_size=batch_size, mesh=mesh,
-                         data_axes=('data',),
-                         transform_spec=packing_transform(seq_len),
-                         num_epochs=None, shuffle_row_groups=True) as loader:
-        with mesh:
-            for i, batch in enumerate(loader.iter_steps(steps)):
-                params, opt_state, loss = step(params, opt_state,
-                                               batch['tokens'])
-                if i % 5 == 0:
-                    print('step %d loss %.4f' % (i, float(loss)))
+    try:
+        with make_jax_loader(dataset_url, batch_size=batch_size, mesh=mesh,
+                             data_axes=('data',),
+                             transform_spec=packing_transform(seq_len),
+                             num_epochs=None,
+                             shuffle_row_groups=True) as loader:
+            if ckpt is not None:
+                start_step = ckpt.restore_loader(loader)
+                params, opt_state = ckpt.restore_state((params, opt_state))
+                if start_step:
+                    print('resumed from checkpoint step %d' % start_step)
+                if start_step >= steps:
+                    print('checkpoint already at step %d >= requested %d '
+                          'steps; nothing to train' % (start_step, steps))
+                    return None
+            with mesh:
+                for i, batch in enumerate(
+                        loader.iter_steps(steps - start_step), start_step):
+                    params, opt_state, loss = step(params, opt_state,
+                                                   batch['tokens'])
+                    if i % 5 == 0:
+                        print('step %d loss %.4f' % (i, float(loss)))
+                    if ckpt is not None and (i + 1) % checkpoint_every == 0:
+                        ckpt.save(i + 1, (params, opt_state), loader)
+    finally:
+        if ckpt is not None:
+            ckpt.close()
     return float(loss)
 
 
@@ -115,7 +158,11 @@ if __name__ == '__main__':
     parser.add_argument('--generate', action='store_true')
     parser.add_argument('--steps', type=int, default=20)
     parser.add_argument('--batch-size', type=int, default=16)
+    parser.add_argument('--checkpoint-dir', default=None,
+                        help='joint model+data checkpoints; rerun the same '
+                             'command to resume after an interruption')
     args = parser.parse_args()
     if args.generate:
         generate_c4_like(args.dataset_url)
-    pretrain(args.dataset_url, batch_size=args.batch_size, steps=args.steps)
+    pretrain(args.dataset_url, batch_size=args.batch_size, steps=args.steps,
+             checkpoint_dir=args.checkpoint_dir)

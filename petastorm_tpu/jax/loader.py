@@ -44,6 +44,7 @@ def make_jax_loader(dataset_url_or_urls, batch_size, mesh=None, data_axes=None,
                     shuffling_queue_capacity=None, min_after_retrieve=None,
                     extra_capacity=None, seed=0, last_batch='drop',
                     dtypes=None, prefetch=2, num_epochs=1,
+                    inmemory_cache_all=False,
                     reader_factory=None, **reader_kwargs):
     """Create a :class:`JaxLoader` over a Parquet dataset.
 
@@ -63,6 +64,9 @@ def make_jax_loader(dataset_url_or_urls, batch_size, mesh=None, data_axes=None,
     :param dtypes: optional ``{field: numpy dtype}`` cast applied on host
         before staging (e.g. ``{'image': jnp.bfloat16}``).
     :param prefetch: number of device batches staged ahead of the consumer.
+    :param inmemory_cache_all: decode once, replay epochs from device
+        memory (see :class:`InMemoryCachedLoader`); requires
+        ``num_epochs=1`` — re-iterate for more epochs.
     :param reader_factory: reader constructor (defaults to
         :func:`petastorm_tpu.reader.make_batch_reader`).
     :param reader_kwargs: forwarded to the reader factory (predicates,
@@ -84,19 +88,29 @@ def make_jax_loader(dataset_url_or_urls, batch_size, mesh=None, data_axes=None,
     """
     from petastorm_tpu.reader import make_batch_reader
     factory = reader_factory or make_batch_reader
+    if inmemory_cache_all and num_epochs not in (1, None):
+        raise ValueError(
+            'inmemory_cache_all caches exactly one epoch and replays it; '
+            'pass num_epochs=1 (the default) and re-iterate the loader for '
+            'more epochs (got num_epochs=%r)' % (num_epochs,))
     reader = factory(dataset_url_or_urls, schema_fields=fields,
-                     num_epochs=num_epochs, **reader_kwargs)
+                     num_epochs=1 if inmemory_cache_all else num_epochs,
+                     **reader_kwargs)
     try:
-        return JaxLoader(reader, batch_size, mesh=mesh, data_axes=data_axes,
-                         shuffle_rows=shuffle_rows,
-                         shuffling_queue_capacity=shuffling_queue_capacity,
-                         min_after_retrieve=min_after_retrieve,
-                         extra_capacity=extra_capacity, seed=seed,
-                         last_batch=last_batch, dtypes=dtypes, prefetch=prefetch)
+        loader = JaxLoader(reader, batch_size, mesh=mesh, data_axes=data_axes,
+                           shuffle_rows=shuffle_rows,
+                           shuffling_queue_capacity=shuffling_queue_capacity,
+                           min_after_retrieve=min_after_retrieve,
+                           extra_capacity=extra_capacity, seed=seed,
+                           last_batch=last_batch, dtypes=dtypes,
+                           prefetch=prefetch)
     except Exception:
         reader.stop()
         reader.join()
         raise
+    if inmemory_cache_all:
+        return InMemoryCachedLoader(loader, seed=seed)
+    return loader
 
 
 class JaxLoader:
@@ -518,6 +532,15 @@ class JaxLoader:
     def reader(self):
         return self._reader
 
+    @property
+    def epoch(self):
+        """Number of completed replay passes (0 during the first pass)."""
+        return self._epoch
+
+    @property
+    def diagnostics(self):
+        return self._reader.diagnostics
+
     def state_dict(self):
         """Row-group-granular, at-least-once checkpoint of the DATA
         POSITION AS DELIVERED to the consumer.
@@ -552,6 +575,126 @@ class JaxLoader:
         if self._stage_thread is not None:
             self._stage_thread.join(timeout=10)
         self._reader.join()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.stop()
+
+
+class InMemoryCachedLoader:
+    """Epoch replay from device memory: decode once, train many epochs.
+
+    Wraps a single-epoch :class:`JaxLoader`. The first pass streams
+    normally while retaining every delivered device batch; subsequent
+    passes serve those arrays directly — no Parquet read, no codec decode,
+    no host→device transfer — in a per-epoch reshuffled batch order. The
+    flagship-loader counterpart of the torch bridge's
+    ``BatchedDataLoader(inmemory_cache_all=True)`` (reference:
+    ``petastorm/pytorch.py:344-407``), with the cache living in HBM as
+    ``jax.Array``s instead of torch tensors.
+
+    Fit check is the caller's job: the whole epoch must fit in device (or
+    host, for CPU-backed arrays) memory. Iteration state checkpointing is
+    unsupported — replay epochs have no reader position (resume by
+    replaying the cached epoch from its start).
+    """
+
+    def __init__(self, loader, seed=0):
+        self._loader = loader
+        self._seed = seed
+        self._cache = []
+        self._cache_epoch = None
+        self._complete = False
+        self._stopped = False
+        self._replay_epoch = 0
+
+    # -- iteration -----------------------------------------------------------
+
+    def __iter__(self):
+        if self._stopped:
+            raise RuntimeError('InMemoryCachedLoader was stopped (its cache '
+                               'is released); construct a new loader to '
+                               'iterate again')
+        if not self._complete:
+            return self._first_pass()
+        return self._replay()
+
+    def _first_pass(self):
+        it = iter(self._loader)
+        if self._loader.epoch != self._cache_epoch:
+            # the underlying loader began a NEW pass (e.g. the previous
+            # first-pass generator was abandoned exactly at the epoch
+            # boundary, so its epilogue never ran): batches cached from the
+            # stale pass would otherwise duplicate every row
+            self._cache = []
+            self._cache_epoch = self._loader.epoch
+        for batch in it:
+            self._cache.append(batch)
+            yield batch
+        self._complete = True
+
+    def _replay(self):
+        self._replay_epoch += 1
+        order = np.arange(len(self._cache))
+        rng = np.random.RandomState(
+            None if self._seed is None
+            else (self._seed + self._replay_epoch) % (2 ** 32))
+        rng.shuffle(order)
+        for i in order:
+            yield self._cache[i]
+
+    def iter_steps(self, num_steps):
+        """Exactly ``num_steps`` batches, continuing across calls and epoch
+        boundaries (see :meth:`JaxLoader.iter_steps`)."""
+        it = getattr(self, '_steps_iter', None)
+        for _ in range(num_steps):
+            while True:
+                if it is None:
+                    it = iter(self)
+                try:
+                    yield next(it)
+                    break
+                except StopIteration:
+                    if not self._cache:
+                        raise RuntimeError(
+                            'inmemory_cache_all loader produced no batches; '
+                            'the dataset is empty (or every batch was '
+                            "dropped by last_batch='drop')") from None
+                    it = None
+        self._steps_iter = it
+
+    # -- passthrough ---------------------------------------------------------
+
+    @property
+    def schema(self):
+        return self._loader.schema
+
+    @property
+    def reader(self):
+        return self._loader.reader
+
+    @property
+    def diagnostics(self):
+        return self._loader.reader.diagnostics
+
+    def state_dict(self):
+        raise RuntimeError(
+            'inmemory_cache_all loaders have no checkpointable reader '
+            'position (replay epochs never touch the reader); checkpoint '
+            'the train state alone and replay the cached epoch on resume')
+
+    def load_state_dict(self, state):
+        raise RuntimeError(
+            'inmemory_cache_all loaders have no checkpointable reader '
+            'position to restore; replay the cached epoch from its start '
+            'instead')
+
+    def stop(self):
+        self._stopped = True
+        self._loader.stop()
+        self._cache = []
 
     def __enter__(self):
         return self

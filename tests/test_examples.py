@@ -127,6 +127,25 @@ class TestLmExample:
         loss = pretrain(url, batch_size=8, steps=6)
         assert np.isfinite(loss)
 
+    def test_pretrain_checkpoint_resume(self, tmp_path):
+        # interrupt after 8 of 12 steps, rerun: training resumes from the
+        # checkpoint (model + data position together), ending with 12 total
+        from examples.lm.pretrain_example import generate_c4_like, pretrain
+        url = 'file://' + str(tmp_path / 'c4')
+        ckpt_dir = str(tmp_path / 'ckpt')
+        generate_c4_like(url, num_docs=128)
+        pretrain(url, batch_size=8, steps=8, checkpoint_dir=ckpt_dir,
+                 checkpoint_every=4)
+        loss = pretrain(url, batch_size=8, steps=12, checkpoint_dir=ckpt_dir,
+                        checkpoint_every=4)
+        assert np.isfinite(loss)
+        from petastorm_tpu.jax import TrainCheckpointer
+        with TrainCheckpointer(ckpt_dir) as ckpt:
+            assert ckpt.latest_step == 12
+        # rerunning an already-complete run is a no-op, not a crash
+        assert pretrain(url, batch_size=8, steps=12,
+                        checkpoint_dir=ckpt_dir) is None
+
     def test_long_context_seq_parallel_pretrain(self, tmp_path):
         # the full long-context path: packed rows → data x seq mesh → ring
         # attention inside the train step (tiny shapes for CI speed)
