@@ -183,6 +183,33 @@ def _attention(x, qkv_w, out_w, n_heads, dtype, seq_axis=None, mesh=None):
                       preferred_element_type=jnp.float32).astype(dtype)
 
 
+def _block_attention_half(block, x, config, mesh=None):
+    """Pre-norm attention sublayer with residual + sharding constraint."""
+    h = _rmsnorm(x, block['ln1'])
+    x = x + _attention(h, block['qkv'], block['attn_out'], config.n_heads,
+                       config.dtype, seq_axis=config.seq_axis, mesh=mesh)
+    return _constrain(x, config.seq_axis)
+
+
+def _block_dense_ffn_half(block, x, config):
+    """Pre-norm dense-FFN sublayer with residual + sharding constraint."""
+    dtype = config.dtype
+    h = _rmsnorm(x, block['ln2'])
+    h = jnp.einsum('bsd,df->bsf', h, block['mlp_in'].astype(dtype),
+                   preferred_element_type=jnp.float32)
+    h = jax.nn.gelu(h.astype(jnp.float32)).astype(dtype)
+    x = x + jnp.einsum('bsf,fd->bsd', h, block['mlp_out'].astype(dtype),
+                       preferred_element_type=jnp.float32).astype(dtype)
+    return _constrain(x, config.seq_axis)
+
+
+def _block_forward(block, x, config, mesh=None):
+    """One dense transformer block — shared by the layered forward and the
+    pipeline stage executor."""
+    x = _block_attention_half(block, x, config, mesh=mesh)
+    return _block_dense_ffn_half(block, x, config)
+
+
 def transformer_forward_with_aux(params, tokens, config, mesh=None):
     """tokens (B, S) int32 → (logits (B, S, V) f32, scalar aux loss).
 
@@ -203,24 +230,15 @@ def transformer_forward_with_aux(params, tokens, config, mesh=None):
     x = x + params['pos_embed'][:tokens.shape[1]].astype(dtype)
     x = _constrain(x, seq)
     for block in params['blocks']:
-        h = _rmsnorm(x, block['ln1'])
-        x = x + _attention(h, block['qkv'], block['attn_out'], c.n_heads,
-                           dtype, seq_axis=seq, mesh=mesh)
-        x = _constrain(x, seq)
-        h = _rmsnorm(x, block['ln2'])
         if c.n_experts > 0:
+            x = _block_attention_half(block, x, c, mesh=mesh)
+            h = _rmsnorm(x, block['ln2'])
             from petastorm_tpu.models.moe import moe_forward
             ffn_out, aux = moe_forward(block['moe'], h, c.moe_config())
             aux_total = aux_total + aux
-            x = x + ffn_out.astype(dtype)
+            x = _constrain(x + ffn_out.astype(dtype), seq)
         else:
-            h = jnp.einsum('bsd,df->bsf', h, block['mlp_in'].astype(dtype),
-                           preferred_element_type=jnp.float32)
-            h = jax.nn.gelu(h.astype(jnp.float32)).astype(dtype)
-            x = x + jnp.einsum('bsf,fd->bsd', h,
-                               block['mlp_out'].astype(dtype),
-                               preferred_element_type=jnp.float32).astype(dtype)
-        x = _constrain(x, seq)
+            x = _block_forward(block, x, c, mesh=mesh)
     x = _rmsnorm(x, params['ln_f'])
     logits = jnp.einsum('bsd,dv->bsv', x, params['lm_head'].astype(dtype),
                         preferred_element_type=jnp.float32)
@@ -281,6 +299,118 @@ def transformer_loss(params, tokens, config, mesh=None):
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return -ll.mean() + config.moe_aux_weight * aux
+
+
+def init_pipelined_transformer_params(rng, config, mesh, pipe_axis=None):
+    """Parameters for the PIPELINE-PARALLEL transformer: blocks stacked on
+    a leading ``(n_stages, layers_per_stage)`` axis pair sharded over
+    ``pipe_axis``, composing with tensor-parallel splits over ``'model'``
+    and data parallelism over ``'data'`` on the same mesh (3D: dp×pp×tp in
+    one jitted step).
+
+    Requires ``config.n_layers % mesh.shape[pipe_axis] == 0``. Dense FFN
+    only (MoE/seq-parallel pipelining not yet composed).
+    """
+    from petastorm_tpu.parallel.mesh import PIPE_AXIS
+    if pipe_axis is None:
+        pipe_axis = PIPE_AXIS
+    c = config
+    if c.n_experts > 0 or c.seq_axis is not None:
+        raise NotImplementedError('pipelined transformer currently composes '
+                                  'dp×pp×tp; MoE and seq-parallel configs '
+                                  'use the layered forward')
+    n_stages = mesh.shape[pipe_axis]
+    if c.n_layers % n_stages:
+        raise ValueError('n_layers=%d not divisible into %d pipeline stages'
+                         % (c.n_layers, n_stages))
+    from petastorm_tpu.parallel.pipeline import shard_stage_params
+
+    params = init_transformer_params(rng, c)  # unsharded, layered
+    blocks = params.pop('blocks')
+    per_stage = c.n_layers // n_stages
+
+    def stack(name):
+        stacked = jnp.stack([b[name] for b in blocks])
+        return stacked.reshape((n_stages, per_stage)
+                               + stacked.shape[1:])
+
+    stages = {name: stack(name) for name in blocks[0]}
+    top_specs = _param_specs(c)
+    block_specs = top_specs['blocks'][0]
+    inner_specs = {
+        # dims after the stage axis: (layers_per_stage, *param dims) — the
+        # layer dim replicates, the param dims keep their Megatron splits
+        name: P(None, *_restrict_spec_to_mesh(block_specs[name], mesh))
+        for name in stages
+    }
+    stages = shard_stage_params(stages, mesh, axis_name=pipe_axis,
+                                inner_specs=inner_specs)
+
+    placed = {
+        name: jax.device_put(
+            params[name],
+            NamedSharding(mesh, _restrict_spec_to_mesh(top_specs[name],
+                                                       mesh)))
+        for name in ('embed', 'pos_embed', 'ln_f', 'lm_head')
+    }
+    placed['stages'] = stages
+    return placed
+
+
+def pipelined_transformer_forward(params, tokens, config, mesh,
+                                  pipe_axis=None, n_microbatches=None):
+    """tokens (B, S) int32 → logits (B, S, V) f32, with the block stack
+    executed as a GPipe pipeline over ``mesh[pipe_axis]`` (embedding and
+    head run outside the pipeline on every stage's devices)."""
+    from petastorm_tpu.parallel.mesh import PIPE_AXIS
+    from petastorm_tpu.parallel.pipeline import pipeline_apply
+
+    if pipe_axis is None:
+        pipe_axis = PIPE_AXIS
+    c = config
+    dtype = c.dtype
+    per_stage = next(iter(params['stages'].values())).shape[1]
+
+    x = params['embed'][tokens].astype(dtype)
+    x = x + params['pos_embed'][:tokens.shape[1]].astype(dtype)
+    x = _constrain(x)
+
+    def stage_fn(stage_params, x):
+        for layer in range(per_stage):
+            block = {name: leaf[layer]
+                     for name, leaf in stage_params.items()}
+            x = _block_forward(block, x, c)
+        return x
+
+    x = pipeline_apply(stage_fn, params['stages'], x, mesh,
+                       axis_name=pipe_axis, n_microbatches=n_microbatches)
+    x = _rmsnorm(x, params['ln_f'])
+    return jnp.einsum('bsd,dv->bsv', x, params['lm_head'].astype(dtype),
+                      preferred_element_type=jnp.float32)
+
+
+def pipelined_transformer_train_step(config, optimizer, mesh,
+                                     pipe_axis=None, n_microbatches=None):
+    """Jittable dp×pp×tp train step over stacked-stage parameters."""
+
+    import optax
+
+    def loss_fn(params, tokens):
+        logits = pipelined_transformer_forward(
+            params, tokens[:, :-1], config, mesh, pipe_axis=pipe_axis,
+            n_microbatches=n_microbatches)
+        targets = tokens[:, 1:]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        return -ll.mean()
+
+    @jax.jit
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    return step
 
 
 def transformer_train_step(config, optimizer, mesh=None):

@@ -31,16 +31,28 @@ from jax import lax
 from petastorm_tpu.parallel.mesh import PIPE_AXIS
 
 
-def shard_stage_params(stage_params, mesh, axis_name=PIPE_AXIS):
+def shard_stage_params(stage_params, mesh, axis_name=PIPE_AXIS,
+                       inner_specs=None):
     """Place a stacked-stage parameter pytree so each leaf's leading
-    (stage) axis is sharded over ``axis_name``: one stage per mesh slice."""
+    (stage) axis is sharded over ``axis_name``: one stage per mesh slice.
+
+    :param inner_specs: optional pytree of PartitionSpecs for the
+        dimensions AFTER the stage axis (e.g. Megatron tensor-parallel
+        splits over ``'model'``); default replicates them.
+    """
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    def place(leaf):
-        spec = P(axis_name, *([None] * (jnp.ndim(leaf) - 1)))
-        return jax.device_put(leaf, NamedSharding(mesh, spec))
+    def place(leaf, inner=None):
+        rest = tuple(inner) if inner is not None else ()
+        rest = rest + (None,) * (jnp.ndim(leaf) - 1 - len(rest))
+        return jax.device_put(leaf,
+                              NamedSharding(mesh, P(axis_name, *rest)))
 
-    return jax.tree_util.tree_map(place, stage_params)
+    if inner_specs is None:
+        return jax.tree_util.tree_map(place, stage_params)
+    # PartitionSpec is a pytree LEAF, so a specs tree mirrors the params
+    # tree structurally and tree_map pairs them leaf-for-leaf
+    return jax.tree_util.tree_map(place, stage_params, inner_specs)
 
 
 def _to_varying(x, axis_name):
@@ -122,9 +134,15 @@ def pipeline_apply(stage_fn, stage_params, x, mesh, axis_name=PIPE_AXIS,
     # transposes that correctly (see _to_varying). No check_rep=False
     # fallback — on a jax too old for it, wrong input gradients would be
     # silent, which is strictly worse than an ImportError.
+    #
+    # Manual ONLY over the pipe axis: any other mesh axes (data, model)
+    # stay auto, so the batch rides in data-sharded, stage weights keep
+    # their tensor-parallel layout, and XLA inserts the dp/tp collectives
+    # inside each stage as usual — this is what lets pp compose with dp
+    # and tp in ONE jitted step.
     from jax import shard_map
     fn = shard_map(body, mesh=mesh, in_specs=(param_specs, P()),
-                   out_specs=P(), check_vma=True)
+                   out_specs=P(), axis_names={axis_name}, check_vma=True)
     return fn(stage_params, x)
 
 
