@@ -165,6 +165,10 @@ class JaxLoader:
         self._delivered_by_epoch = {}   # epoch -> {item_index, ...}
         self._next_pull_id = 0
         self._uses_provenance = hasattr(reader, 'next_batch_info')
+        # staging gauges (see diagnostics): who is waiting on whom?
+        self._consumer_wait_s = 0.0   # consumer blocked on get → input-bound
+        self._stage_blocked_s = 0.0   # producer blocked on put → compute-bound
+        self._batches_delivered = 0
 
     # -- sharding ------------------------------------------------------------
 
@@ -291,7 +295,11 @@ class JaxLoader:
                         else _NO_ITEM)
             if item is _NO_ITEM:
                 try:
-                    item = self._out_queue.get(timeout=0.1)
+                    t0 = time.monotonic()
+                    try:
+                        item = self._out_queue.get(timeout=0.1)
+                    finally:
+                        self._consumer_wait_s += time.monotonic() - t0
                 except queue.Empty:
                     if self._stage_error is not None:
                         raise self._stage_error
@@ -320,6 +328,7 @@ class JaxLoader:
             batch, pull_counts = item
             if pull_counts:
                 self._record_delivery(pull_counts)
+            self._batches_delivered += 1
             return batch
 
     def _record_delivery(self, pull_counts):
@@ -515,12 +524,18 @@ class JaxLoader:
         return device_batch
 
     def _put_blocking(self, item):
-        while not self._stop_event.is_set():
-            try:
-                self._out_queue.put(item, timeout=0.1)
-                return
-            except queue.Full:
-                continue
+        start = time.monotonic()
+        try:
+            while not self._stop_event.is_set():
+                try:
+                    self._out_queue.put(item, timeout=0.1)
+                    return
+                except queue.Full:
+                    continue
+        finally:
+            # time the producer spent blocked on a full queue: back-pressure
+            # from a consumer that is NOT input-bound
+            self._stage_blocked_s += time.monotonic() - start
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -539,7 +554,23 @@ class JaxLoader:
 
     @property
     def diagnostics(self):
-        return self._reader.diagnostics
+        """Reader pool gauges plus the staging layer's own: queue depth,
+        delivered-batch count, and the two wait clocks that say WHO is the
+        bottleneck — high ``consumer_wait_s`` means the input pipeline is
+        too slow (add decode workers / prefetch); high
+        ``stage_backpressure_s`` means the training step is (keep prefetch
+        small, the input side is not the problem)."""
+        diag = dict(self._reader.diagnostics)
+        diag.update({
+            'stage_queue_depth': (self._out_queue.qsize()
+                                  if self._out_queue is not None else 0),
+            'stage_leftovers': len(self._leftovers),
+            'batches_delivered': self._batches_delivered,
+            'consumer_wait_s': round(self._consumer_wait_s, 3),
+            'stage_backpressure_s': round(self._stage_blocked_s, 3),
+            'pulls_in_flight': len(self._pull_info),
+        })
+        return diag
 
     def state_dict(self):
         """Row-group-granular, at-least-once checkpoint of the DATA
@@ -677,7 +708,9 @@ class InMemoryCachedLoader:
 
     @property
     def diagnostics(self):
-        return self._loader.reader.diagnostics
+        # the full JaxLoader merge (pool + staging gauges), so the
+        # tpu_guide's consumer_wait_s/backpressure advice applies here too
+        return self._loader.diagnostics
 
     def state_dict(self):
         raise RuntimeError(

@@ -131,6 +131,21 @@ def test_bad_divisibility_rejected(scalar_dataset):
                         fields=['^id$'])
 
 
+def test_staging_diagnostics(scalar_dataset):
+    with make_jax_loader(scalar_dataset.url, batch_size=10, fields=['^id$'],
+                         last_batch='short') as loader:
+        n = sum(1 for _ in loader)
+        diag = loader.diagnostics
+    assert diag['batches_delivered'] == n == 10
+    assert diag['stage_queue_depth'] == 0
+    assert diag['stage_leftovers'] == 0
+    assert diag['pulls_in_flight'] == 0  # everything delivered
+    assert diag['consumer_wait_s'] >= 0.0
+    assert diag['stage_backpressure_s'] >= 0.0
+    # the reader-pool gauges ride along in the merge
+    assert 'output_queue_size' in diag
+
+
 def test_mid_pass_iter_resumes_same_pass(scalar_dataset):
     # iter() follows the iterator protocol: while a pass is in progress it
     # returns self and resumes (it does NOT restart or raise), so
