@@ -121,15 +121,16 @@ def _measure_rows(url):
         return MEASURE_SAMPLES / (time.monotonic() - start)
 
 
-def _measure_lm_tokens(tmp, seq_len=128, warmup_rows=64, measure_rows=2048):
+def _build_c4_like(url):
+    from examples.lm.pretrain_example import generate_c4_like
+    generate_c4_like(url, num_docs=2048)
+
+
+def _measure_lm_tokens(url, seq_len=128, warmup_rows=64, measure_rows=2048):
     """BASELINE config 5: variable-length token docs packed to fixed
     ``seq_len`` rows on the decode workers — packed tokens/sec."""
-    from examples.lm.pretrain_example import (
-        generate_c4_like, packing_transform,
-    )
+    from examples.lm.pretrain_example import packing_transform
 
-    url = 'file://' + tmp + '/c4_like'
-    generate_c4_like(url, num_docs=2048)
     rate, _ = _measure_batch(url, warmup_rows, measure_rows,
                              transform_spec=packing_transform(seq_len))
     return rate * seq_len
@@ -293,6 +294,60 @@ def _measure_jax(url, batch_size, warmup, measure, fields, timeout=150):
     return _run_json_subprocess([sys.executable, '-c', code], timeout)
 
 
+_LM_TRAIN_SNIPPET = r'''
+import json, os, sys, time
+sys.path.insert(0, %(repo)r)
+if os.environ.get('BENCH_JAX_PLATFORM'):
+    import jax
+    jax.config.update('jax_platforms', os.environ['BENCH_JAX_PLATFORM'])
+import jax
+import optax
+from petastorm_tpu.jax import make_jax_loader
+from petastorm_tpu.models.transformer import (
+    TransformerConfig, init_transformer_params, transformer_train_step,
+)
+from examples.lm.pretrain_example import packing_transform
+
+url, batch, seq_len, warmup, measure = (
+    %(url)r, %(batch)d, %(seq)d, %(warmup)d, %(measure)d)
+config = TransformerConfig(vocab_size=256, d_model=128, n_heads=4,
+                           n_layers=4, d_ff=512, max_seq_len=seq_len)
+params = init_transformer_params(jax.random.PRNGKey(0), config)
+optimizer = optax.adamw(1e-3)
+opt_state = optimizer.init(params)
+step = transformer_train_step(config, optimizer)
+with make_jax_loader(url, batch_size=batch, num_epochs=None,
+                     transform_spec=packing_transform(seq_len),
+                     shuffle_row_groups=True) as loader:
+    it = loader.iter_steps(warmup + measure)
+    for _ in range(warmup):
+        params, opt_state, loss = step(params, opt_state, next(it)['tokens'])
+    loss.block_until_ready()
+    start = time.monotonic()
+    for _ in range(measure):
+        params, opt_state, loss = step(params, opt_state, next(it)['tokens'])
+    loss.block_until_ready()
+    elapsed = time.monotonic() - start
+print(json.dumps({
+    "steps_per_sec": measure / elapsed,
+    "train_tokens_per_sec": measure * batch * seq_len / elapsed,
+    "final_loss": float(loss),
+}))
+'''
+
+
+def _measure_lm_train(url, batch=16, seq_len=128, warmup=3, measure=20,
+                      timeout=240):
+    """END-TO-END training throughput: Parquet docs → packed batches →
+    device staging → a real transformer optimizer step on the default
+    device (the TPU chip under the driver)."""
+    code = _LM_TRAIN_SNIPPET % {
+        'repo': os.path.dirname(os.path.abspath(__file__)), 'url': url,
+        'batch': batch, 'seq': seq_len, 'warmup': warmup,
+        'measure': measure}
+    return _run_json_subprocess([sys.executable, '-c', code], timeout)
+
+
 def main():
     import numpy as np
 
@@ -309,7 +364,10 @@ def main():
         batch_rate, _ = _measure_batch(hello_url, 1000, 8000)
         extra['hello_world_batch_rows_per_sec'] = round(batch_rate, 1)
 
-        extra['lm_packed_tokens_per_sec'] = round(_measure_lm_tokens(tmp), 1)
+        c4_url = 'file://' + tmp + '/c4_like'
+        _build_c4_like(c4_url)
+        extra['lm_packed_tokens_per_sec'] = round(_measure_lm_tokens(c4_url),
+                                                  1)
 
         img_bytes = int(np.prod(IMAGENET_SHAPE))
         # best of 2: the shared box is noisy and this is the north-star rate
@@ -320,14 +378,14 @@ def main():
         extra['imagenet_batch_rows_per_sec'] = round(img_rate, 1)
         extra['imagenet_decoded_mb_per_sec'] = round(img_mb, 1)
 
-        def jax_metrics(prefix, *args):
-            result = _measure_jax(*args)
+        def jax_metrics(prefix, *args, fn=_measure_jax):
+            result = fn(*args)
             if 'error' in result and not os.environ.get('BENCH_JAX_PLATFORM'):
                 # chip/tunnel unavailable: still record the staging path on
                 # the CPU backend, marked as such
                 os.environ['BENCH_JAX_PLATFORM'] = 'cpu'
                 try:
-                    cpu_result = _measure_jax(*args)
+                    cpu_result = fn(*args)
                 finally:
                     del os.environ['BENCH_JAX_PLATFORM']
                 if 'error' not in cpu_result:
@@ -335,13 +393,21 @@ def main():
                     extra['%s_tpu_error' % prefix] = result['error']
                     result = cpu_result
             for k, v in result.items():
-                extra['%s_%s' % (prefix, k)] = (round(v, 1)
-                                                if isinstance(v, float) else v)
+                if isinstance(v, float):
+                    # keep 4 significant digits: rates are O(10^3)+ but
+                    # steps/sec and losses are O(1) and would be erased by
+                    # fixed 1-decimal rounding
+                    v = float('%.4g' % v)
+                extra['%s_%s' % (prefix, k)] = v
 
         jax_metrics('hello_world_jax', hello_url, 256, 1024, 8192,
                     ['^id$', '^array_4d$', '^image1$'])
         jax_metrics('imagenet_jax', imagenet_url, 64, IMAGENET_ROWS // 2,
                     IMAGENET_ROWS * 3, ['^image$'])
+
+        # end-to-end TRAINING throughput on the default device: Parquet →
+        # packed batches → H2D → real transformer optimizer steps
+        jax_metrics('lm_train', c4_url, fn=_measure_lm_train)
 
         # North star (BASELINE.json): ratio vs a tf.data+TFRecord pipeline
         # decoding the SAME jpeg bytes on the same machine. Target >= 0.9.
