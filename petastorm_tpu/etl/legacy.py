@@ -75,7 +75,20 @@ class _RestrictedUnpickler(pickle.Unpickler):
         ('builtins', 'set'): set,
         ('builtins', 'object'): object,
         ('copyreg', '_reconstructor'): __import__('copyreg')._reconstructor,
+        # Python-2 module spellings: the reference's oldest datasets
+        # (0.4.x-0.7.x, committed in its tree) were pickled under py2
+        ('copy_reg', '_reconstructor'): __import__('copyreg')._reconstructor,
+        ('__builtin__', 'frozenset'): frozenset,
+        ('__builtin__', 'set'): set,
+        ('__builtin__', 'object'): object,
+        ('__builtin__', 'tuple'): tuple,
+        ('__builtin__', 'list'): list,
+        ('__builtin__', 'dict'): dict,
     }
+
+    # legacy numpy scalar-type names removed in numpy 2.0; py2-era pickles
+    # reference them
+    _NUMPY_RENAMES = {'unicode_': 'str_', 'string_': 'bytes_'}
 
     def find_class(self, module, name):
         if (module, name) in self._ALLOWED:
@@ -91,7 +104,7 @@ class _RestrictedUnpickler(pickle.Unpickler):
                     except ImportError:  # numpy 1.x
                         from numpy.core import multiarray
                     return getattr(multiarray, name)
-                return getattr(np, name)
+                return getattr(np, self._NUMPY_RENAMES.get(name, name))
             raise pickle.UnpicklingError(
                 'Refusing to depickle numpy attribute %s.%s from a dataset footer'
                 % (module, name))
@@ -107,7 +120,10 @@ class _RestrictedUnpickler(pickle.Unpickler):
 
 
 def _loads(blob):
-    return _RestrictedUnpickler(io.BytesIO(blob)).load()
+    # latin1: the standard decoding for Python-2 pickles (maps each byte
+    # 1:1, so py2 str payloads like numpy scalar bytes survive); a no-op
+    # for py3-written pickles, whose strings are SHORT_BINUNICODE
+    return _RestrictedUnpickler(io.BytesIO(blob), encoding='latin1').load()
 
 
 # ---------------------------------------------------------------------------
@@ -154,8 +170,26 @@ def _convert_spark_type(shim):
 def _convert_field(shim_field):
     if isinstance(shim_field, _ShimField):
         name, numpy_dtype, shape, codec, nullable = shim_field
-    else:  # very old pickles may carry a shim object with attributes
-        d = shim_field.__dict__
+    else:
+        # pre-0.7.6 pickles reconstruct fields through a namedtuple-restore
+        # helper — the shim captures it as
+        # _shim_args = (typename, field_names, values)
+        d = dict(getattr(shim_field, '__dict__', {}))
+        args = d.get('_shim_args')
+        if (args and len(args) == 3 and isinstance(args[1], (tuple, list))
+                and isinstance(args[2], (tuple, list))):
+            if len(args[1]) != len(args[2]):
+                # zip would silently truncate, turning a malformed pickle
+                # into silently-undecoded (raw bytes) columns
+                raise MetadataError(
+                    'Pickled field restore has %d names but %d values'
+                    % (len(args[1]), len(args[2])))
+            d.update(zip(args[1], args[2]))
+        missing = {'name', 'numpy_dtype', 'shape'} - set(d)
+        if missing:
+            raise MetadataError('Pickled field has unexpected structure: '
+                                'missing %s in %r' % (sorted(missing),
+                                                      sorted(d)))
         name, numpy_dtype, shape = d['name'], d['numpy_dtype'], d['shape']
         codec, nullable = d.get('codec'), d.get('nullable', False)
     return UnischemaField(name, numpy_dtype, tuple(shape),
