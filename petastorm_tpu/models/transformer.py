@@ -45,10 +45,14 @@ class TransformerConfig:
     expert_axis: str = 'expert'
     # sequence/context parallelism: a mesh axis name (e.g. 'seq') shards
     # the sequence dimension of every activation; attention then runs
-    # through ring_attention (exact, global causal mask) so no single chip
-    # ever holds the full sequence. Requires passing the mesh to
-    # transformer_train_step/forward.
+    # through the chosen exact strategy (global causal mask) so no single
+    # chip ever holds the full sequence. Requires passing the mesh to
+    # transformer_train_step/forward. seq_impl: 'ring' (ppermute KV
+    # rotation — works for any head count, O(S/N) score memory) or
+    # 'ulysses' (all-to-all head split — fewer collectives, needs
+    # n_heads % n_seq_shards == 0).
     seq_axis: str = None
+    seq_impl: str = 'ring'
 
     def moe_config(self):
         from petastorm_tpu.models.moe import MoEConfig
@@ -146,7 +150,8 @@ def _rmsnorm(x, gain):
     return (x.astype(jnp.float32) * jax.lax.rsqrt(var + 1e-6) * gain).astype(x.dtype)
 
 
-def _attention(x, qkv_w, out_w, n_heads, dtype, seq_axis=None, mesh=None):
+def _attention(x, qkv_w, out_w, n_heads, dtype, seq_axis=None, mesh=None,
+               seq_impl='ring'):
     b, s, d = x.shape
     head_dim = d // n_heads
     qkv = jnp.einsum('bsd,de->bse', x, qkv_w.astype(dtype),
@@ -155,15 +160,23 @@ def _attention(x, qkv_w, out_w, n_heads, dtype, seq_axis=None, mesh=None):
 
     if seq_axis is not None and mesh is not None:
         # sequence parallel: attention is the ONLY cross-token op, so it is
-        # the only place the seq sharding needs special handling — ring
-        # attention applies the causal mask over GLOBAL positions while the
+        # the only place the seq sharding needs special handling — both
+        # strategies apply the causal mask over GLOBAL positions while the
         # S axis stays sharded over `seq_axis`
-        from petastorm_tpu.ops.ring_attention import ring_attention
+        if seq_impl == 'ring':
+            from petastorm_tpu.ops.ring_attention import \
+                ring_attention as seq_attention
+        elif seq_impl == 'ulysses':
+            from petastorm_tpu.ops.ulysses_attention import \
+                ulysses_attention as seq_attention
+        else:
+            raise ValueError("seq_impl must be 'ring' or 'ulysses'; got %r"
+                             % (seq_impl,))
         batch_axis = DATA_AXIS if DATA_AXIS in mesh.axis_names else None
         bshd = (b, s, n_heads, head_dim)
-        ctx = ring_attention(q.reshape(bshd), k_.reshape(bshd),
-                             v.reshape(bshd), mesh, axis_name=seq_axis,
-                             causal=True, batch_axis=batch_axis)
+        ctx = seq_attention(q.reshape(bshd), k_.reshape(bshd),
+                            v.reshape(bshd), mesh, axis_name=seq_axis,
+                            causal=True, batch_axis=batch_axis)
         ctx = ctx.reshape(b, s, d)
     else:
         def heads(t):
@@ -187,7 +200,8 @@ def _block_attention_half(block, x, config, mesh=None):
     """Pre-norm attention sublayer with residual + sharding constraint."""
     h = _rmsnorm(x, block['ln1'])
     x = x + _attention(h, block['qkv'], block['attn_out'], config.n_heads,
-                       config.dtype, seq_axis=config.seq_axis, mesh=mesh)
+                       config.dtype, seq_axis=config.seq_axis, mesh=mesh,
+                       seq_impl=config.seq_impl)
     return _constrain(x, config.seq_axis)
 
 

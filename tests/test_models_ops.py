@@ -179,16 +179,23 @@ class TestSequenceParallelTransformer:
         base.update(kw)
         return TransformerConfig(**base)
 
-    def test_seq_parallel_logits_match_dense(self):
+    @pytest.mark.parametrize('seq_impl', ['ring', 'ulysses'])
+    def test_seq_parallel_logits_match_dense(self, seq_impl):
         # activations stay sequence-sharded through every block and
-        # attention runs the ring collective — the logits must be identical
-        # to the unsharded model (sharding is layout, not semantics)
+        # attention runs the chosen collective — the logits must be
+        # identical to the unsharded model (sharding is layout, not
+        # semantics), for BOTH strategies
         from jax.sharding import Mesh, NamedSharding, PartitionSpec
         from petastorm_tpu.models.transformer import (
             init_transformer_params, transformer_forward,
         )
-        dense_config = self._config()
-        sp_config = self._config(seq_axis='seq')
+        # ulysses needs heads divisible by the 8-way mesh; ring must keep
+        # working with FEWER heads than devices (its distinguishing
+        # capability), so only the ulysses case overrides n_heads
+        n_heads = 8 if seq_impl == 'ulysses' else 2
+        dense_config = self._config(n_heads=n_heads)
+        sp_config = self._config(seq_axis='seq', seq_impl=seq_impl,
+                                 n_heads=n_heads)
         params = init_transformer_params(jax.random.PRNGKey(0), dense_config)
         tokens = jnp.asarray(
             np.random.RandomState(0).randint(0, 32, (2, 16), np.int32))
