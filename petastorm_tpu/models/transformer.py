@@ -54,6 +54,14 @@ class TransformerConfig:
     seq_axis: str = None
     seq_impl: str = 'ring'
 
+    def __post_init__(self):
+        # validate at construction, not mid-trace inside layer 0's
+        # attention (and even when seq_axis is unset, where the typo would
+        # otherwise silently train dense)
+        if self.seq_impl not in ('ring', 'ulysses'):
+            raise ValueError("seq_impl must be 'ring' or 'ulysses'; got %r"
+                             % (self.seq_impl,))
+
     def moe_config(self):
         from petastorm_tpu.models.moe import MoEConfig
         return MoEConfig(d_model=self.d_model, d_ff=self.d_ff,

@@ -236,6 +236,12 @@ class TestSequenceParallelTransformer:
         assert np.isfinite(float(loss))
         assert float(loss) < first
 
+    def test_invalid_seq_impl_rejected_at_construction(self):
+        # a typo'd strategy must fail at config time, even when seq_axis
+        # is unset (it would otherwise silently train dense)
+        with pytest.raises(ValueError, match="'ring' or 'ulysses'"):
+            self._config(seq_impl='ulises')
+
     def test_seq_axis_without_mesh_raises(self):
         from petastorm_tpu.models.transformer import (
             init_transformer_params, transformer_forward,
@@ -294,4 +300,11 @@ class TestGraftEntry:
     def test_dryrun_multichip(self, capsys):
         import __graft_entry__ as g
         g.dryrun_multichip(8)
-        assert 'one train step' in capsys.readouterr().out
+        out = capsys.readouterr().out
+        # every parallelism family must report — a silently dropped
+        # section would pass an 'any output' check
+        assert 'one train step' in out                    # dp x tp
+        assert 'MoE train step' in out                    # dp x ep
+        assert 'FULL dp x pp x tp train step' in out      # 3D
+        assert 'pipeline matches the sequential oracle' in out
+        assert 'ring + Ulysses attention' in out          # sp, both
