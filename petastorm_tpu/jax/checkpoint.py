@@ -120,6 +120,25 @@ class TrainCheckpointer:
             return 0
         ocp = self._ocp
         import jax
+        # Probe the checkpoint's item inventory instead of relying on the
+        # restore call's exception type (orbax surfaces a missing Composite
+        # item differently across versions — KeyError today, but not
+        # contractually), so the documented "starts fresh" fallback cannot
+        # be broken by an orbax upgrade.
+        try:
+            items = set(self._manager.item_metadata(step).keys())
+            # only trust an inventory that lists the always-present train
+            # state: some orbax versions omit items they cannot infer a
+            # handler for, and a false "absent" would silently skip a
+            # recoverable data-position restore
+            has_loader = (_LOADER_KEY in items if _STATE_KEY in items
+                          else None)
+        except Exception:  # noqa: BLE001 - probe unsupported: try restore
+            has_loader = None
+        if has_loader is False:
+            logger.warning('checkpoint step %s was saved without loader '
+                           'state; data position starts fresh', step)
+            return step
         try:
             restored = self._manager.restore(
                 step, args=ocp.args.Composite(**{

@@ -192,6 +192,16 @@ class JaxLoader:
     # -- iteration -----------------------------------------------------------
 
     def __iter__(self):
+        """Start a pass — or, per the iterator protocol, resume the pass in
+        progress (``iter(it) is it``), replaying the dataset only when the
+        previous pass is exhausted.
+
+        .. note:: a mid-pass ``iter()`` whose prefetch queue is momentarily
+           empty blocks until the staging thread either stages a batch
+           (resume) or finishes the pass (replay) — it cannot tell which
+           side of the epoch boundary it is on until one happens. With a
+           stalled reader that wait is unbounded; ``stop()`` unblocks it.
+        """
         if self._stage_thread is not None:
             if self._stop_event.is_set():
                 raise RuntimeError('JaxLoader was stopped; construct a new '
@@ -548,6 +558,25 @@ class JaxLoader:
         return self._reader
 
     @property
+    def batch_size(self):
+        return self._batch_size
+
+    @property
+    def last_batch(self):
+        """The last-batch policy: ``'drop'``, ``'pad'`` or ``'short'``."""
+        return self._last_batch
+
+    @property
+    def shuffle_rows(self):
+        return self._shuffle_rows
+
+    @property
+    def sharding(self):
+        """The resolved :class:`~jax.sharding.NamedSharding` batches are
+        staged with, or None without a mesh."""
+        return self._sharding
+
+    @property
     def epoch(self):
         """Number of completed replay passes (0 during the first pass)."""
         return self._epoch
@@ -630,16 +659,33 @@ class InMemoryCachedLoader:
     host, for CPU-backed arrays) memory. Iteration state checkpointing is
     unsupported — replay epochs have no reader position (resume by
     replaying the cached epoch from its start).
+
+    **Replay shuffling.** When the wrapped loader row-shuffles
+    (``shuffle_rows=True``), replay epochs re-draw BATCH MEMBERSHIP too:
+    the cached epoch's rows are pooled on device (one concatenated array
+    per field, built lazily at the first replay) and re-batched under a
+    fresh permutation each epoch — matching the reference torch loader's
+    behavior of re-feeding cached rows through a fresh shuffling buffer
+    (``petastorm/pytorch.py:344-407``), but as device-side gathers instead
+    of a host-side buffer. Without ``shuffle_rows`` only the batch ORDER
+    is shuffled (row composition is frozen after epoch 1). Row-level
+    replay is single-host only: on a multi-process run the cached arrays'
+    local shards cannot be re-gathered host-locally, so it degrades to
+    batch-order shuffling with a warning.
     """
 
     def __init__(self, loader, seed=0):
         self._loader = loader
         self._seed = seed
         self._cache = []
+        self._row_cache = None     # field -> one concatenated device array
+        self._row_count = 0
         self._cache_epoch = None
         self._complete = False
+        self._produced_any = False
         self._stopped = False
         self._replay_epoch = 0
+        self._steps_iter = None
 
     # -- iteration -----------------------------------------------------------
 
@@ -663,23 +709,126 @@ class InMemoryCachedLoader:
             self._cache_epoch = self._loader.epoch
         for batch in it:
             self._cache.append(batch)
+            self._produced_any = True
             yield batch
         self._complete = True
 
     def _replay(self):
         self._replay_epoch += 1
-        order = np.arange(len(self._cache))
         rng = np.random.RandomState(
             None if self._seed is None
             else (self._seed + self._replay_epoch) % (2 ** 32))
+        if self._loader.shuffle_rows and self._row_replay_supported():
+            yield from self._replay_rows(rng)
+            return
+        cache = self._cache
+        order = np.arange(len(cache))
         rng.shuffle(order)
         for i in order:
-            yield self._cache[i]
+            if self._stopped:
+                raise RuntimeError('InMemoryCachedLoader was stopped (its '
+                                   'cache is released) while a replay '
+                                   'iterator was live')
+            yield cache[i]
+
+    def _row_replay_supported(self):
+        import jax
+        if jax.process_count() == 1:
+            return True
+        if not getattr(self, '_warned_multiprocess', False):
+            logger.warning(
+                'inmemory_cache_all: row-level replay shuffling needs the '
+                'whole epoch addressable on this host; on a %d-process run '
+                'replay reshuffles batch order only',
+                jax.process_count())
+            self._warned_multiprocess = True
+        return False
+
+    def _ensure_row_cache(self):
+        """Pool the cached epoch into one device array per field (valid
+        rows only), releasing the per-batch cache — the pooled copy
+        replaces it, keeping peak HBM at ~one epoch (plus one field's
+        pooled copy while it concatenates)."""
+        if self._row_cache is not None:
+            return
+        if not self._cache:
+            self._row_cache = {}
+            self._row_count = 0
+            return
+        import jax.numpy as jnp
+        names = [n for n in self._cache[0] if n != MASK_FIELD]
+        parts = {n: [] for n in names}
+        for b in self._cache:
+            mask = b.get(MASK_FIELD)
+            for n in names:
+                arr = b[n]
+                if mask is not None:
+                    arr = arr[np.asarray(mask)]
+                parts[n].append(arr)
+        # drop the per-batch refs BEFORE materializing pooled copies
+        # (`parts` keeps the arrays alive) and release each field's pieces
+        # as its pooled copy lands, so peak HBM stays ~one epoch. Publish
+        # to self only on success: a mid-pooling failure (device OOM) must
+        # leave the loader observably broken (retry re-raises), not with
+        # an empty row cache that silently replays zero batches.
+        self._cache = []
+        pooled = {}
+        try:
+            for n in names:
+                pooled[n] = jnp.concatenate(parts.pop(n), axis=0)
+        except Exception:
+            # per-batch refs are gone; poison further replays explicitly
+            self._stopped = True
+            raise
+        self._row_cache = pooled
+        self._row_count = int(next(iter(pooled.values())).shape[0])
+
+    def _replay_rows(self, rng):
+        import jax
+        import jax.numpy as jnp
+        self._ensure_row_cache()
+        n = self._row_count
+        if n == 0:
+            return
+        bs = self._loader.batch_size
+        policy = self._loader.last_batch
+        sharding = self._loader.sharding
+        # snapshot: stop() nulls _row_cache under a live generator; the
+        # per-batch _stopped check below turns that into the intended
+        # RuntimeError instead of an AttributeError mid-comprehension
+        row_cache = self._row_cache
+        perm = rng.permutation(n)
+        stop = n - (n % bs) if policy == 'drop' else n
+        for start in range(0, stop, bs):
+            if self._stopped:
+                raise RuntimeError('InMemoryCachedLoader was stopped (its '
+                                   'cache is released) while a replay '
+                                   'iterator was live')
+            idx = jnp.asarray(perm[start:start + bs])
+            k = int(idx.shape[0])
+            batch = {name: jnp.take(arr, idx, axis=0)
+                     for name, arr in row_cache.items()}
+            if policy == 'pad':
+                if k < bs:
+                    batch = {name: jnp.concatenate(
+                        [a, jnp.zeros((bs - k,) + a.shape[1:], a.dtype)])
+                        for name, a in batch.items()}
+                mask = np.zeros(bs, dtype=bool)
+                mask[:k] = True
+                batch[MASK_FIELD] = jnp.asarray(mask)
+            if sharding is not None:
+                batch = {name: jax.device_put(a, sharding)
+                         for name, a in batch.items()}
+            yield batch
 
     def iter_steps(self, num_steps):
         """Exactly ``num_steps`` batches, continuing across calls and epoch
         boundaries (see :meth:`JaxLoader.iter_steps`)."""
-        it = getattr(self, '_steps_iter', None)
+        if self._stopped:
+            raise RuntimeError('InMemoryCachedLoader was stopped (its cache '
+                               'is released); construct a new loader to '
+                               'iterate again')
+        it = self._steps_iter
         for _ in range(num_steps):
             while True:
                 if it is None:
@@ -688,7 +837,7 @@ class InMemoryCachedLoader:
                     yield next(it)
                     break
                 except StopIteration:
-                    if not self._cache:
+                    if not self._produced_any:
                         raise RuntimeError(
                             'inmemory_cache_all loader produced no batches; '
                             'the dataset is empty (or every batch was '
@@ -728,6 +877,11 @@ class InMemoryCachedLoader:
         self._stopped = True
         self._loader.stop()
         self._cache = []
+        self._row_cache = None
+        # a saved iter_steps cursor over the now-released cache must not
+        # survive: resuming it would IndexError instead of the intended
+        # 'was stopped' RuntimeError above
+        self._steps_iter = None
 
     def __enter__(self):
         return self

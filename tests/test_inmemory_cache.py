@@ -35,6 +35,79 @@ def test_replay_reshuffles_batch_order(scalar_dataset):
     assert second != third
 
 
+def test_row_shuffle_replay_redraws_batch_membership(scalar_dataset):
+    # with shuffle_rows, replay must reshuffle ROW-to-batch composition
+    # (not just batch order), mirroring the reference torch loader's
+    # fresh-shuffling-buffer replay (petastorm/pytorch.py:344-407)
+    with make_jax_loader(scalar_dataset.url, batch_size=10, fields=['^id$'],
+                         shuffle_rows=True, seed=3,
+                         inmemory_cache_all=True) as loader:
+        first = [frozenset(np.asarray(b['id']).tolist()) for b in loader]
+        second = [frozenset(np.asarray(b['id']).tolist()) for b in loader]
+        third = [frozenset(np.asarray(b['id']).tolist()) for b in loader]
+    # every replay is a full epoch...
+    assert sorted(x for s in second for x in s) == list(range(100))
+    assert sorted(x for s in third for x in s) == list(range(100))
+    # ...and batch membership changed, not merely batch order
+    assert set(second) != set(first)
+    assert set(third) != set(second)
+
+
+def test_row_shuffle_replay_pads_tail(scalar_dataset):
+    # 100 rows, batch 30, pad: replay must re-pad its tail with a mask
+    with make_jax_loader(scalar_dataset.url, batch_size=30, fields=['^id$'],
+                         shuffle_rows=True, last_batch='pad', seed=5,
+                         inmemory_cache_all=True) as loader:
+        list(loader)
+        replay = list(loader)
+    assert len(replay) == 4
+    seen = []
+    for b in replay:
+        mask = np.asarray(b['valid_mask'])
+        assert len(mask) == 30
+        seen.extend(np.asarray(b['id'])[mask].tolist())
+    assert sorted(seen) == list(range(100))
+    counts = sorted(int(np.asarray(b['valid_mask']).sum()) for b in replay)
+    assert counts == [10, 30, 30, 30]
+
+
+def test_row_shuffle_replay_of_empty_cache_is_empty(scalar_dataset):
+    # zero batches cached (drop + oversize batch): replay must stay empty,
+    # not IndexError building the row cache
+    with make_jax_loader(scalar_dataset.url, batch_size=512, fields=['^id$'],
+                         shuffle_rows=True, last_batch='drop',
+                         inmemory_cache_all=True) as loader:
+        assert list(loader) == []
+        assert list(loader) == []
+
+
+def test_stopped_iter_steps_raises_not_indexerror(scalar_dataset):
+    # a saved iter_steps cursor must not outlive stop(): resuming used to
+    # IndexError over the released cache instead of raising 'stopped'
+    loader = make_jax_loader(scalar_dataset.url, batch_size=20,
+                             fields=['^id$'], inmemory_cache_all=True)
+    list(loader.iter_steps(7))
+    loader.stop()
+    with pytest.raises(RuntimeError, match='stopped'):
+        list(loader.iter_steps(1))
+
+
+def test_live_replay_generator_sees_stop_as_runtimeerror(scalar_dataset):
+    # a generator the caller already holds must surface stop() as the
+    # 'stopped' RuntimeError, not IndexError/AttributeError over the
+    # released cache
+    for shuffle in (False, True):
+        loader = make_jax_loader(scalar_dataset.url, batch_size=10,
+                                 fields=['^id$'], shuffle_rows=shuffle,
+                                 inmemory_cache_all=True)
+        list(loader)                      # complete the first pass
+        g = iter(loader)                  # live replay generator
+        next(g)
+        loader.stop()
+        with pytest.raises(RuntimeError, match='stopped'):
+            next(g)
+
+
 def test_cached_batches_are_same_arrays(scalar_dataset):
     # replay must reuse the staged device arrays (no re-stage, no copy)
     with make_jax_loader(scalar_dataset.url, batch_size=20, fields=['^id$'],
@@ -104,7 +177,7 @@ def test_state_dict_raises_actionable(scalar_dataset):
             loader.state_dict()
 
 
-def test_empty_result_iter_steps_raises(tmp_path, scalar_dataset):
+def test_empty_result_iter_steps_raises(scalar_dataset):
     # batch_size larger than the dataset with 'drop': zero batches cached
     with make_jax_loader(scalar_dataset.url, batch_size=512, fields=['^id$'],
                          last_batch='drop',
