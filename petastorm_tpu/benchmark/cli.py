@@ -11,7 +11,16 @@ import sys
 def build_parser():
     parser = argparse.ArgumentParser(
         description='petastorm_tpu reader throughput benchmark')
-    parser.add_argument('dataset_url', help='file:// or remote dataset URL')
+    parser.add_argument('dataset_url', nargs='?', default=None,
+                        help='file:// or remote dataset URL (optional with '
+                             '--reader dummy)')
+    parser.add_argument('--reader', default='real',
+                        choices=['real', 'dummy'],
+                        help="'dummy' serves synthetic in-RAM data (no I/O, "
+                             'no decode): the framework-overhead upper '
+                             'bound to calibrate real numbers against')
+    parser.add_argument('--dummy-dim', type=int, default=64,
+                        help='row vector length for --reader dummy')
     parser.add_argument('--field-regex', nargs='+', default=None,
                         help='regex patterns selecting fields to read')
     parser.add_argument('-w', '--warmup-cycles', type=int, default=200)
@@ -32,9 +41,13 @@ def build_parser():
 
 
 def main(argv=None):
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
     if args.verbose:
         logging.basicConfig(level=logging.DEBUG)
+    if args.dataset_url is None and args.reader != 'dummy':
+        parser.error('dataset_url is required unless --reader dummy')
+    import numpy as np
     from petastorm_tpu.benchmark.throughput import reader_throughput
     result = reader_throughput(
         args.dataset_url, field_regex=args.field_regex,
@@ -42,7 +55,9 @@ def main(argv=None):
         pool_type=args.pool_type, loaders_count=args.loaders_count,
         read_method=args.read_method, batch_size=args.batch_size,
         shuffle_row_groups=not args.no_shuffle,
-        spawn_new_process=args.spawn_new_process)
+        spawn_new_process=args.spawn_new_process,
+        reader_type=args.reader,
+        dummy_fields={'test': ((args.dummy_dim,), np.float32)})
     print(result)
     return 0
 

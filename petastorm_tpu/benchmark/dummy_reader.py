@@ -1,0 +1,188 @@
+"""Synthetic calibration readers: the framework-overhead upper bound.
+
+Reader-shaped objects that serve pre-generated in-RAM data with zero I/O
+and zero decode cost (reference: ``petastorm/benchmark/dummy_reader.py:25-44``,
+whose ``DummyReader`` yields one cached numpy batch forever). Feeding one
+through the SAME consumers as a real reader — the throughput benchmark's
+measure loops, :func:`~petastorm_tpu.jax.make_jax_loader` — isolates the
+framework's own cost, so an end-to-end number decomposes::
+
+    sec/row(real) = sec/row(dummy)        # staging/re-batch/H2D machinery
+                  + I/O + decode          # the remainder
+
+Unlike the reference's (one frozen batch), a small pool of distinct random
+batches is cycled so downstream shuffling buffers and caches cannot
+degenerate to a single hot cache line; generation still happens once, at
+construction.
+"""
+
+import collections
+import itertools
+
+import numpy as np
+
+#: default synthetic schema, matching the reference's ``dim=64`` float32
+DEFAULT_FIELDS = {'test': ((64,), np.float32)}
+
+
+def _make_schema(fields):
+    from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+    import pyarrow as pa
+    out = []
+    for name, (shape, dtype) in fields.items():
+        dtype = np.dtype(dtype)
+        if shape == ():
+            codec = ScalarCodec(pa.from_numpy_dtype(dtype))
+        else:
+            codec = NdarrayCodec()
+        out.append(UnischemaField(name, dtype.type, shape, codec, False))
+    return Unischema('dummy', out)
+
+
+class DummyBatchReader:
+    """Batched reader over synthetic data; duck-type compatible with
+    ``make_batch_reader`` consumers (iteration, ``stop``/``join``/``reset``,
+    ``schema``, ``diagnostics``).
+
+    :param fields: ``{name: (row_shape, dtype)}`` (default: one 64-float32
+        vector field, the reference's shape).
+    :param batch_size: rows per served batch.
+    :param num_batches: batches per epoch, or None for an endless stream.
+    :param distinct_batches: size of the pre-generated pool that is cycled.
+    """
+
+    batched_output = True
+
+    def __init__(self, fields=None, batch_size=1000, num_batches=None,
+                 distinct_batches=8, seed=0):
+        self._fields = dict(fields or DEFAULT_FIELDS)
+        self._batch_size = batch_size
+        self._num_batches = num_batches
+        self._schema = _make_schema(self._fields)
+        self._row_type = collections.namedtuple(  # noqa: PYI024 - data row
+            'dummy_batch', list(self._fields))
+        rng = np.random.RandomState(seed)
+        self._pool = [self._row_type(**{
+            name: rng.uniform(size=(batch_size,) + tuple(shape))
+                     .astype(dtype, copy=False)
+            for name, (shape, dtype) in self._fields.items()})
+            for _ in range(distinct_batches)]
+        self._served = 0
+        self._stopped = False
+
+    # -- reader surface ------------------------------------------------------
+
+    @property
+    def schema(self):
+        return self._schema
+
+    @property
+    def batch_size(self):
+        return self._batch_size
+
+    @property
+    def diagnostics(self):
+        return {'dummy_batches_served': self._served}
+
+    @property
+    def last_row_consumed(self):
+        return (self._num_batches is not None
+                and self._served >= self._num_batches)
+
+    def __iter__(self):
+        source = (itertools.cycle(self._pool) if self._num_batches is None
+                  else itertools.islice(itertools.cycle(self._pool),
+                                        self._num_batches - self._served))
+        for batch in source:
+            if self._stopped:
+                return
+            self._served += 1
+            yield batch
+
+    def __next__(self):
+        if self._iter is None:
+            self._iter = iter(self)
+        return next(self._iter)
+
+    _iter = None
+
+    def reset(self):
+        self._served = 0
+        self._iter = None
+
+    def stop(self):
+        self._stopped = True
+
+    def join(self):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.stop()
+
+
+class DummyRowReader:
+    """Row-at-a-time flavor for ``make_reader``-style consumers: the same
+    synthetic pool, served as per-row namedtuples."""
+
+    batched_output = False
+
+    def __init__(self, fields=None, num_rows=None, distinct_batches=8,
+                 seed=0, batch_size=1000):
+        self._batched = DummyBatchReader(fields=fields, batch_size=batch_size,
+                                         distinct_batches=distinct_batches,
+                                         seed=seed)
+        self._num_rows = num_rows
+        self._row_type = self._batched._row_type
+        self._served = 0
+        self._stopped = False
+
+    @property
+    def schema(self):
+        return self._batched.schema
+
+    @property
+    def diagnostics(self):
+        return {'dummy_rows_served': self._served}
+
+    @property
+    def last_row_consumed(self):
+        return self._num_rows is not None and self._served >= self._num_rows
+
+    def __iter__(self):
+        for batch in self._batched:
+            n = len(batch[0])
+            for i in range(n):
+                if self._stopped or (self._num_rows is not None
+                                     and self._served >= self._num_rows):
+                    return
+                self._served += 1
+                yield self._row_type(*(col[i] for col in batch))
+
+    def __next__(self):
+        if self._iter is None:
+            self._iter = iter(self)
+        return next(self._iter)
+
+    _iter = None
+
+    def reset(self):
+        self._batched.reset()
+        self._served = 0
+        self._iter = None
+
+    def stop(self):
+        self._stopped = True
+        self._batched.stop()
+
+    def join(self):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.stop()

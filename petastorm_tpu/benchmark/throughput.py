@@ -33,7 +33,8 @@ def reader_throughput(dataset_url, field_regex=None, warmup_cycles=200,
                       measure_cycles=1000, pool_type='thread',
                       loaders_count=None, read_method='python',
                       shuffle_row_groups=True, batch_size=128,
-                      spawn_new_process=False):
+                      spawn_new_process=False, reader_type='real',
+                      dummy_fields=None):
     """Measure read throughput of a dataset.
 
     :param read_method: ``'python'`` — rows via ``make_reader`` (the
@@ -43,30 +44,46 @@ def reader_throughput(dataset_url, field_regex=None, warmup_cycles=200,
         :func:`~petastorm_tpu.jax.make_jax_loader`.
     :param spawn_new_process: re-run the measurement in a fresh process for
         clean RSS numbers (reference: ``throughput.py:144-149``).
+    :param reader_type: ``'real'`` reads ``dataset_url``; ``'dummy'``
+        substitutes a zero-I/O zero-decode synthetic reader
+        (:mod:`~petastorm_tpu.benchmark.dummy_reader`) so the result is the
+        framework-machinery upper bound — the real/dummy delta is the
+        I/O+decode cost. ``dataset_url`` is ignored under ``'dummy'``.
+    :param dummy_fields: ``{name: (row_shape, dtype)}`` for the synthetic
+        reader (default: one 64-float32 vector field).
     """
+    if reader_type not in ('real', 'dummy'):
+        raise ValueError("reader_type must be 'real' or 'dummy'; got %r"
+                         % (reader_type,))
     if spawn_new_process:
         return _run_in_subprocess(
             dataset_url, field_regex=field_regex, warmup_cycles=warmup_cycles,
             measure_cycles=measure_cycles, pool_type=pool_type,
             loaders_count=loaders_count, read_method=read_method,
-            shuffle_row_groups=shuffle_row_groups, batch_size=batch_size)
+            shuffle_row_groups=shuffle_row_groups, batch_size=batch_size,
+            reader_type=reader_type, dummy_fields=dummy_fields)
 
     import psutil
     process = psutil.Process()
     process.cpu_percent()  # prime the sampler
 
+    dummy = dummy_fields if reader_type == 'dummy' else None
     if read_method == 'python':
         counter = _measure_rows(dataset_url, field_regex, warmup_cycles,
                                 measure_cycles, pool_type, loaders_count,
-                                shuffle_row_groups)
+                                shuffle_row_groups,
+                                dummy=dummy, use_dummy=reader_type == 'dummy')
     elif read_method == 'batch':
         counter = _measure_batches(dataset_url, field_regex, warmup_cycles,
                                    measure_cycles, pool_type, loaders_count,
-                                   shuffle_row_groups)
+                                   shuffle_row_groups,
+                                   dummy=dummy,
+                                   use_dummy=reader_type == 'dummy')
     elif read_method == 'jax':
         counter = _measure_jax(dataset_url, field_regex, warmup_cycles,
                                measure_cycles, shuffle_row_groups, batch_size,
-                               loaders_count)
+                               loaders_count,
+                               dummy=dummy, use_dummy=reader_type == 'dummy')
     else:
         raise ValueError("read_method must be 'python', 'batch' or 'jax'; "
                          'got %r' % read_method)
@@ -81,11 +98,17 @@ def reader_throughput(dataset_url, field_regex=None, warmup_cycles=200,
 
 
 def _measure_rows(url, field_regex, warmup, measure, pool_type, workers,
-                  shuffle):
-    from petastorm_tpu.reader import make_reader
-    with make_reader(url, schema_fields=field_regex, num_epochs=None,
-                     reader_pool_type=pool_type, workers_count=workers,
-                     shuffle_row_groups=shuffle) as reader:
+                  shuffle, dummy=None, use_dummy=False):
+    if use_dummy:
+        from petastorm_tpu.benchmark.dummy_reader import DummyRowReader
+        reader_cm = DummyRowReader(fields=dummy)
+    else:
+        from petastorm_tpu.reader import make_reader
+        reader_cm = make_reader(url, schema_fields=field_regex,
+                                num_epochs=None, reader_pool_type=pool_type,
+                                workers_count=workers,
+                                shuffle_row_groups=shuffle)
+    with reader_cm as reader:
         for _ in range(warmup):
             next(reader)
         start = time.monotonic()
@@ -95,11 +118,18 @@ def _measure_rows(url, field_regex, warmup, measure, pool_type, workers,
 
 
 def _measure_batches(url, field_regex, warmup, measure, pool_type, workers,
-                     shuffle):
-    from petastorm_tpu.reader import make_batch_reader
-    with make_batch_reader(url, schema_fields=field_regex, num_epochs=None,
-                           reader_pool_type=pool_type, workers_count=workers,
-                           shuffle_row_groups=shuffle) as reader:
+                     shuffle, dummy=None, use_dummy=False):
+    if use_dummy:
+        from petastorm_tpu.benchmark.dummy_reader import DummyBatchReader
+        reader_cm = DummyBatchReader(fields=dummy)
+    else:
+        from petastorm_tpu.reader import make_batch_reader
+        reader_cm = make_batch_reader(url, schema_fields=field_regex,
+                                      num_epochs=None,
+                                      reader_pool_type=pool_type,
+                                      workers_count=workers,
+                                      shuffle_row_groups=shuffle)
+    with reader_cm as reader:
         seen = 0
         for batch in reader:
             seen += len(next(iter(batch._asdict().values())))
@@ -115,11 +145,21 @@ def _measure_batches(url, field_regex, warmup, measure, pool_type, workers,
 
 
 def _measure_jax(url, field_regex, warmup, measure, shuffle, batch_size,
-                 workers):
+                 workers, dummy=None, use_dummy=False):
     from petastorm_tpu.jax import make_jax_loader
+    kwargs = {}
+    if use_dummy:
+        from petastorm_tpu.benchmark.dummy_reader import DummyBatchReader
+
+        def factory(_url, schema_fields=None, num_epochs=None, **_kw):
+            return DummyBatchReader(fields=dummy)
+
+        kwargs['reader_factory'] = factory
+    else:
+        kwargs['workers_count'] = workers
+        kwargs['shuffle_row_groups'] = shuffle
     with make_jax_loader(url, batch_size=batch_size, fields=field_regex,
-                         num_epochs=None, workers_count=workers,
-                         shuffle_row_groups=shuffle) as loader:
+                         num_epochs=None, **kwargs) as loader:
         it = iter(loader)
         seen = 0
         while seen < warmup:
@@ -151,7 +191,9 @@ def _run_in_subprocess(dataset_url, **kwargs):
             tempfile.NamedTemporaryFile(suffix='.pkl') as out_f:
         pickle.dump(kwargs, kw_f)
         kw_f.flush()
-        subprocess.check_call([sys.executable, '-c', code, dataset_url,
+        # dataset_url may be None under reader_type='dummy' (ignored by the
+        # measurement); argv entries must still be strings
+        subprocess.check_call([sys.executable, '-c', code, dataset_url or '',
                                kw_f.name, out_f.name])
         with open(out_f.name, 'rb') as result_f:
             return pickle.load(result_f)

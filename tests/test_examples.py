@@ -29,6 +29,7 @@ def external_url(tmp_path_factory):
 
 
 class TestMnistExamples:
+    @pytest.mark.slow
     def test_pytorch_example_trains(self, mnist_url):
         from examples.mnist.pytorch_example import train
         loss = train(mnist_url, batch_size=64, epochs=1, log_interval=1000)
@@ -39,6 +40,7 @@ class TestMnistExamples:
         accuracy = evaluate(mnist_url, Net(), batch_size=64)
         assert 0.0 <= accuracy <= 1.0
 
+    @pytest.mark.slow
     def test_tf_example_trains(self, mnist_url):
         from examples.mnist.tf_example import train
         loss = train(mnist_url, batch_size=64, steps_per_epoch=4)
@@ -120,6 +122,7 @@ class TestLmExample:
         assert len(flat) == len(stream) // 32 * 32
         assert np.array_equal(flat, stream[:len(flat)])
 
+    @pytest.mark.slow
     def test_pretrain_learns(self, tmp_path):
         from examples.lm.pretrain_example import generate_c4_like, pretrain
         url = 'file://' + str(tmp_path / 'c4')
@@ -127,6 +130,7 @@ class TestLmExample:
         loss = pretrain(url, batch_size=8, steps=6)
         assert np.isfinite(loss)
 
+    @pytest.mark.slow
     def test_pretrain_checkpoint_resume(self, tmp_path):
         # interrupt after 8 of 12 steps, rerun: training resumes from the
         # checkpoint (model + data position together), ending with 12 total
@@ -146,6 +150,7 @@ class TestLmExample:
         assert pretrain(url, batch_size=8, steps=12,
                         checkpoint_dir=ckpt_dir) is None
 
+    @pytest.mark.slow
     def test_long_context_seq_parallel_pretrain(self, tmp_path):
         # the full long-context path: packed rows → data x seq mesh → ring
         # attention inside the train step (tiny shapes for CI speed)

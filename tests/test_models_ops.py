@@ -54,6 +54,7 @@ class TestNormalizeOp:
 
 
 class TestTransformer:
+    @pytest.mark.slow
     def test_forward_shapes(self):
         from petastorm_tpu.models.transformer import (
             TransformerConfig, init_transformer_params, transformer_forward,
@@ -66,6 +67,7 @@ class TestTransformer:
         assert logits.shape == (2, 8, 32)
         assert logits.dtype == jnp.float32
 
+    @pytest.mark.slow
     def test_train_step_reduces_loss_on_memorizable_batch(self):
         from petastorm_tpu.models.transformer import (
             TransformerConfig, init_transformer_params, transformer_train_step,
@@ -85,6 +87,7 @@ class TestTransformer:
             first = float(loss) if first is None else first
         assert float(loss) < first
 
+    @pytest.mark.slow
     def test_sharded_train_step_on_mesh(self):
         from jax.sharding import NamedSharding, PartitionSpec
         from petastorm_tpu.models.transformer import (
@@ -113,6 +116,7 @@ class TestTransformer:
 
 
 class TestMoETransformer:
+    @pytest.mark.slow
     def test_moe_train_step_on_data_expert_mesh(self):
         # full expert-parallel train step: experts sharded over 'expert',
         # batch over 'data'; loss finite and expert weights stay sharded
@@ -141,6 +145,7 @@ class TestMoETransformer:
         assert params2['blocks'][0]['moe']['w_in'].sharding.spec[0] == \
             'expert'
 
+    @pytest.mark.slow
     def test_moe_model_learns(self):
         from petastorm_tpu.models.transformer import (
             TransformerConfig, init_transformer_params, transformer_train_step,
@@ -210,6 +215,7 @@ class TestSequenceParallelTransformer:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    atol=2e-4, rtol=2e-4)
 
+    @pytest.mark.slow
     def test_seq_parallel_train_step_on_data_seq_mesh(self):
         # combined dp x sp: batch sharded over 'data', sequence over 'seq'
         from jax.sharding import NamedSharding, PartitionSpec
@@ -253,6 +259,7 @@ class TestSequenceParallelTransformer:
 
 
 class TestMnist:
+    @pytest.mark.slow
     def test_train_step_learns(self, synthetic_dataset):
         """End-to-end: Parquet images → JaxLoader → CNN step (tiny)."""
         from petastorm_tpu.jax import make_jax_loader
@@ -297,6 +304,7 @@ class TestGraftEntry:
         out = jax.jit(fn)(*args)
         assert out.shape == (8, 10)
 
+    @pytest.mark.slow
     def test_dryrun_multichip(self, capsys):
         import __graft_entry__ as g
         g.dryrun_multichip(8)

@@ -45,6 +45,67 @@ class TestThroughput:
         assert 'samples/sec' in capsys.readouterr().out
 
 
+class TestDummyReader:
+    """Calibration mode: synthetic zero-I/O readers through the same
+    measurement paths (reference: ``petastorm/benchmark/dummy_reader.py``)."""
+
+    def test_dummy_batch_reader_serves_schema_shaped_batches(self):
+        from petastorm_tpu.benchmark.dummy_reader import DummyBatchReader
+        with DummyBatchReader(batch_size=32, num_batches=3) as reader:
+            batches = list(reader)
+        assert len(batches) == 3
+        assert batches[0].test.shape == (32, 64)
+        assert batches[0].test.dtype == np.float32
+        assert reader.schema.test.name == 'test'
+        assert reader.last_row_consumed
+
+    def test_dummy_row_reader_bounded(self):
+        from petastorm_tpu.benchmark.dummy_reader import DummyRowReader
+        with DummyRowReader(num_rows=10) as reader:
+            rows = list(reader)
+        assert len(rows) == 10
+        assert rows[0].test.shape == (64,)
+
+    def test_dummy_python_mode(self):
+        result = reader_throughput(None, warmup_cycles=5, measure_cycles=20,
+                                   reader_type='dummy')
+        assert result.samples == 20
+        assert result.samples_per_second > 0
+
+    def test_dummy_batch_mode(self):
+        result = reader_throughput(None, warmup_cycles=10, measure_cycles=50,
+                                   read_method='batch', reader_type='dummy')
+        assert result.samples >= 50
+
+    def test_dummy_jax_mode_is_framework_upper_bound(self):
+        result = reader_throughput(None, warmup_cycles=8, measure_cycles=64,
+                                   read_method='jax', batch_size=8,
+                                   reader_type='dummy')
+        assert result.samples >= 64
+        assert result.samples_per_second > 0
+
+    def test_dummy_cycles_distinct_batches(self):
+        from petastorm_tpu.benchmark.dummy_reader import DummyBatchReader
+        with DummyBatchReader(batch_size=4, num_batches=4,
+                              distinct_batches=2) as reader:
+            batches = list(reader)
+        assert np.array_equal(batches[0].test, batches[2].test)
+        assert not np.array_equal(batches[0].test, batches[1].test)
+
+    def test_dummy_spawn_new_process(self):
+        # the documented --reader dummy mode has no URL; the clean-RSS
+        # subprocess path must tolerate dataset_url=None
+        result = reader_throughput(None, warmup_cycles=2, measure_cycles=10,
+                                   reader_type='dummy',
+                                   spawn_new_process=True)
+        assert result.samples == 10
+
+    def test_cli_dummy_mode_needs_no_url(self, capsys):
+        from petastorm_tpu.benchmark.cli import main
+        assert main(['--reader', 'dummy', '-w', '5', '-m', '10']) == 0
+        assert 'samples/sec' in capsys.readouterr().out
+
+
 class TestCopyDataset:
     def test_full_copy(self, synthetic_dataset, tmp_path):
         target = 'file://' + str(tmp_path / 'copy')
