@@ -1,13 +1,20 @@
-"""HDFS high-availability namenode resolution and failover.
+"""HDFS high-availability namenode resolution and runtime failover.
 
 Re-design of ``petastorm/hdfs/namenode.py`` on top of fsspec/pyarrow's HDFS
 driver: the reference hand-wrapped libhdfs/libhdfs3 clients and decorated
-every filesystem method with failover (``namenode.py:146-239``); here HA is
-resolved **up front** — a logical nameservice from ``hdfs-site.xml`` is
-expanded to its namenode list and connection attempts round-robin through
-them — and the returned filesystem is a plain fsspec filesystem. (Per-call
-RPC failover after a connection is established is the Hadoop client
-library's own job.)
+every filesystem method with failover (``namenode.py:146-239``); here HA
+works in two stages:
+
+* **connect time** — a logical nameservice from ``hdfs-site.xml`` is
+  expanded to its namenode list and connection attempts round-robin
+  through them (:class:`HdfsConnector`);
+* **runtime** — for HA nameservices the returned filesystem is an
+  :class:`HAHdfsFilesystem` proxy: any filesystem method that raises an
+  I/O error mid-use reconnects to the next namenode (max
+  ``MAX_FAILOVER_ATTEMPTS`` failovers per call, mirroring the reference's
+  ``namenode.py:146-239`` retry decorator) and retries. The proxy is
+  picklable (``namenode.py:231`` ``__reduce__`` parity), reconnecting on
+  unpickle, so it rides the process-pool spawn boundary.
 
 Configuration source: an explicit dict (e.g. from a Spark
 ``HadoopConfiguration``) or the site XMLs under ``$HADOOP_HOME`` /
@@ -15,6 +22,7 @@ Configuration source: an explicit dict (e.g. from a Spark
 (``namenode.py:44-57``).
 """
 
+import functools
 import logging
 import os
 import xml.etree.ElementTree as ET
@@ -23,6 +31,9 @@ logger = logging.getLogger(__name__)
 
 _HADOOP_ENV_VARS = ('HADOOP_HOME', 'HADOOP_PREFIX', 'HADOOP_INSTALL')
 MAX_NAMENODE_ATTEMPTS = 2
+#: reconnect-to-next-namenode retries per filesystem call (reference:
+#: ``petastorm/hdfs/namenode.py:40`` MAX_FAILOVER_ATTEMPTS)
+MAX_FAILOVER_ATTEMPTS = 2
 
 
 class HdfsConnectError(RuntimeError):
@@ -100,6 +111,25 @@ class HdfsNamenodeResolver:
         return nameservice, namenodes
 
 
+def _connect_first_alive(candidates, storage_options, connect_fn):
+    """Try ``(index, address)`` candidates in order; return
+    ``(filesystem, index)`` for the first that connects, else raise
+    :class:`HdfsConnectError` listing every attempt."""
+    errors = []
+    for index, address in candidates:
+        host, _, port = address.partition(':')
+        try:
+            fs = connect_fn(host, int(port) if port else 8020,
+                            storage_options)
+            return fs, index
+        except Exception as e:  # noqa: BLE001 - try the next namenode
+            logger.warning('Failed to connect to namenode %s: %s', address, e)
+            errors.append('%s: %s' % (address, e))
+    raise HdfsConnectError(
+        'Could not connect to any namenode of %s; attempts: %s'
+        % ([address for _, address in candidates], errors))
+
+
 class HdfsConnector:
     """Round-robin connection attempts over resolved namenodes
     (reference: ``namenode.py:241-319``)."""
@@ -115,30 +145,116 @@ class HdfsConnector:
                 max_attempts=MAX_NAMENODE_ATTEMPTS, connect_fn=None):
         """First namenode that accepts a connection wins; each candidate is
         tried at most once, up to ``max_attempts`` candidates."""
-        connect_fn = connect_fn or cls._connect_one
-        errors = []
-        for address in namenodes[:max_attempts]:
-            host, _, port = address.partition(':')
+        fs, _ = _connect_first_alive(
+            list(enumerate(namenodes))[:max_attempts], storage_options,
+            connect_fn or cls._connect_one)
+        return fs
+
+
+class HAHdfsFilesystem:
+    """Failover proxy over an fsspec filesystem: reconnect + retry on I/O
+    errors, rotating through the nameservice's namenodes.
+
+    Every attribute access delegates to the live filesystem; calling a
+    proxied method that raises an :class:`OSError` (other than
+    :class:`FileNotFoundError` — a missing path is an answer, not an
+    outage) reconnects to the NEXT namenode and retries the call, up to
+    ``max_failovers`` reconnects per call. This is the fsspec-shaped
+    equivalent of the reference's per-method failover decoration of its
+    hand-rolled HDFS client (``petastorm/hdfs/namenode.py:146-239``).
+
+    Picklable like the reference's ``HAHdfsClient`` (``namenode.py:231``):
+    unpickling reconnects from the namenode list, so the proxy crosses the
+    process-pool spawn boundary inside :class:`ParquetDatasetInfo`. A
+    custom ``connect_fn`` is not pickled — reconstruction uses the default
+    fsspec connector.
+
+    File handles returned by ``open()`` bind the connection that created
+    them: a handle that starts failing is not retried (re-``open`` from
+    the caller, as the readers do per row-group), but the next ``open``
+    fails over.
+    """
+
+    def __init__(self, namenodes, storage_options=None,
+                 max_failovers=MAX_FAILOVER_ATTEMPTS, connect_fn=None):
+        if not namenodes:
+            raise ValueError('HAHdfsFilesystem needs at least one namenode')
+        self._namenodes = list(namenodes)
+        self._storage_options = storage_options
+        self._max_failovers = max_failovers
+        self._connect_fn = connect_fn or HdfsConnector._connect_one
+        self._active = 0
+        self._fs = None
+        self._connect(initial=True)
+
+    # -- connection management ----------------------------------------------
+
+    def _connect(self, initial=False):
+        """Connect to the next live namenode, starting at ``self._active``;
+        every namenode is tried once before giving up."""
+        n = len(self._namenodes)
+        rotation = [((self._active + offset) % n,
+                     self._namenodes[(self._active + offset) % n])
+                    for offset in range(n)]
+        self._fs, self._active = _connect_first_alive(
+            rotation, self._storage_options, self._connect_fn)
+        if not initial:
+            logger.warning('HDFS failover: now connected to namenode %s',
+                           self._namenodes[self._active])
+
+    def _failover(self):
+        self._active = (self._active + 1) % len(self._namenodes)
+        self._connect()
+
+    # -- proxying ------------------------------------------------------------
+
+    def _call_with_failover(self, name, *args, **kwargs):
+        failovers = 0
+        while True:
             try:
-                return connect_fn(host, int(port) if port else 8020,
-                                  storage_options)
-            except Exception as e:  # noqa: BLE001 - try the next namenode
-                logger.warning('Failed to connect to namenode %s: %s',
-                               address, e)
-                errors.append('%s: %s' % (address, e))
-        raise HdfsConnectError(
-            'Could not connect to any namenode of %s; attempts: %s'
-            % (namenodes, errors))
+                return getattr(self._fs, name)(*args, **kwargs)
+            except FileNotFoundError:
+                raise
+            except OSError as e:
+                if failovers >= self._max_failovers:
+                    raise
+                failovers += 1
+                logger.warning(
+                    'HDFS %s() failed on namenode %s (%s); failing over '
+                    '(%d/%d)', name, self._namenodes[self._active], e,
+                    failovers, self._max_failovers)
+                self._failover()
+
+    def __getattr__(self, name):
+        if name.startswith('_'):
+            raise AttributeError(name)
+        value = getattr(self._fs, name)
+        if callable(value):
+            return functools.partial(self._call_with_failover, name)
+        return value
+
+    def __reduce__(self):
+        return (type(self), (self._namenodes, self._storage_options,
+                             self._max_failovers))
+
+    def __repr__(self):
+        return ('HAHdfsFilesystem(namenodes=%r, active=%r)'
+                % (self._namenodes, self._namenodes[self._active]))
 
 
 def connect_hdfs_url(url, hadoop_configuration=None, storage_options=None,
-                     connect_fn=None):
+                     connect_fn=None, max_failovers=MAX_FAILOVER_ATTEMPTS):
     """(fs, path) for an ``hdfs://`` URL, expanding HA nameservices.
 
     * ``hdfs:///path`` → ``fs.defaultFS`` nameservice.
     * ``hdfs://nameservice/path`` (no port) → nameservice lookup, falling
       back to treating the netloc as a plain ``host``.
     * ``hdfs://host:port/path`` → direct connection.
+
+    Multi-namenode resolutions (a real HA nameservice) return an
+    :class:`HAHdfsFilesystem` with runtime failover; single-address URLs
+    return the plain filesystem, matching the reference's
+    HA-clients-only failover scope.
     """
     from urllib.parse import urlparse
     parsed = urlparse(url)
@@ -150,6 +266,11 @@ def connect_hdfs_url(url, hadoop_configuration=None, storage_options=None,
     else:
         namenodes = (resolver.resolve_hdfs_name_service(parsed.netloc)
                      or [parsed.netloc + ':8020'])
-    fs = HdfsConnector.connect(namenodes, storage_options,
-                               connect_fn=connect_fn)
+    if len(namenodes) > 1:
+        fs = HAHdfsFilesystem(namenodes, storage_options,
+                              max_failovers=max_failovers,
+                              connect_fn=connect_fn)
+    else:
+        fs = HdfsConnector.connect(namenodes, storage_options,
+                                   connect_fn=connect_fn)
     return fs, parsed.path

@@ -2,11 +2,13 @@
 HDFS (reference strategy: ``petastorm/hdfs/tests/test_hdfs_namenode.py``)."""
 
 import os
+import pickle
 
 import pytest
 
 from petastorm_tpu.hdfs import (
-    HdfsConnectError, HdfsConnector, HdfsNamenodeResolver, connect_hdfs_url,
+    HAHdfsFilesystem, HdfsConnectError, HdfsConnector, HdfsNamenodeResolver,
+    connect_hdfs_url,
 )
 
 HC = {
@@ -92,6 +94,147 @@ class TestConnector:
         with pytest.raises(HdfsConnectError):
             HdfsConnector.connect(['a:1', 'b:2', 'c:3'],
                                   connect_fn=_connector_fn({'a', 'b'}))
+
+
+class _FlakyFS:
+    """Filesystem stand-in that starts raising I/O errors after
+    ``healthy_calls`` successful method calls (a namenode dying mid-use)."""
+
+    def __init__(self, host, healthy_calls=0, exc=OSError):
+        self.host = host
+        self._budget = healthy_calls
+        self._exc = exc
+
+    def ls(self, path):
+        if self._budget <= 0:
+            raise self._exc('namenode %s is down' % self.host)
+        self._budget -= 1
+        return ['%s:%s' % (self.host, path)]
+
+
+def _flaky_connector(budgets):
+    """connect_fn whose fs for each host has a limited healthy-call budget
+    (None = always healthy)."""
+    def connect(host, port, storage_options):
+        budget = budgets.get(host)
+        return _FlakyFS(host, float('inf') if budget is None else budget)
+    return connect
+
+
+class TestRuntimeFailover:
+    """Established-connection failover (reference:
+    ``petastorm/hdfs/namenode.py:146-239``): a live filesystem starts
+    raising I/O errors and calls transparently move to the next namenode."""
+
+    def test_midstream_error_fails_over(self):
+        fs = HAHdfsFilesystem(['a:1', 'b:2'],
+                              connect_fn=_flaky_connector({'a': 2, 'b': None}))
+        assert fs.ls('/x') == ['a:/x']
+        assert fs.ls('/y') == ['a:/y']
+        # namenode a is now dead: the same call must answer from b
+        assert fs.ls('/z') == ['b:/z']
+        assert 'active=\'b:2\'' in repr(fs)
+
+    def test_rotation_wraps_and_comes_back(self):
+        # a dies; after b also dies the rotation returns to a (recovered)
+        budgets = {'a': 1, 'b': 1}
+        connects = []
+
+        def connect(host, port, storage_options):
+            connects.append(host)
+            healthy = float('inf') if len(connects) > 3 else budgets[host]
+            return _FlakyFS(host, healthy)
+
+        fs = HAHdfsFilesystem(['a:1', 'b:2'], connect_fn=connect)
+        assert fs.ls('/1') == ['a:/1']
+        assert fs.ls('/2') == ['b:/2']   # a dead -> b
+        assert fs.ls('/3') == ['a:/3']   # b dead -> back to a (reconnected)
+
+    def test_file_not_found_is_not_retried(self):
+        calls = []
+
+        class _FS:
+            def info(self, path):
+                calls.append(path)
+                raise FileNotFoundError(path)
+
+        fs = HAHdfsFilesystem(['a:1', 'b:2'],
+                              connect_fn=lambda *a: _FS())
+        with pytest.raises(FileNotFoundError):
+            fs.info('/missing')
+        assert calls == ['/missing']  # one attempt, no failover
+
+    def test_failover_budget_exhausted_reraises(self):
+        fs = HAHdfsFilesystem(['a:1', 'b:2'], max_failovers=2,
+                              connect_fn=_flaky_connector({'a': 0, 'b': 0}))
+        with pytest.raises(OSError, match='down'):
+            fs.ls('/x')
+
+    def test_non_callable_attributes_pass_through(self):
+        fs = HAHdfsFilesystem(['a:1'],
+                              connect_fn=_flaky_connector({'a': None}))
+        assert fs.host == 'a'
+
+    def test_pickle_reconnects(self, monkeypatch):
+        # the reference's HAHdfsClient is picklable via __reduce__
+        # (namenode.py:231); ours reconnects from the namenode list on
+        # unpickle (the custom connect_fn is intentionally not carried)
+        monkeypatch.setattr(
+            HdfsConnector, '_connect_one',
+            staticmethod(_flaky_connector({'a': None, 'b': None})))
+        fs = HAHdfsFilesystem(['a:1', 'b:2'])
+        clone = pickle.loads(pickle.dumps(fs))
+        assert clone.ls('/x') == ['a:/x']
+        assert clone._max_failovers == fs._max_failovers
+
+    def test_reader_completes_epoch_across_failover(self, scalar_dataset,
+                                                    monkeypatch, tmp_path):
+        """The VERDICT-prescribed fault injection: a reader mid-epoch on a
+        connected fs that starts raising I/O errors must fail over and
+        finish the epoch."""
+        import fsspec
+
+        from petastorm_tpu.reader import make_batch_reader
+
+        local = fsspec.filesystem('file')
+        root = scalar_dataset.url[len('file://'):]
+
+        class _DyingLocal:
+            """Local fs that permanently dies after `budget` open() calls."""
+
+            def __init__(self, budget):
+                self._budget = budget
+
+            def __getattr__(self, name):
+                attr = getattr(local, name)
+                if name == 'open' and callable(attr):
+                    def flaky_open(*args, **kwargs):
+                        if self._budget <= 0:
+                            raise OSError('namenode nn-a lost')
+                        self._budget -= 1
+                        return attr(*args, **kwargs)
+                    return flaky_open
+                return attr
+
+        def connect(host, port, storage_options):
+            # nn-a survives the metadata reads + first row-group, then dies
+            return _DyingLocal(4) if host == 'nn-a' else local
+
+        proxy = HAHdfsFilesystem(['nn-a:8020', 'nn-b:8020'],
+                                 connect_fn=connect)
+        monkeypatch.setattr(
+            'petastorm_tpu.etl.dataset_metadata.'
+            'get_filesystem_and_path_or_paths',
+            lambda url, storage_options=None: (proxy, root))
+
+        with make_batch_reader('hdfs://myns' + root,
+                               shuffle_row_groups=False) as reader:
+            ids = []
+            for batch in reader:
+                ids.extend(batch.id.tolist())
+        assert sorted(ids) == list(range(100))
+        # the epoch finished on the standby namenode
+        assert proxy._namenodes[proxy._active] == 'nn-b:8020'
 
 
 class TestConnectUrl:
