@@ -2,6 +2,7 @@
 (reference: ``petastorm/spark/``)."""
 
 from petastorm_tpu.spark.spark_dataset_converter import (  # noqa: F401
-    DatasetConverter, SparkDatasetConverter, make_dataframe_converter,
-    make_spark_converter,
+    DatasetConverter, SparkDatasetConverter, check_dataset_file_median_size,
+    make_dataframe_converter, make_spark_converter, spark_unify_float_precision,
+    spark_vectors_to_arrays, wait_file_available,
 )

@@ -23,6 +23,7 @@ import hashlib
 import logging
 import os
 import threading
+import time
 import uuid
 
 logger = logging.getLogger(__name__)
@@ -32,6 +33,83 @@ _CACHE_LOCK = threading.Lock()
 
 #: Spark conf key for the parent cache dir (reference: ``:170``)
 PARENT_CACHE_DIR_URL_CONF = 'petastorm.spark.converter.parentCacheDirUrl'
+
+#: eventual-consistency wait bound (reference: ``:595``)
+FILE_AVAILABILITY_WAIT_TIMEOUT_S = 30
+#: files below this median trigger the repartition advisory (``:624-627``)
+RECOMMENDED_FILE_SIZE_BYTES = 50 * 1024 * 1024
+
+
+def wait_file_available(url_or_path_list, fs=None, timeout_s=None,
+                        poll_interval_s=0.1):
+    """Block until every materialized file is visible, or raise.
+
+    Guards readers against eventually-consistent stores (S3-style) where a
+    just-written object may not list/stat yet (reference
+    ``spark_dataset_converter.py:595-621``). Paths are polled concurrently;
+    a file still absent after ``timeout_s`` raises :class:`RuntimeError`
+    naming the stragglers.
+
+    :param fs: optional fsspec filesystem; resolved from the URLs when
+        omitted (injectable for tests and for pre-resolved callers).
+    :param timeout_s: wait bound; defaults to the module's
+        ``FILE_AVAILABILITY_WAIT_TIMEOUT_S`` read at call time.
+    """
+    from concurrent.futures import ThreadPoolExecutor
+    if timeout_s is None:
+        timeout_s = FILE_AVAILABILITY_WAIT_TIMEOUT_S
+    urls = list(url_or_path_list)
+    if not urls:
+        return
+    if fs is None:
+        from petastorm_tpu.fs import get_filesystem_and_path_or_paths
+        fs, paths = get_filesystem_and_path_or_paths(urls)
+    else:
+        paths = urls
+
+    def _wait(path):
+        deadline = time.monotonic() + timeout_s
+        while True:
+            if fs.exists(path):
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(poll_interval_s)
+
+    with ThreadPoolExecutor(max_workers=min(64, len(paths))) as pool:
+        results = list(pool.map(_wait, paths))
+    failed = [u for u, ok in zip(urls, results) if not ok]
+    if failed:
+        raise RuntimeError(
+            'Timeout while waiting for materialized files to appear: %s. '
+            'Check that the dataframe write succeeded.' % ', '.join(failed))
+
+
+def check_dataset_file_median_size(url_or_path_list, fs=None):
+    """Advise on under-sized Parquet files; returns the median byte size.
+
+    A median part-file below ~50 MB wastes reader parallelism on open/footer
+    overhead (reference ``spark_dataset_converter.py:624-640``, which only
+    checked local paths; fsspec ``size`` makes this store-agnostic). The
+    advisory is a warning, never an error.
+    """
+    urls = list(url_or_path_list)
+    if len(urls) < 2:
+        return None
+    if fs is None:
+        from petastorm_tpu.fs import get_filesystem_and_path_or_paths
+        fs, paths = get_filesystem_and_path_or_paths(urls)
+    else:
+        paths = urls
+    sizes = sorted(fs.size(p) for p in paths)
+    median = sizes[len(sizes) // 2]  # the larger one on a tie
+    if median < RECOMMENDED_FILE_SIZE_BYTES:
+        logger.warning(
+            'The median parquet file size %d B (< 50 MB) is small; total '
+            '%d B over %d files. Repartition/coalesce the dataframe to '
+            'fewer, larger files for better read performance (first file: '
+            '%s).', median, sum(sizes), len(sizes), urls[0])
+    return median
 
 
 class DatasetConverter:
@@ -132,17 +210,24 @@ class SparkDatasetConverter(DatasetConverter):
 
 
 def make_dataframe_converter(df, parent_cache_dir_url, compression=None,
-                             rowgroup_size_rows=10000):
+                             rowgroup_size_rows=10000, dtype=None):
     """Materialize a pandas DataFrame or pyarrow Table into a cached Parquet
     copy and return a :class:`DatasetConverter`.
 
     Cache hits are content-addressed: the same data + parent dir reuses the
     existing copy instead of re-materializing.
+
+    :param dtype: ``'float32'``/``'float64'`` unifies floating-point
+        columns (scalars and lists) to that precision before writing — the
+        reference converter's ``dtype`` behavior (``:524-543``; it defaults
+        to float32 there, the natural feed precision for bf16 TPU models).
+        None (default) preserves the input precision.
     """
     import pyarrow as pa
 
     table = (pa.Table.from_pandas(df, preserve_index=False)
              if not isinstance(df, pa.Table) else df)
+    table = _cast_table_precision(table, dtype)
     fingerprint = _table_fingerprint(table, parent_cache_dir_url)
     with _CACHE_LOCK:
         cached = _CACHE_REGISTRY.get(fingerprint)
@@ -152,7 +237,8 @@ def make_dataframe_converter(df, parent_cache_dir_url, compression=None,
 
     cache_url = '%s/%s' % (parent_cache_dir_url.rstrip('/'),
                            'ds-%s' % uuid.uuid4().hex[:16])
-    _write_table(table, cache_url, compression, rowgroup_size_rows)
+    path = _write_table(table, cache_url, compression, rowgroup_size_rows)
+    wait_file_available([path], fs=_cache_fs(cache_url))
     converter = SparkDatasetConverter(cache_url, table.num_rows)
     with _CACHE_LOCK:
         _CACHE_REGISTRY[fingerprint] = converter
@@ -161,12 +247,15 @@ def make_dataframe_converter(df, parent_cache_dir_url, compression=None,
 
 
 def make_spark_converter(df, parent_cache_dir_url=None, compression=None,
-                         rowgroup_size_mb=32):
+                         rowgroup_size_mb=32, dtype='float32'):
     """Spark-parity converter (requires pyspark; reference ``:646-706``):
     the DataFrame is materialized by Spark into the parent cache dir (from
     the argument or the ``petastorm.spark.converter.parentCacheDirUrl``
-    Spark conf), with float-precision and vector→array handling left to the
-    caller's select."""
+    Spark conf). Before writing, ML vector columns become plain arrays and
+    floating-point columns unify to ``dtype`` (reference ``:524-557``;
+    default float32, like the reference). After writing, the materialized
+    files are awaited (eventual-consistency stores) and the median file
+    size advisory runs (``:595-640``)."""
     try:
         import pyspark  # noqa: F401
     except ImportError as e:
@@ -181,6 +270,9 @@ def make_spark_converter(df, parent_cache_dir_url=None, compression=None,
         raise ValueError(
             'parent_cache_dir_url must be given or set via the %r Spark conf'
             % PARENT_CACHE_DIR_URL_CONF)
+
+    df = spark_vectors_to_arrays(df, dtype or 'float64')
+    df = spark_unify_float_precision(df, dtype)
 
     fingerprint = hashlib.sha1(
         (parent_cache_dir_url + df._jdf.queryExecution().analyzed().toString())
@@ -197,11 +289,94 @@ def make_spark_converter(df, parent_cache_dir_url=None, compression=None,
         writer = writer.option('compression', compression)
     writer.option('parquet.block.size',
                   rowgroup_size_mb * 1024 * 1024).parquet(cache_url)
+    _await_and_advise(spark, cache_url)
     converter = SparkDatasetConverter(cache_url, df.count())
     with _CACHE_LOCK:
         _CACHE_REGISTRY[fingerprint] = converter
     atexit.register(converter.delete)
     return converter
+
+
+def spark_vectors_to_arrays(df, dtype='float64', vector_to_array=None):
+    """Spark ML/MLlib vector columns → plain ``array<dtype>`` columns.
+
+    Parquet (and every consumer downstream of it) has no notion of the
+    ``VectorUDT`` struct encoding, so vectors must flatten before
+    materialization (reference ``spark_dataset_converter.py:546-557``).
+    Dispatch is by type name, not isinstance, so the logic is testable with
+    a duck-typed dataframe when pyspark is absent.
+
+    :param vector_to_array: injectable for tests; defaults to
+        ``pyspark.ml.functions.vector_to_array``.
+    """
+    vector_cols = [f.name for f in df.schema
+                   if type(f.dataType).__name__ == 'VectorUDT']
+    if not vector_cols:
+        return df
+    if vector_to_array is None:
+        from pyspark.ml.functions import vector_to_array
+    for name in vector_cols:
+        df = df.withColumn(name, vector_to_array(df[name], dtype))
+    return df
+
+
+def spark_unify_float_precision(df, dtype):
+    """Cast float scalars/arrays to ``dtype`` ('float32'/'float64'/None).
+
+    Reference ``spark_dataset_converter.py:524-543``: training feeds want
+    one precision (float32 for bf16/f32 TPU models), not whatever mix the
+    upstream ETL produced. None disables the cast. Uses ``typeName()``
+    dispatch + string cast targets, so a duck-typed dataframe exercises it
+    without pyspark.
+    """
+    if dtype is None:
+        return df
+    if dtype not in ('float32', 'float64'):
+        raise ValueError("dtype must be 'float32', 'float64' or None; "
+                         'got %r' % (dtype,))
+    source, target = (('double', 'float') if dtype == 'float32'
+                      else ('float', 'double'))
+    converted = []
+    for field in df.schema:
+        data_type = field.dataType
+        if data_type.typeName() == source:
+            df = df.withColumn(field.name, df[field.name].cast(target))
+            converted.append(field.name)
+        elif (data_type.typeName() == 'array'
+              and data_type.elementType.typeName() == source):
+            df = df.withColumn(field.name,
+                               df[field.name].cast('array<%s>' % target))
+            converted.append(field.name)
+    if converted:
+        logger.warning('Converting floating-point columns %s to %s',
+                       converted, dtype)
+    return df
+
+
+def _await_and_advise(spark, cache_url):
+    """Post-materialization: wait for the written part files to be visible
+    and run the median-size advisory over them.
+
+    The file inventory comes from Spark's DRIVER-SIDE metadata
+    (``inputFiles()``, like the reference ``:697``), never from listing the
+    store — on an eventually-consistent store a not-yet-visible file is
+    also not yet listed, so a listing-derived wait would trivially pass on
+    the visible subset and miss exactly the files the wait exists for."""
+    try:
+        file_urls = sorted(spark.read.parquet(cache_url).inputFiles())
+    except Exception:  # noqa: BLE001 - advisory must never break the write
+        logger.warning('Could not enumerate the materialized files of %s '
+                       'from Spark metadata; skipping the availability '
+                       'wait and size advisory', cache_url, exc_info=True)
+        return
+    parquet_urls = [u for u in file_urls if u.endswith('.parquet')]
+    if not parquet_urls:
+        return
+    fs = _cache_fs(cache_url)
+    from petastorm_tpu.fs import get_dataset_path
+    paths = [get_dataset_path(u) for u in parquet_urls]
+    wait_file_available(paths, fs=fs)
+    check_dataset_file_median_size(paths, fs=fs)
 
 
 # -- internals ---------------------------------------------------------------
@@ -224,12 +399,50 @@ def _table_fingerprint(table, parent_url):
     return h.hexdigest()
 
 
+def _cache_fs(cache_url):
+    from petastorm_tpu.fs import get_filesystem_and_path_or_paths
+    return get_filesystem_and_path_or_paths(cache_url)[0]
+
+
+def _cast_table_precision(table, dtype):
+    """Arrow-side equivalent of the reference's float-precision unification
+    (``:524-543``): float scalars and list<float> columns cast to
+    ``dtype``; other columns untouched."""
+    if dtype is None:
+        return table
+    if dtype not in ('float32', 'float64'):
+        raise ValueError("dtype must be 'float32', 'float64' or None; "
+                         'got %r' % (dtype,))
+    import pyarrow as pa
+    source, target = ((pa.float64(), pa.float32()) if dtype == 'float32'
+                      else (pa.float32(), pa.float64()))
+    fields = []
+    changed = []
+    for field in table.schema:
+        if field.type == source:
+            fields.append(field.with_type(target))
+            changed.append(field.name)
+        elif (pa.types.is_list(field.type)
+              and field.type.value_type == source):
+            fields.append(field.with_type(pa.list_(target)))
+            changed.append(field.name)
+        else:
+            fields.append(field)
+    if not changed:
+        return table
+    logger.warning('Converting floating-point columns %s to %s', changed,
+                   dtype)
+    return table.cast(pa.schema(fields))
+
+
 def _write_table(table, cache_url, compression, rowgroup_size_rows):
     import pyarrow.parquet as pq
 
     from petastorm_tpu.fs import get_filesystem_and_path_or_paths
     fs, path = get_filesystem_and_path_or_paths(cache_url)
     fs.makedirs(path, exist_ok=True)
-    with fs.open(os.path.join(path, 'part-00000.parquet'), 'wb') as f:
+    part_path = os.path.join(path, 'part-00000.parquet')
+    with fs.open(part_path, 'wb') as f:
         pq.write_table(table, f, compression=compression or 'snappy',
                        row_group_size=rowgroup_size_rows)
+    return part_path

@@ -94,6 +94,239 @@ class TestDataFrameConverter:
         with pytest.raises(ImportError, match='pyspark'):
             make_spark_converter(object())
 
+    def test_dtype_unifies_float_precision(self, tmp_path):
+        import pyarrow.parquet as pq
+        df = pd.DataFrame({'id': np.arange(10),
+                           'x64': np.arange(10) * 0.5,
+                           'arr': [np.arange(3, dtype=np.float64)] * 10})
+        converter = make_dataframe_converter(
+            df, 'file://' + str(tmp_path / 'cache_f32'), dtype='float32')
+        root = converter.cache_dir_url[len('file://'):]
+        schema = pq.read_table(root).schema
+        import pyarrow as pa
+        assert schema.field('x64').type == pa.float32()
+        assert schema.field('arr').type == pa.list_(pa.float32())
+        assert schema.field('id').type == pa.int64()
+        converter.delete()
+
+    def test_dtype_invalid_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match='float32'):
+            make_dataframe_converter(_df(), 'file://' + str(tmp_path / 'c'),
+                                     dtype='float16')
+
+
+class _MapFS:
+    """Injectable fsspec stand-in over a {path: size} dict; paths can be
+    scheduled to appear after N exists() polls."""
+
+    def __init__(self, sizes, appear_after=None):
+        self._sizes = dict(sizes)
+        self._appear_after = dict(appear_after or {})
+
+    def exists(self, path):
+        waits = self._appear_after.get(path, 0)
+        if waits > 0:
+            self._appear_after[path] = waits - 1
+            return False
+        return path in self._sizes
+
+    def size(self, path):
+        return self._sizes[path]
+
+
+class TestConverterOperationalBehaviors:
+    """The reference converter's S3-wait / file-size-advisory / precision
+    behaviors (``spark_dataset_converter.py:524-640``), testable without
+    pyspark via injectable filesystems and duck-typed dataframes."""
+
+    def test_wait_file_available_polls_until_visible(self):
+        from petastorm_tpu.spark import wait_file_available
+        fs = _MapFS({'/a': 1, '/b': 2}, appear_after={'/b': 3})
+        wait_file_available(['/a', '/b'], fs=fs, poll_interval_s=0.001)
+
+    def test_wait_file_available_timeout_names_stragglers(self):
+        from petastorm_tpu.spark import wait_file_available
+        fs = _MapFS({'/a': 1})
+        with pytest.raises(RuntimeError, match='/never'):
+            wait_file_available(['/a', '/never'], fs=fs, timeout_s=0.05,
+                                poll_interval_s=0.01)
+
+    def test_wait_file_available_empty_list_noop(self):
+        from petastorm_tpu.spark import wait_file_available
+        wait_file_available([], fs=_MapFS({}))
+
+    def test_median_size_advisory_warns_on_small_files(self, caplog):
+        import logging
+        from petastorm_tpu.spark import check_dataset_file_median_size
+        fs = _MapFS({'/a': 10, '/b': 20, '/c': 30})
+        with caplog.at_level(logging.WARNING,
+                             logger='petastorm_tpu.spark.spark_dataset_converter'):
+            median = check_dataset_file_median_size(['/a', '/b', '/c'], fs=fs)
+        assert median == 20
+        assert any('median' in r.message for r in caplog.records)
+
+    def test_median_size_advisory_quiet_on_big_files(self, caplog):
+        import logging
+        from petastorm_tpu.spark import check_dataset_file_median_size
+        big = 64 * 1024 * 1024
+        fs = _MapFS({'/a': big, '/b': big + 1})
+        with caplog.at_level(logging.WARNING):
+            median = check_dataset_file_median_size(['/a', '/b'], fs=fs)
+        assert median == big + 1  # larger of the tie, like the reference
+        assert not any('median' in r.message for r in caplog.records)
+
+    def test_median_size_single_file_skipped(self):
+        from petastorm_tpu.spark import check_dataset_file_median_size
+        assert check_dataset_file_median_size(['/a'], fs=_MapFS({'/a': 1})) is None
+
+
+class _FakeType:
+    def __init__(self, name, element=None):
+        self._name = name
+        if element is not None:
+            self.elementType = element
+
+    def typeName(self):
+        return self._name
+
+
+class _FakeColumn:
+    def __init__(self, name):
+        self.name = name
+        self.casts = []
+
+    def cast(self, target):
+        return ('cast', self.name, target)
+
+
+class _FakeField:
+    def __init__(self, name, data_type):
+        self.name = name
+        self.dataType = data_type
+
+
+class _FakeDF:
+    """Duck-typed pyspark DataFrame: schema + withColumn/indexing."""
+
+    def __init__(self, fields):
+        self.schema = [_FakeField(n, t) for n, t in fields]
+        self.replaced = {}
+
+    def __getitem__(self, name):
+        return _FakeColumn(name)
+
+    def withColumn(self, name, expr):
+        self.replaced[name] = expr
+        return self
+
+
+class TestSparkColumnConversions:
+    def test_precision_casts_double_scalars_and_arrays(self):
+        from petastorm_tpu.spark import spark_unify_float_precision
+        df = _FakeDF([('d', _FakeType('double')),
+                      ('f', _FakeType('float')),
+                      ('ad', _FakeType('array', _FakeType('double'))),
+                      ('i', _FakeType('integer'))])
+        out = spark_unify_float_precision(df, 'float32')
+        assert out.replaced == {'d': ('cast', 'd', 'float'),
+                                'ad': ('cast', 'ad', 'array<float>')}
+
+    def test_precision_float64_direction(self):
+        from petastorm_tpu.spark import spark_unify_float_precision
+        df = _FakeDF([('f', _FakeType('float'))])
+        out = spark_unify_float_precision(df, 'float64')
+        assert out.replaced == {'f': ('cast', 'f', 'double')}
+
+    def test_precision_none_is_noop(self):
+        from petastorm_tpu.spark import spark_unify_float_precision
+        df = _FakeDF([('d', _FakeType('double'))])
+        assert spark_unify_float_precision(df, None) is df
+        assert df.replaced == {}
+
+    def test_precision_invalid_dtype_rejected(self):
+        from petastorm_tpu.spark import spark_unify_float_precision
+        with pytest.raises(ValueError, match='float32'):
+            spark_unify_float_precision(_FakeDF([]), 'int8')
+
+    def test_vectors_flattened_via_injected_converter(self):
+        from petastorm_tpu.spark import spark_vectors_to_arrays
+        VectorUDT = type('VectorUDT', (), {'typeName': lambda self: 'vector'})
+        df = _FakeDF([('vec', VectorUDT()), ('i', _FakeType('integer'))])
+        calls = []
+
+        def fake_vector_to_array(col, dtype):
+            calls.append((col.name, dtype))
+            return ('array_of', col.name, dtype)
+
+        out = spark_vectors_to_arrays(df, 'float32',
+                                      vector_to_array=fake_vector_to_array)
+        assert calls == [('vec', 'float32')]
+        assert out.replaced == {'vec': ('array_of', 'vec', 'float32')}
+
+    def test_await_and_advise_uses_driver_metadata(self, tmp_path, caplog):
+        # the wait list must come from spark's inputFiles() (driver
+        # metadata), never from listing the store — listing on an
+        # eventually-consistent store misses exactly the files the wait
+        # guards (reference :697)
+        import logging
+
+        from petastorm_tpu.spark.spark_dataset_converter import (
+            _await_and_advise,
+        )
+        root = tmp_path / 'ds'
+        root.mkdir()
+        for name in ('part-0.parquet', 'part-1.parquet', 'part-2.parquet'):
+            (root / name).write_bytes(b'x' * 100)
+
+        class _FakeRead:
+            def parquet(self, url):
+                class _DF:
+                    @staticmethod
+                    def inputFiles():
+                        return ['file://%s/%s' % (root, n) for n in
+                                ('part-0.parquet', 'part-1.parquet',
+                                 'part-2.parquet')]
+                return _DF()
+
+        class _FakeSpark:
+            read = _FakeRead()
+
+        with caplog.at_level(logging.WARNING):
+            _await_and_advise(_FakeSpark(), 'file://' + str(root))
+        assert any('median' in r.message for r in caplog.records)
+
+    def test_await_and_advise_missing_file_raises(self, tmp_path):
+        from petastorm_tpu.spark.spark_dataset_converter import (
+            _await_and_advise,
+        )
+
+        class _FakeSpark:
+            class read:
+                @staticmethod
+                def parquet(url):
+                    class _DF:
+                        @staticmethod
+                        def inputFiles():
+                            return ['file://%s/gone.parquet' % tmp_path,
+                                    'file://%s/gone2.parquet' % tmp_path]
+                    return _DF()
+
+        import petastorm_tpu.spark.spark_dataset_converter as mod
+        orig = mod.FILE_AVAILABILITY_WAIT_TIMEOUT_S
+        mod.FILE_AVAILABILITY_WAIT_TIMEOUT_S = 0.05
+        try:
+            with pytest.raises(RuntimeError, match='gone'):
+                _await_and_advise(_FakeSpark(), 'file://' + str(tmp_path))
+        finally:
+            mod.FILE_AVAILABILITY_WAIT_TIMEOUT_S = orig
+
+    def test_no_vectors_never_imports_pyspark(self):
+        # without vector columns the pyspark import must not even be
+        # attempted (this environment has no pyspark to import)
+        from petastorm_tpu.spark import spark_vectors_to_arrays
+        df = _FakeDF([('i', _FakeType('integer'))])
+        assert spark_vectors_to_arrays(df, 'float32') is df
+
 
 class TestTestUtil:
     def test_generate_datapoint_matches_schema(self):
