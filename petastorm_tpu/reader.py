@@ -313,6 +313,10 @@ class Reader:
         """
         from petastorm_tpu.parallel.sharding import default_shard_info
         cur_shard, shard_count = default_shard_info(cur_shard, shard_count)
+        # observability parity with the reference Reader's exposed shard
+        # attributes: the RESOLVED assignment (post JAX-process defaulting)
+        self.cur_shard = cur_shard
+        self.shard_count = shard_count
         if shard_count is None:
             return piece_indices
         if shard_count > len(piece_indices):

@@ -52,7 +52,8 @@ def wait_file_available(url_or_path_list, fs=None, timeout_s=None,
 
     :param fs: optional fsspec filesystem; resolved from the URLs when
         omitted (injectable for tests and for pre-resolved callers).
-    :param timeout_s: wait bound; defaults to the module's
+    :param timeout_s: wait bound for the WHOLE call (one shared deadline,
+        not per file); defaults to the module's
         ``FILE_AVAILABILITY_WAIT_TIMEOUT_S`` read at call time.
     """
     from concurrent.futures import ThreadPoolExecutor
@@ -67,9 +68,19 @@ def wait_file_available(url_or_path_list, fs=None, timeout_s=None,
     else:
         paths = urls
 
+    # one deadline for the call: with more paths than pool slots, a
+    # per-task deadline starting at task RUN time would stack up to
+    # (paths/slots) x timeout of total blocking
+    deadline = time.monotonic() + timeout_s
+
     def _wait(path):
-        deadline = time.monotonic() + timeout_s
         while True:
+            # drop fsspec's listing/dircache first: on caching filesystems
+            # (s3fs, gcsfs) the first miss would otherwise be re-served
+            # from cache forever, defeating the poll
+            invalidate = getattr(fs, 'invalidate_cache', None)
+            if invalidate is not None:
+                invalidate()
             if fs.exists(path):
                 return True
             if time.monotonic() >= deadline:
@@ -93,6 +104,7 @@ def check_dataset_file_median_size(url_or_path_list, fs=None):
     checked local paths; fsspec ``size`` makes this store-agnostic). The
     advisory is a warning, never an error.
     """
+    from concurrent.futures import ThreadPoolExecutor
     urls = list(url_or_path_list)
     if len(urls) < 2:
         return None
@@ -101,7 +113,10 @@ def check_dataset_file_median_size(url_or_path_list, fs=None):
         fs, paths = get_filesystem_and_path_or_paths(urls)
     else:
         paths = urls
-    sizes = sorted(fs.size(p) for p in paths)
+    # size() is one round trip per file on object stores; fetch them
+    # concurrently so the advisory costs ~one round trip, not N
+    with ThreadPoolExecutor(max_workers=min(64, len(paths))) as pool:
+        sizes = sorted(pool.map(fs.size, paths))
     median = sizes[len(sizes) // 2]  # the larger one on a tie
     if median < RECOMMENDED_FILE_SIZE_BYTES:
         logger.warning(
@@ -357,11 +372,13 @@ def _await_and_advise(spark, cache_url):
     """Post-materialization: wait for the written part files to be visible
     and run the median-size advisory over them.
 
-    The file inventory comes from Spark's DRIVER-SIDE metadata
-    (``inputFiles()``, like the reference ``:697``), never from listing the
-    store — on an eventually-consistent store a not-yet-visible file is
-    also not yet listed, so a listing-derived wait would trivially pass on
-    the visible subset and miss exactly the files the wait exists for."""
+    The file inventory comes from ``spark.read.parquet(url).inputFiles()``
+    — a fresh Spark read of the just-committed dataset, exactly the
+    reference's source (``:700-703``). Spark's commit protocol makes that
+    index complete once the write returns; the wait then covers the
+    remaining hazard on eventually-consistent stores: a file that is
+    INDEXED but whose object is not yet individually visible to readers
+    (list-after-write vs read-after-write consistency lag)."""
     try:
         file_urls = sorted(spark.read.parquet(cache_url).inputFiles())
     except Exception:  # noqa: BLE001 - advisory must never break the write

@@ -1,0 +1,92 @@
+"""Worker process for the REAL 2-process ``jax.distributed`` loader test.
+
+Launched by ``tests/test_multihost.py`` (never run as a pytest module):
+each worker joins a 2-process JAX distributed runtime over CPU devices,
+builds a mesh spanning BOTH processes' devices, and drives
+``make_jax_loader`` + ``iter_steps`` the documented multi-host way —
+proving, with actual process boundaries (not monkeypatched
+``_jax_process_info``):
+
+* reader sharding defaults to (process_index, process_count) — disjoint
+  row-group shards per host with zero configuration;
+* global batch assembly via ``jax.make_array_from_process_local_data``
+  (``jax/loader.py``): every step's array is GLOBAL (batch_size x
+  process_count rows) while each host contributed only its shard;
+* fixed-step epochs over an infinite loader keep collectives aligned
+  across hosts whose shards are UNEVEN (the documented pod-hang hazard) —
+  both workers run the same step count and every per-step ``psum``-style
+  reduction agrees.
+
+Results are written as JSON for the parent to assert on.
+"""
+
+import json
+import os
+import sys
+
+
+def main():
+    coordinator, process_id, num_processes, url, steps, batch, out_path = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4],
+        int(sys.argv[5]), int(sys.argv[6]), sys.argv[7])
+
+    os.environ['JAX_PLATFORMS'] = 'cpu'
+    os.environ.setdefault(
+        'XLA_FLAGS', '--xla_force_host_platform_device_count=4')
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import jax
+
+    jax.config.update('jax_platforms', 'cpu')
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    assert jax.process_count() == num_processes
+
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from petastorm_tpu.jax import make_jax_loader
+
+    devices = np.array(jax.devices())  # global: num_processes x 4
+    mesh = Mesh(devices, ('data',))
+
+    @jax.jit
+    def global_sum(arr):
+        return jnp.sum(arr)
+
+    local_ids_per_step = []
+    global_sums = []
+    global_shapes = []
+    with make_jax_loader(url, batch_size=batch, mesh=mesh,
+                         fields=['^id$'], num_epochs=None,
+                         shuffle_row_groups=False) as loader:
+        for step_batch in loader.iter_steps(steps):
+            arr = step_batch['id']
+            global_shapes.append(list(arr.shape))
+            # rows THIS host staged = its addressable shards
+            local = np.concatenate(
+                [np.asarray(s.data) for s in arr.addressable_shards])
+            local_ids_per_step.append(sorted(int(x) for x in local))
+            # a cross-host reduction over the global array: hangs (or
+            # diverges) unless both hosts issue it the same number of times
+            global_sums.append(int(global_sum(arr)))
+        shard_info = {
+            'cur_shard': loader.reader.cur_shard,
+            'shard_count': loader.reader.shard_count,
+        }
+
+    with open(out_path, 'w') as f:
+        json.dump({
+            'process_id': process_id,
+            'process_count': jax.process_count(),
+            'global_shapes': global_shapes,
+            'local_ids_per_step': local_ids_per_step,
+            'global_sums': global_sums,
+            **shard_info,
+        }, f)
+
+
+if __name__ == '__main__':
+    main()

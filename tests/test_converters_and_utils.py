@@ -264,10 +264,9 @@ class TestSparkColumnConversions:
         assert out.replaced == {'vec': ('array_of', 'vec', 'float32')}
 
     def test_await_and_advise_uses_driver_metadata(self, tmp_path, caplog):
-        # the wait list must come from spark's inputFiles() (driver
-        # metadata), never from listing the store — listing on an
-        # eventually-consistent store misses exactly the files the wait
-        # guards (reference :697)
+        # the wait list comes from spark's post-commit inputFiles() (the
+        # reference's source, :700-703); the wait then covers per-object
+        # read-after-write visibility lag for every indexed file
         import logging
 
         from petastorm_tpu.spark.spark_dataset_converter import (

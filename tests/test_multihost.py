@@ -1,0 +1,91 @@
+"""REAL multi-process loader test: two ``jax.distributed`` processes.
+
+Everything else in the suite exercises multi-device code on one process
+(8 virtual CPU devices) or monkeypatches ``_jax_process_info``; this test
+actually spawns two OS processes that join one JAX distributed runtime
+(CPU collectives) and drives ``make_jax_loader`` across the process
+boundary — the SURVEY §5.8 multi-host claim, proven end to end:
+``jax.make_array_from_process_local_data`` global assembly, automatic
+process sharding, and hang-free fixed-step epochs over uneven shards.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       'multihost_worker.py')
+_STEPS = 10
+_BATCH = 8  # per host
+
+
+@pytest.mark.slow
+def test_two_process_distributed_loader(tmp_path):
+    from tests.test_common import create_test_scalar_dataset
+
+    # 5 row-groups over 2 hosts: deliberately UNEVEN shards (3 vs 2
+    # row-groups; 60 vs 40 rows) — the pod-hang shape iter_steps exists for
+    url = 'file://' + str(tmp_path / 'mh_ds')
+    create_test_scalar_dataset(url, num_rows=100, num_files=5)
+
+    with socket.socket() as s:
+        s.bind(('localhost', 0))
+        coordinator = 'localhost:%d' % s.getsockname()[1]
+
+    env = dict(os.environ,
+               XLA_FLAGS='--xla_force_host_platform_device_count=4')
+    # the worker pins the platform itself; a parent-process leftover would
+    # fight jax.distributed's device bookkeeping
+    env.pop('JAX_PLATFORMS', None)
+    outs = [str(tmp_path / ('out%d.json' % i)) for i in range(2)]
+    procs = [subprocess.Popen(
+        [sys.executable, _WORKER, coordinator, str(pid), '2', url,
+         str(_STEPS), str(_BATCH), outs[pid]],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        for pid in range(2)]
+    errs = []
+    for p in procs:
+        try:
+            _, err = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail('multi-host worker hung (the pod-hang this test '
+                        'guards against, or a wedged runtime)')
+        errs.append(err)
+    for p, err in zip(procs, errs):
+        assert p.returncode == 0, 'worker failed:\n%s' % err[-3000:]
+
+    results = [json.load(open(o)) for o in outs]
+    r0, r1 = sorted(results, key=lambda r: r['process_id'])
+
+    # both workers ran the SAME fixed step count (no divergence, no hang)
+    assert len(r0['local_ids_per_step']) == _STEPS
+    assert len(r1['local_ids_per_step']) == _STEPS
+
+    # shard defaults resolved from the distributed runtime, not config
+    assert (r0['cur_shard'], r0['shard_count']) == (0, 2)
+    assert (r1['cur_shard'], r1['shard_count']) == (1, 2)
+
+    # every step staged a GLOBAL array: per-host batch x process count
+    assert all(shape == [_BATCH * 2] for shape in r0['global_shapes'])
+    assert all(shape == [_BATCH * 2] for shape in r1['global_shapes'])
+
+    # each host contributed exactly its per-host batch of each global array
+    assert all(len(ids) == _BATCH for ids in r0['local_ids_per_step'])
+    assert all(len(ids) == _BATCH for ids in r1['local_ids_per_step'])
+
+    # shard-disjoint delivery: the hosts' row sets never overlap, and the
+    # infinite stream (no per-epoch tail drop) covers the whole dataset
+    ids0 = {x for step in r0['local_ids_per_step'] for x in step}
+    ids1 = {x for step in r1['local_ids_per_step'] for x in step}
+    assert not (ids0 & ids1)
+    assert ids0 | ids1 == set(range(100))
+
+    # cross-host collectives agreed at every step: the global reduction
+    # (sum over the assembled array) matches on both hosts
+    assert r0['global_sums'] == r1['global_sums']
