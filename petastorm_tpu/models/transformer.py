@@ -232,6 +232,16 @@ def _block_forward(block, x, config, mesh=None):
     return _block_dense_ffn_half(block, x, config)
 
 
+def _block_moe_half(block, x, config, seq=None):
+    """MoE FFN sublayer (RMSNorm → Switch MoE → constrained residual) —
+    shared by the layered forward and the pipeline stage executor.
+    Returns ``(x, aux)``."""
+    from petastorm_tpu.models.moe import moe_forward
+    h = _rmsnorm(x, block['ln2'])
+    ffn_out, aux = moe_forward(block['moe'], h, config.moe_config())
+    return _constrain(x + ffn_out.astype(config.dtype), seq), aux
+
+
 def transformer_forward_with_aux(params, tokens, config, mesh=None):
     """tokens (B, S) int32 → (logits (B, S, V) f32, scalar aux loss).
 
@@ -254,11 +264,8 @@ def transformer_forward_with_aux(params, tokens, config, mesh=None):
     for block in params['blocks']:
         if c.n_experts > 0:
             x = _block_attention_half(block, x, c, mesh=mesh)
-            h = _rmsnorm(x, block['ln2'])
-            from petastorm_tpu.models.moe import moe_forward
-            ffn_out, aux = moe_forward(block['moe'], h, c.moe_config())
+            x, aux = _block_moe_half(block, x, c, seq=seq)
             aux_total = aux_total + aux
-            x = _constrain(x + ffn_out.astype(dtype), seq)
         else:
             x = _block_forward(block, x, c, mesh=mesh)
     x = _rmsnorm(x, params['ln_f'])
@@ -326,21 +333,24 @@ def transformer_loss(params, tokens, config, mesh=None):
 def init_pipelined_transformer_params(rng, config, mesh, pipe_axis=None):
     """Parameters for the PIPELINE-PARALLEL transformer: blocks stacked on
     a leading ``(n_stages, layers_per_stage)`` axis pair sharded over
-    ``pipe_axis``, composing with tensor-parallel splits over ``'model'``
-    and data parallelism over ``'data'`` on the same mesh (3D: dp×pp×tp in
-    one jitted step).
+    ``pipe_axis``, composing with tensor-parallel splits over ``'model'``,
+    expert parallelism over the config's ``expert_axis`` (MoE configs),
+    and data parallelism over ``'data'`` on the same mesh — dp×pp×tp or
+    dp×pp×ep in one jitted step.
 
-    Requires ``config.n_layers % mesh.shape[pipe_axis] == 0``. Dense FFN
-    only (MoE/seq-parallel pipelining not yet composed).
+    Requires ``config.n_layers % mesh.shape[pipe_axis] == 0``.
+    Seq-parallel pipelining is not composed (ring/Ulysses attention is
+    manual over the seq axis and cannot nest inside the pipe-manual
+    shard_map); seq-parallel configs use the layered forward.
     """
     from petastorm_tpu.parallel.mesh import PIPE_AXIS
     if pipe_axis is None:
         pipe_axis = PIPE_AXIS
     c = config
-    if c.n_experts > 0 or c.seq_axis is not None:
-        raise NotImplementedError('pipelined transformer currently composes '
-                                  'dp×pp×tp; MoE and seq-parallel configs '
-                                  'use the layered forward')
+    if c.seq_axis is not None:
+        raise NotImplementedError('pipelined transformer composes '
+                                  'dp×pp×tp and dp×pp×ep; seq-parallel '
+                                  'configs use the layered forward')
     n_stages = mesh.shape[pipe_axis]
     if c.n_layers % n_stages:
         raise ValueError('n_layers=%d not divisible into %d pipeline stages'
@@ -351,20 +361,21 @@ def init_pipelined_transformer_params(rng, config, mesh, pipe_axis=None):
     blocks = params.pop('blocks')
     per_stage = c.n_layers // n_stages
 
-    def stack(name):
-        stacked = jnp.stack([b[name] for b in blocks])
-        return stacked.reshape((n_stages, per_stage)
-                               + stacked.shape[1:])
+    def stack(*leaves):
+        # n_layers leaves → (n_stages, layers_per_stage, *param dims);
+        # tree_map over the block pytrees handles nested MoE params too
+        stacked = jnp.stack(leaves)
+        return stacked.reshape((n_stages, per_stage) + stacked.shape[1:])
 
-    stages = {name: stack(name) for name in blocks[0]}
+    stages = jax.tree_util.tree_map(stack, *blocks)
     top_specs = _param_specs(c)
     block_specs = top_specs['blocks'][0]
-    inner_specs = {
-        # dims after the stage axis: (layers_per_stage, *param dims) — the
-        # layer dim replicates, the param dims keep their Megatron splits
-        name: P(None, *_restrict_spec_to_mesh(block_specs[name], mesh))
-        for name in stages
-    }
+    # dims after the stage axis: (layers_per_stage, *param dims) — the
+    # layer dim replicates, the param dims keep their Megatron/expert
+    # splits (PartitionSpec is a pytree leaf, so tree_map walks specs)
+    inner_specs = jax.tree_util.tree_map(
+        lambda spec: P(None, *_restrict_spec_to_mesh(spec, mesh)),
+        block_specs)
     stages = shard_stage_params(stages, mesh, axis_name=pipe_axis,
                                 inner_specs=inner_specs)
 
@@ -379,11 +390,15 @@ def init_pipelined_transformer_params(rng, config, mesh, pipe_axis=None):
     return placed
 
 
-def pipelined_transformer_forward(params, tokens, config, mesh,
-                                  pipe_axis=None, n_microbatches=None):
-    """tokens (B, S) int32 → logits (B, S, V) f32, with the block stack
-    executed as a GPipe pipeline over ``mesh[pipe_axis]`` (embedding and
-    head run outside the pipeline on every stage's devices)."""
+def pipelined_transformer_forward_with_aux(params, tokens, config, mesh,
+                                           pipe_axis=None,
+                                           n_microbatches=None):
+    """tokens (B, S) int32 → (logits (B, S, V) f32, aux scalar), with the
+    block stack executed as a GPipe pipeline over ``mesh[pipe_axis]``
+    (embedding and head run outside the pipeline on every stage's
+    devices). MoE configs route per microbatch inside each stage; the aux
+    scalar is the Switch load-balancing loss summed over layers, averaged
+    over microbatches (0.0 for dense configs)."""
     from petastorm_tpu.parallel.mesh import PIPE_AXIS
     from petastorm_tpu.parallel.pipeline import pipeline_apply
 
@@ -391,40 +406,67 @@ def pipelined_transformer_forward(params, tokens, config, mesh,
         pipe_axis = PIPE_AXIS
     c = config
     dtype = c.dtype
-    per_stage = next(iter(params['stages'].values())).shape[1]
+    per_stage = jax.tree_util.tree_leaves(params['stages'])[0].shape[1]
+    moe = c.n_experts > 0
 
     x = params['embed'][tokens].astype(dtype)
     x = x + params['pos_embed'][:tokens.shape[1]].astype(dtype)
     x = _constrain(x)
 
     def stage_fn(stage_params, x):
+        aux_total = jnp.zeros((), jnp.float32)
         for layer in range(per_stage):
-            block = {name: leaf[layer]
-                     for name, leaf in stage_params.items()}
-            x = _block_forward(block, x, c)
-        return x
+            block = jax.tree_util.tree_map(lambda leaf: leaf[layer],
+                                           stage_params)
+            if moe:
+                x = _block_attention_half(block, x, c)
+                x, aux = _block_moe_half(block, x, c)
+                aux_total = aux_total + aux
+            else:
+                x = _block_forward(block, x, c)
+        return (x, aux_total) if moe else x
 
-    x = pipeline_apply(stage_fn, params['stages'], x, mesh,
-                       axis_name=pipe_axis, n_microbatches=n_microbatches)
+    if moe:
+        x, aux = pipeline_apply(stage_fn, params['stages'], x, mesh,
+                                axis_name=pipe_axis,
+                                n_microbatches=n_microbatches,
+                                with_aux=True)
+    else:
+        x = pipeline_apply(stage_fn, params['stages'], x, mesh,
+                           axis_name=pipe_axis,
+                           n_microbatches=n_microbatches)
+        aux = jnp.zeros((), jnp.float32)
     x = _rmsnorm(x, params['ln_f'])
-    return jnp.einsum('bsd,dv->bsv', x, params['lm_head'].astype(dtype),
-                      preferred_element_type=jnp.float32)
+    logits = jnp.einsum('bsd,dv->bsv', x, params['lm_head'].astype(dtype),
+                        preferred_element_type=jnp.float32)
+    return logits, aux
+
+
+def pipelined_transformer_forward(params, tokens, config, mesh,
+                                  pipe_axis=None, n_microbatches=None):
+    """tokens (B, S) int32 → logits (B, S, V) f32 (aux discarded)."""
+    logits, _ = pipelined_transformer_forward_with_aux(
+        params, tokens, config, mesh, pipe_axis=pipe_axis,
+        n_microbatches=n_microbatches)
+    return logits
 
 
 def pipelined_transformer_train_step(config, optimizer, mesh,
                                      pipe_axis=None, n_microbatches=None):
-    """Jittable dp×pp×tp train step over stacked-stage parameters."""
+    """Jittable dp×pp×tp (or dp×pp×ep for MoE configs) train step over
+    stacked-stage parameters; MoE aux joins the loss exactly as in the
+    layered :func:`transformer_loss`."""
 
     import optax
 
     def loss_fn(params, tokens):
-        logits = pipelined_transformer_forward(
+        logits, aux = pipelined_transformer_forward_with_aux(
             params, tokens[:, :-1], config, mesh, pipe_axis=pipe_axis,
             n_microbatches=n_microbatches)
         targets = tokens[:, 1:]
         logp = jax.nn.log_softmax(logits, axis=-1)
         ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-        return -ll.mean()
+        return -ll.mean() + config.moe_aux_weight * aux
 
     @jax.jit
     def step(params, opt_state, tokens):

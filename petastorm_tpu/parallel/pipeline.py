@@ -71,10 +71,17 @@ def _to_varying(x, axis_name):
 
 
 def _pipeline_local(stage_params, x, stage_fn, axis_name, n_stages,
-                    n_microbatches):
+                    n_microbatches, with_aux=False):
     """Per-device body under shard_map: ``stage_params`` leaves have a
     leading stage axis of local size 1; ``x`` is the full (replicated)
-    batch."""
+    batch.
+
+    With ``with_aux`` the stage returns ``(y, scalar)`` and the scalars
+    accumulate ONLY over real (stage, microbatch) pairs — warmup/drain
+    bubble ticks run zero activations through the stage and their aux
+    contribution (e.g. a Switch router's load statistics over garbage
+    tokens) must not leak into the loss. A microbatch m is inside stage s
+    exactly at tick ``t = m + s``."""
     stage = lax.axis_index(axis_name)
     params_local = jax.tree_util.tree_map(lambda p: p[0], stage_params)
 
@@ -83,29 +90,52 @@ def _pipeline_local(stage_params, x, stage_fn, axis_name, n_stages,
     # warmup/drain padding: ticks past the feed carry zeros into stage 0
     pad = jnp.zeros((n_stages - 1,) + feed.shape[1:], x.dtype)
     feed = _to_varying(jnp.concatenate([feed, pad], axis=0), axis_name)
+    ticks = _to_varying(jnp.arange(feed.shape[0]), axis_name)
     perm = [(i, i + 1) for i in range(n_stages - 1)]
 
-    def tick(act, x_t):
+    def tick(carry, inp):
+        act, aux_acc = carry
+        x_t, t = inp
         x_in = jnp.where(stage == 0, x_t, act)
-        y = stage_fn(params_local, x_in)
+        if with_aux:
+            y, aux = stage_fn(params_local, x_in)
+            offset = t - stage
+            real = jnp.logical_and(offset >= 0, offset < n_microbatches)
+            aux_acc = aux_acc + jnp.where(real, aux.astype(jnp.float32), 0.0)
+        else:
+            y = stage_fn(params_local, x_in)
         emit = jnp.where(stage == n_stages - 1, y, jnp.zeros_like(y))
         act_next = lax.ppermute(y, axis_name, perm) if perm else y
-        return act_next, emit
+        return (act_next, aux_acc), emit
 
-    _, emits = lax.scan(tick, jnp.zeros_like(feed[0]), feed)
+    aux0 = _to_varying(jnp.zeros((), jnp.float32), axis_name)
+    (_, aux_acc), emits = lax.scan(tick, (jnp.zeros_like(feed[0]), aux0),
+                                   (feed, ticks))
     outs = emits[n_stages - 1:]                 # (M, mb, ...) on last stage
     outs = lax.psum(outs, axis_name)            # replicate to every stage
-    return outs.reshape(x.shape)
+    outs = outs.reshape(x.shape)
+    if not with_aux:
+        return outs
+    # total over stages; mean over microbatches — each microbatch's pass
+    # through all stages approximates the sequential model's per-layer aux
+    # over the full batch (per-microbatch routing statistics, the standard
+    # sharded-MoE estimator)
+    aux_total = lax.psum(aux_acc, axis_name) / n_microbatches
+    return outs, aux_total
 
 
 def pipeline_apply(stage_fn, stage_params, x, mesh, axis_name=PIPE_AXIS,
-                   n_microbatches=None):
+                   n_microbatches=None, with_aux=False):
     """Apply ``n_stages`` sequential stages to ``x`` with the stage stack
     sharded over ``mesh[axis_name]``.
 
     :param stage_fn: ``(params_slice, microbatch) -> microbatch`` — one
         stage's computation; output shape must equal input shape (the
-        activation rotates through homogeneous pipeline slots).
+        activation rotates through homogeneous pipeline slots). With
+        ``with_aux``, returns ``(microbatch, scalar)`` instead and the
+        call returns ``(output, aux)`` where ``aux`` sums the scalars over
+        stages and averages over microbatches (bubble ticks excluded) —
+        the MoE-loss shape of auxiliary outputs.
     :param stage_params: pytree whose leaves carry a leading
         ``n_stages`` axis (use :func:`shard_stage_params` to place it).
     :param x: (batch, ...) input, replicated over the pipe axis.
@@ -113,7 +143,8 @@ def pipeline_apply(stage_fn, stage_params, x, mesh, axis_name=PIPE_AXIS,
         microbatches → less bubble, smaller per-tick matmuls). Must divide
         the batch.
     :return: (batch, ...) output, replicated over the pipe axis — equal to
-        sequentially applying the stages.
+        sequentially applying the stages; plus the aux scalar when
+        ``with_aux``.
     """
     from jax.sharding import PartitionSpec as P
 
@@ -128,28 +159,43 @@ def pipeline_apply(stage_fn, stage_params, x, mesh, axis_name=PIPE_AXIS,
         lambda p: P(axis_name, *([None] * (jnp.ndim(p) - 1))), stage_params)
     body = functools.partial(_pipeline_local, stage_fn=stage_fn,
                              axis_name=axis_name, n_stages=n_stages,
-                             n_microbatches=n_microbatches)
+                             n_microbatches=n_microbatches,
+                             with_aux=with_aux)
     # check_vma=True (replication tracked soundly) is REQUIRED here: the
     # batch enters replicated, and only the varying-manual-axes machinery
     # transposes that correctly (see _to_varying). No check_rep=False
     # fallback — on a jax too old for it, wrong input gradients would be
     # silent, which is strictly worse than an ImportError.
     #
-    # Manual ONLY over the pipe axis: any other mesh axes (data, model)
-    # stay auto, so the batch rides in data-sharded, stage weights keep
-    # their tensor-parallel layout, and XLA inserts the dp/tp collectives
-    # inside each stage as usual — this is what lets pp compose with dp
-    # and tp in ONE jitted step.
+    # Manual ONLY over the pipe axis: any other mesh axes (data, model,
+    # expert) stay auto, so the batch rides in data-sharded, stage weights
+    # keep their tensor-parallel/expert layout, and XLA inserts the
+    # dp/tp/ep collectives inside each stage as usual — this is what lets
+    # pp compose with the other axes in ONE jitted step.
     from jax import shard_map
+    out_specs = (P(), P()) if with_aux else P()
     fn = shard_map(body, mesh=mesh, in_specs=(param_specs, P()),
-                   out_specs=P(), axis_names={axis_name}, check_vma=True)
+                   out_specs=out_specs, axis_names={axis_name},
+                   check_vma=True)
     return fn(stage_params, x)
 
 
-def reference_pipeline(stage_fn, stage_params, x):
-    """Sequential oracle: apply each stage in order on the full batch."""
+def reference_pipeline(stage_fn, stage_params, x, with_aux=False):
+    """Sequential oracle: apply each stage in order on the full batch.
+
+    With ``with_aux`` the per-stage scalars sum over stages on the FULL
+    batch — what :func:`pipeline_apply` computes exactly at
+    ``n_microbatches=1`` and estimates (per-microbatch statistics) above.
+    """
     n_stages = jax.tree_util.tree_leaves(stage_params)[0].shape[0]
+    aux_total = jnp.zeros((), jnp.float32)
     for s in range(n_stages):
         params_s = jax.tree_util.tree_map(lambda p: p[s], stage_params)
-        x = stage_fn(params_s, x)
+        if with_aux:
+            x, aux = stage_fn(params_s, x)
+            aux_total = aux_total + aux.astype(jnp.float32)
+        else:
+            x = stage_fn(params_s, x)
+    if with_aux:
+        return x, aux_total
     return x
