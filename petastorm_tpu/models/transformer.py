@@ -336,7 +336,12 @@ def init_pipelined_transformer_params(rng, config, mesh, pipe_axis=None):
     ``pipe_axis``, composing with tensor-parallel splits over ``'model'``,
     expert parallelism over the config's ``expert_axis`` (MoE configs),
     and data parallelism over ``'data'`` on the same mesh — dp×pp×tp or
-    dp×pp×ep in one jitted step.
+    pp×ep in one jitted step.
+
+    .. warning:: the VALIDATED MoE compositions are pp×ep and dp×pp
+       (experts replicated). A mesh naming data + pipe + expert together
+       CHECK-crashes XLA:CPU's SPMD partitioner (compiler bug — see
+       docs/troubleshoot.md) and is unvalidated on TPU hardware.
 
     Requires ``config.n_layers % mesh.shape[pipe_axis] == 0``.
     Seq-parallel pipelining is not composed (ring/Ulysses attention is
@@ -453,7 +458,8 @@ def pipelined_transformer_forward(params, tokens, config, mesh,
 
 def pipelined_transformer_train_step(config, optimizer, mesh,
                                      pipe_axis=None, n_microbatches=None):
-    """Jittable dp×pp×tp (or dp×pp×ep for MoE configs) train step over
+    """Jittable dp×pp×tp (or pp×ep for MoE configs — see the mesh caveat
+    on :func:`init_pipelined_transformer_params`) train step over
     stacked-stage parameters; MoE aux joins the loss exactly as in the
     layered :func:`transformer_loss`."""
 

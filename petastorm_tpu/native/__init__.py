@@ -1,9 +1,15 @@
 """First-party native (C) components for the decode hot path.
 
-``decode_npy_batch`` is built lazily on first import (g++/cc via
-setuptools) and cached next to the source; any build or import failure
-degrades silently to the pure-Python decode path — the native layer is an
-accelerator, never a dependency.
+Two extensions, each built lazily on first use (cc via setuptools) and
+cached next to the source; any build or import failure degrades silently
+to the pure-Python decode path — the native layer is an accelerator,
+never a dependency:
+
+* ``_npy_batch.decode_npy_batch`` — batched ``.npy`` cell decode
+  (:class:`~petastorm_tpu.codecs.NdarrayCodec`).
+* ``_jpeg_batch.decode_jpeg_batch`` — batched RGB JPEG decode via
+  libjpeg(-turbo) (:class:`~petastorm_tpu.codecs.CompressedImageCodec`);
+  needs ``jpeglib.h`` + ``-ljpeg`` at build time.
 """
 
 import logging
@@ -13,21 +19,28 @@ import sysconfig
 logger = logging.getLogger(__name__)
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-_native = None
-_build_attempted = False
+
+#: extension name -> (source file, Extension kwargs beyond sources)
+_EXTENSIONS = {
+    '_npy_batch': ('npy_batch.c', {'numpy_include': True}),
+    '_jpeg_batch': ('jpeg_batch.c', {'libraries': ['jpeg']}),
+}
+
+_loaded = {}            # name -> module
+_attempted = set()      # names whose build/load already failed this process
 
 
-def _find_built_extension():
+def _find_built_extension(name):
     """Path of a current compiled extension, or None.
 
     A .so older than its C source is stale (the exported signature may have
     changed) and is treated as absent so it gets rebuilt.
     """
     suffix = sysconfig.get_config_var('EXT_SUFFIX') or '.so'
-    path = os.path.join(_HERE, '_npy_batch' + suffix)
+    path = os.path.join(_HERE, name + suffix)
     if not os.path.exists(path):
         return None
-    source = os.path.join(_HERE, 'npy_batch.c')
+    source = os.path.join(_HERE, _EXTENSIONS[name][0])
     try:
         if os.path.getmtime(path) < os.path.getmtime(source):
             return None
@@ -38,8 +51,8 @@ def _find_built_extension():
     return path
 
 
-def _build_extension():
-    """One-shot in-tree build of the C extension.
+def _build_extension(name):
+    """One-shot in-tree build of one C extension.
 
     Serialized via an exclusive flock so concurrently-spawned pool workers
     hitting first decode don't race `build_ext --inplace` in the same
@@ -47,16 +60,22 @@ def _build_extension():
     """
     import subprocess
     import sys
+    source, opts = _EXTENSIONS[name]
+    include_lines = ''
+    ext_kwargs = "extra_compile_args=['-O3']"
+    if opts.get('numpy_include'):
+        include_lines = 'import numpy as np\n'
+        ext_kwargs += ', include_dirs=[np.get_include()]'
+    if opts.get('libraries'):
+        ext_kwargs += ', libraries=%r' % (opts['libraries'],)
     script = (
-        "import os\n"
-        "from setuptools import setup, Extension\n"
-        "import numpy as np\n"
-        "os.chdir(%r)\n"
-        "setup(name='_npy_batch', script_args=['build_ext', '--inplace'],\n"
-        "      ext_modules=[Extension('_npy_batch', ['npy_batch.c'],\n"
-        "                             include_dirs=[np.get_include()],\n"
-        "                             extra_compile_args=['-O3'])])\n"
-    ) % _HERE
+        'import os\n'
+        'from setuptools import setup, Extension\n'
+        + include_lines +
+        'os.chdir(%r)\n'
+        "setup(name=%r, script_args=['build_ext', '--inplace'],\n"
+        '      ext_modules=[Extension(%r, [%r], %s)])\n'
+    ) % (_HERE, name, name, source, ext_kwargs)
     lock_path = os.path.join(_HERE, '.build.lock')
     with open(lock_path, 'w') as lock_file:
         try:
@@ -67,33 +86,41 @@ def _build_extension():
             # accept the (unlikely) build race rather than disable native
             pass
         # The winner of the lock builds; losers find a fresh .so here.
-        if _find_built_extension() is None:
+        if _find_built_extension(name) is None:
             subprocess.run([sys.executable, '-c', script], check=True,
                            capture_output=True, timeout=120)
 
 
-def get_native_module():
-    """The compiled ``_npy_batch`` module, or None when unavailable."""
-    global _native, _build_attempted
-    if _native is not None:
-        return _native
-    if _build_attempted:
+def _get_extension(name):
+    if name in _loaded:
+        return _loaded[name]
+    if name in _attempted:
         return None
-    _build_attempted = True
+    _attempted.add(name)
     try:
-        if _find_built_extension() is None:
-            _build_extension()
+        if _find_built_extension(name) is None:
+            _build_extension(name)
         import importlib.util
-        path = _find_built_extension()
+        path = _find_built_extension(name)
         if path is None:
             return None
-        spec = importlib.util.spec_from_file_location('_npy_batch', path)
+        spec = importlib.util.spec_from_file_location(name, path)
         module = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(module)
-        _native = module
-        logger.debug('Native NPY batch decoder loaded from %s', path)
+        _loaded[name] = module
+        logger.debug('Native extension %s loaded from %s', name, path)
+        return module
     except Exception:  # noqa: BLE001 - native layer is best-effort
-        logger.info('Native NPY decoder unavailable; using the Python '
-                    'decode path', exc_info=True)
+        logger.info('Native extension %s unavailable; using the Python '
+                    'decode path', name, exc_info=True)
         return None
-    return _native
+
+
+def get_native_module():
+    """The compiled ``_npy_batch`` module, or None when unavailable."""
+    return _get_extension('_npy_batch')
+
+
+def get_jpeg_module():
+    """The compiled ``_jpeg_batch`` module, or None when unavailable."""
+    return _get_extension('_jpeg_batch')

@@ -128,3 +128,128 @@ class TestCodecIntegration:
         arrs = [np.ones((i + 1, 3), np.float32) for i in range(3)]
         batch = codec.decode_batch(field, [codec.encode(field, a) for a in arrs])
         assert [b.shape for b in batch] == [(1, 3), (2, 3), (3, 3)]
+
+
+@pytest.fixture(scope='module')
+def jpeg_native():
+    from petastorm_tpu.native import get_jpeg_module
+    module = get_jpeg_module()
+    if module is None:
+        pytest.skip('native jpeg extension could not be built '
+                    '(no libjpeg dev files?)')
+    return module
+
+
+def _jpeg_cells(n, h=48, w=64, seed=0, quality=90):
+    import cv2
+    rng = np.random.RandomState(seed)
+    cells, images = [], []
+    for _ in range(n):
+        base = cv2.resize((rng.rand(8, 8, 3) * 200).astype(np.uint8), (w, h),
+                          interpolation=cv2.INTER_CUBIC)
+        img = np.clip(base.astype(np.float64) + rng.rand(h, w, 3) * 40,
+                      0, 255).astype(np.uint8)
+        ok, enc = cv2.imencode('.jpeg',
+                               cv2.cvtColor(img, cv2.COLOR_RGB2BGR),
+                               [int(cv2.IMWRITE_JPEG_QUALITY), quality])
+        assert ok
+        cells.append(enc.tobytes())
+        images.append(img)
+    return cells, images
+
+
+class TestNativeJpegDecoder:
+    def test_bit_exact_with_cv2(self, jpeg_native):
+        import cv2
+        cells, _ = _jpeg_cells(6)
+        out = np.empty((6, 48, 64, 3), np.uint8)
+        assert jpeg_native.decode_jpeg_batch(cells, out) == 6
+        for i, cell in enumerate(cells):
+            ref = cv2.imdecode(np.frombuffer(cell, np.uint8),
+                               cv2.IMREAD_COLOR_RGB)
+            np.testing.assert_array_equal(out[i], ref)
+
+    def test_corrupt_cell_stops_prefix(self, jpeg_native):
+        cells, _ = _jpeg_cells(5)
+        cells[2] = cells[2][:40]
+        out = np.empty((5, 48, 64, 3), np.uint8)
+        assert jpeg_native.decode_jpeg_batch(cells, out) == 2
+
+    def test_wrong_size_stops(self, jpeg_native):
+        cells, _ = _jpeg_cells(3)
+        out = np.empty((3, 32, 32, 3), np.uint8)
+        assert jpeg_native.decode_jpeg_batch(cells, out) == 0
+
+    def test_grayscale_rejected_to_python_path(self, jpeg_native):
+        import cv2
+        gray = (np.arange(48 * 64, dtype=np.uint8).reshape(48, 64))
+        ok, enc = cv2.imencode('.jpeg', gray)
+        cells, _ = _jpeg_cells(2)
+        out = np.empty((3, 48, 64, 3), np.uint8)
+        assert jpeg_native.decode_jpeg_batch(
+            [cells[0], enc.tobytes(), cells[1]], out) == 1
+
+    def test_arrow_buffer_cells(self, jpeg_native):
+        import pyarrow as pa
+        cells, _ = _jpeg_cells(4)
+        arr = pa.array(cells, pa.binary())
+        out = np.empty((4, 48, 64, 3), np.uint8)
+        assert jpeg_native.decode_jpeg_batch(
+            [v.as_buffer() for v in arr], out) == 4
+
+    def test_bad_out_array_raises(self, jpeg_native):
+        cells, _ = _jpeg_cells(1)
+        with pytest.raises(ValueError, match='uint8'):
+            jpeg_native.decode_jpeg_batch(cells,
+                                          np.empty((1, 4, 4, 4), np.uint8))
+
+
+class TestJpegCodecIntegration:
+    def test_codec_batch_bit_exact_with_per_cell(self):
+        from petastorm_tpu.codecs import CompressedImageCodec
+        codec = CompressedImageCodec('jpeg', quality=92)
+        field = UnischemaField('im', np.uint8, (48, 64, 3), codec, False)
+        cells = [codec.encode(field, img)
+                 for img in _jpeg_cells(8, seed=3)[1]]
+        batch = codec.decode_batch(field, cells)
+        assert isinstance(batch, np.ndarray) and batch.shape == (8, 48, 64, 3)
+        for i, cell in enumerate(cells):
+            np.testing.assert_array_equal(batch[i], codec.decode(field, cell))
+
+    def test_codec_batch_with_mid_batch_oddball(self):
+        # a grayscale cell mid-batch: native rejects it, _decode_into
+        # raises on the shape mismatch, the codec falls back to the
+        # per-cell list path preserving the odd cell's true shape
+        import cv2
+        from petastorm_tpu.codecs import CompressedImageCodec
+        codec = CompressedImageCodec('jpeg')
+        field = UnischemaField('im', np.uint8, (48, 64, 3), codec, False)
+        cells = [codec.encode(field, img)
+                 for img in _jpeg_cells(5, seed=4)[1]]
+        gray = (np.arange(48 * 64, dtype=np.uint8).reshape(48, 64))
+        ok, enc = cv2.imencode('.jpeg', gray)
+        cells.insert(2, bytearray(enc.tobytes()))
+        decoded = codec.decode_batch(field, cells)
+        assert isinstance(decoded, list) and len(decoded) == 6
+        assert decoded[2].shape == (48, 64)
+        assert decoded[0].shape == (48, 64, 3)
+
+    def test_mid_batch_png_cell_keeps_native_tail(self):
+        # a PNG cell in a jpeg-codec batch: native rejects it, cv2 decodes
+        # it into its row, and the native loop RE-ENTERS for the tail (the
+        # dense array comes back fully populated, not a list)
+        import cv2
+        from petastorm_tpu.codecs import CompressedImageCodec
+        codec = CompressedImageCodec('jpeg')
+        field = UnischemaField('im', np.uint8, (48, 64, 3), codec, False)
+        images = _jpeg_cells(6, seed=5)[1]
+        cells = [codec.encode(field, img) for img in images]
+        ok, png = cv2.imencode('.png', cv2.cvtColor(images[3],
+                                                    cv2.COLOR_RGB2BGR))
+        cells[3] = bytearray(png.tobytes())
+        batch = codec.decode_batch(field, cells)
+        assert isinstance(batch, np.ndarray) and batch.shape == (6, 48, 64, 3)
+        np.testing.assert_array_equal(batch[3], images[3])  # png lossless
+        for i in (0, 1, 2, 4, 5):
+            np.testing.assert_array_equal(batch[i],
+                                          codec.decode(field, cells[i]))
