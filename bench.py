@@ -256,9 +256,9 @@ def _measure_tfdata(tfrecord_path, warmup, measure, timeout=240):
 _JAX_SNIPPET = r'''
 import json, os, sys, time
 sys.path.insert(0, %(repo)r)
+import jax
 if os.environ.get('BENCH_JAX_PLATFORM'):
     # env JAX_PLATFORMS alone loses to a preregistered TPU plugin
-    import jax
     jax.config.update('jax_platforms', os.environ['BENCH_JAX_PLATFORM'])
 from petastorm_tpu.jax import make_jax_loader
 url, batch_size, warmup, measure, fields = %(url)r, %(batch)d, %(warmup)d, %(measure)d, %(fields)r
@@ -279,8 +279,29 @@ with make_jax_loader(url, batch_size=batch_size, fields=fields,
             nbytes += arr.nbytes
         seen += batch_size
     elapsed = time.monotonic() - start
+
+# Raw H2D calibration: device_put the SAME host batch shapes in a tight
+# loop — the link's achievable bandwidth with zero pipeline around it.
+# h2d_efficiency = loader H2D / raw H2D attributes the host-vs-jax gap:
+# ~1.0 means the staging layer saturates the link (the gap IS the link,
+# e.g. a tunneled chip); <1.0 means staging overhead steals bandwidth.
+import numpy as np
+hosts = [{k: np.array(v) for k, v in b.items()} for _ in range(2)]
+batch_bytes = sum(a.nbytes for a in hosts[0].values())
+reps = max(4, min(64, int(3e8 / max(1, batch_bytes))))
+jax.device_put(hosts[0])  # warm any lazy init
+start = time.monotonic()
+for i in range(reps):
+    put = jax.device_put(hosts[i %% 2])  # alternate: defeat any caching
+    for arr in put.values():
+        arr.block_until_ready()
+raw_elapsed = time.monotonic() - start
+raw_mb = reps * batch_bytes / raw_elapsed / 2 ** 20
+loader_mb = nbytes / elapsed / 2 ** 20
 print(json.dumps({"rows_per_sec": seen / elapsed,
-                  "h2d_mb_per_sec": nbytes / elapsed / 2 ** 20}))
+                  "h2d_mb_per_sec": loader_mb,
+                  "raw_h2d_mb_per_sec": raw_mb,
+                  "h2d_efficiency": loader_mb / raw_mb}))
 '''
 
 

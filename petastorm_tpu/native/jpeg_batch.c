@@ -147,8 +147,10 @@ decode_jpeg_batch(PyObject *self, PyObject *args)
     for (i = 0; i < n; i++) {
         PyObject *cell = PySequence_GetItem(cells, i);
         int rc;
-        if (cell == NULL)
+        if (cell == NULL) {
+            PyErr_Clear();  /* decode the prefix; Python path owns the rest */
             break;
+        }
         rc = PyObject_GetBuffer(cell, &views[i], PyBUF_SIMPLE);
         Py_DECREF(cell);
         if (rc != 0) {
