@@ -1,6 +1,6 @@
 """First-party native (C) components for the decode hot path.
 
-Two extensions, each built lazily on first use (cc via setuptools) and
+Each extension is built lazily on first use (cc via setuptools) and
 cached next to the source; any build or import failure degrades silently
 to the pure-Python decode path — the native layer is an accelerator,
 never a dependency:
@@ -10,6 +10,8 @@ never a dependency:
 * ``_jpeg_batch.decode_jpeg_batch`` — batched RGB JPEG decode via
   libjpeg(-turbo) (:class:`~petastorm_tpu.codecs.CompressedImageCodec`);
   needs ``jpeglib.h`` + ``-ljpeg`` at build time.
+* ``_png_batch.decode_png_batch`` — batched RGB PNG decode via libpng
+  (same codec); needs ``png.h`` + ``-lpng`` at build time.
 """
 
 import logging
@@ -24,6 +26,7 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 _EXTENSIONS = {
     '_npy_batch': ('npy_batch.c', {'numpy_include': True}),
     '_jpeg_batch': ('jpeg_batch.c', {'libraries': ['jpeg']}),
+    '_png_batch': ('png_batch.c', {'libraries': ['png']}),
 }
 
 _loaded = {}            # name -> module
@@ -124,3 +127,8 @@ def get_native_module():
 def get_jpeg_module():
     """The compiled ``_jpeg_batch`` module, or None when unavailable."""
     return _get_extension('_jpeg_batch')
+
+
+def get_png_module():
+    """The compiled ``_png_batch`` module, or None when unavailable."""
+    return _get_extension('_png_batch')

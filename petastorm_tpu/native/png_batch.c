@@ -1,0 +1,203 @@
+/* Native batched PNG decode: the 8-bit RGB fast path of
+ * CompressedImageCodec, sibling of jpeg_batch.c.
+ *
+ * decode_png_batch(cells, out): decode each PNG cell straight into row i
+ * of a preallocated (N, H, W, 3) uint8 batch with libpng — PNG stores RGB
+ * natively, so rows land in the output with no channel conversion at all,
+ * bit-identical to the cv2 path. The whole loop runs with the GIL
+ * RELEASED in one native call; per cell, libpng's own decode cost equals
+ * cv2's (~10us for a 32x32 cell, measured), so the win is the removed
+ * per-cell Python dispatch/alloc (~5us/cell, ~40% of the small-image
+ * path).
+ *
+ * Returns the count of successfully decoded leading cells; a cell that is
+ * not a non-interlaced 8-bit RGB PNG of exactly the declared (H, W) stops
+ * the loop, and the caller routes the remainder through the generic cv2
+ * path (same prefix-count contract as jpeg_batch.c / npy_batch.c).
+ *
+ * Framework rationale (SURVEY.md section 7.3): the hello-world headline
+ * rate is png-decode-bound; the reference left this loop to per-cell
+ * OpenCV calls (petastorm/codecs.py:102-130) — here it is first-party
+ * native code.
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <setjmp.h>
+#include <stddef.h>
+#include <string.h>
+#include <png.h>
+
+struct pt_mem_reader {
+    const unsigned char *data;
+    size_t len;
+    size_t pos;
+};
+
+static void
+pt_read_fn(png_structp png, png_bytep out, png_size_t n)
+{
+    struct pt_mem_reader *r = (struct pt_mem_reader *)png_get_io_ptr(png);
+    if (r->pos + n > r->len)
+        png_error(png, "premature end of PNG data");
+    memcpy(out, r->data + r->pos, n);
+    r->pos += n;
+}
+
+static void
+pt_png_warn(png_structp png, png_const_charp msg)
+{
+    /* no stderr chatter from a data-loader hot loop */
+    (void)png;
+    (void)msg;
+}
+
+static void
+pt_png_error(png_structp png, png_const_charp msg)
+{
+    /* libpng's DEFAULT error handler prints to stderr before jumping;
+     * corrupt cells are an expected input here (they fall back to the
+     * Python path), so jump silently */
+    (void)msg;
+    png_longjmp(png, 1);
+}
+
+/* Decode one cell; returns 0 on success, -1 on mismatch/corruption. */
+static int
+decode_one(const unsigned char *buf, size_t len, unsigned char *dst,
+           int height, int width)
+{
+    struct pt_mem_reader rd = { buf, len, 0 };
+    png_structp png;
+    png_infop info;
+    int r;
+
+    png = png_create_read_struct(PNG_LIBPNG_VER_STRING, NULL, pt_png_error,
+                                 pt_png_warn);
+    if (png == NULL)
+        return -1;
+    info = png_create_info_struct(png);
+    if (info == NULL) {
+        png_destroy_read_struct(&png, NULL, NULL);
+        return -1;
+    }
+    if (setjmp(png_jmpbuf(png))) {
+        png_destroy_read_struct(&png, &info, NULL);
+        return -1;
+    }
+    png_set_read_fn(png, &rd, pt_read_fn);
+    png_read_info(png, info);
+    if (png_get_color_type(png, info) != PNG_COLOR_TYPE_RGB
+        || png_get_bit_depth(png, info) != 8
+        || png_get_interlace_type(png, info) != PNG_INTERLACE_NONE
+        || (int)png_get_image_height(png, info) != height
+        || (int)png_get_image_width(png, info) != width) {
+        /* grayscale / palette / RGBA / 16-bit / interlaced / wrong size:
+         * the Python path owns these */
+        png_destroy_read_struct(&png, &info, NULL);
+        return -1;
+    }
+    for (r = 0; r < height; r++)
+        png_read_row(png, dst + (size_t)r * (size_t)width * 3, NULL);
+    png_destroy_read_struct(&png, &info, NULL);
+    return 0;
+}
+
+static PyObject *
+decode_png_batch(PyObject *self, PyObject *args)
+{
+    PyObject *cells;
+    PyObject *out_obj;
+    Py_buffer out_view;
+    Py_ssize_t n, i, decoded;
+    Py_buffer *views = NULL;
+    int height, width;
+
+    (void)self;
+    if (!PyArg_ParseTuple(args, "OO", &cells, &out_obj))
+        return NULL;
+    if (PyObject_GetBuffer(out_obj, &out_view,
+                           PyBUF_WRITABLE | PyBUF_ND
+                           | PyBUF_C_CONTIGUOUS) != 0)
+        return NULL;
+
+    if (out_view.ndim != 4 || out_view.itemsize != 1
+        || out_view.shape[3] != 3) {
+        PyBuffer_Release(&out_view);
+        PyErr_SetString(PyExc_ValueError,
+                        "out must be a C-contiguous (N, H, W, 3) uint8 array");
+        return NULL;
+    }
+    n = out_view.shape[0];
+    height = (int)out_view.shape[1];
+    width = (int)out_view.shape[2];
+
+    if (!PySequence_Check(cells) || PySequence_Size(cells) != n) {
+        PyBuffer_Release(&out_view);
+        PyErr_SetString(PyExc_ValueError,
+                        "cells must be a sequence matching out's batch dim");
+        return NULL;
+    }
+
+    views = PyMem_Calloc((size_t)(n ? n : 1), sizeof(Py_buffer));
+    if (views == NULL) {
+        PyBuffer_Release(&out_view);
+        return PyErr_NoMemory();
+    }
+    for (i = 0; i < n; i++) {
+        PyObject *cell = PySequence_GetItem(cells, i);
+        int rc;
+        if (cell == NULL) {
+            PyErr_Clear();  /* decode the prefix; Python path owns the rest */
+            break;
+        }
+        rc = PyObject_GetBuffer(cell, &views[i], PyBUF_SIMPLE);
+        Py_DECREF(cell);
+        if (rc != 0) {
+            PyErr_Clear();
+            break;
+        }
+    }
+    {
+        Py_ssize_t n_views = i;
+        size_t row_bytes = (size_t)height * (size_t)width * 3;
+        unsigned char *out_base = (unsigned char *)out_view.buf;
+
+        decoded = 0;
+        Py_BEGIN_ALLOW_THREADS
+        for (i = 0; i < n_views; i++) {
+            if (decode_one((const unsigned char *)views[i].buf,
+                           (size_t)views[i].len,
+                           out_base + (size_t)i * row_bytes,
+                           height, width) != 0)
+                break;
+            decoded++;
+        }
+        Py_END_ALLOW_THREADS
+
+        for (i = 0; i < n_views; i++)
+            PyBuffer_Release(&views[i]);
+    }
+    PyMem_Free(views);
+    PyBuffer_Release(&out_view);
+    return PyLong_FromSsize_t(decoded);
+}
+
+static PyMethodDef png_batch_methods[] = {
+    {"decode_png_batch", decode_png_batch, METH_VARARGS,
+     "Batched RGB PNG decode into a preallocated (N,H,W,3) uint8 array; "
+     "returns the decoded prefix count"},
+    {NULL, NULL, 0, NULL}
+};
+
+static struct PyModuleDef png_batch_module = {
+    PyModuleDef_HEAD_INIT, "_png_batch",
+    "Native batched PNG decoder (libpng)", -1, png_batch_methods,
+    NULL, NULL, NULL, NULL
+};
+
+PyMODINIT_FUNC
+PyInit__png_batch(void)
+{
+    return PyModule_Create(&png_batch_module);
+}

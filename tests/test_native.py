@@ -253,3 +253,70 @@ class TestJpegCodecIntegration:
         for i in (0, 1, 2, 4, 5):
             np.testing.assert_array_equal(batch[i],
                                           codec.decode(field, cells[i]))
+
+
+@pytest.fixture(scope='module')
+def png_native():
+    from petastorm_tpu.native import get_png_module
+    module = get_png_module()
+    if module is None:
+        pytest.skip('native png extension could not be built '
+                    '(no libpng dev files?)')
+    return module
+
+
+class TestNativePngDecoder:
+    def _png_cells(self, n, h=32, w=32, seed=0):
+        import cv2
+        rng = np.random.RandomState(seed)
+        cells, images = [], []
+        for _ in range(n):
+            img = rng.randint(0, 255, (h, w, 3), dtype=np.uint8)
+            ok, enc = cv2.imencode('.png', cv2.cvtColor(img,
+                                                        cv2.COLOR_RGB2BGR))
+            assert ok
+            cells.append(enc.tobytes())
+            images.append(img)
+        return cells, images
+
+    def test_lossless_roundtrip(self, png_native):
+        cells, images = self._png_cells(6)
+        out = np.empty((6, 32, 32, 3), np.uint8)
+        assert png_native.decode_png_batch(cells, out) == 6
+        for i in range(6):
+            np.testing.assert_array_equal(out[i], images[i])
+
+    def test_corrupt_cell_stops_prefix(self, png_native):
+        cells, _ = self._png_cells(4)
+        cells[1] = cells[1][:30]
+        out = np.empty((4, 32, 32, 3), np.uint8)
+        assert png_native.decode_png_batch(cells, out) == 1
+
+    def test_gray_or_wrong_size_rejected(self, png_native):
+        import cv2
+        cells, _ = self._png_cells(2)
+        gray = np.arange(32 * 32, dtype=np.uint8).reshape(32, 32)
+        ok, enc = cv2.imencode('.png', gray)
+        out = np.empty((3, 32, 32, 3), np.uint8)
+        assert png_native.decode_png_batch(
+            [cells[0], enc.tobytes(), cells[1]], out) == 1
+        small = np.empty((2, 16, 16, 3), np.uint8)
+        assert png_native.decode_png_batch(cells, small) == 0
+
+    def test_codec_batch_uses_native_and_matches(self, png_native,
+                                                 monkeypatch):
+        from petastorm_tpu.codecs import CompressedImageCodec
+        calls = []
+        real = png_native.decode_png_batch
+        monkeypatch.setattr(
+            png_native, 'decode_png_batch',
+            lambda cells, out: calls.append(len(cells)) or real(cells, out))
+        codec = CompressedImageCodec('png')
+        field = UnischemaField('im', np.uint8, (32, 32, 3), codec, False)
+        images = self._png_cells(8, seed=9)[1]
+        cells = [codec.encode(field, img) for img in images]
+        batch = codec.decode_batch(field, cells)
+        assert calls, 'native png path was not used'
+        assert isinstance(batch, np.ndarray) and batch.shape == (8, 32, 32, 3)
+        for i in range(8):
+            np.testing.assert_array_equal(batch[i], images[i])
