@@ -1,0 +1,36 @@
+# Developer entry points (reference equivalent: /root/reference/Makefile).
+# Every target runs in-place against the working tree.
+
+PYTHON ?= python
+
+.PHONY: test test-fast lint typecheck bench dryrun docker clean
+
+# full suite (~10 min: includes the compile-heavy model/attention tests)
+test:
+	$(PYTHON) -m pytest tests/ -q
+
+# quick profile (~3-4 min): skips tests marked slow
+test-fast:
+	$(PYTHON) -m pytest tests/ -q -m "not slow"
+
+lint:
+	$(PYTHON) -m flake8 petastorm_tpu tests examples
+
+typecheck:
+	$(PYTHON) -m mypy petastorm_tpu
+
+# one JSON line of round metrics (row/batch/jax/lm-train/vs-tf.data)
+bench:
+	$(PYTHON) bench.py
+
+# compile + execute every parallelism family on an 8-virtual-device mesh
+dryrun:
+	JAX_PLATFORMS=cpu $(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+docker:
+	docker build -t petastorm-tpu-dev -f docker/Dockerfile .
+
+clean:
+	rm -rf build dist *.egg-info petastorm_tpu/native/build \
+	       petastorm_tpu/native/*.so
+	find . -name __pycache__ -type d -exec rm -rf {} +

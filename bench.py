@@ -339,6 +339,11 @@ url, batch, seq_len, warmup, measure = (
 # config — the loader-vs-synthetic ratio stays meaningful, MFU does not
 # (no 'peak' for CPU, so it is omitted anyway).
 if jax.default_backend() == 'cpu':
+    # seq 1024 attention alone is ~minutes/step on CPU; shrink the whole
+    # shape so the fallback still finishes inside the subprocess timeout
+    seq_len = min(seq_len, 256)
+    batch = min(batch, 8)
+    measure = min(measure, 8)
     config = TransformerConfig(vocab_size=256, d_model=128, n_heads=4,
                                n_layers=4, d_ff=512, max_seq_len=seq_len)
 else:

@@ -172,6 +172,59 @@ def _make_ngram_dataset(tf, reader):
 
 _TF_TENSOR_ITERATORS = None
 
+#: well-known op name monitoring tools grep for
+#: (reference: ``petastorm/tf_utils.py:46-48``)
+RANDOM_SHUFFLING_QUEUE_SIZE = 'random_shuffling_queue_size'
+
+
+def shuffling_queue_size_tensor(reader):
+    """A scalar int64 tensor named ``random_shuffling_queue_size`` reporting
+    how many decoded ITEMS (row-group result batches — not individual
+    rows) are buffered or in flight ahead of the consumer right now.
+
+    TF2 re-design of the reference's well-known queue-size op
+    (``petastorm/tf_utils.py:46-48``: its TF1 ``RandomShuffleQueue`` exposed
+    ``.size()`` under that name for TensorBoard fill-level monitoring; TF2's
+    ``dataset.shuffle`` hides its buffer). The value comes from the reader's
+    own :attr:`diagnostics` gauges: explicit queue depths where the pool
+    reports them (thread pool, JaxLoader staging), otherwise
+    ventilated-minus-processed in-flight items (process pool) — evaluate it
+    in a summary callback each step::
+
+        tf.summary.scalar('shuffling_queue_size',
+                          shuffling_queue_size_tensor(reader))
+
+    A shrinking value means the consumer outruns the input pipeline (add
+    workers); a steadily full gauge means the input side is not the
+    bottleneck.
+    """
+    tf = _import_tf()
+
+    def _size():
+        return np.int64(_buffered_item_count(
+            getattr(reader, 'diagnostics', None) or {}))
+
+    return tf.py_function(_size, [], tf.int64,
+                          name=RANDOM_SHUFFLING_QUEUE_SIZE)
+
+
+def _buffered_item_count(diag):
+    """Decoded items buffered/in flight per the diagnostics gauges."""
+    total = 0
+    found = False
+    for key in ('stage_queue_depth', 'output_queue_size'):
+        value = diag.get(key)
+        if isinstance(value, (int, float)):
+            total += int(value)
+            found = True
+    if not found:
+        ventilated = diag.get('items_ventilated')
+        processed = diag.get('items_processed')
+        if isinstance(ventilated, (int, float)) \
+                and isinstance(processed, (int, float)):
+            total = max(0, int(ventilated) - int(processed))
+    return total
+
 
 def tf_tensors(reader, shuffling_queue_capacity=0, min_after_dequeue=0):
     """TF1-style compat shim: each call yields the reader's next row as eager

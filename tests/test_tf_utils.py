@@ -182,3 +182,35 @@ def test_training_loop_consumes_dataset(scalar_dataset):
         model = tf.keras.Sequential([tf.keras.layers.Dense(1)])
         model.compile(optimizer='sgd', loss='mse')
         model.fit(dataset, epochs=1, verbose=0)
+
+
+def test_shuffling_queue_size_tensor(synthetic_dataset):
+    import tensorflow as tf
+
+    from petastorm_tpu.reader import make_reader
+    from petastorm_tpu.tf_utils import (
+        RANDOM_SHUFFLING_QUEUE_SIZE, shuffling_queue_size_tensor,
+    )
+    assert RANDOM_SHUFFLING_QUEUE_SIZE == 'random_shuffling_queue_size'
+    with make_reader(synthetic_dataset.url, schema_fields=['^id$'],
+                     num_epochs=None) as reader:
+        next(reader)  # pipeline warm: queues have content
+        size = shuffling_queue_size_tensor(reader)
+        assert size.dtype == tf.int64
+        # the tensor must track the reader's LIVE gauges, not a constant
+        from petastorm_tpu.tf_utils import _buffered_item_count
+        want = _buffered_item_count(reader.diagnostics)
+        got = int(size.numpy())
+        assert abs(got - want) <= 2  # pipeline may progress between reads
+        assert got > 0  # warm endless pipeline: something is buffered
+
+
+def test_buffered_item_count_gauge_sources():
+    from petastorm_tpu.tf_utils import _buffered_item_count
+    # explicit queue depths win (thread pool / JaxLoader staging)
+    assert _buffered_item_count({'stage_queue_depth': 2,
+                                 'output_queue_size': 3}) == 5
+    # process pool: in-flight = ventilated - processed
+    assert _buffered_item_count({'items_ventilated': 7,
+                                 'items_processed': 4}) == 3
+    assert _buffered_item_count({}) == 0
