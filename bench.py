@@ -265,19 +265,32 @@ url, batch_size, warmup, measure, fields = %(url)r, %(batch)d, %(warmup)d, %(mea
 with make_jax_loader(url, batch_size=batch_size, fields=fields,
                      num_epochs=None,
                      shuffle_row_groups=True) as loader:
+    import jax.numpy as jnp
     it = iter(loader)
     seen = 0
+    fence = jnp.zeros((), jnp.float32)
     while seen < warmup:
-        next(it); seen += batch_size
+        b = next(it); seen += batch_size
+        for arr in b.values():
+            # warm the fence ops' compiles outside the measured window
+            fence = fence + jnp.sum(arr[..., :1].astype(jnp.float32))
+    float(fence)
     seen = 0
     nbytes = 0
+    fence = jnp.zeros((), jnp.float32)
     start = time.monotonic()
     while seen < measure:
         b = next(it)
         for arr in b.values():
             arr.block_until_ready()
             nbytes += arr.nbytes
+            # device-side touch of every staged array: the final host READ
+            # of `fence` (below) transitively requires every transfer to
+            # have really completed, even if an experimental runtime's
+            # ready-signal fires early
+            fence = fence + jnp.sum(arr[..., :1].astype(jnp.float32))
         seen += batch_size
+    float(fence)
     elapsed = time.monotonic() - start
 
 # Raw H2D calibration: device_put the SAME host batch shapes in a tight
@@ -289,12 +302,22 @@ import numpy as np
 hosts = [{k: np.array(v) for k, v in b.items()} for _ in range(2)]
 batch_bytes = sum(a.nbytes for a in hosts[0].values())
 reps = max(4, min(64, int(3e8 / max(1, batch_bytes))))
-jax.device_put(hosts[0])  # warm any lazy init
+# warm lazy init AND the fence ops' compiles outside the timed window
+for arr in jax.device_put(hosts[0]).values():
+    np.asarray(arr.ravel()[:1])
 start = time.monotonic()
+put = None
 for i in range(reps):
     put = jax.device_put(hosts[i %% 2])  # alternate: defeat any caching
     for arr in put.values():
         arr.block_until_ready()
+# final-rep D2H value reads: transfers execute in dispatch order on the
+# device, so forcing the LAST rep's arrays to concrete host values bounds
+# the whole sequence even if intermediate ready-signals fired early (a
+# per-rep device-op fence would dominate the measurement with dispatch
+# overhead on fast links)
+for arr in put.values():
+    np.asarray(arr.ravel()[:1])  # device-side slice: 1-element D2H only
 raw_elapsed = time.monotonic() - start
 raw_mb = reps * batch_bytes / raw_elapsed / 2 ** 20
 loader_mb = nbytes / elapsed / 2 ** 20
@@ -381,15 +404,18 @@ with make_jax_loader(url, batch_size=batch, num_epochs=None,
         if len(staged) < 4:
             staged.append(tokens)
         params, opt_state, loss = step(params, opt_state, tokens)
-    loss.block_until_ready()
+    # Timing fence: a DEVICE-TO-HOST VALUE READ, not block_until_ready.
+    # The value of step N's loss transitively requires every prior step's
+    # compute, and a concrete host float cannot be delivered early by an
+    # experimental runtime the way a too-eager ready-signal can.
+    float(loss)
     start = time.monotonic()
     for _ in range(measure):
         params, opt_state, loss = step(params, opt_state, next(it)['tokens'])
-    loss.block_until_ready()
-    loader_elapsed = time.monotonic() - start
     # the reported loss is the LOADER-FED run's final loss; the synthetic
     # re-feed below keeps training and must not redefine it
     final_loss = float(loss)
+    loader_elapsed = time.monotonic() - start
 
 # Same step count fed from batches ALREADY in HBM: the loader-free step
 # time. input_bound_util = loader-fed / in-HBM step time; <=1.05 means the
@@ -401,7 +427,7 @@ if staged:
     for i in range(measure):
         params, opt_state, loss = step(params, opt_state,
                                        staged[i %% len(staged)])
-    loss.block_until_ready()
+    float(loss)  # same D2H fence as the loader-fed loop
     synthetic_elapsed = time.monotonic() - start
 
 result = {
