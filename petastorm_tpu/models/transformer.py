@@ -53,6 +53,12 @@ class TransformerConfig:
     # n_heads % n_seq_shards == 0).
     seq_axis: str = None
     seq_impl: str = 'ring'
+    # single-chip attention implementation: 'dense' materializes the
+    # (B,H,S,S) scores (exact, runs anywhere); 'flash' uses the fused
+    # Pallas kernel on TPU (ops/flash_attention.py; falls back to dense
+    # off-TPU so the same config tests on the CPU mesh). Ignored when
+    # seq_axis is set — ring/Ulysses own the sharded-sequence case.
+    attn_impl: str = 'dense'
 
     def __post_init__(self):
         # validate at construction, not mid-trace inside layer 0's
@@ -61,6 +67,9 @@ class TransformerConfig:
         if self.seq_impl not in ('ring', 'ulysses'):
             raise ValueError("seq_impl must be 'ring' or 'ulysses'; got %r"
                              % (self.seq_impl,))
+        if self.attn_impl not in ('dense', 'flash'):
+            raise ValueError("attn_impl must be 'dense' or 'flash'; got %r"
+                             % (self.attn_impl,))
 
     def moe_config(self):
         from petastorm_tpu.models.moe import MoEConfig
@@ -159,7 +168,7 @@ def _rmsnorm(x, gain):
 
 
 def _attention(x, qkv_w, out_w, n_heads, dtype, seq_axis=None, mesh=None,
-               seq_impl='ring'):
+               seq_impl='ring', attn_impl='dense'):
     b, s, d = x.shape
     head_dim = d // n_heads
     qkv = jnp.einsum('bsd,de->bse', x, qkv_w.astype(dtype),
@@ -186,6 +195,12 @@ def _attention(x, qkv_w, out_w, n_heads, dtype, seq_axis=None, mesh=None,
                             v.reshape(bshd), mesh, axis_name=seq_axis,
                             causal=True, batch_axis=batch_axis)
         ctx = ctx.reshape(b, s, d)
+    elif attn_impl == 'flash':
+        from petastorm_tpu.ops.flash_attention import flash_causal_attention
+        bshd = (b, s, n_heads, head_dim)
+        ctx = flash_causal_attention(q.reshape(bshd), k_.reshape(bshd),
+                                     v.reshape(bshd))
+        ctx = ctx.reshape(b, s, d)
     else:
         def heads(t):
             return t.reshape(b, s, n_heads, head_dim).transpose(0, 2, 1, 3)
@@ -209,7 +224,7 @@ def _block_attention_half(block, x, config, mesh=None):
     h = _rmsnorm(x, block['ln1'])
     x = x + _attention(h, block['qkv'], block['attn_out'], config.n_heads,
                        config.dtype, seq_axis=config.seq_axis, mesh=mesh,
-                       seq_impl=config.seq_impl)
+                       seq_impl=config.seq_impl, attn_impl=config.attn_impl)
     return _constrain(x, config.seq_axis)
 
 

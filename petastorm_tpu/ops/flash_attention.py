@@ -1,0 +1,81 @@
+"""Fused causal attention on the MXU: the Pallas flash-attention kernel.
+
+The dense attention path materializes the full ``(B, H, S, S)`` score
+tensor in HBM — at seq 1024+ that is the transformer's HBM-bandwidth
+hot spot and the ceiling on single-chip MFU. This wraps jax's shipped
+Pallas TPU flash-attention kernel (blockwise online-softmax; scores only
+ever live in VMEM tiles) behind this framework's ``(B, S, H, D)`` layout,
+with two fallbacks so the SAME model code runs everywhere:
+
+* real TPU → the Pallas kernel;
+* any other backend → the exact dense reference (tests oracle against it;
+  CPU-mesh CI never depends on kernel support).
+
+Selected per-model via ``TransformerConfig(attn_impl='flash')``; combines
+with dp/tp/pp meshes (the kernel runs per-shard under XLA's auto
+partitioning) but not with ``seq_axis`` (ring/Ulysses own the sharded-S
+case).
+
+The reference framework has no model execution layer (SURVEY.md §0);
+this is part of the TPU-native consumer layer, alongside
+:mod:`petastorm_tpu.ops.ring_attention`.
+"""
+
+import jax
+import numpy as np
+
+from petastorm_tpu.ops.ring_attention import reference_attention
+
+#: the kernel's default block size: sequences must be multiples of it
+#: (jax's _verify_block rejects others); shorter/ragged lengths take the
+#: dense path rather than shrinking blocks below MXU tiles
+_FLASH_BLOCK = 128
+
+
+def reference_causal_attention(q, k, v, sm_scale):
+    """Dense causal attention oracle — the ONE shared dense oracle
+    (:func:`petastorm_tpu.ops.ring_attention.reference_attention`), so a
+    numerics change there is the single source of truth here too."""
+    return reference_attention(q, k, v, causal=True, scale=sm_scale)
+
+
+def _on_tpu():
+    try:
+        return jax.default_backend() == 'tpu'
+    except Exception:  # noqa: BLE001 - uninitialized backend
+        return False
+
+
+def flash_causal_attention(q, k, v, sm_scale=None, force_kernel=False):
+    """Causal self-attention, fused when the backend supports it.
+
+    :param q, k, v: ``(B, S, H, D)`` activations (the framework layout).
+    :param sm_scale: score scale; default ``1/sqrt(D)``.
+    :param force_kernel: run the Pallas kernel even off-TPU (interpret
+        mode — slow, for kernel-correctness tests only).
+    :return: ``(B, S, H, D)`` context, same dtype as ``q``.
+    """
+    b, s, h, d = q.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / np.sqrt(d)
+    use_kernel = force_kernel or (_on_tpu() and s % _FLASH_BLOCK == 0
+                                  and s >= _FLASH_BLOCK)
+    if not use_kernel:
+        return reference_causal_attention(q, k, v, sm_scale)
+
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        flash_attention,
+    )
+
+    def run():
+        # kernel layout is (B, H, S, D)
+        bhsd = lambda t: t.transpose(0, 2, 1, 3)  # noqa: E731
+        out = flash_attention(bhsd(q), bhsd(k), bhsd(v), causal=True,
+                              sm_scale=float(sm_scale))
+        return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+    if force_kernel and not _on_tpu():
+        from jax.experimental.pallas import tpu as pltpu
+        with pltpu.force_tpu_interpret_mode():
+            return run()
+    return run()
