@@ -354,6 +354,7 @@ from examples.lm.pretrain_example import packing_transform
 
 url, batch, seq_len, warmup, measure = (
     %(url)r, %(batch)d, %(seq)d, %(warmup)d, %(measure)d)
+warmup = max(1, warmup)  # the impl-selection step below consumes one batch
 # Realistically-sized decoder (~185M params): large enough that the
 # per-step matmuls tile the MXU and MFU is meaningful (BASELINE.json metric;
 # a toy model would measure dispatch latency, not feeding capacity). On a
@@ -361,17 +362,19 @@ url, batch, seq_len, warmup, measure = (
 # subprocess timeout by an order of magnitude, so fall back to a small
 # config — the loader-vs-synthetic ratio stays meaningful, MFU does not
 # (no 'peak' for CPU, so it is omitted anyway).
-if jax.default_backend() == 'cpu':
+on_cpu = jax.default_backend() == 'cpu'
+if on_cpu:
     # seq 1024 attention alone is ~minutes/step on CPU; shrink the whole
     # shape so the fallback still finishes inside the subprocess timeout
     seq_len = min(seq_len, 256)
     batch = min(batch, 8)
     measure = min(measure, 8)
-    config = TransformerConfig(vocab_size=256, d_model=128, n_heads=4,
-                               n_layers=4, d_ff=512, max_seq_len=seq_len)
+    model_kw = dict(vocab_size=256, d_model=128, n_heads=4,
+                    n_layers=4, d_ff=512, max_seq_len=seq_len)
 else:
-    config = TransformerConfig(vocab_size=16384, d_model=1024, n_heads=16,
-                               n_layers=12, d_ff=4096, max_seq_len=seq_len)
+    model_kw = dict(vocab_size=16384, d_model=1024, n_heads=16,
+                    n_layers=12, d_ff=4096, max_seq_len=seq_len)
+config = TransformerConfig(**model_kw)
 params = init_transformer_params(jax.random.PRNGKey(0), config)
 optimizer = optax.adamw(1e-3)
 opt_state = optimizer.init(params)
@@ -394,12 +397,35 @@ _PEAKS = (('v5 lite', 197e12), ('v5e', 197e12), ('v5p', 459e12),
 kind = jax.devices()[0].device_kind.lower()
 peak = next((p for key, p in _PEAKS if key in kind), None)
 
+attn_impl = 'dense'
 with make_jax_loader(url, batch_size=batch, num_epochs=None,
                      transform_spec=packing_transform(seq_len),
                      shuffle_row_groups=True) as loader:
     it = loader.iter_steps(warmup + measure)
     staged = []
-    for _ in range(warmup):
+    first = next(it)['tokens']
+    staged.append(first)
+    from petastorm_tpu.ops.flash_attention import kernel_supported
+    if kernel_supported(seq_len):
+        # try the fused Pallas flash-attention step first (no HBM score
+        # tensor -> higher MFU); an unsupported kernel on this chip just
+        # falls back to the dense step, params untouched (functional).
+        # kernel_supported is the wrapper module's own gate, so 'flash'
+        # in the output always means the fused kernel actually ran.
+        try:
+            flash_cfg = TransformerConfig(attn_impl='flash', **model_kw)
+            flash_step = transformer_train_step(flash_cfg, optimizer)
+            p2, o2, l2 = flash_step(params, opt_state, first)
+            float(l2)
+            config, step, attn_impl = flash_cfg, flash_step, 'flash'
+            params, opt_state, loss = p2, o2, l2
+        except Exception as e:
+            print('flash attention unavailable, dense fallback: %%r' %% (e,),
+                  file=sys.stderr)
+            params, opt_state, loss = step(params, opt_state, first)
+    else:
+        params, opt_state, loss = step(params, opt_state, first)
+    for _ in range(warmup - 1):
         tokens = next(it)['tokens']
         if len(staged) < 4:
             staged.append(tokens)
@@ -437,6 +463,7 @@ result = {
     "model_params_m": round((n_matmul + c.vocab_size * c.d_model
                              + c.max_seq_len * c.d_model) / 1e6, 1),
     "device_kind": jax.devices()[0].device_kind,
+    "attn_impl": attn_impl,
 }
 if synthetic_elapsed is not None:
     result["input_bound_util"] = loader_elapsed / synthetic_elapsed

@@ -46,6 +46,15 @@ def _on_tpu():
         return False
 
 
+def kernel_supported(seq_len):
+    """Would :func:`flash_causal_attention` run the FUSED kernel (not the
+    dense fallback) for this sequence length on the current backend? The
+    single source of truth for callers (e.g. the bench) deciding whether
+    an ``attn_impl='flash'`` config buys anything here."""
+    return _on_tpu() and seq_len >= _FLASH_BLOCK \
+        and seq_len % _FLASH_BLOCK == 0
+
+
 def flash_causal_attention(q, k, v, sm_scale=None, force_kernel=False):
     """Causal self-attention, fused when the backend supports it.
 
@@ -58,8 +67,7 @@ def flash_causal_attention(q, k, v, sm_scale=None, force_kernel=False):
     b, s, h, d = q.shape
     if sm_scale is None:
         sm_scale = 1.0 / np.sqrt(d)
-    use_kernel = force_kernel or (_on_tpu() and s % _FLASH_BLOCK == 0
-                                  and s >= _FLASH_BLOCK)
+    use_kernel = force_kernel or kernel_supported(s)
     if not use_kernel:
         return reference_causal_attention(q, k, v, sm_scale)
 
