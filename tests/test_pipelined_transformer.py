@@ -273,3 +273,26 @@ def test_indivisible_layers_rejected():
 
 
 
+
+
+def test_bf16_pipelined_step_on_pipe_mesh():
+    # the PRODUCTION dtype through the pipeline: historically XLA:CPU
+    # crashed compiling ANY bf16 pipelined step ('Invalid binary
+    # instruction opcode copy'); on current jaxlib only the 3-axis
+    # dp x pp x tp bf16 combination still does (docs/troubleshoot.md).
+    # Keep the working pipe-only bf16 case covered so a regression to the
+    # old blanket crash is caught on the CPU mesh.
+    mesh = make_named_mesh({'pipe': 2}, devices=jax.devices()[:2])
+    config = _config(n_layers=2, dtype=jnp.bfloat16)
+    with mesh:
+        params = init_pipelined_transformer_params(jax.random.PRNGKey(1),
+                                                   config, mesh)
+        optimizer = optax.adam(1e-2)
+        step = pipelined_transformer_train_step(config, optimizer, mesh,
+                                                n_microbatches=2)
+        tokens = jax.device_put(
+            jnp.asarray(np.random.RandomState(2)
+                        .randint(0, 32, (4, 9), np.int32)),
+            NamedSharding(mesh, P(None, None)))
+        _, _, loss = step(params, optimizer.init(params), tokens)
+    assert np.isfinite(float(loss))
