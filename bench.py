@@ -341,6 +341,7 @@ def _measure_jax(url, batch_size, warmup, measure, fields, timeout=150):
 _LM_TRAIN_SNIPPET = r'''
 import json, os, sys, time
 sys.path.insert(0, %(repo)r)
+import numpy as np
 if os.environ.get('BENCH_JAX_PLATFORM'):
     import jax
     jax.config.update('jax_platforms', os.environ['BENCH_JAX_PLATFORM'])
@@ -396,6 +397,31 @@ _PEAKS = (('v5 lite', 197e12), ('v5e', 197e12), ('v5p', 459e12),
           ('v3', 123e12), ('v2', 45e12))
 kind = jax.devices()[0].device_kind.lower()
 peak = next((p for key, p in _PEAKS if key in kind), None)
+
+
+def measured_matmul_tflops(n=4096, reps=24):
+    """Achievable bf16 matmul rate on THIS device, measured: a chained
+    (sequentially dependent) square-matmul loop under one jit, fenced by
+    a device-to-host value read. Cross-checks the book peak: if the
+    device_kind's table entry disagrees wildly with what the silicon
+    actually does, MFU numbers against the book value are meaningless
+    (e.g. a tunnel that misreports its device kind)."""
+    import jax.numpy as jnp
+
+    def chain(a):
+        def body(x, _):
+            return jnp.tanh(x @ a) , None
+        x, _ = jax.lax.scan(body, a, None, length=reps)
+        return x
+
+    a = jnp.asarray(np.random.RandomState(0).rand(n, n) * 0.01,
+                    jnp.bfloat16)
+    run = jax.jit(chain)
+    float(run(a)[0, 0])  # compile + warm
+    start = time.monotonic()
+    float(run(a)[0, 0])  # D2H fence
+    elapsed = time.monotonic() - start
+    return 2.0 * n ** 3 * reps / elapsed / 1e12
 
 attn_impl = 'dense'
 with make_jax_loader(url, batch_size=batch, num_epochs=None,
@@ -472,6 +498,16 @@ if peak is not None:
     if synthetic_elapsed is not None:
         result["synthetic_mfu"] = (flops_per_step * measure
                                    / synthetic_elapsed / peak)
+if not on_cpu:
+    # self-validate the MFU denominator against the silicon (skipped on
+    # CPU, where 3.3 TFLOP of matmul is a minute of wall time)
+    try:
+        measured = measured_matmul_tflops()
+        result["measured_matmul_tflops"] = measured
+        if peak is not None:
+            result["measured_vs_book_peak"] = measured * 1e12 / peak
+    except Exception as e:
+        print('matmul calibration failed: %%r' %% (e,), file=sys.stderr)
 print(json.dumps(result))
 '''
 
