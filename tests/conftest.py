@@ -27,6 +27,46 @@ jax.config.update('jax_platforms', 'cpu')
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# Process-pool-parametrized variants each pay a multi-second ZMQ worker
+# spawn (spawn-not-fork, full interpreter + pyarrow import per worker), so
+# the full dummy/thread/process matrix dominates the suite's wall time. The
+# quick profile (-m "not slow") keeps ONE representative process variant per
+# behavior family; process-pool-SPECIFIC tests (worker error propagation,
+# checkpoint-across-process) are unparametrized, never match '[process',
+# and so always stay in the quick profile. The rest of the matrix runs in
+# the full suite, mirroring the reference's all-flavors parametrization
+# (petastorm/tests/test_end_to_end.py:42-58).
+_FAST_PROCESS_KEEP = frozenset([
+    'tests/test_end_to_end.py::test_simple_read_all_fields[process]',
+    'tests/test_workers_pool.py::test_identity_roundtrip[process-2]',
+    'tests/test_ngram.py::TestNGramEndToEnd::test_basic[process]',
+])
+
+# pool param id component, wherever it lands in a (possibly stacked)
+# parametrize id: '[process]', '[process-2]', '[2-process]'
+_PROCESS_ID_RE = __import__('re').compile(r'\[(?:[^\]]*-)?process\b')
+
+
+def pytest_collection_modifyitems(config, items):
+    kept = set()
+    for item in items:
+        if item.nodeid in _FAST_PROCESS_KEEP:
+            kept.add(item.nodeid)
+            continue
+        if (_PROCESS_ID_RE.search(item.name)
+                and not any(m.name == 'slow' for m in item.iter_markers())):
+            item.add_marker(pytest.mark.slow)
+    # A rename/reparametrize must not silently drop process coverage from
+    # the quick profile: a keep entry is STALE when a *process* variant of
+    # its test function was collected but none matched the pinned nodeid.
+    # Runs that collect no process sibling (single-id selections, partial
+    # files) prove nothing either way and stay silent.
+    process_funcs = {i.nodeid.split('[', 1)[0] for i in items
+                     if _PROCESS_ID_RE.search(i.name)}
+    stale = [n for n in _FAST_PROCESS_KEEP - kept
+             if n.split('[', 1)[0] in process_funcs]
+    assert not stale, 'stale _FAST_PROCESS_KEEP entries: %s' % sorted(stale)
+
 
 @pytest.fixture(scope='session')
 def synthetic_dataset(tmp_path_factory):
