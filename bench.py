@@ -399,29 +399,47 @@ kind = jax.devices()[0].device_kind.lower()
 peak = next((p for key, p in _PEAKS if key in kind), None)
 
 
-def measured_matmul_tflops(n=4096, reps=24):
+def measured_matmul_tflops(n=4096, reps_lo=64, reps_hi=320):
     """Achievable bf16 matmul rate on THIS device, measured: a chained
     (sequentially dependent) square-matmul loop under one jit, fenced by
     a device-to-host value read. Cross-checks the book peak: if the
     device_kind's table entry disagrees wildly with what the silicon
     actually does, MFU numbers against the book value are meaningless
-    (e.g. a tunnel that misreports its device kind)."""
+    (e.g. a tunnel that misreports its device kind).
+
+    TWO rep counts, rate from the delta: one timed call carries a fixed
+    dispatch + tunnel-RTT + D2H-fence cost (observed ~100ms on a tunneled
+    chip — comparable to the compute itself), and (t_hi - t_lo) cancels
+    exactly that constant. The per-rep rescale keeps values finite and
+    fuses into the matmul epilogue (unlike a tanh, which would add a
+    separate HBM-bound elementwise pass to every rep)."""
     import jax.numpy as jnp
 
-    def chain(a):
+    def chain(a, reps):
+        scale = jnp.bfloat16(4.0 / n)  # keeps x bounded: |row sum| ~ n/4
         def body(x, _):
-            return jnp.tanh(x @ a) , None
+            return (x @ a) * scale, None
         x, _ = jax.lax.scan(body, a, None, length=reps)
         return x
 
-    a = jnp.asarray(np.random.RandomState(0).rand(n, n) * 0.01,
+    a = jnp.asarray(np.random.RandomState(0).rand(n, n) * 0.5,
                     jnp.bfloat16)
-    run = jax.jit(chain)
-    float(run(a)[0, 0])  # compile + warm
-    start = time.monotonic()
-    float(run(a)[0, 0])  # D2H fence
-    elapsed = time.monotonic() - start
-    return 2.0 * n ** 3 * reps / elapsed / 1e12
+    run = jax.jit(chain, static_argnums=1)
+
+    def timed(reps):
+        float(run(a, reps)[0, 0])  # compile + warm
+        start = time.monotonic()
+        float(run(a, reps)[0, 0])  # D2H fence
+        return time.monotonic() - start
+
+    t_lo, t_hi = timed(reps_lo), timed(reps_hi)
+    if t_hi <= t_lo:
+        # timing noise swallowed the compute delta: report a calibration
+        # failure, never a clamped garbage rate posing as a measurement
+        raise RuntimeError('non-positive timing delta (t_lo=%%.4fs, '
+                           't_hi=%%.4fs): timing too noisy to calibrate'
+                           %% (t_lo, t_hi))
+    return 2.0 * n ** 3 * (reps_hi - reps_lo) / (t_hi - t_lo) / 1e12
 
 attn_impl = 'dense'
 with make_jax_loader(url, batch_size=batch, num_epochs=None,
@@ -553,13 +571,20 @@ print(json.dumps({"loss": loss,
 '''
 
 
-def _measure_pp_bf16(timeout=600):
+def _measure_pp_bf16(timeout=300):
     """VERDICT r2 #7: the bf16 pipelined train step has never executed
     anywhere (XLA:CPU crashes on it; the dryrun pins f32). Compile + step
-    it on the real chip."""
+    it on the real chip. Two attempts: the tunneled chip's backend init
+    is observed to wedge transiently (whole-process hang before
+    jax.devices() returns), and a healthy compile+step of this tiny
+    config finishes in well under one attempt's timeout."""
     code = _PP_BF16_SNIPPET % {
         'repo': os.path.dirname(os.path.abspath(__file__))}
-    return _run_json_subprocess([sys.executable, '-c', code], timeout)
+    argv = [sys.executable, '-c', code]
+    result = _run_json_subprocess(argv, timeout)
+    if 'error' in result and not os.environ.get('BENCH_JAX_PLATFORM'):
+        result = _run_json_subprocess(argv, timeout)
+    return result
 
 
 def _measure_lm_train(url, batch=8, seq_len=1024, warmup=4, measure=16,
