@@ -168,14 +168,31 @@ def _rmsnorm(x, gain):
 
 
 def _attention(x, qkv_w, out_w, n_heads, dtype, seq_axis=None, mesh=None,
-               seq_impl='ring', attn_impl='dense'):
+               seq_impl='ring', attn_impl='dense', seq_manual=False):
     b, s, d = x.shape
     head_dim = d // n_heads
     qkv = jnp.einsum('bsd,de->bse', x, qkv_w.astype(dtype),
                      preferred_element_type=jnp.float32).astype(dtype)
     q, k_, v = jnp.split(qkv, 3, axis=-1)
 
-    if seq_axis is not None and mesh is not None:
+    if seq_axis is not None and seq_manual:
+        # already INSIDE a shard_map manual over seq_axis (the pipelined
+        # forward's pipe x seq region): call the strategies' per-device
+        # bodies directly — their own shard_map wrappers cannot nest
+        bshd = (b, s, n_heads, head_dim)
+        if seq_impl == 'ring':
+            from petastorm_tpu.ops.ring_attention import \
+                _ring_attention_local
+            ctx = _ring_attention_local(
+                q.reshape(bshd), k_.reshape(bshd), v.reshape(bshd),
+                axis_name=seq_axis, causal=True, scale=head_dim ** -0.5)
+        else:
+            from petastorm_tpu.ops.ulysses_attention import _ulysses_local
+            ctx = _ulysses_local(
+                q.reshape(bshd), k_.reshape(bshd), v.reshape(bshd),
+                axis_name=seq_axis, causal=True, scale=head_dim ** -0.5)
+        ctx = ctx.reshape(b, s, d)
+    elif seq_axis is not None and mesh is not None:
         # sequence parallel: attention is the ONLY cross-token op, so it is
         # the only place the seq sharding needs special handling — both
         # strategies apply the causal mask over GLOBAL positions while the
@@ -219,16 +236,22 @@ def _attention(x, qkv_w, out_w, n_heads, dtype, seq_axis=None, mesh=None,
                       preferred_element_type=jnp.float32).astype(dtype)
 
 
-def _block_attention_half(block, x, config, mesh=None):
-    """Pre-norm attention sublayer with residual + sharding constraint."""
+def _block_attention_half(block, x, config, mesh=None, seq_manual=False):
+    """Pre-norm attention sublayer with residual + sharding constraint.
+
+    ``seq_manual``: running inside a shard_map already manual over
+    ``config.seq_axis`` (the pp×sp pipeline) — attention calls the
+    strategy's per-device body, and the seq constraint (now a manual
+    axis, unreachable by with_sharding_constraint) is skipped."""
     h = _rmsnorm(x, block['ln1'])
     x = x + _attention(h, block['qkv'], block['attn_out'], config.n_heads,
                        config.dtype, seq_axis=config.seq_axis, mesh=mesh,
-                       seq_impl=config.seq_impl, attn_impl=config.attn_impl)
-    return _constrain(x, config.seq_axis)
+                       seq_impl=config.seq_impl, attn_impl=config.attn_impl,
+                       seq_manual=seq_manual)
+    return _constrain(x, None if seq_manual else config.seq_axis)
 
 
-def _block_dense_ffn_half(block, x, config):
+def _block_dense_ffn_half(block, x, config, seq_manual=False):
     """Pre-norm dense-FFN sublayer with residual + sharding constraint."""
     dtype = config.dtype
     h = _rmsnorm(x, block['ln2'])
@@ -237,14 +260,15 @@ def _block_dense_ffn_half(block, x, config):
     h = jax.nn.gelu(h.astype(jnp.float32)).astype(dtype)
     x = x + jnp.einsum('bsf,fd->bsd', h, block['mlp_out'].astype(dtype),
                        preferred_element_type=jnp.float32).astype(dtype)
-    return _constrain(x, config.seq_axis)
+    return _constrain(x, None if seq_manual else config.seq_axis)
 
 
-def _block_forward(block, x, config, mesh=None):
+def _block_forward(block, x, config, mesh=None, seq_manual=False):
     """One dense transformer block — shared by the layered forward and the
     pipeline stage executor."""
-    x = _block_attention_half(block, x, config, mesh=mesh)
-    return _block_dense_ffn_half(block, x, config)
+    x = _block_attention_half(block, x, config, mesh=mesh,
+                              seq_manual=seq_manual)
+    return _block_dense_ffn_half(block, x, config, seq_manual=seq_manual)
 
 
 def _block_moe_half(block, x, config, seq=None):
@@ -359,18 +383,22 @@ def init_pipelined_transformer_params(rng, config, mesh, pipe_axis=None):
        docs/troubleshoot.md) and is unvalidated on TPU hardware.
 
     Requires ``config.n_layers % mesh.shape[pipe_axis] == 0``.
-    Seq-parallel pipelining is not composed (ring/Ulysses attention is
-    manual over the seq axis and cannot nest inside the pipe-manual
-    shard_map); seq-parallel configs use the layered forward.
+    Seq-parallel composition (pp×sp): DENSE configs with ``seq_axis`` set
+    pipeline with the sequence sharded over that axis — the pipeline
+    shard_map goes manual over both axes and attention runs the
+    ring/Ulysses per-device body (``ops/ring_attention.py:48``,
+    ``ops/ulysses_attention.py:33``) inside each stage. MoE does not
+    compose with seq sharding (the Switch router's capacity partition is
+    per full sequence).
     """
     from petastorm_tpu.parallel.mesh import PIPE_AXIS
     if pipe_axis is None:
         pipe_axis = PIPE_AXIS
     c = config
-    if c.seq_axis is not None:
+    if c.seq_axis is not None and c.n_experts > 0:
         raise NotImplementedError('pipelined transformer composes '
-                                  'dp×pp×tp and dp×pp×ep; seq-parallel '
-                                  'configs use the layered forward')
+                                  'dp×pp×tp, dp×pp×ep and pp×sp; '
+                                  'seq-parallel MoE is not supported')
     n_stages = mesh.shape[pipe_axis]
     if c.n_layers % n_stages:
         raise ValueError('n_layers=%d not divisible into %d pipeline stages'
@@ -418,7 +446,10 @@ def pipelined_transformer_forward_with_aux(params, tokens, config, mesh,
     (embedding and head run outside the pipeline on every stage's
     devices). MoE configs route per microbatch inside each stage; the aux
     scalar is the Switch load-balancing loss summed over layers, averaged
-    over microbatches (0.0 for dense configs)."""
+    over microbatches (0.0 for dense configs). Dense configs with
+    ``seq_axis`` set compose pp×sp: the sequence dim additionally shards
+    over that axis through the pipeline (requires the post-shift sequence
+    length divisible by the seq axis size)."""
     from petastorm_tpu.parallel.mesh import PIPE_AXIS
     from petastorm_tpu.parallel.pipeline import pipeline_apply
 
@@ -428,10 +459,11 @@ def pipelined_transformer_forward_with_aux(params, tokens, config, mesh,
     dtype = c.dtype
     per_stage = jax.tree_util.tree_leaves(params['stages'])[0].shape[1]
     moe = c.n_experts > 0
+    seq = c.seq_axis
 
     x = params['embed'][tokens].astype(dtype)
     x = x + params['pos_embed'][:tokens.shape[1]].astype(dtype)
-    x = _constrain(x)
+    x = _constrain(x, seq)
 
     def stage_fn(stage_params, x):
         aux_total = jnp.zeros((), jnp.float32)
@@ -443,7 +475,7 @@ def pipelined_transformer_forward_with_aux(params, tokens, config, mesh,
                 x, aux = _block_moe_half(block, x, c)
                 aux_total = aux_total + aux
             else:
-                x = _block_forward(block, x, c)
+                x = _block_forward(block, x, c, seq_manual=seq is not None)
         return (x, aux_total) if moe else x
 
     if moe:
@@ -454,8 +486,9 @@ def pipelined_transformer_forward_with_aux(params, tokens, config, mesh,
     else:
         x = pipeline_apply(stage_fn, params['stages'], x, mesh,
                            axis_name=pipe_axis,
-                           n_microbatches=n_microbatches)
+                           n_microbatches=n_microbatches, seq_axis=seq)
         aux = jnp.zeros((), jnp.float32)
+    x = _constrain(x, seq)
     x = _rmsnorm(x, params['ln_f'])
     logits = jnp.einsum('bsd,dv->bsv', x, params['lm_head'].astype(dtype),
                         preferred_element_type=jnp.float32)

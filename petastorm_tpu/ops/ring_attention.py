@@ -55,9 +55,14 @@ def _ring_attention_local(q, k, v, axis_name, causal, scale):
     q_pos = my_idx * s_local + jnp.arange(s_local)
     perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
 
-    o = jnp.zeros(q.shape[:3] + (v.shape[-1],), jnp.float32)
-    m = jnp.full((b, h, s_local), -jnp.inf, jnp.float32)
-    l = jnp.zeros((b, h, s_local), jnp.float32)
+    # vma promotion: under a check_vma=True manual region (the pp×sp
+    # pipeline calls this body directly) the fori_loop carry must already
+    # vary over every axis q does; standalone (manual_shard_map,
+    # check_vma=False) this is a no-op
+    from petastorm_tpu.parallel.mesh import match_vma
+    o = match_vma(jnp.zeros(q.shape[:3] + (v.shape[-1],), jnp.float32), q)
+    m = match_vma(jnp.full((b, h, s_local), -jnp.inf, jnp.float32), q)
+    l = match_vma(jnp.zeros((b, h, s_local), jnp.float32), q)
 
     def step(t, state):
         o, m, l, k_blk, v_blk = state

@@ -38,6 +38,15 @@ def _ulysses_local(q, k, v, axis_name, causal, scale):
     attention runs on the full sequence; the inverse collective restores
     (B, S/N, H, D).
     """
+    # the wrapper validates this too, but direct callers (the pp×sp
+    # pipeline's seq_manual branch) must get the same actionable error,
+    # not an obscure all_to_all shape failure mid-trace
+    n = lax.psum(1, axis_name)
+    if q.shape[2] % n:
+        raise ValueError(
+            'ulysses attention needs n_heads %% n_seq_shards == 0 (got %d '
+            'heads over %d shards on axis %r); use ring attention instead'
+            % (q.shape[2], n, axis_name))
     # seq-sharded -> head-sharded: split heads (axis 2), concat seq (axis 1)
     qh = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
     kh = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)

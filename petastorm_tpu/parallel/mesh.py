@@ -100,6 +100,28 @@ def manual_shard_map(body, mesh, in_specs, out_specs,
                           out_specs=out_specs, check_rep=check_replication)
 
 
+def match_vma(x, ref):
+    """Promote ``x``'s varying-manual-axes set to cover ``ref``'s.
+
+    Under ``shard_map(check_vma=True)`` a ``lax.scan``/``fori_loop`` carry
+    must keep a stable vma type, so a fresh constant (``jnp.zeros``)
+    initializing a carry that accumulates device-varying values needs an
+    explicit ``pvary`` over the reference's axes. Outside a checked manual
+    region (or on jax without vma tracking) this is a no-op.
+    """
+    import jax
+    from jax import lax
+    typeof = getattr(jax, 'typeof', None)
+    if typeof is None:  # jax before vma tracking: nothing to promote
+        return x
+    ref_vma = getattr(typeof(ref), 'vma', None)
+    if not ref_vma:
+        return x
+    have = getattr(typeof(x), 'vma', frozenset())
+    missing = tuple(sorted(ref_vma - have))
+    return lax.pvary(x, missing) if missing else x
+
+
 def data_sharding(mesh, ndim=1):
     """NamedSharding that shards axis 0 over 'data', replicating the rest."""
     from jax.sharding import NamedSharding, PartitionSpec

@@ -108,9 +108,10 @@ def _pipeline_local(stage_params, x, stage_fn, axis_name, n_stages,
         act_next = lax.ppermute(y, axis_name, perm) if perm else y
         return (act_next, aux_acc), emit
 
+    from petastorm_tpu.parallel.mesh import match_vma
     aux0 = _to_varying(jnp.zeros((), jnp.float32), axis_name)
-    (_, aux_acc), emits = lax.scan(tick, (jnp.zeros_like(feed[0]), aux0),
-                                   (feed, ticks))
+    act0 = match_vma(jnp.zeros_like(feed[0]), feed)  # pp×sp: + seq axis
+    (_, aux_acc), emits = lax.scan(tick, (act0, aux0), (feed, ticks))
     outs = emits[n_stages - 1:]                 # (M, mb, ...) on last stage
     outs = lax.psum(outs, axis_name)            # replicate to every stage
     outs = outs.reshape(x.shape)
@@ -125,7 +126,7 @@ def _pipeline_local(stage_params, x, stage_fn, axis_name, n_stages,
 
 
 def pipeline_apply(stage_fn, stage_params, x, mesh, axis_name=PIPE_AXIS,
-                   n_microbatches=None, with_aux=False):
+                   n_microbatches=None, with_aux=False, seq_axis=None):
     """Apply ``n_stages`` sequential stages to ``x`` with the stage stack
     sharded over ``mesh[axis_name]``.
 
@@ -142,6 +143,12 @@ def pipeline_apply(stage_fn, stage_params, x, mesh, axis_name=PIPE_AXIS,
     :param n_microbatches: pipeline chunking (default ``n_stages``; more
         microbatches → less bubble, smaller per-tick matmuls). Must divide
         the batch.
+    :param seq_axis: pp×sp composition — a second MANUAL mesh axis that
+        shards ``x``'s dim 1 (the sequence). ``stage_fn`` then sees local
+        sequence blocks and must run collective attention over
+        ``seq_axis`` itself (e.g. the ring/Ulysses per-device bodies);
+        position-wise ops need no change. Requires ``x.shape[1]``
+        divisible by the axis size. Not composed with ``with_aux``.
     :return: (batch, ...) output, replicated over the pipe axis — equal to
         sequentially applying the stages; plus the aux scalar when
         ``with_aux``.
@@ -154,6 +161,18 @@ def pipeline_apply(stage_fn, stage_params, x, mesh, axis_name=PIPE_AXIS,
     if x.shape[0] % n_microbatches:
         raise ValueError('batch %d not divisible into %d microbatches'
                          % (x.shape[0], n_microbatches))
+    axis_names = {axis_name}
+    x_spec = P()
+    if seq_axis is not None:
+        if with_aux:
+            raise NotImplementedError('seq_axis does not compose with '
+                                      'with_aux (pp×sp is dense-only)')
+        n_seq = mesh.shape[seq_axis]
+        if x.ndim < 2 or x.shape[1] % n_seq:
+            raise ValueError('x dim 1 (%s) not divisible over %d seq '
+                             'shards' % (x.shape[1:2] or 'absent', n_seq))
+        axis_names.add(seq_axis)
+        x_spec = P(None, seq_axis)
 
     param_specs = jax.tree_util.tree_map(
         lambda p: P(axis_name, *([None] * (jnp.ndim(p) - 1))), stage_params)
@@ -167,15 +186,16 @@ def pipeline_apply(stage_fn, stage_params, x, mesh, axis_name=PIPE_AXIS,
     # fallback — on a jax too old for it, wrong input gradients would be
     # silent, which is strictly worse than an ImportError.
     #
-    # Manual ONLY over the pipe axis: any other mesh axes (data, model,
-    # expert) stay auto, so the batch rides in data-sharded, stage weights
-    # keep their tensor-parallel/expert layout, and XLA inserts the
-    # dp/tp/ep collectives inside each stage as usual — this is what lets
-    # pp compose with the other axes in ONE jitted step.
+    # Manual ONLY over the pipe axis (+ seq_axis for pp×sp): any other
+    # mesh axes (data, model, expert) stay auto, so the batch rides in
+    # data-sharded, stage weights keep their tensor-parallel/expert
+    # layout, and XLA inserts the dp/tp/ep collectives inside each stage
+    # as usual — this is what lets pp compose with the other axes in ONE
+    # jitted step.
     from jax import shard_map
-    out_specs = (P(), P()) if with_aux else P()
-    fn = shard_map(body, mesh=mesh, in_specs=(param_specs, P()),
-                   out_specs=out_specs, axis_names={axis_name},
+    out_specs = (P(), P()) if with_aux else x_spec
+    fn = shard_map(body, mesh=mesh, in_specs=(param_specs, x_spec),
+                   out_specs=out_specs, axis_names=axis_names,
                    check_vma=True)
     return fn(stage_params, x)
 
