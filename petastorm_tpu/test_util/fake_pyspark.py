@@ -1,0 +1,464 @@
+"""A pandas-backed fake ``pyspark`` deep enough to EXECUTE the Spark parity
+surface without a JVM.
+
+The reference tests multi-node behavior with mocks where the real system is
+unavailable (mocked HDFS namenodes, ``hdfs/tests/test_hdfs_namenode.py:41-53``;
+``ReaderMock`` as a fake source). This module applies the same strategy to
+pyspark: :func:`install` registers fake ``pyspark`` / ``pyspark.sql`` /
+``pyspark.ml.*`` modules in ``sys.modules`` so that
+:func:`~petastorm_tpu.spark.make_spark_converter`,
+:func:`~petastorm_tpu.spark_utils.dataset_as_rdd` and
+``materialize_dataset(spark=...)`` run their REAL code paths — vector
+flattening, float-precision unification, plan-fingerprint dedupe, the
+Spark-side parquet write, the availability wait and size advisory, hadoop
+conf save/restore, executor-side decode — against a pandas/pyarrow engine.
+
+Only the API those paths touch is implemented; anything else raises
+``AttributeError`` loudly. The emulation covers (reference file:line for the
+behavior each backs):
+
+* ``DataFrame.schema`` fields with ``dataType.typeName()`` / ``VectorUDT``
+  (``spark_dataset_converter.py:546-557``),
+* ``withColumn`` + ``Column.cast`` for scalar and ``array<...>`` casts
+  (``:524-543``),
+* ``pyspark.ml.functions.vector_to_array``,
+* ``df.write.option(...).parquet(url)`` — a real pyarrow parquet write,
+* ``spark.read.parquet(url).inputFiles()`` (``:700-703``),
+* ``df._jdf.queryExecution().analyzed().toString()`` — a content
+  fingerprint standing in for the logical plan (``:498-506``),
+* ``spark.sparkContext.parallelize(...).flatMap/map/collect`` — local
+  execution of the executor closures (``spark_utils.py:23-52``),
+* ``spark.sparkContext._jsc.hadoopConfiguration()`` get/set/setInt/unset
+  (``etl/dataset_metadata.py:135-178``).
+"""
+
+import glob
+import hashlib
+import os
+import sys
+import types
+import uuid
+
+import numpy as np
+
+
+# -- schema types ------------------------------------------------------------
+
+class _DataType:
+    _type_name = 'void'
+
+    def typeName(self):  # noqa: N802 - pyspark API casing
+        return self._type_name
+
+    def __repr__(self):
+        return type(self).__name__
+
+
+class DoubleType(_DataType):
+    _type_name = 'double'
+
+
+class FloatType(_DataType):
+    _type_name = 'float'
+
+
+class LongType(_DataType):
+    _type_name = 'bigint'
+
+
+class StringType(_DataType):
+    _type_name = 'string'
+
+
+class ArrayType(_DataType):
+    _type_name = 'array'
+
+    def __init__(self, element_type):
+        self.elementType = element_type
+
+    def __repr__(self):
+        return 'ArrayType(%r)' % (self.elementType,)
+
+
+class VectorUDT(_DataType):
+    """Name-matched: the converter dispatches on
+    ``type(f.dataType).__name__ == 'VectorUDT'``."""
+    _type_name = 'udt'
+
+
+class StructField:
+    def __init__(self, name, data_type):
+        self.name = name
+        self.dataType = data_type
+
+    def __repr__(self):
+        return 'StructField(%s,%r)' % (self.name, self.dataType)
+
+
+class DenseVector:
+    """Stand-in for ``pyspark.ml.linalg.DenseVector``."""
+
+    def __init__(self, values):
+        self.values = np.asarray(values, np.float64)
+
+    def toArray(self):  # noqa: N802 - pyspark API casing
+        return self.values
+
+    def __repr__(self):
+        # value-based, like the real DenseVector: the plan fingerprint
+        # (_JDataFrame.toString) hashes cell reprs, and an identity-based
+        # default repr would break content-addressed cache dedupe for any
+        # dataframe still holding vectors
+        return 'DenseVector(%s)' % self.values.tolist()
+
+
+# -- columns (lazy expressions applied by withColumn) ------------------------
+
+_CAST_NUMPY = {'float': np.float32, 'double': np.float64,
+               'int': np.int32, 'bigint': np.int64}
+_CAST_TYPE = {'float': FloatType, 'double': DoubleType,
+              'int': LongType, 'bigint': LongType}
+
+
+class Column:
+    def __init__(self, name, transform=None, out_type=None):
+        self.name = name
+        self._transform = transform           # pandas Series -> pandas Series
+        self._out_type = out_type             # _DataType after the transform
+
+    def cast(self, target):
+        if target.startswith('array<') and target.endswith('>'):
+            elem = target[len('array<'):-1]
+            np_t, t_t = _CAST_NUMPY[elem], _CAST_TYPE[elem]
+
+            def conv(series):
+                return series.map(lambda cell: np.asarray(cell, np_t))
+
+            return Column(self.name, conv, ArrayType(t_t()))
+        np_t, t_t = _CAST_NUMPY[target], _CAST_TYPE[target]
+        return Column(self.name, lambda s: s.astype(np_t), t_t())
+
+    def apply(self, series):
+        return self._transform(series) if self._transform else series
+
+
+def vector_to_array(col, dtype='float64'):
+    """``pyspark.ml.functions.vector_to_array`` equivalent."""
+    np_t = _CAST_NUMPY[{'float32': 'float', 'float64': 'double'}
+                       .get(dtype, dtype)]
+    t_t = FloatType if np_t is np.float32 else DoubleType
+
+    def conv(series):
+        return series.map(lambda vec: np.asarray(
+            vec.values if isinstance(vec, DenseVector) else vec, np_t))
+
+    return Column(col.name, conv, ArrayType(t_t()))
+
+
+# -- dataframe ---------------------------------------------------------------
+
+def _infer_field(name, series):
+    if series.dtype == np.float32:
+        return StructField(name, FloatType())
+    if series.dtype == np.float64:
+        return StructField(name, DoubleType())
+    if np.issubdtype(series.dtype, np.integer):
+        return StructField(name, LongType())
+    first = next((v for v in series if v is not None), None)
+    if isinstance(first, DenseVector):
+        return StructField(name, VectorUDT())
+    if isinstance(first, (list, np.ndarray)):
+        elem = np.asarray(first)
+        inner = (FloatType() if elem.dtype == np.float32 else
+                 DoubleType() if elem.dtype == np.float64 else LongType())
+        return StructField(name, ArrayType(inner))
+    return StructField(name, StringType())
+
+
+class DataFrame:
+    def __init__(self, pdf, session, fields=None):
+        self._pdf = pdf.reset_index(drop=True)
+        self.sparkSession = session
+        self.schema = (list(fields) if fields is not None
+                       else [_infer_field(c, pdf[c]) for c in pdf.columns])
+        # the logical-plan handle the converter fingerprints (':498-506');
+        # content-addressed so "same dataframe" -> same plan string
+        self._jdf = _JDataFrame(self)
+
+    def __getitem__(self, name):
+        return Column(name)
+
+    def withColumn(self, name, col):  # noqa: N802 - pyspark API casing
+        pdf = self._pdf.copy()
+        pdf[name] = col.apply(pdf[col.name]).values
+        out_type = col._out_type or next(
+            f.dataType for f in self.schema if f.name == col.name)
+        if any(f.name == name for f in self.schema):
+            fields = [StructField(name, out_type) if f.name == name else f
+                      for f in self.schema]
+        else:  # like real pyspark: a new name APPENDS a column
+            fields = list(self.schema) + [StructField(name, out_type)]
+        return DataFrame(pdf, self.sparkSession, fields)
+
+    def count(self):
+        return len(self._pdf)
+
+    def collect(self):
+        import collections
+        row_cls = collections.namedtuple('Row', list(self._pdf.columns))
+        return [row_cls(**rec) for rec in self._pdf.to_dict('records')]
+
+    @property
+    def write(self):
+        return _Writer(self)
+
+    def toPandas(self):  # noqa: N802 - pyspark API casing
+        return self._pdf.copy()
+
+
+class _JDataFrame:
+    def __init__(self, df):
+        self._df = df
+
+    def queryExecution(self):  # noqa: N802 - pyspark API casing
+        return self
+
+    def analyzed(self):
+        return self
+
+    def toString(self):  # noqa: N802 - pyspark API casing
+        h = hashlib.sha1()
+        h.update(repr([(f.name, repr(f.dataType))
+                       for f in self._df.schema]).encode())
+        for name in self._df._pdf.columns:
+            for cell in self._df._pdf[name]:
+                h.update(repr(np.asarray(cell).tolist()
+                              if isinstance(cell, (list, np.ndarray))
+                              else cell).encode())
+        return 'FakeLogicalPlan(%s)' % h.hexdigest()
+
+
+def _arrow_table(df):
+    import pyarrow as pa
+    arrays, names = [], []
+    for field in df.schema:
+        series = df._pdf[field.name]
+        t = field.dataType
+        if isinstance(t, ArrayType):
+            np_t = _CAST_NUMPY[t.elementType.typeName()]
+            pa_t = pa.list_(pa.from_numpy_dtype(np_t))
+            arrays.append(pa.array(
+                [np.asarray(v, np_t) for v in series], pa_t))
+        elif isinstance(t, VectorUDT):
+            raise ValueError('VectorUDT column %r cannot be written to '
+                             'parquet; flatten it first (the converter '
+                             'does this via vector_to_array)' % field.name)
+        elif isinstance(t, FloatType):
+            arrays.append(pa.array(series.astype(np.float32), pa.float32()))
+        else:
+            arrays.append(pa.array(series))
+        names.append(field.name)
+    return pa.table(dict(zip(names, arrays)))
+
+
+class _Writer:
+    def __init__(self, df):
+        self._df = df
+        self._options = {}
+
+    def option(self, key, value):
+        self._options[key] = value
+        return self
+
+    def parquet(self, url):
+        import pyarrow.parquet as pq
+        path = url[len('file://'):] if url.startswith('file://') else url
+        os.makedirs(path, exist_ok=True)
+        table = _arrow_table(self._df)
+        # two part files (when rows allow), like a 2-partition write: the
+        # availability wait and the median-size advisory then exercise
+        # their multi-file paths
+        n = table.num_rows
+        splits = [table] if n < 2 else [table.slice(0, n // 2),
+                                        table.slice(n // 2)]
+        for i, part in enumerate(splits):
+            name = 'part-%05d-%s.snappy.parquet' % (i, uuid.uuid4().hex[:12])
+            pq.write_table(part, os.path.join(path, name),
+                           compression=self._options.get('compression',
+                                                         'snappy'))
+        with open(os.path.join(path, '_SUCCESS'), 'w'):
+            pass
+
+
+class _LazyParquetFrame:
+    """Lazy read result, like real Spark's: ``inputFiles()`` answers from
+    the file listing alone; data materializes only when a DataFrame method
+    actually needs it (``_await_and_advise`` only lists files — eager
+    decode there would be pure waste AND less faithful)."""
+
+    def __init__(self, parts, session):
+        self._parts = parts
+        self._session = session
+        self._df = None
+
+    def inputFiles(self):  # noqa: N802 - pyspark API casing
+        return ['file://' + p for p in self._parts]
+
+    def _materialize(self):
+        if self._df is None:
+            import pyarrow.parquet as pq
+            pdf = pq.ParquetDataset(self._parts).read().to_pandas()
+            self._df = DataFrame(pdf, self._session)
+        return self._df
+
+    def __getattr__(self, name):
+        return getattr(self._materialize(), name)
+
+
+class _Reader:
+    def __init__(self, session):
+        self._session = session
+
+    def parquet(self, url):
+        path = url[len('file://'):] if url.startswith('file://') else url
+        parts = sorted(glob.glob(os.path.join(path, '*.parquet')))
+        if not parts:
+            raise FileNotFoundError('no parquet files under %s' % path)
+        return _LazyParquetFrame(parts, self._session)
+
+
+# -- context / session -------------------------------------------------------
+
+class _HadoopConf:
+    def __init__(self):
+        self._conf = {}
+
+    def get(self, key, default=None):
+        return self._conf.get(key, default)
+
+    def set(self, key, value):
+        self._conf[key] = value
+
+    def setInt(self, key, value):  # noqa: N802 - py4j API casing
+        self._conf[key] = int(value)
+
+    def unset(self, key):
+        self._conf.pop(key, None)
+
+
+class _JSparkContext:
+    def __init__(self):
+        self._hadoop_conf = _HadoopConf()
+
+    def hadoopConfiguration(self):  # noqa: N802 - py4j API casing
+        return self._hadoop_conf
+
+
+class RDD:
+    """Local, eager stand-in: transformations compose; collect() runs the
+    closures in-process — the executor-side decode of ``dataset_as_rdd``
+    really executes, just not remotely."""
+
+    def __init__(self, items):
+        self._items = list(items)
+
+    def map(self, fn):
+        return RDD([fn(item) for item in self._items])
+
+    def flatMap(self, fn):  # noqa: N802 - pyspark API casing
+        return RDD([out for item in self._items for out in fn(item)])
+
+    def collect(self):
+        return list(self._items)
+
+    def count(self):
+        return len(self._items)
+
+
+class SparkContext:
+    def __init__(self):
+        self._jsc = _JSparkContext()
+
+    def parallelize(self, items, num_slices=None):
+        return RDD(items)
+
+
+class _RuntimeConf:
+    def __init__(self):
+        self._conf = {}
+
+    def get(self, key, default=None):
+        return self._conf.get(key, default)
+
+    def set(self, key, value):
+        self._conf[key] = value
+
+
+class SparkSession:
+    def __init__(self):
+        self.sparkContext = SparkContext()
+        self.conf = _RuntimeConf()
+
+    def range(self, n):
+        import pandas as pd
+        return DataFrame(pd.DataFrame({'id': np.arange(n, dtype=np.int64)}),
+                         self)
+
+    def createDataFrame(self, pdf):  # noqa: N802 - pyspark API casing
+        return DataFrame(pdf, self)
+
+    @property
+    def read(self):
+        return _Reader(self)
+
+    def stop(self):
+        pass
+
+
+# -- sys.modules installation ------------------------------------------------
+
+_FAKE_MODULES = ('pyspark', 'pyspark.sql', 'pyspark.ml',
+                 'pyspark.ml.functions', 'pyspark.ml.linalg')
+
+
+def install():
+    """Register the fake modules; returns the displaced ``sys.modules``
+    entries for :func:`uninstall`."""
+    displaced = {name: sys.modules.get(name) for name in _FAKE_MODULES}
+
+    pyspark = types.ModuleType('pyspark')
+    pyspark.__version__ = '0.0.fake'
+    pyspark.SparkContext = SparkContext
+
+    sql = types.ModuleType('pyspark.sql')
+    sql.SparkSession = SparkSession
+    sql.DataFrame = DataFrame
+
+    ml = types.ModuleType('pyspark.ml')
+    ml_functions = types.ModuleType('pyspark.ml.functions')
+    ml_functions.vector_to_array = vector_to_array
+    ml_linalg = types.ModuleType('pyspark.ml.linalg')
+    ml_linalg.DenseVector = DenseVector
+    ml_linalg.VectorUDT = VectorUDT
+
+    pyspark.sql = sql
+    pyspark.ml = ml
+    ml.functions = ml_functions
+    ml.linalg = ml_linalg
+
+    for name, module in (('pyspark', pyspark), ('pyspark.sql', sql),
+                         ('pyspark.ml', ml),
+                         ('pyspark.ml.functions', ml_functions),
+                         ('pyspark.ml.linalg', ml_linalg)):
+        sys.modules[name] = module
+    return displaced
+
+
+def uninstall(displaced):
+    """Restore the ``sys.modules`` entries :func:`install` displaced."""
+    for name in _FAKE_MODULES:
+        previous = displaced.get(name)
+        if previous is None:
+            sys.modules.pop(name, None)
+        else:
+            sys.modules[name] = previous

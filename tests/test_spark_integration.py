@@ -4,7 +4,9 @@ mirroring the reference's ``tests/test_spark_dataset_converter.py``).
 
 This environment ships no pyspark, so the integration class skips here; the
 gating class asserts the pyspark-requiring entry points fail loudly with
-actionable guidance instead of deep inside a Spark call.
+actionable guidance instead of deep inside a Spark call. The same code
+paths these skipped tests cover DO execute in this environment against the
+fake pyspark engine — see ``tests/test_fake_spark_execution.py``.
 """
 
 import argparse
