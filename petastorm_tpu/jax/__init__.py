@@ -1,7 +1,9 @@
 """JAX bridge: sharded ``jax.Array`` batch loaders (the TPU-native
 equivalent of the reference's tf/torch consumer layers)."""
 
-from petastorm_tpu.jax.loader import JaxLoader, MASK_FIELD, make_jax_loader  # noqa: F401
+from petastorm_tpu.jax.loader import (  # noqa: F401
+    JaxLoader, LEN_SUFFIX, MASK_FIELD, make_jax_loader,
+)
 
 
 def __getattr__(name):

@@ -33,6 +33,8 @@ _NO_ITEM = object()
 
 #: name of the validity-mask column added under ``last_batch='pad'``
 MASK_FIELD = 'valid_mask'
+#: suffix of the true-size companion column added per ``pad_ragged`` field
+LEN_SUFFIX = '_len'
 # hidden per-row provenance column riding through the staging buffers; maps
 # each row back to the reader pull (row-group) it came from so checkpoints
 # can be delivery-accurate. Added after the reader, stripped before device.
@@ -44,7 +46,7 @@ def make_jax_loader(dataset_url_or_urls, batch_size, mesh=None, data_axes=None,
                     shuffling_queue_capacity=None, min_after_retrieve=None,
                     extra_capacity=None, seed=0, last_batch='drop',
                     dtypes=None, prefetch=2, num_epochs=1,
-                    inmemory_cache_all=False,
+                    inmemory_cache_all=False, pad_ragged=None,
                     reader_factory=None, **reader_kwargs):
     """Create a :class:`JaxLoader` over a Parquet dataset.
 
@@ -67,6 +69,18 @@ def make_jax_loader(dataset_url_or_urls, batch_size, mesh=None, data_axes=None,
     :param inmemory_cache_all: decode once, replay epochs from device
         memory (see :class:`InMemoryCachedLoader`); requires
         ``num_epochs=1`` — re-iterate for more epochs.
+    :param pad_ragged: ``{field: size or (sizes...)}`` — densify
+        variable-shape fields (``(None, ...)`` Unischema dims, the shape
+        class the reference's batched reader simply rejects,
+        ``arrow_reader_worker.py:176-178``) to STATIC shapes: each
+        variable dim pads with zeros (or truncates) to the given size, and
+        a companion ``<field>_len`` int32 column carries every row's TRUE
+        size(s) — ``(B,)`` for one variable dim, ``(B, k)`` for ``k``. A
+        truncated row's stored length exceeds the padded extent, so
+        truncation stays detectable and ``arange(L) < len`` masks
+        saturate correctly. Static shapes are the XLA-idiomatic answer to
+        raggedness: the train step compiles once, and masks built from
+        ``<field>_len`` replace dynamic shapes.
     :param reader_factory: reader constructor (defaults to
         :func:`petastorm_tpu.reader.make_batch_reader`).
     :param reader_kwargs: forwarded to the reader factory (predicates,
@@ -103,7 +117,7 @@ def make_jax_loader(dataset_url_or_urls, batch_size, mesh=None, data_axes=None,
                            min_after_retrieve=min_after_retrieve,
                            extra_capacity=extra_capacity, seed=seed,
                            last_batch=last_batch, dtypes=dtypes,
-                           prefetch=prefetch)
+                           prefetch=prefetch, pad_ragged=pad_ragged)
     except Exception:
         reader.stop()
         reader.join()
@@ -119,10 +133,21 @@ class JaxLoader:
     def __init__(self, reader, batch_size, mesh=None, data_axes=None,
                  shuffle_rows=False, shuffling_queue_capacity=None,
                  min_after_retrieve=None, extra_capacity=None, seed=0,
-                 last_batch='drop', dtypes=None, prefetch=2):
+                 last_batch='drop', dtypes=None, prefetch=2,
+                 pad_ragged=None):
         if last_batch not in ('drop', 'pad', 'short'):
             raise ValueError("last_batch must be 'drop', 'pad' or 'short'; "
                              'got %r' % (last_batch,))
+        self._pad_ragged = {
+            name: (sizes,) if np.ndim(sizes) == 0 else tuple(sizes)
+            for name, sizes in (pad_ragged or {}).items()}
+        for name, sizes in self._pad_ragged.items():
+            if not all(isinstance(s, (int, np.integer)) and s > 0
+                       for s in sizes):
+                raise ValueError('pad_ragged[%r] must be a positive int or '
+                                 'tuple of positive ints; got %r'
+                                 % (name, sizes))
+        self._pad_ragged_checked = not self._pad_ragged
         if not getattr(reader, 'batched_output', True):
             raise ValueError(
                 'JaxLoader requires a batched reader (make_batch_reader); '
@@ -456,6 +481,14 @@ class JaxLoader:
         try:
             buf = self._make_buffer()
             for columns in self._pull_batches():
+                if self._pad_ragged:
+                    # densify BEFORE the buffer: a variable field arrives
+                    # as a dense (n, ...) array from a uniform row-group
+                    # but as an object array from a ragged one, and the
+                    # buffers cannot mix the two forms (nor two dense
+                    # widths); after this, every chunk has ONE static
+                    # shape and the shuffle buffer preallocates correctly
+                    columns = self._densify_ragged(columns)
                 buf.add_many(columns)
                 while buf.can_retrieve:
                     self._emit(buf.retrieve())
@@ -502,6 +535,86 @@ class JaxLoader:
         # only when the consumer actually receives this item in __next__
         self._put_blocking((self._to_device(host_batch), pull_counts))
 
+    def _densify_ragged(self, columns):
+        """Apply the ``pad_ragged`` policy to one reader chunk: variable
+        -shape columns become static-shape dense arrays plus a
+        ``<name>_len`` TRUE-size column (a truncated row's stored length
+        exceeds the padded extent — that is how truncation stays
+        detectable; masks built as ``arange(L) < len`` saturate correctly).
+
+        Runs BEFORE the staging buffer (see ``_stage_loop``): a variable
+        field arrives as a 1-d OBJECT array from a ragged row-group but as
+        an already-dense ``(n, ...)`` array from a uniform one, and the
+        buffers can mix neither the two forms nor two dense widths."""
+        out = dict(columns)
+        for name, targets in self._pad_ragged.items():
+            if name not in out:
+                if not self._pad_ragged_checked:
+                    raise ValueError(
+                        'pad_ragged field %r is not in the batch (available: '
+                        '%s); check the name against fields=/the schema'
+                        % (name, sorted(n for n in columns
+                                        if n != _PULL_FIELD)))
+                continue
+            len_name = name + LEN_SUFFIX
+            if len_name in out:
+                raise ValueError(
+                    'pad_ragged would add column %r but the batch already '
+                    'has one; rename the source column' % len_name)
+            col = out[name]
+            k = len(targets)
+            n = len(col)
+            if n == 0:
+                continue
+            if col.dtype == object:
+                # None cells (nullable fields) densify as all-zero rows
+                # with true size 0 — the natural mask value downstream
+                cells = [None if cell is None else np.asarray(cell)
+                         for cell in col]
+                first = next((c for c in cells if c is not None), None)
+                if first is None:
+                    raise ValueError(
+                        'pad_ragged[%r]: every cell in this batch is None; '
+                        'cell dtype/trailing shape cannot be inferred. '
+                        'Filter all-null batches with a predicate, or '
+                        'drop the field' % name)
+                trailing = first.shape[k:]
+                dense = np.zeros((n,) + targets + trailing, first.dtype)
+                lens = np.zeros((n, k), np.int32)
+                for i, cell in enumerate(cells):
+                    if cell is None:
+                        continue  # lens stay 0, dense row stays zeros
+                    if cell.ndim != k + len(trailing):
+                        raise ValueError(
+                            'pad_ragged[%r]: row has %d dims but the policy '
+                            'names %d variable dim(s) over trailing shape %r'
+                            % (name, cell.ndim, k, trailing))
+                    lens[i] = cell.shape[:k]
+                    clipped = tuple(min(cell.shape[d], targets[d])
+                                    for d in range(k))
+                    region = (i,) + tuple(slice(0, c) for c in clipped)
+                    dense[region] = cell[tuple(slice(0, c) for c in clipped)]
+            else:
+                # pre-stacked dense chunk: every row shares one shape, so
+                # one vectorized slice assignment replaces the row loop
+                if col.ndim < 1 + k:
+                    raise ValueError(
+                        'pad_ragged[%r]: dense chunk has %d row dims but '
+                        'the policy names %d variable dim(s)'
+                        % (name, col.ndim - 1, k))
+                trailing = col.shape[1 + k:]
+                dense = np.zeros((n,) + targets + trailing, col.dtype)
+                clipped = tuple(min(col.shape[1 + d], targets[d])
+                                for d in range(k))
+                region = (slice(None),) + tuple(slice(0, c) for c in clipped)
+                dense[region] = col[region]
+                lens = np.broadcast_to(
+                    np.asarray(col.shape[1:1 + k], np.int32), (n, k)).copy()
+            out[name] = dense
+            out[len_name] = lens[:, 0] if k == 1 else lens
+        self._pad_ragged_checked = True
+        return out
+
     def _pad(self, host_batch, n):
         out = {}
         for name, arr in host_batch.items():
@@ -521,8 +634,9 @@ class JaxLoader:
             if arr.dtype == object:
                 raise TypeError(
                     'Field %r has variable shape (object dtype) and cannot '
-                    'be staged to device; project it away with fields=, or '
-                    'densify/pad it with a TransformSpec' % name)
+                    'be staged to device; pad it to a static shape with '
+                    'pad_ragged={%r: <size>}, project it away with fields=, '
+                    'or densify it with a TransformSpec' % (name, name))
             want = self._dtypes.get(name)
             if want is not None:
                 arr = arr.astype(want)
