@@ -47,6 +47,7 @@ def make_jax_loader(dataset_url_or_urls, batch_size, mesh=None, data_axes=None,
                     extra_capacity=None, seed=0, last_batch='drop',
                     dtypes=None, prefetch=2, num_epochs=1,
                     inmemory_cache_all=False, pad_ragged=None,
+                    bucket_boundaries=None,
                     reader_factory=None, **reader_kwargs):
     """Create a :class:`JaxLoader` over a Parquet dataset.
 
@@ -81,6 +82,16 @@ def make_jax_loader(dataset_url_or_urls, batch_size, mesh=None, data_axes=None,
         saturate correctly. Static shapes are the XLA-idiomatic answer to
         raggedness: the train step compiles once, and masks built from
         ``<field>_len`` replace dynamic shapes.
+    :param bucket_boundaries: ``{field: [b1, b2, ...]}`` (one field) —
+        length-bucketed batching, the XLA re-design of tf.data's
+        ``bucket_by_sequence_length``: rows route to the smallest
+        boundary ≥ their leading length, each bucket fills its own
+        fixed-``batch_size`` batches, and the field pads to the BUCKET's
+        bound (rows past the largest boundary truncate into it; the
+        ``<field>_len`` column keeps true lengths). Emitted shapes are
+        static per bucket, so jit compiles one step per bucket and
+        padding waste drops from pad-to-max to pad-to-bucket. Composes
+        with ``pad_ragged`` for OTHER fields.
     :param reader_factory: reader constructor (defaults to
         :func:`petastorm_tpu.reader.make_batch_reader`).
     :param reader_kwargs: forwarded to the reader factory (predicates,
@@ -117,7 +128,8 @@ def make_jax_loader(dataset_url_or_urls, batch_size, mesh=None, data_axes=None,
                            min_after_retrieve=min_after_retrieve,
                            extra_capacity=extra_capacity, seed=seed,
                            last_batch=last_batch, dtypes=dtypes,
-                           prefetch=prefetch, pad_ragged=pad_ragged)
+                           prefetch=prefetch, pad_ragged=pad_ragged,
+                           bucket_boundaries=bucket_boundaries)
     except Exception:
         reader.stop()
         reader.join()
@@ -134,7 +146,7 @@ class JaxLoader:
                  shuffle_rows=False, shuffling_queue_capacity=None,
                  min_after_retrieve=None, extra_capacity=None, seed=0,
                  last_batch='drop', dtypes=None, prefetch=2,
-                 pad_ragged=None):
+                 pad_ragged=None, bucket_boundaries=None):
         if last_batch not in ('drop', 'pad', 'short'):
             raise ValueError("last_batch must be 'drop', 'pad' or 'short'; "
                              'got %r' % (last_batch,))
@@ -148,6 +160,25 @@ class JaxLoader:
                                  'tuple of positive ints; got %r'
                                  % (name, sizes))
         self._pad_ragged_checked = not self._pad_ragged
+        self._bucket_field = None
+        self._bucket_bounds = None
+        if bucket_boundaries:
+            if len(bucket_boundaries) != 1:
+                raise ValueError('bucket_boundaries supports exactly one '
+                                 'field; got %s'
+                                 % sorted(bucket_boundaries))
+            ((name, bounds),) = bucket_boundaries.items()
+            bounds = [int(b) for b in bounds]
+            if not bounds or bounds != sorted(set(bounds)) or bounds[0] <= 0:
+                raise ValueError('bucket_boundaries[%r] must be strictly '
+                                 'ascending positive ints; got %r'
+                                 % (name, bounds))
+            if name in self._pad_ragged:
+                raise ValueError('field %r cannot be in both pad_ragged and '
+                                 'bucket_boundaries (the boundaries define '
+                                 'its padding)' % name)
+            self._bucket_field = name
+            self._bucket_bounds = np.asarray(bounds, np.int64)
         if not getattr(reader, 'batched_output', True):
             raise ValueError(
                 'JaxLoader requires a batched reader (make_batch_reader); '
@@ -479,6 +510,9 @@ class JaxLoader:
 
     def _stage_loop(self):
         try:
+            if self._bucket_field is not None:
+                self._stage_loop_bucketed()
+                return
             buf = self._make_buffer()
             for columns in self._pull_batches():
                 if self._pad_ragged:
@@ -513,6 +547,126 @@ class JaxLoader:
             # queue is full.
             self._produce_done.set()
             self._put_blocking(_SENTINEL_END)
+
+    def _stage_loop_bucketed(self):
+        """Length-bucketed staging (the ``bucket_by_sequence_length``
+        shape of tf.data, re-designed for XLA): each bucket keeps its own
+        fixed-``batch_size`` buffer, every chunk is split by the bucket
+        field's per-row length and densified to the bucket's bound, and a
+        batch emits whenever any bucket fills. Emitted shapes are static
+        PER BUCKET — jit compiles one step per bucket (bounded by the
+        boundary count), and padding waste drops from pad-to-max to
+        pad-to-bucket."""
+        buffers = {}
+        for columns in self._pull_batches():
+            if self._pad_ragged:
+                columns = self._densify_ragged(columns)
+            for bound, subcols in self._split_by_bucket(columns):
+                buf = buffers.get(bound)
+                if buf is None:
+                    buf = buffers[bound] = self._make_buffer()
+                buf.add_many(subcols)
+                while buf.can_retrieve:
+                    self._emit(buf.retrieve())
+                    if self._stop_event.is_set():
+                        return
+            if self._stop_event.is_set():
+                return
+        for buf in buffers.values():
+            buf.finish()
+            while buf.can_retrieve:
+                self._emit(buf.retrieve())
+                if self._stop_event.is_set():
+                    return
+
+    @staticmethod
+    def _object_cells(col, name, policy):
+        """Normalize an object column to per-row ndarrays (None kept) and
+        return ``(cells, first_non_none)`` — shared None/dtype-inference
+        contract of ``pad_ragged`` and ``bucket_boundaries``."""
+        cells = [None if c is None else np.asarray(c) for c in col]
+        first = next((c for c in cells if c is not None), None)
+        if first is None:
+            raise ValueError(
+                '%s[%r]: every cell in this batch is None; cell dtype/'
+                'trailing shape cannot be inferred. Filter all-null '
+                'batches with a predicate, or drop the field'
+                % (policy, name))
+        return cells, first
+
+    @staticmethod
+    def _reserve_len_column(columns, name, policy):
+        """The ``<name>_len`` companion column's name, after checking the
+        batch does not already carry one."""
+        len_name = name + LEN_SUFFIX
+        if len_name in columns:
+            raise ValueError(
+                '%s would add column %r but the batch already has one; '
+                'rename the source column' % (policy, len_name))
+        return len_name
+
+    def _split_by_bucket(self, columns):
+        """Split one chunk by the bucket field's per-row leading length.
+        Yields ``(bound, subcolumns)`` with the bucket field densified to
+        ``(n_rows, bound, *trailing)`` plus its true-length column; rows
+        longer than the largest boundary truncate into the last bucket
+        (true length preserved, same contract as ``pad_ragged``)."""
+        name = self._bucket_field
+        if name not in columns:
+            raise ValueError(
+                'bucket_boundaries field %r is not in the batch '
+                '(available: %s); check the name against fields=/the '
+                'schema' % (name, sorted(n for n in columns
+                                         if n != _PULL_FIELD)))
+        len_name = self._reserve_len_column(columns, name,
+                                            'bucket_boundaries')
+        col = columns[name]
+        n = len(col)
+        if n == 0:
+            return
+        if col.dtype == object:
+            cells, first = self._object_cells(col, name,
+                                              'bucket_boundaries')
+            if first.ndim < 1:
+                raise ValueError(
+                    'bucket_boundaries[%r]: cells are scalars; bucketing '
+                    'needs a leading sequence dim' % name)
+            lens = np.asarray([0 if c is None else c.shape[0]
+                               for c in cells], np.int32)
+            trailing = first.shape[1:]
+            dtype = first.dtype
+        else:
+            if col.ndim < 2:
+                raise ValueError(
+                    'bucket_boundaries[%r]: column is scalar per row; '
+                    'bucketing needs a leading sequence dim' % name)
+            cells = None  # uniform dense chunk: one length for all rows
+            lens = np.full(n, col.shape[1], np.int32)
+            trailing = col.shape[2:]
+            dtype = col.dtype
+        bounds = self._bucket_bounds
+        # searchsorted('left') → index of the smallest bound >= len;
+        # longer-than-largest rows clamp into the last bucket (truncate)
+        bucket_idx = np.minimum(np.searchsorted(bounds, lens, side='left'),
+                                len(bounds) - 1)
+        for b in np.unique(bucket_idx):
+            bound = int(bounds[b])
+            rows = np.flatnonzero(bucket_idx == b)
+            dense = np.zeros((len(rows), bound) + trailing, dtype)
+            if cells is None:
+                keep = min(col.shape[1], bound)
+                dense[:, :keep] = col[rows, :keep]
+            else:
+                for j, i in enumerate(rows):
+                    cell = cells[i]
+                    if cell is None:
+                        continue
+                    keep = min(cell.shape[0], bound)
+                    dense[j, :keep] = cell[:keep]
+            subcols = {k: (v[rows] if k != name else dense)
+                       for k, v in columns.items()}
+            subcols[len_name] = lens[rows]
+            yield bound, subcols
 
     def _emit(self, host_batch):
         host_batch = dict(host_batch)
@@ -556,11 +710,7 @@ class JaxLoader:
                         % (name, sorted(n for n in columns
                                         if n != _PULL_FIELD)))
                 continue
-            len_name = name + LEN_SUFFIX
-            if len_name in out:
-                raise ValueError(
-                    'pad_ragged would add column %r but the batch already '
-                    'has one; rename the source column' % len_name)
+            len_name = self._reserve_len_column(out, name, 'pad_ragged')
             col = out[name]
             k = len(targets)
             n = len(col)
@@ -569,15 +719,7 @@ class JaxLoader:
             if col.dtype == object:
                 # None cells (nullable fields) densify as all-zero rows
                 # with true size 0 — the natural mask value downstream
-                cells = [None if cell is None else np.asarray(cell)
-                         for cell in col]
-                first = next((c for c in cells if c is not None), None)
-                if first is None:
-                    raise ValueError(
-                        'pad_ragged[%r]: every cell in this batch is None; '
-                        'cell dtype/trailing shape cannot be inferred. '
-                        'Filter all-null batches with a predicate, or '
-                        'drop the field' % name)
+                cells, first = self._object_cells(col, name, 'pad_ragged')
                 trailing = first.shape[k:]
                 dense = np.zeros((n,) + targets + trailing, first.dtype)
                 lens = np.zeros((n, k), np.int32)
@@ -685,6 +827,11 @@ class JaxLoader:
     @property
     def shuffle_rows(self):
         return self._shuffle_rows
+
+    @property
+    def bucket_field(self):
+        """The ``bucket_boundaries`` field name, or None."""
+        return self._bucket_field
 
     @property
     def sharding(self):
@@ -849,6 +996,16 @@ class InMemoryCachedLoader:
 
     def _row_replay_supported(self):
         import jax
+        if self._loader.bucket_field is not None:
+            # bucketed batches carry per-bucket widths; pooling them into
+            # one array per field cannot concatenate. Batch-order replay
+            # (shapes preserved per batch) is the sound fallback.
+            if not getattr(self, '_warned_bucketed', False):
+                logger.warning(
+                    'inmemory_cache_all: bucket_boundaries batches have '
+                    'per-bucket shapes; replay reshuffles batch order only')
+                self._warned_bucketed = True
+            return False
         if jax.process_count() == 1:
             return True
         if not getattr(self, '_warned_multiprocess', False):

@@ -276,6 +276,117 @@ def test_pad_ragged_mixed_chunk_forms_across_rowgroups(tmp_path,
                 np.asarray(batch['tokens'][i])[:size], want)
 
 
+@pytest.mark.parametrize('shuffle_rows', [False, True])
+def test_bucket_boundaries_routes_by_length(ragged_dataset, shuffle_rows):
+    # tokens lengths are 3..11; boundaries [6, 12] → every emitted batch
+    # is entirely short (padded to 6) or entirely long (padded to 12)
+    with make_jax_loader(ragged_dataset.url, batch_size=4,
+                         fields=['^id$', '^tokens$'],
+                         bucket_boundaries={'tokens': [6, 12]},
+                         shuffle_rows=shuffle_rows, last_batch='short',
+                         shuffle_row_groups=False) as loader:
+        batches = list(loader)
+    by_id = {d['id']: d for d in ragged_dataset.rows}
+    seen = []
+    for batch in batches:
+        bound = batch['tokens'].shape[1]
+        assert bound in (6, 12)
+        for i, row_id in enumerate(np.asarray(batch['id']).tolist()):
+            want = by_id[row_id]['tokens']
+            assert int(batch['tokens_len'][i]) == len(want)
+            # routed to the smallest boundary >= its length
+            assert bound == (6 if len(want) <= 6 else 12)
+            np.testing.assert_array_equal(
+                np.asarray(batch['tokens'][i])[:len(want)], want)
+            assert (np.asarray(batch['tokens'][i])[len(want):] == 0).all()
+            seen.append(row_id)
+    # 'short' tail policy: every row delivered exactly once across buckets
+    assert sorted(seen) == sorted(d['id'] for d in ragged_dataset.rows)
+
+
+def test_bucket_boundaries_truncates_into_last_bucket(ragged_dataset):
+    # largest boundary 8 < max length 11: long rows truncate into the
+    # last bucket with their TRUE length preserved
+    with make_jax_loader(ragged_dataset.url, batch_size=4,
+                         fields=['^id$', '^tokens$'],
+                         bucket_boundaries={'tokens': [4, 8]},
+                         last_batch='short',
+                         shuffle_row_groups=False) as loader:
+        batches = list(loader)
+    by_id = {d['id']: d for d in ragged_dataset.rows}
+    truncated = 0
+    for batch in batches:
+        bound = batch['tokens'].shape[1]
+        for i, row_id in enumerate(np.asarray(batch['id']).tolist()):
+            want = by_id[row_id]['tokens']
+            assert int(batch['tokens_len'][i]) == len(want)
+            if len(want) > 8:
+                truncated += 1
+                assert bound == 8
+                np.testing.assert_array_equal(np.asarray(batch['tokens'][i]),
+                                              want[:8])
+    assert truncated > 0
+
+
+def test_bucket_boundaries_composes_with_pad_ragged(ragged_dataset):
+    # tokens bucketed, frames (a DIFFERENT ragged field) statically padded
+    with make_jax_loader(ragged_dataset.url, batch_size=4,
+                         bucket_boundaries={'tokens': [6, 12]},
+                         pad_ragged={'frames': 6}, last_batch='short',
+                         shuffle_row_groups=False) as loader:
+        batches = list(loader)
+    by_id = {d['id']: d for d in ragged_dataset.rows}
+    for batch in batches:
+        assert batch['frames'].shape[1:] == (6, 4)
+        for i, row_id in enumerate(np.asarray(batch['id']).tolist()):
+            assert int(batch['frames_len'][i]) == len(by_id[row_id]['frames'])
+
+
+def test_bucket_boundaries_scalar_field_diagnostic(scalar_dataset):
+    # a scalar bucket field must give an actionable error, not an
+    # IndexError from shape poking on the staging thread
+    with make_jax_loader(scalar_dataset.url, batch_size=8,
+                         fields=['^id$'],
+                         bucket_boundaries={'id': [4, 8]},
+                         shuffle_row_groups=False) as loader:
+        with pytest.raises(Exception, match='leading sequence dim'):
+            list(loader)
+
+
+def test_bucket_boundaries_inmemory_cache_replays_batch_order(ragged_dataset):
+    # bucketed batches have per-bucket shapes: row replay cannot pool
+    # them; the cached loader must fall back to batch-order reshuffle
+    with make_jax_loader(ragged_dataset.url, batch_size=4,
+                         fields=['^id$', '^tokens$'],
+                         bucket_boundaries={'tokens': [6, 12]},
+                         shuffle_rows=True, last_batch='short',
+                         inmemory_cache_all=True,
+                         shuffle_row_groups=False) as loader:
+        first = [np.asarray(b['id']).tolist() for b in loader]
+        second = [np.asarray(b['id']).tolist() for b in loader]
+    assert sorted(sum(first, [])) == sorted(sum(second, []))
+    # each replayed batch is one of the cached batches (order reshuffled)
+    assert {tuple(b) for b in first} == {tuple(b) for b in second}
+
+
+def test_bucket_boundaries_validation():
+    with pytest.raises(ValueError, match='ascending'):
+        from petastorm_tpu.jax.loader import JaxLoader
+
+        class _R:
+            batched_output = True
+        JaxLoader(_R(), 4, bucket_boundaries={'tokens': [8, 4]})
+    from petastorm_tpu.jax.loader import JaxLoader
+
+    class _R:
+        batched_output = True
+    with pytest.raises(ValueError, match='exactly one'):
+        JaxLoader(_R(), 4, bucket_boundaries={'a': [4], 'b': [8]})
+    with pytest.raises(ValueError, match='both pad_ragged'):
+        JaxLoader(_R(), 4, bucket_boundaries={'a': [4]},
+                  pad_ragged={'a': 4})
+
+
 def test_pad_ragged_unknown_field_raises(ragged_dataset):
     with make_jax_loader(ragged_dataset.url, batch_size=8,
                          pad_ragged={'no_such_field': 16},
