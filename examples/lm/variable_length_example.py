@@ -1,0 +1,91 @@
+"""LM finetuning-style training on VARIABLE-LENGTH documents — no packing.
+
+The sibling :mod:`examples.lm.pretrain_example` packs documents into fixed
+rows (the pretraining recipe, where document boundaries may blur). Packing
+is wrong for instruction tuning / per-document objectives, where each row
+must stay one document. This example shows the loader-native alternative:
+
+1. **Documents on disk**: the same C4-style ``(None,)`` int32 token rows.
+2. **Length-bucketed device stage**: ``make_jax_loader(bucket_boundaries=
+   {'tokens': [64, 128, 256, 512]})`` routes each document to the
+   smallest boundary that fits, pads only to that bucket's bound, and
+   emits a ``tokens_len`` column with true lengths — the XLA re-design of
+   tf.data's ``bucket_by_sequence_length`` (per-bucket static shapes; one
+   compiled step per bucket instead of per ragged shape).
+3. **Masked train step**:
+   :func:`petastorm_tpu.models.transformer.transformer_masked_train_step`
+   — next-token loss over real targets only, normalized by the real
+   target count so the gradient scale does not depend on padding.
+"""
+
+import argparse
+
+import numpy as np
+
+BOUNDARIES = (64, 128, 256, 512)
+
+
+def train_variable_length(dataset_url, batch_size=16, steps=20,
+                          learning_rate=1e-2, boundaries=BOUNDARIES,
+                          d_model=64, n_layers=2, log=print):
+    """Train over bucketed variable-length batches; returns the final loss
+    and the bucket → step-count histogram."""
+    import jax
+    import optax
+
+    from petastorm_tpu.jax import make_jax_loader
+    from petastorm_tpu.models.transformer import (
+        TransformerConfig, init_transformer_params,
+        transformer_masked_train_step,
+    )
+
+    max_len = int(boundaries[-1])
+    config = TransformerConfig(vocab_size=256, d_model=d_model, n_heads=4,
+                               n_layers=n_layers, d_ff=4 * d_model,
+                               max_seq_len=max_len)
+    params = init_transformer_params(jax.random.PRNGKey(0), config)
+    optimizer = optax.adamw(learning_rate)
+    opt_state = optimizer.init(params)
+    step = transformer_masked_train_step(config, optimizer)
+
+    bucket_steps = {}
+    loss = None
+    with make_jax_loader(dataset_url, batch_size=batch_size,
+                         fields=['^tokens$'], num_epochs=None,
+                         bucket_boundaries={'tokens': list(boundaries)},
+                         shuffle_row_groups=True) as loader:
+        it = iter(loader)
+        for i in range(steps):
+            batch = next(it)
+            tokens, lengths = batch['tokens'], batch['tokens_len']
+            bound = tokens.shape[1]
+            params, opt_state, loss = step(params, opt_state, tokens,
+                                           lengths)
+            bucket_steps[bound] = bucket_steps.get(bound, 0) + 1
+            if i % 5 == 0 or i == steps - 1:
+                log('step %3d  bucket %3d  loss %.4f'
+                    % (i, bound, float(loss)))
+    return float(loss), bucket_steps
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--dataset-url', required=True)
+    parser.add_argument('--generate', action='store_true',
+                        help='write the synthetic C4-like dataset first')
+    parser.add_argument('--batch-size', type=int, default=16)
+    parser.add_argument('--steps', type=int, default=20)
+    parser.add_argument('--learning-rate', type=float, default=1e-2)
+    args = parser.parse_args(argv)
+    if args.generate:
+        from examples.lm.pretrain_example import generate_c4_like
+        generate_c4_like(args.dataset_url)
+    loss, buckets = train_variable_length(
+        args.dataset_url, batch_size=args.batch_size, steps=args.steps,
+        learning_rate=args.learning_rate)
+    print('final loss %.4f; steps per bucket: %s'
+          % (loss, dict(sorted(buckets.items()))))
+
+
+if __name__ == '__main__':
+    main()

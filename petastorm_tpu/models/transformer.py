@@ -369,6 +369,58 @@ def transformer_loss(params, tokens, config, mesh=None):
     return -ll.mean() + config.moe_aux_weight * aux
 
 
+def transformer_masked_loss(params, tokens, lengths, config, mesh=None):
+    """Next-token cross-entropy over PADDED (B, S) batches: position ``i``
+    predicts ``i+1`` and contributes only when ``i+1 < length`` — the loss
+    shape for the loader's ``pad_ragged``/``bucket_boundaries`` batches
+    (``tokens`` padded to a static S, ``lengths`` the ``<field>_len``
+    column). Zero-length (padding) rows contribute nothing; the mean
+    normalizes by the REAL target count, so batches of different
+    valid-token totals train at consistent per-token scale.
+
+    DENSE configs only: the Switch router's load-balancing statistics are
+    computed over every position, and masking them per-row is a router
+    change, not a loss change — an unmasked aux would silently train the
+    router to balance pad tokens and break this loss's pad-invariance."""
+    if config.n_experts > 0:
+        raise NotImplementedError(
+            'transformer_masked_loss supports dense configs only: the '
+            'Switch aux statistics would include padding positions. Use '
+            'packed batches (examples.lm.pretrain_example) for MoE.')
+    logits, aux = transformer_forward_with_aux(params, tokens[:, :-1], config,
+                                               mesh=mesh)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    # target position i (0-based over the shifted S-1 axis) is real when
+    # i + 1 < length; lengths can exceed S for truncated rows — the
+    # comparison saturates, exactly the pad_ragged <field>_len contract
+    positions = jnp.arange(targets.shape[1])[None, :]
+    mask = positions + 1 < jnp.minimum(lengths, tokens.shape[1])[:, None]
+    count = jnp.maximum(mask.sum(), 1)
+    return (-(ll * mask).sum() / count
+            + config.moe_aux_weight * aux)
+
+
+def transformer_masked_train_step(config, optimizer, mesh=None):
+    """Jittable ``(params, opt_state, tokens, lengths) -> (params,
+    opt_state, loss)`` over padded/bucketed batches (see
+    :func:`transformer_masked_loss`). One instance compiles per static
+    ``tokens.shape`` — with ``bucket_boundaries`` that is one compile per
+    bucket."""
+
+    import optax
+
+    @jax.jit
+    def step(params, opt_state, tokens, lengths):
+        loss, grads = jax.value_and_grad(transformer_masked_loss)(
+            params, tokens, lengths, config, mesh)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    return step
+
+
 def init_pipelined_transformer_params(rng, config, mesh, pipe_axis=None):
     """Parameters for the PIPELINE-PARALLEL transformer: blocks stacked on
     a leading ``(n_stages, layers_per_stage)`` axis pair sharded over

@@ -151,6 +151,24 @@ class TestLmExample:
                         checkpoint_dir=ckpt_dir) is None
 
     @pytest.mark.slow
+    def test_variable_length_bucketed_training(self, tmp_path):
+        # no-packing path: variable-length docs → length buckets → masked
+        # train step; multiple bucket shapes must actually occur
+        from examples.lm.pretrain_example import generate_c4_like
+        from examples.lm.variable_length_example import (
+            train_variable_length,
+        )
+        url = 'file://' + str(tmp_path / 'c4_var')
+        generate_c4_like(url, num_docs=192)
+        loss, buckets = train_variable_length(
+            url, batch_size=8, steps=10, boundaries=(64, 128, 256, 512),
+            d_model=32, n_layers=1, log=lambda *a: None)
+        assert np.isfinite(loss)
+        assert sum(buckets.values()) == 10
+        assert len(buckets) >= 2, 'doc lengths 20-400 must hit >=2 buckets'
+        assert set(buckets) <= {64, 128, 256, 512}
+
+    @pytest.mark.slow
     def test_long_context_seq_parallel_pretrain(self, tmp_path):
         # the full long-context path: packed rows → data x seq mesh → ring
         # attention inside the train step (tiny shapes for CI speed)
