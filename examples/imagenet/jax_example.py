@@ -20,16 +20,23 @@ IMAGENET_MEAN = [0.485, 0.456, 0.406]
 IMAGENET_STD = [0.229, 0.224, 0.225]
 
 
+def resize_frame_images(frame, size):
+    """In-place worker-side resize of the frame's ``image`` column — the
+    single resize implementation shared by this example's transform and
+    the ViT example's."""
+    import cv2
+    frame['image'] = [
+        cv2.resize(im, (size, size), interpolation=cv2.INTER_AREA)
+        for im in frame['image']
+    ]
+    return frame
+
+
 def _resize_transform(size=224):
     from petastorm_tpu.transform import TransformSpec
 
     def resize_rows(frame):
-        import cv2
-        frame['image'] = [
-            cv2.resize(im, (size, size), interpolation=cv2.INTER_AREA)
-            for im in frame['image']
-        ]
-        return frame
+        return resize_frame_images(frame, size)
 
     # strings can't live in device HBM: select only the dense image column
     return TransformSpec(

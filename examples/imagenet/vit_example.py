@@ -1,0 +1,94 @@
+"""ImageNet ViT training: the full image loop, Parquet → device → model.
+
+Extends :mod:`examples.imagenet.jax_example` (which stops at normalized
+device batches) through an actual model: worker-side resize + label
+extraction → fixed-shape ``make_jax_loader`` batches → on-device Pallas
+normalization → :mod:`petastorm_tpu.models.vit` train steps, the blocks
+shared with the LM flagship.
+
+Run (after generate_petastorm_imagenet):
+    python -m examples.imagenet.vit_example \
+        --dataset-url file:///tmp/imagenet_petastorm --steps 8
+"""
+
+import argparse
+
+import numpy as np
+
+from examples.imagenet.jax_example import (
+    IMAGENET_MEAN, IMAGENET_STD, resize_frame_images,
+)
+
+
+def _train_transform(size, n_classes):
+    """Resize images and derive an int label from the noun id, worker-side
+    (strings cannot stage to device; the synthetic generator's ids are
+    ``n%08d`` so the numeric tail is the class)."""
+    from petastorm_tpu.transform import TransformSpec
+
+    def rows(frame):
+        frame = resize_frame_images(frame, size)
+        frame['label'] = np.asarray(
+            [int(''.join(ch for ch in nid if ch.isdigit()) or 0) % n_classes
+             for nid in frame['noun_id']], np.int32)
+        return frame
+
+    return TransformSpec(
+        rows,
+        edit_fields=[('image', np.uint8, (size, size, 3), False),
+                     ('label', np.int32, (), False)],
+        selected_fields=['image', 'label'])
+
+
+def train_vit(dataset_url, batch_size=8, steps=8, size=64, patch_size=16,
+              n_classes=16, learning_rate=1e-3, log=print):
+    """Train a small ViT over the imagenet-style dataset; returns the
+    final loss."""
+    import jax
+    import optax
+
+    from petastorm_tpu.jax import make_jax_loader
+    from petastorm_tpu.models.vit import (
+        ViTConfig, init_vit_params, vit_train_step,
+    )
+    from petastorm_tpu.ops import normalize_images
+
+    config = ViTConfig(image_size=size, patch_size=patch_size,
+                       n_classes=n_classes, d_model=64, n_heads=4,
+                       n_layers=2, d_ff=256)
+    params = init_vit_params(jax.random.PRNGKey(0), config)
+    optimizer = optax.adamw(learning_rate)
+    opt_state = optimizer.init(params)
+    step = vit_train_step(config, optimizer)
+
+    loss = None
+    with make_jax_loader(dataset_url, batch_size=batch_size,
+                         transform_spec=_train_transform(size, n_classes),
+                         last_batch='drop', num_epochs=None,
+                         shuffle_row_groups=True) as loader:
+        it = iter(loader)
+        for i in range(steps):
+            batch = next(it)
+            images = normalize_images(batch['image'], mean=IMAGENET_MEAN,
+                                      std=IMAGENET_STD)
+            params, opt_state, loss = step(params, opt_state, images,
+                                           batch['label'])
+            if i % 4 == 0 or i == steps - 1:
+                log('step %3d  loss %.4f' % (i, float(loss)))
+    return float(loss)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--dataset-url',
+                        default='file:///tmp/imagenet_petastorm')
+    parser.add_argument('--batch-size', type=int, default=8)
+    parser.add_argument('--steps', type=int, default=8)
+    args = parser.parse_args(argv)
+    loss = train_vit(args.dataset_url, batch_size=args.batch_size,
+                     steps=args.steps)
+    print('final loss %.4f' % loss)
+
+
+if __name__ == '__main__':
+    main()

@@ -168,7 +168,11 @@ def _rmsnorm(x, gain):
 
 
 def _attention(x, qkv_w, out_w, n_heads, dtype, seq_axis=None, mesh=None,
-               seq_impl='ring', attn_impl='dense', seq_manual=False):
+               seq_impl='ring', attn_impl='dense', seq_manual=False,
+               causal=True):
+    if not causal and attn_impl == 'flash':
+        raise ValueError('the fused flash kernel is causal-only; '
+                         "bidirectional attention needs attn_impl='dense'")
     b, s, d = x.shape
     head_dim = d // n_heads
     qkv = jnp.einsum('bsd,de->bse', x, qkv_w.astype(dtype),
@@ -185,12 +189,12 @@ def _attention(x, qkv_w, out_w, n_heads, dtype, seq_axis=None, mesh=None,
                 _ring_attention_local
             ctx = _ring_attention_local(
                 q.reshape(bshd), k_.reshape(bshd), v.reshape(bshd),
-                axis_name=seq_axis, causal=True, scale=head_dim ** -0.5)
+                axis_name=seq_axis, causal=causal, scale=head_dim ** -0.5)
         else:
             from petastorm_tpu.ops.ulysses_attention import _ulysses_local
             ctx = _ulysses_local(
                 q.reshape(bshd), k_.reshape(bshd), v.reshape(bshd),
-                axis_name=seq_axis, causal=True, scale=head_dim ** -0.5)
+                axis_name=seq_axis, causal=causal, scale=head_dim ** -0.5)
         ctx = ctx.reshape(b, s, d)
     elif seq_axis is not None and mesh is not None:
         # sequence parallel: attention is the ONLY cross-token op, so it is
@@ -210,7 +214,7 @@ def _attention(x, qkv_w, out_w, n_heads, dtype, seq_axis=None, mesh=None,
         bshd = (b, s, n_heads, head_dim)
         ctx = seq_attention(q.reshape(bshd), k_.reshape(bshd),
                             v.reshape(bshd), mesh, axis_name=seq_axis,
-                            causal=True, batch_axis=batch_axis)
+                            causal=causal, batch_axis=batch_axis)
         ctx = ctx.reshape(b, s, d)
     elif attn_impl == 'flash':
         from petastorm_tpu.ops.flash_attention import flash_causal_attention
@@ -226,8 +230,9 @@ def _attention(x, qkv_w, out_w, n_heads, dtype, seq_axis=None, mesh=None,
         scores = jnp.einsum('bhqd,bhkd->bhqk', q, k_,
                             preferred_element_type=jnp.float32)
         scores = scores / np.sqrt(head_dim)
-        mask = jnp.tril(jnp.ones((s, s), bool))
-        scores = jnp.where(mask, scores, -1e30)
+        if causal:
+            mask = jnp.tril(jnp.ones((s, s), bool))
+            scores = jnp.where(mask, scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
         ctx = jnp.einsum('bhqk,bhkd->bhqd', probs, v,
                          preferred_element_type=jnp.float32).astype(dtype)
@@ -236,18 +241,21 @@ def _attention(x, qkv_w, out_w, n_heads, dtype, seq_axis=None, mesh=None,
                       preferred_element_type=jnp.float32).astype(dtype)
 
 
-def _block_attention_half(block, x, config, mesh=None, seq_manual=False):
+def _block_attention_half(block, x, config, mesh=None, seq_manual=False,
+                          causal=True):
     """Pre-norm attention sublayer with residual + sharding constraint.
 
     ``seq_manual``: running inside a shard_map already manual over
     ``config.seq_axis`` (the pp×sp pipeline) — attention calls the
     strategy's per-device body, and the seq constraint (now a manual
-    axis, unreachable by with_sharding_constraint) is skipped."""
+    axis, unreachable by with_sharding_constraint) is skipped.
+    ``causal=False`` gives bidirectional attention (ViT-style encoders);
+    the LM paths keep the causal default."""
     h = _rmsnorm(x, block['ln1'])
     x = x + _attention(h, block['qkv'], block['attn_out'], config.n_heads,
                        config.dtype, seq_axis=config.seq_axis, mesh=mesh,
                        seq_impl=config.seq_impl, attn_impl=config.attn_impl,
-                       seq_manual=seq_manual)
+                       seq_manual=seq_manual, causal=causal)
     return _constrain(x, None if seq_manual else config.seq_axis)
 
 
@@ -263,11 +271,12 @@ def _block_dense_ffn_half(block, x, config, seq_manual=False):
     return _constrain(x, None if seq_manual else config.seq_axis)
 
 
-def _block_forward(block, x, config, mesh=None, seq_manual=False):
-    """One dense transformer block — shared by the layered forward and the
-    pipeline stage executor."""
+def _block_forward(block, x, config, mesh=None, seq_manual=False,
+                   causal=True):
+    """One dense transformer block — shared by the layered forward, the
+    pipeline stage executor, and (with ``causal=False``) the ViT."""
     x = _block_attention_half(block, x, config, mesh=mesh,
-                              seq_manual=seq_manual)
+                              seq_manual=seq_manual, causal=causal)
     return _block_dense_ffn_half(block, x, config, seq_manual=seq_manual)
 
 

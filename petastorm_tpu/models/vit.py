@@ -1,0 +1,154 @@
+"""Vision Transformer: the image-classification flagship consumer.
+
+The reference ships no models at all (SURVEY.md §0 — it is an ingest
+library); its image story stops at the ``examples/imagenet`` reader loop.
+This ViT closes the loop TPU-first: uint8 image batches from the
+:class:`~petastorm_tpu.jax.JaxLoader` (``CompressedImageCodec`` columns,
+natively decoded) → on-device normalization → patch embedding → the SAME
+pre-norm transformer blocks as the LM flagship
+(:mod:`petastorm_tpu.models.transformer` — one block implementation serves
+both model families, so the dp×tp Megatron sharding, sequence
+parallelism, and pipelining machinery apply unchanged) → mean-pool →
+linear classifier.
+
+TPU notes: patchify is a reshape/transpose (no gather); all matmuls are
+bf16 with f32 accumulation via the shared block code; mean-pool instead
+of a CLS token keeps the sequence axis uniform (no ragged concat, XLA
+fuses the reduction into the head matmul's producer).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from petastorm_tpu.models.transformer import (
+    TransformerConfig, _block_forward, _constrain, _param_specs,
+    _restrict_spec_to_mesh, _rmsnorm, init_transformer_params,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    channels: int = 3
+    n_classes: int = 1000
+    d_model: int = 256
+    n_heads: int = 8
+    n_layers: int = 6
+    d_ff: int = 1024
+    dtype: object = jnp.bfloat16
+
+    def __post_init__(self):
+        if self.image_size % self.patch_size:
+            raise ValueError('image_size=%d not divisible by patch_size=%d'
+                             % (self.image_size, self.patch_size))
+
+    @property
+    def n_patches(self):
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def patch_dim(self):
+        return self.patch_size * self.patch_size * self.channels
+
+    def block_config(self):
+        """The shared-transformer-block view of this config."""
+        return TransformerConfig(
+            vocab_size=2,  # unused: ViT has no token embedding
+            d_model=self.d_model, n_heads=self.n_heads,
+            n_layers=self.n_layers, d_ff=self.d_ff,
+            max_seq_len=self.n_patches, dtype=self.dtype)
+
+
+def init_vit_params(rng, config, mesh=None):
+    """Parameters; with a mesh, placed with their dp×tp shardings (the
+    blocks reuse the LM transformer's Megatron specs)."""
+    c = config
+    k_patch, k_cls, k_blocks = jax.random.split(rng, 3)
+    block_params = init_transformer_params(k_blocks, c.block_config())
+    params = {
+        'patch_embed': (jax.random.normal(k_patch,
+                                          (c.patch_dim, c.d_model),
+                                          jnp.float32)
+                        * c.patch_dim ** -0.5),
+        'pos_embed': (jax.random.normal(k_cls, (c.n_patches, c.d_model),
+                                        jnp.float32) * 0.02),
+        'blocks': block_params['blocks'],
+        'ln_f': jnp.ones((c.d_model,), jnp.float32),
+        'head': jnp.zeros((c.d_model, c.n_classes), jnp.float32),
+    }
+    if mesh is not None:
+        block_specs = _param_specs(c.block_config())['blocks']
+        specs = {
+            'patch_embed': P(None, None),
+            'pos_embed': P(None, None),
+            'blocks': block_specs,
+            'ln_f': P(None),
+            'head': P(None, None),
+        }
+        params = jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(
+                x, NamedSharding(mesh, _restrict_spec_to_mesh(s, mesh))),
+            params, specs,
+            is_leaf=lambda x: isinstance(x, (jnp.ndarray, np.ndarray)))
+    return params
+
+
+def _patchify(images, config):
+    """(B, H, W, C) → (B, n_patches, patch_dim) by reshape/transpose —
+    no gathers, XLA lowers this to a relayout."""
+    c = config
+    b = images.shape[0]
+    g = c.image_size // c.patch_size
+    x = images.reshape(b, g, c.patch_size, g, c.patch_size, c.channels)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, g * g, c.patch_dim)
+
+
+def vit_forward(params, images, config):
+    """images (B, H, W, C) float in [0, 1] or normalized → logits
+    (B, n_classes) f32."""
+    c = config
+    dtype = c.dtype
+    bc = c.block_config()
+    x = _patchify(images.astype(dtype), c)
+    x = jnp.einsum('bpd,de->bpe', x, params['patch_embed'].astype(dtype),
+                   preferred_element_type=jnp.float32).astype(dtype)
+    x = x + params['pos_embed'].astype(dtype)
+    x = _constrain(x)
+    for block in params['blocks']:
+        # bidirectional: every patch attends to every patch (a causal
+        # raster-order mask would hide bottom-right content from earlier
+        # positions)
+        x = _block_forward(block, x, bc, causal=False)
+    x = _rmsnorm(x, params['ln_f'])
+    pooled = x.mean(axis=1)
+    return jnp.einsum('bd,dc->bc', pooled, params['head'].astype(dtype),
+                      preferred_element_type=jnp.float32)
+
+
+def vit_loss(params, images, labels, config):
+    import optax
+    logits = vit_forward(params, images, config)
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits, labels).mean()
+
+
+def vit_train_step(config, optimizer):
+    """Jittable ``(params, opt_state, images, labels) -> (params,
+    opt_state, loss)``; under a mesh the loss/grads inherit the params'
+    dp×tp layout (same contract as the LM train step)."""
+    import optax
+
+    @jax.jit
+    def step(params, opt_state, images, labels):
+        loss, grads = jax.value_and_grad(vit_loss)(params, images, labels,
+                                                   config)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    return step

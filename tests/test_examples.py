@@ -182,6 +182,18 @@ class TestLmExample:
 
 
 class TestImagenetExamples:
+    @pytest.mark.slow
+    def test_vit_trains_from_parquet(self, tmp_path):
+        from examples.imagenet.generate_petastorm_imagenet import (
+            generate_petastorm_imagenet,
+        )
+        from examples.imagenet.vit_example import train_vit
+        url = 'file://' + str(tmp_path / 'imagenet')
+        generate_petastorm_imagenet(url, num_rows=48)
+        loss = train_vit(url, batch_size=8, steps=6, size=32, patch_size=8,
+                         n_classes=8, log=lambda *a: None)
+        assert np.isfinite(loss)
+
     def test_generate_and_jax_read(self, tmp_path):
         from examples.imagenet.generate_petastorm_imagenet import (
             generate_petastorm_imagenet,
