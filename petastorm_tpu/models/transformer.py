@@ -59,6 +59,12 @@ class TransformerConfig:
     # off-TPU so the same config tests on the CPU mesh). Ignored when
     # seq_axis is set — ring/Ulysses own the sharded-sequence case.
     attn_impl: str = 'dense'
+    # loss memory: 0 materializes the full (B, S, V) logits in the loss
+    # (exact, simple); N > 0 computes head matmul + cross-entropy in
+    # position chunks of N under jax.checkpoint, so peak HBM for the loss
+    # drops from O(B*S*V) to O(B*N*V) (the backward recomputes each
+    # chunk's logits). Numerically identical up to float reassociation.
+    loss_chunk: int = 0
 
     def __post_init__(self):
         # validate at construction, not mid-trace inside layer 0's
@@ -298,26 +304,9 @@ def transformer_forward_with_aux(params, tokens, config, mesh=None):
     configs (``config.seq_axis``) so attention can run the ring collective;
     other parallelism axes need no mesh argument (constraints find the
     ambient mesh)."""
-    c = config
-    dtype = c.dtype
-    seq = c.seq_axis
-    if seq is not None and mesh is None:
-        raise ValueError('config.seq_axis=%r needs the mesh passed to the '
-                         'forward/train step (ring attention runs a '
-                         'collective over that axis)' % (seq,))
-    aux_total = jnp.zeros((), jnp.float32)
-    x = params['embed'][tokens].astype(dtype)
-    x = x + params['pos_embed'][:tokens.shape[1]].astype(dtype)
-    x = _constrain(x, seq)
-    for block in params['blocks']:
-        if c.n_experts > 0:
-            x = _block_attention_half(block, x, c, mesh=mesh)
-            x, aux = _block_moe_half(block, x, c, seq=seq)
-            aux_total = aux_total + aux
-        else:
-            x = _block_forward(block, x, c, mesh=mesh)
-    x = _rmsnorm(x, params['ln_f'])
-    logits = jnp.einsum('bsd,dv->bsv', x, params['lm_head'].astype(dtype),
+    x, aux_total = _features_with_aux(params, tokens, config, mesh=mesh)
+    logits = jnp.einsum('bsd,dv->bsv', x,
+                        params['lm_head'].astype(config.dtype),
                         preferred_element_type=jnp.float32)
     return logits, aux_total
 
@@ -367,9 +356,80 @@ def _constrain(x, seq_axis=None):
         return x
 
 
+def _features_with_aux(params, tokens, config, mesh=None):
+    """The forward WITHOUT the lm_head: post-``ln_f`` hidden states
+    (B, S, D) + aux — the seam that lets the loss choose how to
+    materialize logits."""
+    c = config
+    seq = c.seq_axis
+    if seq is not None and mesh is None:
+        raise ValueError('config.seq_axis=%r needs the mesh passed to the '
+                         'forward/train step (ring attention runs a '
+                         'collective over that axis)' % (seq,))
+    aux_total = jnp.zeros((), jnp.float32)
+    x = params['embed'][tokens].astype(c.dtype)
+    x = x + params['pos_embed'][:tokens.shape[1]].astype(c.dtype)
+    x = _constrain(x, seq)
+    for block in params['blocks']:
+        if c.n_experts > 0:
+            x = _block_attention_half(block, x, c, mesh=mesh)
+            x, aux = _block_moe_half(block, x, c, seq=seq)
+            aux_total = aux_total + aux
+        else:
+            x = _block_forward(block, x, c, mesh=mesh)
+    return _rmsnorm(x, params['ln_f']), aux_total
+
+
+def _chunked_next_token_nll(x, lm_head, targets, mask, chunk, dtype):
+    """``(sum_nll, count)`` over position chunks of ``chunk``: each chunk
+    runs head-matmul → log_softmax → gather under ``jax.checkpoint``, so
+    the full (B, S, V) logits never exist and the backward recomputes one
+    chunk's logits at a time. ``mask`` weights positions (float/bool)."""
+    from jax import lax
+
+    b, s, d = x.shape
+    pad = (-s) % chunk
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+        targets = jnp.pad(targets, ((0, 0), (0, pad)))
+        mask = jnp.pad(mask, ((0, 0), (0, pad)))
+    n = (s + pad) // chunk
+    xs = x.reshape(b, n, chunk, d).transpose(1, 0, 2, 3)
+    ts = targets.reshape(b, n, chunk).transpose(1, 0, 2)
+    ms = mask.astype(jnp.float32).reshape(b, n, chunk).transpose(1, 0, 2)
+
+    @jax.checkpoint
+    def chunk_nll(xc, tc, mc):
+        logits = jnp.einsum('bcd,dv->bcv', xc, lm_head.astype(dtype),
+                            preferred_element_type=jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, tc[..., None], axis=-1)[..., 0]
+        return -(ll * mc).sum(), mc.sum()
+
+    def body(carry, inp):
+        nll, cnt = carry
+        nll_c, cnt_c = chunk_nll(*inp)
+        return (nll + nll_c, cnt + cnt_c), None
+
+    (nll, cnt), _ = lax.scan(body, (jnp.zeros((), jnp.float32),
+                                    jnp.zeros((), jnp.float32)),
+                             (xs, ts, ms))
+    return nll, cnt
+
+
 def transformer_loss(params, tokens, config, mesh=None):
     """Next-token cross-entropy over (B, S) int token batches (+ weighted
-    Switch aux loss for MoE configs)."""
+    Switch aux loss for MoE configs). ``config.loss_chunk > 0`` computes
+    it chunked (see :class:`TransformerConfig`)."""
+    if config.loss_chunk > 0:
+        x, aux = _features_with_aux(params, tokens[:, :-1], config,
+                                    mesh=mesh)
+        targets = tokens[:, 1:]
+        mask = jnp.ones(targets.shape, jnp.float32)
+        nll, cnt = _chunked_next_token_nll(x, params['lm_head'], targets,
+                                           mask, config.loss_chunk,
+                                           config.dtype)
+        return nll / cnt + config.moe_aux_weight * aux
     logits, aux = transformer_forward_with_aux(params, tokens[:, :-1], config,
                                                mesh=mesh)
     targets = tokens[:, 1:]
@@ -396,16 +456,24 @@ def transformer_masked_loss(params, tokens, lengths, config, mesh=None):
             'transformer_masked_loss supports dense configs only: the '
             'Switch aux statistics would include padding positions. Use '
             'packed batches (examples.lm.pretrain_example) for MoE.')
-    logits, aux = transformer_forward_with_aux(params, tokens[:, :-1], config,
-                                               mesh=mesh)
-    targets = tokens[:, 1:]
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     # target position i (0-based over the shifted S-1 axis) is real when
     # i + 1 < length; lengths can exceed S for truncated rows — the
     # comparison saturates, exactly the pad_ragged <field>_len contract
+    targets = tokens[:, 1:]
     positions = jnp.arange(targets.shape[1])[None, :]
-    mask = positions + 1 < jnp.minimum(lengths, tokens.shape[1])[:, None]
+    mask = (positions + 1
+            < jnp.minimum(lengths, tokens.shape[1])[:, None])
+    if config.loss_chunk > 0:
+        x, aux = _features_with_aux(params, tokens[:, :-1], config,
+                                    mesh=mesh)
+        nll, cnt = _chunked_next_token_nll(
+            x, params['lm_head'], targets, mask, config.loss_chunk,
+            config.dtype)
+        return nll / jnp.maximum(cnt, 1) + config.moe_aux_weight * aux
+    logits, aux = transformer_forward_with_aux(params, tokens[:, :-1], config,
+                                               mesh=mesh)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     count = jnp.maximum(mask.sum(), 1)
     return (-(ll * mask).sum() / count
             + config.moe_aux_weight * aux)
@@ -499,18 +567,19 @@ def init_pipelined_transformer_params(rng, config, mesh, pipe_axis=None):
     return placed
 
 
-def pipelined_transformer_forward_with_aux(params, tokens, config, mesh,
-                                           pipe_axis=None,
-                                           n_microbatches=None):
-    """tokens (B, S) int32 → (logits (B, S, V) f32, aux scalar), with the
-    block stack executed as a GPipe pipeline over ``mesh[pipe_axis]``
-    (embedding and head run outside the pipeline on every stage's
-    devices). MoE configs route per microbatch inside each stage; the aux
-    scalar is the Switch load-balancing loss summed over layers, averaged
-    over microbatches (0.0 for dense configs). Dense configs with
-    ``seq_axis`` set compose pp×sp: the sequence dim additionally shards
-    over that axis through the pipeline (requires the post-shift sequence
-    length divisible by the seq axis size)."""
+def _pipelined_features_with_aux(params, tokens, config, mesh,
+                                 pipe_axis=None, n_microbatches=None):
+    """tokens (B, S) int32 → (post-``ln_f`` hidden (B, S, D), aux scalar),
+    with the block stack executed as a GPipe pipeline over
+    ``mesh[pipe_axis]`` (embedding and head run outside the pipeline on
+    every stage's devices). MoE configs route per microbatch inside each
+    stage; the aux scalar is the Switch load-balancing loss summed over
+    layers, averaged over microbatches (0.0 for dense configs). Dense
+    configs with ``seq_axis`` set compose pp×sp: the sequence dim
+    additionally shards over that axis through the pipeline (requires the
+    post-shift sequence length divisible by the seq axis size). The
+    head-free seam is what lets the pipelined loss honor
+    ``config.loss_chunk`` exactly like the layered one."""
     from petastorm_tpu.parallel.mesh import PIPE_AXIS
     from petastorm_tpu.parallel.pipeline import pipeline_apply
 
@@ -550,8 +619,19 @@ def pipelined_transformer_forward_with_aux(params, tokens, config, mesh,
                            n_microbatches=n_microbatches, seq_axis=seq)
         aux = jnp.zeros((), jnp.float32)
     x = _constrain(x, seq)
-    x = _rmsnorm(x, params['ln_f'])
-    logits = jnp.einsum('bsd,dv->bsv', x, params['lm_head'].astype(dtype),
+    return _rmsnorm(x, params['ln_f']), aux
+
+
+def pipelined_transformer_forward_with_aux(params, tokens, config, mesh,
+                                           pipe_axis=None,
+                                           n_microbatches=None):
+    """tokens (B, S) int32 → (logits (B, S, V) f32, aux scalar) through
+    the pipeline (see :func:`_pipelined_features_with_aux`)."""
+    x, aux = _pipelined_features_with_aux(params, tokens, config, mesh,
+                                          pipe_axis=pipe_axis,
+                                          n_microbatches=n_microbatches)
+    logits = jnp.einsum('bsd,dv->bsv', x,
+                        params['lm_head'].astype(config.dtype),
                         preferred_element_type=jnp.float32)
     return logits, aux
 
@@ -575,10 +655,19 @@ def pipelined_transformer_train_step(config, optimizer, mesh,
     import optax
 
     def loss_fn(params, tokens):
+        targets = tokens[:, 1:]
+        if config.loss_chunk > 0:
+            x, aux = _pipelined_features_with_aux(
+                params, tokens[:, :-1], config, mesh, pipe_axis=pipe_axis,
+                n_microbatches=n_microbatches)
+            mask = jnp.ones(targets.shape, jnp.float32)
+            nll, cnt = _chunked_next_token_nll(
+                x, params['lm_head'], targets, mask, config.loss_chunk,
+                config.dtype)
+            return nll / cnt + config.moe_aux_weight * aux
         logits, aux = pipelined_transformer_forward_with_aux(
             params, tokens[:, :-1], config, mesh, pipe_axis=pipe_axis,
             n_microbatches=n_microbatches)
-        targets = tokens[:, 1:]
         logp = jax.nn.log_softmax(logits, axis=-1)
         ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
         return -ll.mean() + config.moe_aux_weight * aux

@@ -225,6 +225,99 @@ class TestViT:
                                    atol=2e-4, rtol=2e-4)
 
 
+class TestChunkedLoss:
+    def _setup(self, **kw):
+        import dataclasses
+
+        from petastorm_tpu.models.transformer import (
+            TransformerConfig, init_transformer_params,
+        )
+        base = dict(vocab_size=32, d_model=16, n_heads=2, n_layers=1,
+                    d_ff=32, max_seq_len=9, dtype=jnp.float32)
+        base.update(kw)
+        config = TransformerConfig(**base)
+        params = init_transformer_params(jax.random.PRNGKey(0), config)
+        return config, params, dataclasses
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize('chunk', [4, 3])  # 3 does not divide S-1=8
+    def test_chunked_equals_dense_loss_and_grads(self, chunk):
+        from petastorm_tpu.models.transformer import transformer_loss
+        config, params, dataclasses = self._setup()
+        chunked_cfg = dataclasses.replace(config, loss_chunk=chunk)
+        tokens = jnp.asarray(
+            np.random.RandomState(0).randint(0, 32, (4, 9), np.int32))
+        dense, dense_grads = jax.value_and_grad(transformer_loss)(
+            params, tokens, config)
+        ck, ck_grads = jax.value_and_grad(transformer_loss)(
+            params, tokens, chunked_cfg)
+        np.testing.assert_allclose(float(ck), float(dense), rtol=1e-5)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-4),
+            ck_grads, dense_grads)
+
+    @pytest.mark.slow
+    def test_chunked_masked_loss_matches(self):
+        from petastorm_tpu.models.transformer import (
+            transformer_masked_loss,
+        )
+        config, params, dataclasses = self._setup()
+        chunked_cfg = dataclasses.replace(config, loss_chunk=4)
+        tokens = jnp.asarray(
+            np.random.RandomState(1).randint(0, 32, (4, 9), np.int32))
+        lengths = jnp.asarray([3, 9, 6, 1], jnp.int32)
+        dense = float(transformer_masked_loss(params, tokens, lengths,
+                                              config))
+        ck = float(transformer_masked_loss(params, tokens, lengths,
+                                           chunked_cfg))
+        np.testing.assert_allclose(ck, dense, rtol=1e-5)
+
+    @pytest.mark.slow
+    def test_pipelined_step_honors_loss_chunk(self):
+        # same weights, pipelined train step with and without loss_chunk:
+        # identical loss and updated params (the chunked path is exact)
+        import dataclasses
+
+        from petastorm_tpu.models.transformer import (
+            TransformerConfig, init_pipelined_transformer_params,
+            pipelined_transformer_train_step,
+        )
+        from petastorm_tpu.parallel.mesh import make_named_mesh
+        mesh = make_named_mesh({'pipe': 2}, devices=jax.devices()[:2])
+        config = TransformerConfig(vocab_size=32, d_model=16, n_heads=2,
+                                   n_layers=2, d_ff=32, max_seq_len=9,
+                                   dtype=jnp.float32)
+        chunked_cfg = dataclasses.replace(config, loss_chunk=3)
+        tokens = jnp.asarray(
+            np.random.RandomState(3).randint(0, 32, (4, 9), np.int32))
+        results = []
+        for cfg in (config, chunked_cfg):
+            with mesh:
+                params = init_pipelined_transformer_params(
+                    jax.random.PRNGKey(0), cfg, mesh)
+                opt = optax.adamw(1e-3)
+                step = pipelined_transformer_train_step(
+                    cfg, opt, mesh, n_microbatches=2)
+                p2, _, loss = step(params, opt.init(params), tokens)
+            results.append((float(loss), np.asarray(p2['lm_head'])))
+        np.testing.assert_allclose(results[1][0], results[0][0], rtol=1e-5)
+        np.testing.assert_allclose(results[1][1], results[0][1],
+                                   atol=1e-5, rtol=1e-4)
+
+    @pytest.mark.slow
+    def test_chunked_moe_loss_matches(self):
+        from petastorm_tpu.models.transformer import transformer_loss
+        config, params, dataclasses = self._setup(n_experts=4,
+                                                  capacity_factor=8.0)
+        chunked_cfg = dataclasses.replace(config, loss_chunk=4)
+        tokens = jnp.asarray(
+            np.random.RandomState(2).randint(0, 32, (4, 9), np.int32))
+        dense = float(transformer_loss(params, tokens, config))
+        ck = float(transformer_loss(params, tokens, chunked_cfg))
+        np.testing.assert_allclose(ck, dense, rtol=1e-5)
+
+
 class TestMaskedLoss:
     def _setup(self, seq=8):
         from petastorm_tpu.models.transformer import (
