@@ -80,10 +80,46 @@ def greedy_generate(params, prompt, config, max_new_tokens):
     config. The whole decode is ONE jittable function: prefill + a
     ``lax.scan`` of single-token steps over the static KV cache.
     """
+    return _generate(params, prompt, config, max_new_tokens, rng=None)
+
+
+def sample_generate(params, prompt, config, max_new_tokens, rng,
+                    temperature=1.0, top_k=0):
+    """Stochastic decode: categorical sampling at ``temperature``,
+    optionally restricted to the ``top_k`` highest logits (0 = full
+    vocab). Same static-cache scan as :func:`greedy_generate`;
+    ``temperature`` → 0 recovers greedy (use :func:`greedy_generate`
+    directly for that — it skips the RNG plumbing)."""
+    if temperature <= 0:
+        raise ValueError('temperature must be > 0; for deterministic '
+                         'decoding use greedy_generate')
+    return _generate(params, prompt, config, max_new_tokens, rng=rng,
+                     temperature=temperature, top_k=top_k)
+
+
+def _select(logits, rng, temperature, top_k):
+    """One next-token choice from (B, V) logits."""
+    if rng is None:
+        return jnp.argmax(logits, axis=-1)
+    if top_k > 0:
+        k = min(top_k, logits.shape[-1])  # top_k >= V = full-vocab
+        if k < logits.shape[-1]:
+            # O(V log k) threshold, not a full sort of the logits on the
+            # per-token hot path
+            kth = lax.top_k(logits, k)[0][:, -1][:, None]
+            logits = jnp.where(logits >= kth, logits, -jnp.inf)
+    return jax.random.categorical(rng, logits / temperature, axis=-1)
+
+
+def _generate(params, prompt, config, max_new_tokens, rng,
+              temperature=1.0, top_k=0):
     c = config
     if c.n_experts > 0 or c.seq_axis is not None:
-        raise NotImplementedError('greedy_generate supports dense, '
-                                  'unsharded-sequence configs')
+        raise NotImplementedError('greedy_generate/sample_generate support '
+                                  'dense, unsharded-sequence configs')
+    if max_new_tokens < 1:
+        raise ValueError('max_new_tokens must be >= 1; got %d'
+                         % max_new_tokens)
     b, p = prompt.shape
     total = p + max_new_tokens
     if total > c.max_seq_len:
@@ -110,13 +146,17 @@ def greedy_generate(params, prompt, config, max_new_tokens):
         v_cache = v_cache.at[i, :, :p].set(v)
         x = x + _attend(q, k, v, causal, block['attn_out'], c)
         x = _block_dense_ffn_half(block, x, c)
-    next_token = jnp.argmax(_head_logits(params, x[:, -1], c),
-                            axis=-1).astype(prompt.dtype)
+    if rng is not None:
+        rng, first_rng = jax.random.split(rng)
+    else:
+        first_rng = None
+    next_token = _select(_head_logits(params, x[:, -1], c), first_rng,
+                         temperature, top_k).astype(prompt.dtype)
 
     # -- decode: one scan step per new token (max_new_tokens - 1 steps:
     # the prefill already decided token 1, and emitting the FRESH token
     # each step avoids a final forward whose output would be discarded)
-    def step(carry, _):
+    def step(carry, step_rng):
         k_cache, v_cache, token, pos = carry
         x = (params['embed'][token].astype(c.dtype)
              + lax.dynamic_index_in_dim(
@@ -134,11 +174,15 @@ def greedy_generate(params, prompt, config, max_new_tokens):
                             block['attn_out'], c)
             x = _block_dense_ffn_half(block, x, c)
         logits = _head_logits(params, x[:, 0], c)
-        new_token = jnp.argmax(logits, axis=-1).astype(token.dtype)
+        new_token = _select(logits, step_rng, temperature,
+                            top_k).astype(token.dtype)
         return (k_cache, v_cache, new_token, pos + 1), new_token
 
+    step_rngs = (None if rng is None
+                 else jax.random.split(rng, max(max_new_tokens - 1, 1))
+                 [:max_new_tokens - 1])
     _, later = lax.scan(
-        step, (k_cache, v_cache, next_token, jnp.int32(p)), None,
+        step, (k_cache, v_cache, next_token, jnp.int32(p)), step_rngs,
         length=max_new_tokens - 1)
     generated = jnp.concatenate(
         [next_token[:, None], jnp.moveaxis(later, 0, 1)], axis=1)

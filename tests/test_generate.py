@@ -71,6 +71,64 @@ def test_bf16_decode_runs():
     assert ((got >= 0) & (got < 32)).all()
 
 
+def test_top_k_one_equals_greedy():
+    from petastorm_tpu.models.generate import sample_generate
+    config, params = _setup()
+    prompt = jnp.asarray(
+        np.random.RandomState(4).randint(0, 32, (2, 4), np.int32))
+    greedy = greedy_generate(params, prompt, config, max_new_tokens=6)
+    sampled = sample_generate(params, prompt, config, max_new_tokens=6,
+                              rng=jax.random.PRNGKey(0), temperature=0.5,
+                              top_k=1)
+    np.testing.assert_array_equal(np.asarray(sampled), np.asarray(greedy))
+
+
+def test_sampling_is_seeded_and_in_vocab():
+    from petastorm_tpu.models.generate import sample_generate
+    config, params = _setup()
+    prompt = jnp.asarray(
+        np.random.RandomState(5).randint(0, 32, (2, 4), np.int32))
+    a = sample_generate(params, prompt, config, max_new_tokens=8,
+                        rng=jax.random.PRNGKey(1), temperature=1.5)
+    b = sample_generate(params, prompt, config, max_new_tokens=8,
+                        rng=jax.random.PRNGKey(1), temperature=1.5)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    got = np.asarray(a)
+    assert got.shape == (2, 12)
+    assert ((got >= 0) & (got < 32)).all()
+    other = sample_generate(params, prompt, config, max_new_tokens=8,
+                            rng=jax.random.PRNGKey(2), temperature=1.5)
+    assert not np.array_equal(np.asarray(other), got), \
+        'different seeds should (overwhelmingly) differ at T=1.5'
+
+
+def test_top_k_beyond_vocab_is_full_vocab():
+    from petastorm_tpu.models.generate import sample_generate
+    config, params = _setup()
+    prompt = jnp.asarray(
+        np.random.RandomState(6).randint(0, 32, (1, 4), np.int32))
+    full = sample_generate(params, prompt, config, max_new_tokens=4,
+                           rng=jax.random.PRNGKey(0), temperature=1.0)
+    clamped = sample_generate(params, prompt, config, max_new_tokens=4,
+                              rng=jax.random.PRNGKey(0), temperature=1.0,
+                              top_k=1000)
+    np.testing.assert_array_equal(np.asarray(clamped), np.asarray(full))
+
+
+def test_zero_new_tokens_rejected():
+    config, params = _setup()
+    with pytest.raises(ValueError, match='max_new_tokens'):
+        greedy_generate(params, jnp.zeros((1, 4), jnp.int32), config, 0)
+
+
+def test_zero_temperature_rejected():
+    from petastorm_tpu.models.generate import sample_generate
+    config, params = _setup()
+    with pytest.raises(ValueError, match='temperature'):
+        sample_generate(params, jnp.zeros((1, 4), jnp.int32), config, 2,
+                        rng=jax.random.PRNGKey(0), temperature=0.0)
+
+
 def test_overflow_rejected():
     config, params = _setup(max_seq_len=8)
     prompt = jnp.zeros((1, 5), jnp.int32)
