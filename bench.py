@@ -533,6 +533,77 @@ print(json.dumps(result))
 '''
 
 
+_LM_DECODE_SNIPPET = r'''
+import json, os, sys, time
+sys.path.insert(0, %(repo)r)
+import numpy as np
+if os.environ.get('BENCH_JAX_PLATFORM'):
+    import jax
+    jax.config.update('jax_platforms', os.environ['BENCH_JAX_PLATFORM'])
+import jax
+import jax.numpy as jnp
+from petastorm_tpu.models.transformer import (
+    TransformerConfig, init_transformer_params,
+)
+from petastorm_tpu.models.generate import greedy_generate
+
+# inference throughput on the SAME model family as lm_train: KV-cache
+# greedy decode, one jitted prefill+scan; tokens/sec = new tokens over
+# wall time after a D2H value fence
+on_cpu = jax.default_backend() == 'cpu'
+if on_cpu:
+    kw = dict(vocab_size=256, d_model=128, n_heads=4, n_layers=4,
+              d_ff=512, max_seq_len=160)
+    batch, prompt_len, n_lo, n_hi = 4, 16, 8, 32
+else:
+    kw = dict(vocab_size=16384, d_model=1024, n_heads=16, n_layers=12,
+              d_ff=4096, max_seq_len=1024)
+    batch, prompt_len, n_lo, n_hi = 8, 128, 64, 256
+config = TransformerConfig(**kw)
+params = init_transformer_params(jax.random.PRNGKey(0), config)
+prompt = jnp.asarray(np.random.RandomState(0).randint(
+    0, kw['vocab_size'], (batch, prompt_len), np.int32))
+
+# two decode lengths, rate from the delta: one call's time includes the
+# prefill + dispatch/compile-cache costs, and (t_hi - t_lo) cancels them
+# so the metric is the PURE per-token decode rate. Median of 3 per
+# length: single runs on this box swing about ten percent (same policy
+# as the imagenet/tfdata metrics).
+import statistics
+runs = {n: jax.jit(lambda p, t, n=n: greedy_generate(p, t, config, n))
+        for n in (n_lo, n_hi)}
+
+
+def timed(n):
+    int(runs[n](params, prompt)[0, -1])  # compile + warm
+    samples = []
+    for _ in range(3):
+        start = time.monotonic()
+        int(runs[n](params, prompt)[0, -1])  # D2H fence
+        samples.append(time.monotonic() - start)
+    return statistics.median(samples)
+
+t_lo, t_hi = timed(n_lo), timed(n_hi)
+if t_hi <= t_lo:
+    print(json.dumps({"error": "non-positive decode timing delta"}))
+    sys.exit(0)
+rate = batch * (n_hi - n_lo) / (t_hi - t_lo)
+print(json.dumps({
+    "decode_tokens_per_sec": rate,
+    "per_stream_tokens_per_sec": rate / batch,
+    "batch": batch, "new_tokens": n_hi,
+    "device_kind": jax.devices()[0].device_kind,
+}))
+'''
+
+
+def _measure_lm_decode(timeout=600):
+    """KV-cache inference throughput on the flagship model family."""
+    code = _LM_DECODE_SNIPPET % {
+        'repo': os.path.dirname(os.path.abspath(__file__))}
+    return _run_json_subprocess([sys.executable, '-c', code], timeout)
+
+
 _PP_BF16_SNIPPET = r'''
 import json, os, sys
 sys.path.insert(0, %(repo)r)
@@ -680,6 +751,9 @@ def main():
         # end-to-end TRAINING throughput on the default device: Parquet →
         # packed batches → H2D → real transformer optimizer steps
         jax_metrics('lm_train', c4_url, fn=_measure_lm_train)
+
+        # inference: KV-cache greedy decode rate on the same model family
+        jax_metrics('lm_decode', fn=_measure_lm_decode)
 
         # bf16 pipelined train step smoke — meaningful on the real chip
         # (the 1-stage shape happens to compile on current XLA:CPU too,

@@ -73,18 +73,21 @@ def _head_logits(params, x_last, config):
                       preferred_element_type=jnp.float32)
 
 
-def greedy_generate(params, prompt, config, max_new_tokens):
+def greedy_generate(params, prompt, config, max_new_tokens, eos_token=None):
     """Greedy decode: prompt (B, P) int32 → (B, P + max_new_tokens).
 
     Requires ``P + max_new_tokens <= config.max_seq_len`` and a dense
     config. The whole decode is ONE jittable function: prefill + a
     ``lax.scan`` of single-token steps over the static KV cache.
-    """
-    return _generate(params, prompt, config, max_new_tokens, rng=None)
+    ``eos_token``: rows that emit it keep emitting it (finished rows
+    freeze — the scan's shape stays static, the standard XLA pattern for
+    early stop)."""
+    return _generate(params, prompt, config, max_new_tokens, rng=None,
+                     eos_token=eos_token)
 
 
 def sample_generate(params, prompt, config, max_new_tokens, rng,
-                    temperature=1.0, top_k=0):
+                    temperature=1.0, top_k=0, eos_token=None):
     """Stochastic decode: categorical sampling at ``temperature``,
     optionally restricted to the ``top_k`` highest logits (0 = full
     vocab). Same static-cache scan as :func:`greedy_generate`;
@@ -94,7 +97,8 @@ def sample_generate(params, prompt, config, max_new_tokens, rng,
         raise ValueError('temperature must be > 0; for deterministic '
                          'decoding use greedy_generate')
     return _generate(params, prompt, config, max_new_tokens, rng=rng,
-                     temperature=temperature, top_k=top_k)
+                     temperature=temperature, top_k=top_k,
+                     eos_token=eos_token)
 
 
 def _select(logits, rng, temperature, top_k):
@@ -112,7 +116,7 @@ def _select(logits, rng, temperature, top_k):
 
 
 def _generate(params, prompt, config, max_new_tokens, rng,
-              temperature=1.0, top_k=0):
+              temperature=1.0, top_k=0, eos_token=None):
     c = config
     if c.n_experts > 0 or c.seq_axis is not None:
         raise NotImplementedError('greedy_generate/sample_generate support '
@@ -156,8 +160,11 @@ def _generate(params, prompt, config, max_new_tokens, rng,
     # -- decode: one scan step per new token (max_new_tokens - 1 steps:
     # the prefill already decided token 1, and emitting the FRESH token
     # each step avoids a final forward whose output would be discarded)
+    done0 = (jnp.zeros((b,), bool) if eos_token is None
+             else next_token == eos_token)
+
     def step(carry, step_rng):
-        k_cache, v_cache, token, pos = carry
+        k_cache, v_cache, token, pos, done = carry
         x = (params['embed'][token].astype(c.dtype)
              + lax.dynamic_index_in_dim(
                  params['pos_embed'], pos, keepdims=False).astype(c.dtype))
@@ -176,14 +183,20 @@ def _generate(params, prompt, config, max_new_tokens, rng,
         logits = _head_logits(params, x[:, 0], c)
         new_token = _select(logits, step_rng, temperature,
                             top_k).astype(token.dtype)
-        return (k_cache, v_cache, new_token, pos + 1), new_token
+        if eos_token is not None:
+            # finished rows keep emitting EOS; static shapes throughout
+            new_token = jnp.where(done, jnp.asarray(eos_token,
+                                                    token.dtype),
+                                  new_token)
+            done = done | (new_token == eos_token)
+        return (k_cache, v_cache, new_token, pos + 1, done), new_token
 
     step_rngs = (None if rng is None
                  else jax.random.split(rng, max(max_new_tokens - 1, 1))
                  [:max_new_tokens - 1])
     _, later = lax.scan(
-        step, (k_cache, v_cache, next_token, jnp.int32(p)), step_rngs,
-        length=max_new_tokens - 1)
+        step, (k_cache, v_cache, next_token, jnp.int32(p), done0),
+        step_rngs, length=max_new_tokens - 1)
     generated = jnp.concatenate(
         [next_token[:, None], jnp.moveaxis(later, 0, 1)], axis=1)
     return jnp.concatenate([prompt, generated], axis=1)

@@ -115,6 +115,31 @@ def test_top_k_beyond_vocab_is_full_vocab():
     np.testing.assert_array_equal(np.asarray(clamped), np.asarray(full))
 
 
+def test_eos_freezes_finished_rows():
+    # pick the token the model would greedily emit at step k as "EOS":
+    # from then on that row must emit only EOS, while other rows continue
+    config, params = _setup()
+    prompt = jnp.asarray(
+        np.random.RandomState(7).randint(0, 32, (2, 5), np.int32))
+    free = np.asarray(greedy_generate(params, prompt, config,
+                                      max_new_tokens=8))
+    # an "EOS" row 0 emits but row 1 never does — lets the test pin both
+    # the freeze AND per-row independence
+    eos = next(int(t) for t in free[0, 5:]
+               if t not in free[1, 5:].tolist())
+    stopped = np.asarray(greedy_generate(params, prompt, config,
+                                         max_new_tokens=8, eos_token=eos))
+    row = stopped[0, 5:]
+    hit = int(np.argmax(row == eos))
+    assert row[hit] == eos
+    assert (row[hit:] == eos).all(), 'row must freeze at EOS'
+    # prefix before EOS matches the unconstrained decode
+    np.testing.assert_array_equal(row[:hit], free[0, 5:5 + hit])
+    # per-row independence: a bug collapsing the done mask across the
+    # batch would freeze row 1 too
+    np.testing.assert_array_equal(stopped[1], free[1])
+
+
 def test_zero_new_tokens_rejected():
     config, params = _setup()
     with pytest.raises(ValueError, match='max_new_tokens'):
