@@ -41,9 +41,11 @@ def _train_transform(size, n_classes):
 
 
 def train_vit(dataset_url, batch_size=8, steps=8, size=64, patch_size=16,
-              n_classes=16, learning_rate=1e-3, log=print):
+              n_classes=16, learning_rate=1e-3, augment=True, log=print):
     """Train a small ViT over the imagenet-style dataset; returns the
-    final loss."""
+    final loss. ``augment`` applies per-step ON-DEVICE random flips +
+    cutout (``petastorm_tpu.ops.augment``) — elementwise work that fuses
+    into the step while the host stays free for decode."""
     import jax
     import optax
 
@@ -51,7 +53,9 @@ def train_vit(dataset_url, batch_size=8, steps=8, size=64, patch_size=16,
     from petastorm_tpu.models.vit import (
         ViTConfig, init_vit_params, vit_train_step,
     )
-    from petastorm_tpu.ops import normalize_images
+    from petastorm_tpu.ops import (
+        normalize_images, random_cutout, random_flip_horizontal,
+    )
 
     config = ViTConfig(image_size=size, patch_size=patch_size,
                        n_classes=n_classes, d_model=64, n_heads=4,
@@ -67,10 +71,22 @@ def train_vit(dataset_url, batch_size=8, steps=8, size=64, patch_size=16,
                          last_batch='drop', num_epochs=None,
                          shuffle_row_groups=True) as loader:
         it = iter(loader)
+        aug_key = jax.random.PRNGKey(1)
+
+        @jax.jit
+        def prepare(key, images):
+            # ONE jitted dispatch for the whole augment+normalize input
+            # pipeline — the ops fuse, intermediates never round-trip HBM
+            if augment:
+                images = random_flip_horizontal(key, images)
+                images = random_cutout(jax.random.fold_in(key, 1), images,
+                                       size // 8)
+            return normalize_images(images, mean=IMAGENET_MEAN,
+                                    std=IMAGENET_STD)
+
         for i in range(steps):
             batch = next(it)
-            images = normalize_images(batch['image'], mean=IMAGENET_MEAN,
-                                      std=IMAGENET_STD)
+            images = prepare(jax.random.fold_in(aug_key, i), batch['image'])
             params, opt_state, loss = step(params, opt_state, images,
                                            batch['label'])
             if i % 4 == 0 or i == steps - 1:

@@ -1,4 +1,7 @@
 """TPU kernels (Pallas) and collective ops for the hot paths."""
 
+from petastorm_tpu.ops.augment import (  # noqa: F401
+    random_crop, random_cutout, random_flip_horizontal,
+)
 from petastorm_tpu.ops.normalize import normalize_images  # noqa: F401
 from petastorm_tpu.ops.ring_attention import ring_attention  # noqa: F401

@@ -1,0 +1,63 @@
+"""On-device image augmentation: flips, crops, cutout — jit/vmap native.
+
+The reference's augmentation story is "run it in the TransformSpec on the
+decode workers" (host CPU, per-row Python). On TPU the better split is:
+workers decode + resize to a FIXED shape (static shapes for XLA), and the
+cheap elementwise/gather augmentations run ON DEVICE inside the jitted
+step — they fuse into the input pipeline of the model and cost ~nothing
+next to the first conv/matmul, while the host stays free for decode.
+
+All ops take an explicit ``jax.random`` key (functional, reproducible,
+per-step keys via ``jax.random.fold_in``) and NHWC uint8/float batches.
+Randomness is PER IMAGE (a ``vmap`` over the batch), not per batch.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def random_flip_horizontal(key, images, p=0.5):
+    """Flip each image left-right with probability ``p`` (per image)."""
+    flags = jax.random.bernoulli(key, p, (images.shape[0],))
+    return jnp.where(flags[:, None, None, None], images[:, :, ::-1], images)
+
+
+def random_crop(key, images, crop_h, crop_w):
+    """Crop a random (crop_h, crop_w) window per image (uniform offsets).
+
+    (B, H, W, C) → (B, crop_h, crop_w, C); requires crop ≤ image dims.
+    ``lax.dynamic_slice`` under ``vmap`` — one gather per image, static
+    output shape.
+    """
+    b, h, w, c = images.shape
+    if crop_h > h or crop_w > w:
+        raise ValueError('crop (%d, %d) exceeds image (%d, %d)'
+                         % (crop_h, crop_w, h, w))
+    ky, kx = jax.random.split(key)
+    ys = jax.random.randint(ky, (b,), 0, h - crop_h + 1)
+    xs = jax.random.randint(kx, (b,), 0, w - crop_w + 1)
+
+    def crop_one(image, y, x):
+        return lax.dynamic_slice(image, (y, x, 0), (crop_h, crop_w, c))
+
+    return jax.vmap(crop_one)(images, ys, xs)
+
+
+def random_cutout(key, images, size, fill=0):
+    """Zero (or ``fill``) a random ``size``×``size`` square per image —
+    the standard cutout regularizer, as a mask (no scatter: a boolean
+    window test against per-image offsets, fused elementwise)."""
+    b, h, w, _ = images.shape
+    if size > h or size > w:
+        raise ValueError('cutout size %d exceeds image (%d, %d)'
+                         % (size, h, w))
+    ky, kx = jax.random.split(key)
+    ys = jax.random.randint(ky, (b,), 0, h - size + 1)
+    xs = jax.random.randint(kx, (b,), 0, w - size + 1)
+    rows = jnp.arange(h)[None, :, None]            # (1, H, 1)
+    cols = jnp.arange(w)[None, None, :]            # (1, 1, W)
+    inside = ((rows >= ys[:, None, None]) & (rows < ys[:, None, None] + size)
+              & (cols >= xs[:, None, None]) & (cols < xs[:, None, None] + size))
+    return jnp.where(inside[..., None], jnp.asarray(fill, images.dtype),
+                     images)

@@ -75,6 +75,22 @@ class WeightedSamplingReader:
     def next(self):
         return self.__next__()
 
+    def reset(self):
+        """Restart the mix for another pass (the consumer contract
+        :class:`~petastorm_tpu.jax.JaxLoader` re-iteration relies on — it
+        calls ``reader.reset()`` when a fully consumed loader is iterated
+        again).
+
+        A probabilistic mix ends when ANY source runs dry
+        (:attr:`last_row_consumed`), which necessarily leaves the other
+        sources mid-stream. Reset therefore restarts the DRY sources and
+        lets the mid-stream ones continue from where they were — sound
+        for a mix, whose per-pass row coverage is probabilistic by
+        construction (there is no epoch alignment to restore)."""
+        for r in self._readers:
+            if getattr(r, 'last_row_consumed', False):
+                r.reset()
+
     def stop(self):
         for r in self._readers:
             r.stop()

@@ -115,6 +115,77 @@ class TestTransformer:
             PartitionSpec(None, 'model')
 
 
+class TestAugmentOps:
+    def _images(self, b=4, h=8, w=10, c=3):
+        rng = np.random.RandomState(0)
+        return jnp.asarray(rng.randint(0, 255, (b, h, w, c), np.uint8))
+
+    def test_flip_is_per_image_and_exact(self):
+        from petastorm_tpu.ops import random_flip_horizontal
+        images = self._images()
+        out = np.asarray(random_flip_horizontal(jax.random.PRNGKey(0),
+                                                images, p=0.5))
+        src = np.asarray(images)
+        flipped = rigid = 0
+        for i in range(4):
+            if np.array_equal(out[i], src[i, :, ::-1]):
+                flipped += 1
+            elif np.array_equal(out[i], src[i]):
+                rigid += 1
+        assert flipped + rigid == 4, 'each image either flips or not'
+        # p=1 flips everything; p=0 nothing
+        all_f = np.asarray(random_flip_horizontal(jax.random.PRNGKey(1),
+                                                  images, p=1.0))
+        np.testing.assert_array_equal(all_f, src[:, :, ::-1])
+        none = np.asarray(random_flip_horizontal(jax.random.PRNGKey(1),
+                                                 images, p=0.0))
+        np.testing.assert_array_equal(none, src)
+
+    def test_crop_windows_match_source(self):
+        from petastorm_tpu.ops import random_crop
+        images = self._images()
+        out = np.asarray(random_crop(jax.random.PRNGKey(0), images, 5, 6))
+        assert out.shape == (4, 5, 6, 3)
+        src = np.asarray(images)
+        for i in range(4):
+            # the crop must appear somewhere in the source image
+            found = any(
+                np.array_equal(out[i], src[i, y:y + 5, x:x + 6])
+                for y in range(4) for x in range(5))
+            assert found, 'crop %d is not a window of its source' % i
+
+    def test_crop_too_large_rejected(self):
+        from petastorm_tpu.ops import random_crop
+        with pytest.raises(ValueError, match='exceeds'):
+            random_crop(jax.random.PRNGKey(0), self._images(), 9, 6)
+
+    def test_cutout_zeroes_one_square(self):
+        from petastorm_tpu.ops import random_cutout
+        images = jnp.ones((3, 8, 8, 3), jnp.uint8) * 7
+        out = np.asarray(random_cutout(jax.random.PRNGKey(0), images, 4))
+        for i in range(3):
+            zeros = (out[i] == 0).all(axis=-1)
+            assert zeros.sum() == 16, 'exactly one 4x4 square'
+            ys, xs = np.where(zeros)
+            assert ys.max() - ys.min() == 3 and xs.max() - xs.min() == 3
+
+    def test_jit_and_determinism(self):
+        from petastorm_tpu.ops import (
+            random_crop, random_cutout, random_flip_horizontal,
+        )
+        images = self._images()
+        key = jax.random.PRNGKey(9)
+
+        def pipeline(k, im):
+            im = random_flip_horizontal(k, im)
+            im = random_crop(jax.random.fold_in(k, 1), im, 6, 6)
+            return random_cutout(jax.random.fold_in(k, 2), im, 2)
+
+        eager = np.asarray(pipeline(key, images))
+        jitted = np.asarray(jax.jit(pipeline)(key, images))
+        np.testing.assert_array_equal(eager, jitted)
+
+
 class TestViT:
     def _config(self, **kw):
         from petastorm_tpu.models.vit import ViTConfig
