@@ -224,6 +224,12 @@ class JaxLoader:
         # staging gauges (see diagnostics): who is waiting on whom?
         self._consumer_wait_s = 0.0   # consumer blocked on get → input-bound
         self._stage_blocked_s = 0.0   # producer blocked on put → compute-bound
+        # steady-state baseline for autotune_report: the wait clocks up to
+        # (and including) each pass's FIRST delivered batch are spin-up
+        # (reader/decoder startup), not contention — snapshotting them out
+        # keeps the attribution honest
+        self._wait_baseline = (0.0, 0.0)
+        self._awaiting_first_delivery = True
         self._batches_delivered = 0
 
     # -- sharding ------------------------------------------------------------
@@ -333,6 +339,10 @@ class JaxLoader:
             self._reader.reset()
             self._exhausted = False
             self._epoch += 1
+            # each pass's spin-up wait is excluded from autotune's
+            # steady-state attribution (baseline re-snapshots at the new
+            # pass's first delivery)
+            self._awaiting_first_delivery = True
             # reset() restarts the reader's epoch numbering from 0; stale
             # provenance would corrupt the delivery-accurate checkpoint
             with self._prov_lock:
@@ -395,6 +405,10 @@ class JaxLoader:
             if pull_counts:
                 self._record_delivery(pull_counts)
             self._batches_delivered += 1
+            if self._awaiting_first_delivery:
+                self._wait_baseline = (self._consumer_wait_s,
+                                       self._stage_blocked_s)
+                self._awaiting_first_delivery = False
             return batch
 
     def _record_delivery(self, pull_counts):
@@ -863,6 +877,60 @@ class JaxLoader:
             'pulls_in_flight': len(self._pull_info),
         })
         return diag
+
+    def autotune_report(self):
+        """Bottleneck attribution + concrete tuning advice, tf.data-style
+        (its AUTOTUNE observes the same signals: who waits on whom).
+
+        Built from the two wait clocks :attr:`diagnostics` already
+        tracks: consumer time blocked on the prefetch queue (input-bound)
+        vs stage time blocked pushing into it (compute-bound), measured
+        FROM each pass's first delivered batch — the spin-up wait
+        (reader/decoder startup) is pipeline latency, not contention, and
+        counting it would misattribute compute-bound pipelines as
+        input-bound. Returns
+        ``{'bottleneck': 'input'|'compute'|'balanced'|'undetermined',
+        'input_stall_fraction': float, 'advice': [str, ...], ...}`` —
+        advisory only; nothing is changed."""
+        base_consumer, base_stage = self._wait_baseline
+        consumer = max(self._consumer_wait_s - base_consumer, 0.0)
+        stage = max(self._stage_blocked_s - base_stage, 0.0)
+        total = consumer + stage
+        report = {
+            'consumer_wait_s': round(consumer, 3),
+            'stage_backpressure_s': round(stage, 3),
+            'batches_delivered': self._batches_delivered,
+        }
+        if self._batches_delivered < 4 or total < 0.05:
+            report['bottleneck'] = 'undetermined'
+            report['input_stall_fraction'] = 0.0
+            report['advice'] = ['not enough iteration observed yet; '
+                                'consume more batches before tuning']
+            return report
+        frac = consumer / total
+        report['input_stall_fraction'] = round(frac, 3)
+        if frac > 0.66:
+            report['bottleneck'] = 'input'
+            report['advice'] = [
+                'the consumer waits on data %.0f%% of contended time: add '
+                'decode workers (workers_count), raise prefetch, move '
+                'heavy TransformSpec work off the row path, or switch '
+                "GIL-heavy transforms to reader_pool_type='process'"
+                % (frac * 100),
+            ]
+        elif frac < 0.33:
+            report['bottleneck'] = 'compute'
+            report['advice'] = [
+                'the training step is the bottleneck (staging blocked '
+                '%.0f%% of contended time): the input pipeline is NOT the '
+                'problem; keep prefetch small to save host RAM'
+                % ((1 - frac) * 100),
+            ]
+        else:
+            report['bottleneck'] = 'balanced'
+            report['advice'] = ['producer and consumer are balanced; '
+                                'tune the model step first']
+        return report
 
     def state_dict(self):
         """Row-group-granular, at-least-once checkpoint of the DATA

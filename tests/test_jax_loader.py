@@ -471,6 +471,45 @@ def test_bad_divisibility_rejected(scalar_dataset):
                         fields=['^id$'])
 
 
+def test_autotune_report_attributes_bottleneck(scalar_dataset):
+    import time as _time
+    with make_jax_loader(scalar_dataset.url, batch_size=8, fields=['^id$'],
+                         num_epochs=None, prefetch=1) as loader:
+        it = iter(loader)
+        early = loader.autotune_report()
+        assert early['bottleneck'] == 'undetermined'
+        # slow consumer: the stage blocks pushing into the full queue
+        for _ in range(8):
+            next(it)
+            _time.sleep(0.05)
+        report = loader.autotune_report()
+    assert report['bottleneck'] in ('compute', 'balanced', 'undetermined')
+    assert 0.0 <= report['input_stall_fraction'] <= 1.0
+    assert report['advice'] and all(isinstance(a, str)
+                                    for a in report['advice'])
+
+
+def test_autotune_report_input_bound(synthetic_dataset):
+    from petastorm_tpu.transform import TransformSpec
+    import time as _time
+
+    def slow(frame):
+        _time.sleep(0.05)
+        return frame
+
+    with make_jax_loader(synthetic_dataset.url, batch_size=8,
+                         fields=['^id$'], num_epochs=None,
+                         transform_spec=TransformSpec(slow),
+                         workers_count=1, prefetch=1) as loader:
+        it = iter(loader)
+        for _ in range(8):
+            next(it)  # consume as fast as possible: consumer waits
+        report = loader.autotune_report()
+    assert report['bottleneck'] in ('input', 'balanced')
+    if report['bottleneck'] == 'input':
+        assert 'decode workers' in report['advice'][0]
+
+
 def test_staging_diagnostics(scalar_dataset):
     with make_jax_loader(scalar_dataset.url, batch_size=10, fields=['^id$'],
                          last_batch='short') as loader:

@@ -344,3 +344,27 @@ def test_diagnostics_exposed():
     assert d['items_ventilated'] == 1
     pool.stop()
     pool.join()
+
+
+def test_process_pool_detects_sigkilled_worker():
+    # failure detection (SURVEY §5.3): a worker hard-killed mid-stream
+    # (OOM-killer shape) must surface as a RuntimeError in get_results,
+    # never a silent hang waiting for results that will not come
+    import os
+    import signal
+
+    pool = ProcessPool(2)
+    pool.start(SleepyIdentityWorker)
+    try:
+        for i in range(50):
+            pool.ventilate(i)
+        os.kill(pool._processes[0].pid, signal.SIGKILL)
+        # the killed worker's in-flight items can never complete, so a
+        # drain must end in the dead-worker RuntimeError — anything else
+        # (EmptyResultError, timeout) would mean the death went unnoticed
+        with pytest.raises(RuntimeError, match='died unexpectedly'):
+            for _ in range(60):
+                pool.get_results(timeout=30)
+    finally:
+        pool.stop()
+        pool.join()
