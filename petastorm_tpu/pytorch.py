@@ -20,9 +20,11 @@ from petastorm_tpu.buffers import (
     NoopShufflingBuffer, RandomShufflingBuffer,
 )
 
-_STRING_MESSAGE = (
-    'Field %r is a string/decimal and has no torch representation; project '
-    'it away (schema_fields/TransformSpec) or convert it in a TransformSpec')
+from petastorm_tpu.ragged import (
+    RAGGED_MESSAGE as _RAGGED_MESSAGE,
+    STRING_MESSAGE as _STRING_MESSAGE,
+    reject_object_column as _reject_object_column,
+)
 
 # numpy dtypes torch cannot hold → nearest widening torch-compatible dtype
 # (reference: ``pytorch.py:41-71``).
@@ -62,8 +64,17 @@ def decimal_friendly_collate(batch):
     if isinstance(batch[0], decimal.Decimal):
         return list(batch)
     if isinstance(batch[0], collections.abc.Mapping):
-        return {key: decimal_friendly_collate([d[key] for d in batch])
-                for key in batch[0]}
+        out = {}
+        for key in batch[0]:
+            values = [d[key] for d in batch]
+            if (isinstance(values[0], np.ndarray)
+                    and len({v.shape for v in values
+                             if isinstance(v, np.ndarray)}) > 1):
+                # pre-empt default_collate's opaque 'stack expects each
+                # tensor to be equal size' with the field name + remedies
+                raise TypeError(_RAGGED_MESSAGE % key)
+            out[key] = decimal_friendly_collate(values)
+        return out
     if isinstance(batch[0], tuple) and hasattr(batch[0], '_fields'):
         return type(batch[0])(*(decimal_friendly_collate(samples)
                                 for samples in zip(*batch)))
@@ -243,7 +254,9 @@ class BatchedDataLoader(LoaderBase):
             for name, arr in columns.items():
                 if isinstance(arr, np.ndarray) and arr.dtype in _TORCH_PROMOTIONS:
                     columns[name] = arr.astype(_TORCH_PROMOTIONS[arr.dtype])
-                elif isinstance(arr, np.ndarray) and arr.dtype.kind in 'USO':
+                elif isinstance(arr, np.ndarray) and arr.dtype.kind == 'O':
+                    _reject_object_column(name, arr)
+                elif isinstance(arr, np.ndarray) and arr.dtype.kind in 'US':
                     raise TypeError(_STRING_MESSAGE % name)
             if self._cache is not None:
                 self._cache.append({k: v.copy() for k, v in columns.items()})

@@ -1,0 +1,44 @@
+"""Shared diagnosis of object-dtype columns at the framework-bridge seam.
+
+A decoded column arrives as a 1-d object array in exactly three cases —
+ragged numeric cells (variable-shape fields), string/decimal cells, or
+all-None (nullable) cells — and every dense consumer (torch collation,
+tf.data elements) must reject them with the SAME actionable story. One
+classifier + one message keeps the three bridge call sites from drifting
+into inconsistent diagnoses of identical data.
+"""
+
+import numpy as np
+
+RAGGED_MESSAGE = (
+    'Field %r has variable shape (rows of differing sizes) and cannot be '
+    'collated into one dense tensor; project it away (schema_fields), '
+    'densify it with a TransformSpec, or use '
+    'make_jax_loader(pad_ragged=...) / bucket_boundaries for static-shape '
+    'padded batches')
+STRING_MESSAGE = (
+    'Field %r is a string/decimal and has no dense tensor representation; '
+    'project it away (schema_fields/TransformSpec) or convert it in a '
+    'TransformSpec')
+NULL_MESSAGE = (
+    'Field %r is entirely None in this batch (nullable field); fill or '
+    'filter nulls before dense collation, or project the field away '
+    '(schema_fields)')
+
+
+def classify_object_column(arr):
+    """``'ragged' | 'string' | 'null'`` for a 1-d object column."""
+    first = next((c for c in arr if c is not None), None)
+    if first is None:
+        return 'null'
+    if isinstance(first, (np.ndarray, list, tuple)):
+        return 'ragged'
+    return 'string'
+
+
+def reject_object_column(name, arr):
+    """Raise the classified, actionable ``TypeError`` for ``arr``."""
+    kind = classify_object_column(arr)
+    message = {'ragged': RAGGED_MESSAGE, 'string': STRING_MESSAGE,
+               'null': NULL_MESSAGE}[kind]
+    raise TypeError(message % name)

@@ -85,12 +85,21 @@ def _batch_generator(reader, field_names):
     _guard_not_exhausted(reader)
     for batch in reader:
         columns = batch._asdict()
-        yield tuple(np.asarray([_sanitize_field_tf_types(v)
-                                for v in columns[name]])
-                    if columns[name].dtype == object or
-                    columns[name].dtype.kind == 'M'
-                    else columns[name]
-                    for name in field_names)
+        out = []
+        for name in field_names:
+            col = columns[name]
+            if col.dtype == object or col.dtype.kind == 'M':
+                cells = [_sanitize_field_tf_types(v) for v in col]
+                shapes = {np.shape(c) for c in cells}
+                if len(shapes) > 1:
+                    # pre-empt numpy's opaque 'setting an array element
+                    # with a sequence' (surfacing as an
+                    # InvalidArgumentError mid-iteration inside tf.data)
+                    from petastorm_tpu.ragged import RAGGED_MESSAGE
+                    raise TypeError(RAGGED_MESSAGE % name)
+                col = np.asarray(cells)
+            out.append(col)
+        yield tuple(out)
 
 
 def _field_shape(field, batched):

@@ -28,9 +28,9 @@ class TestSanitize:
         assert row['d'].dtype == np.float32
 
     def test_string_rejected(self):
-        with pytest.raises(TypeError, match='no torch representation'):
+        with pytest.raises(TypeError, match='no dense tensor representation'):
             _sanitize_pytorch_types({'s': 'hello'})
-        with pytest.raises(TypeError, match='no torch representation'):
+        with pytest.raises(TypeError, match='no dense tensor representation'):
             _sanitize_pytorch_types({'s': np.array(['a', 'b'])})
 
     def test_none_rejected(self):
@@ -143,7 +143,7 @@ class TestBatchedDataLoader:
                                    schema_fields=['^id$', '^string$'],
                                    num_epochs=1)
         with BatchedDataLoader(reader, batch_size=10) as loader:
-            with pytest.raises(TypeError, match='no torch representation'):
+            with pytest.raises(TypeError, match='no dense tensor representation'):
                 list(loader)
 
     def test_keep_fields(self, scalar_dataset):

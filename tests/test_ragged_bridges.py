@@ -1,0 +1,49 @@
+"""Actionable errors for variable-shape fields in the torch/tf bridges."""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+from petastorm_tpu.etl.dataset_metadata import write_dataset
+from petastorm_tpu.reader import make_batch_reader, make_reader
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+
+@pytest.fixture(scope='module')
+def ragged_url(tmp_path_factory):
+    url = 'file://' + str(tmp_path_factory.mktemp('ragged_bridge')) + '/ds'
+    schema = Unischema('S', [
+        UnischemaField('id', np.int32, (), ScalarCodec(pa.int32()), False),
+        UnischemaField('tokens', np.int32, (None,), NdarrayCodec(), False),
+    ])
+    rng = np.random.RandomState(0)
+    write_dataset(url, schema,
+                  [{'id': i,
+                    'tokens': rng.randint(0, 9, (2 + i % 4,), np.int32)}
+                   for i in range(20)], rowgroup_size_rows=5)
+    return url
+
+
+def test_torch_batched_names_ragged_field(ragged_url):
+    from petastorm_tpu.pytorch import BatchedDataLoader
+    with make_batch_reader(ragged_url) as reader:
+        loader = BatchedDataLoader(reader, batch_size=4)
+        with pytest.raises(TypeError, match='variable shape.*pad_ragged'):
+            next(iter(loader))
+
+
+def test_torch_row_loader_names_ragged_field(ragged_url):
+    from petastorm_tpu.pytorch import DataLoader
+    with make_reader(ragged_url) as reader:
+        loader = DataLoader(reader, batch_size=4)
+        with pytest.raises(TypeError, match="'tokens'.*variable shape"):
+            next(iter(loader))
+
+
+def test_tf_dataset_names_ragged_field(ragged_url):
+    pytest.importorskip('tensorflow')
+    from petastorm_tpu.tf_utils import make_petastorm_dataset
+    with make_batch_reader(ragged_url) as reader:
+        dataset = make_petastorm_dataset(reader)
+        with pytest.raises(Exception, match='variable shape'):
+            next(iter(dataset))
