@@ -213,6 +213,35 @@ _UNSET = _Unset()
 # Row-group discovery (read side)
 # ---------------------------------------------------------------------------
 
+def count_rows(dataset_info_or_url, storage_options=None,
+               footer_scan_workers=8):
+    """Total row count of a dataset from parquet FOOTERS only — one
+    metadata read per file, no data pages touched.
+
+    The reference's converter carries an explicit ``dataset_size`` it got
+    from Spark (``spark_dataset_converter.py:646-706``); for an existing
+    store this answers the same "``len(dataset)``" question directly.
+    """
+    info = (dataset_info_or_url
+            if isinstance(dataset_info_or_url, ParquetDatasetInfo)
+            else ParquetDatasetInfo(dataset_info_or_url, storage_options))
+    # summary-first, like load_row_groups' 3-way fallback: one already
+    # -cached read answers it on stores with a _metadata file
+    summary = info.summary_metadata
+    if summary is not None and summary.num_rows:
+        return summary.num_rows
+    if not info.file_paths:
+        return 0
+
+    def rows_in(path):
+        with info.open(path) as f:
+            return pq.read_metadata(f).num_rows
+
+    with ThreadPoolExecutor(max_workers=min(footer_scan_workers,
+                                            len(info.file_paths))) as pool:
+        return sum(pool.map(rows_in, info.file_paths))
+
+
 def load_row_groups(dataset_info, footer_scan_workers=8):
     """Enumerate all row-groups of a dataset as :class:`RowGroupPiece` list.
 

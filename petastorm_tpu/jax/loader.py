@@ -788,11 +788,16 @@ class JaxLoader:
         for name, arr in host_batch.items():
             arr = np.asarray(arr)
             if arr.dtype == object:
-                raise TypeError(
-                    'Field %r has variable shape (object dtype) and cannot '
-                    'be staged to device; pad it to a static shape with '
-                    'pad_ragged={%r: <size>}, project it away with fields=, '
-                    'or densify it with a TransformSpec' % (name, name))
+                # shared classified diagnosis (ragged vs string vs null);
+                # the ragged message names pad_ragged/bucket_boundaries
+                from petastorm_tpu.ragged import reject_object_column
+                reject_object_column(name, arr)
+            if arr.dtype.kind in 'US':
+                # fixed-width numpy strings are not object dtype but are
+                # just as undevicable — same diagnosis, not jax's raw
+                # 'not a valid JAX array type'
+                from petastorm_tpu.ragged import STRING_MESSAGE
+                raise TypeError(STRING_MESSAGE % name)
             want = self._dtypes.get(name)
             if want is not None:
                 arr = arr.astype(want)

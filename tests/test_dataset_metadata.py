@@ -231,3 +231,13 @@ def test_explicit_compression_passthrough(tmp_path):
     meta = pq.ParquetFile(
         glob.glob(str(tmp_path / 'ds' / '*.parquet'))[0]).metadata
     assert meta.row_group(0).column(0).compression == 'UNCOMPRESSED'
+
+
+def test_count_rows_footers_only(synthetic_dataset, scalar_dataset):
+    from petastorm_tpu.etl.dataset_metadata import (
+        ParquetDatasetInfo, count_rows,
+    )
+    assert count_rows(synthetic_dataset.url) == 100
+    assert count_rows(scalar_dataset.url) == 100
+    # accepts a pre-resolved info too
+    assert count_rows(ParquetDatasetInfo(synthetic_dataset.url)) == 100

@@ -47,3 +47,21 @@ def test_tf_dataset_names_ragged_field(ragged_url):
         dataset = make_petastorm_dataset(reader)
         with pytest.raises(Exception, match='variable shape'):
             next(iter(dataset))
+
+
+def test_jax_stage_diagnoses_string_field(tmp_path):
+    # fixed-width numpy strings are not object dtype but cannot stage;
+    # the loader must give the classified diagnosis, not jax's raw error
+    from petastorm_tpu.jax import make_jax_loader
+    url = 'file://' + str(tmp_path / 'str_ds')
+    schema = Unischema('S', [
+        UnischemaField('id', np.int64, (), ScalarCodec(pa.int64()), False),
+        UnischemaField('name', np.str_, (), ScalarCodec(pa.string()),
+                       False),
+    ])
+    write_dataset(url, schema,
+                  [{'id': i, 'name': 'n%d' % i} for i in range(16)],
+                  rowgroup_size_rows=8)
+    with make_jax_loader(url, batch_size=4) as loader:
+        with pytest.raises(TypeError, match='string/decimal'):
+            next(iter(loader))
