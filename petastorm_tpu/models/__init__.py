@@ -5,8 +5,14 @@ as consumers of its readers; here they are first-class, TPU-first: bfloat16
 compute, mesh-sharded parameters, jit-compiled train steps.
 """
 
+from petastorm_tpu.models.generate import (  # noqa: F401
+    greedy_generate, sample_generate,
+)
 from petastorm_tpu.models.mnist import MnistCNN, mnist_train_step  # noqa: F401
 from petastorm_tpu.models.transformer import (  # noqa: F401
     TransformerConfig, init_transformer_params, transformer_forward,
-    transformer_train_step,
+    transformer_masked_train_step, transformer_train_step,
+)
+from petastorm_tpu.models.vit import (  # noqa: F401
+    ViTConfig, init_vit_params, vit_forward, vit_train_step,
 )
