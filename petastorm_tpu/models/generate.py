@@ -87,21 +87,25 @@ def greedy_generate(params, prompt, config, max_new_tokens, eos_token=None):
 
 
 def sample_generate(params, prompt, config, max_new_tokens, rng,
-                    temperature=1.0, top_k=0, eos_token=None):
+                    temperature=1.0, top_k=0, top_p=0.0, eos_token=None):
     """Stochastic decode: categorical sampling at ``temperature``,
     optionally restricted to the ``top_k`` highest logits (0 = full
-    vocab). Same static-cache scan as :func:`greedy_generate`;
-    ``temperature`` → 0 recovers greedy (use :func:`greedy_generate`
-    directly for that — it skips the RNG plumbing)."""
+    vocab) and/or the nucleus of cumulative probability ``top_p``
+    (0 = off; both set = intersect, the common pairing). Same
+    static-cache scan as :func:`greedy_generate`; ``temperature`` → 0
+    recovers greedy (use :func:`greedy_generate` directly for that — it
+    skips the RNG plumbing)."""
     if temperature <= 0:
         raise ValueError('temperature must be > 0; for deterministic '
                          'decoding use greedy_generate')
+    if not 0.0 <= top_p <= 1.0:
+        raise ValueError('top_p must be in [0, 1]; got %r' % (top_p,))
     return _generate(params, prompt, config, max_new_tokens, rng=rng,
-                     temperature=temperature, top_k=top_k,
+                     temperature=temperature, top_k=top_k, top_p=top_p,
                      eos_token=eos_token)
 
 
-def _select(logits, rng, temperature, top_k):
+def _select(logits, rng, temperature, top_k, top_p=0.0):
     """One next-token choice from (B, V) logits."""
     if rng is None:
         return jnp.argmax(logits, axis=-1)
@@ -112,11 +116,22 @@ def _select(logits, rng, temperature, top_k):
             # per-token hot path
             kth = lax.top_k(logits, k)[0][:, -1][:, None]
             logits = jnp.where(logits >= kth, logits, -jnp.inf)
+    if top_p > 0.0 and top_p < 1.0:
+        # nucleus: keep the smallest prefix of descending-probability
+        # tokens whose mass reaches top_p (the top token always survives)
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits / temperature, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # a token is inside while the mass BEFORE it is < top_p; the
+        # threshold is the smallest logit still inside
+        inside = cum - probs < top_p
+        kth = jnp.min(jnp.where(inside, sorted_logits, jnp.inf), axis=-1)
+        logits = jnp.where(logits >= kth[:, None], logits, -jnp.inf)
     return jax.random.categorical(rng, logits / temperature, axis=-1)
 
 
 def _generate(params, prompt, config, max_new_tokens, rng,
-              temperature=1.0, top_k=0, eos_token=None):
+              temperature=1.0, top_k=0, top_p=0.0, eos_token=None):
     c = config
     if c.n_experts > 0 or c.seq_axis is not None:
         raise NotImplementedError('greedy_generate/sample_generate support '
@@ -155,7 +170,7 @@ def _generate(params, prompt, config, max_new_tokens, rng,
     else:
         first_rng = None
     next_token = _select(_head_logits(params, x[:, -1], c), first_rng,
-                         temperature, top_k).astype(prompt.dtype)
+                         temperature, top_k, top_p).astype(prompt.dtype)
 
     # -- decode: one scan step per new token (max_new_tokens - 1 steps:
     # the prefill already decided token 1, and emitting the FRESH token
@@ -182,7 +197,7 @@ def _generate(params, prompt, config, max_new_tokens, rng,
             x = _block_dense_ffn_half(block, x, c)
         logits = _head_logits(params, x[:, 0], c)
         new_token = _select(logits, step_rng, temperature,
-                            top_k).astype(token.dtype)
+                            top_k, top_p).astype(token.dtype)
         if eos_token is not None:
             # finished rows keep emitting EOS; static shapes throughout
             new_token = jnp.where(done, jnp.asarray(eos_token,

@@ -168,3 +168,38 @@ def test_moe_and_seq_configs_rejected():
     config2, _ = _setup(seq_axis='seq')
     with pytest.raises(NotImplementedError, match='dense'):
         greedy_generate(params, jnp.zeros((1, 4), jnp.int32), config2, 2)
+
+
+def test_top_p_tiny_nucleus_equals_greedy():
+    # a nucleus smaller than the top token's own probability keeps only
+    # the argmax -> identical to greedy
+    from petastorm_tpu.models.generate import sample_generate
+    config, params = _setup()
+    prompt = jnp.asarray(
+        np.random.RandomState(8).randint(0, 32, (2, 4), np.int32))
+    greedy = greedy_generate(params, prompt, config, max_new_tokens=6)
+    nucleus = sample_generate(params, prompt, config, max_new_tokens=6,
+                              rng=jax.random.PRNGKey(0), temperature=1.0,
+                              top_p=1e-6)
+    np.testing.assert_array_equal(np.asarray(nucleus), np.asarray(greedy))
+
+
+def test_top_p_full_mass_equals_plain_sampling():
+    from petastorm_tpu.models.generate import sample_generate
+    config, params = _setup()
+    prompt = jnp.asarray(
+        np.random.RandomState(9).randint(0, 32, (2, 4), np.int32))
+    plain = sample_generate(params, prompt, config, max_new_tokens=6,
+                            rng=jax.random.PRNGKey(4), temperature=1.3)
+    full = sample_generate(params, prompt, config, max_new_tokens=6,
+                           rng=jax.random.PRNGKey(4), temperature=1.3,
+                           top_p=1.0)
+    np.testing.assert_array_equal(np.asarray(full), np.asarray(plain))
+
+
+def test_top_p_out_of_range_rejected():
+    from petastorm_tpu.models.generate import sample_generate
+    config, params = _setup()
+    with pytest.raises(ValueError, match='top_p'):
+        sample_generate(params, jnp.zeros((1, 4), jnp.int32), config, 2,
+                        rng=jax.random.PRNGKey(0), top_p=1.5)
