@@ -698,3 +698,18 @@ def test_next_after_stop_raises_stop_iteration(scalar_dataset):
     with pytest.raises(StopIteration):
         while True:
             next(it)
+
+
+def test_bucketed_iter_steps_spans_epochs(ragged_dataset):
+    # fixed-step driving (the multi-host pattern) over a bucketed loader:
+    # replay across epoch boundaries keeps emitting per-bucket shapes
+    with make_jax_loader(ragged_dataset.url, batch_size=8,
+                         fields=['^id$', '^tokens$'],
+                         bucket_boundaries={'tokens': [6, 12]},
+                         num_epochs=None,
+                         shuffle_row_groups=False) as loader:
+        widths = set()
+        for batch in loader.iter_steps(12):
+            assert batch['tokens'].shape[0] == 8
+            widths.add(batch['tokens'].shape[1])
+    assert widths <= {6, 12} and widths
