@@ -1,0 +1,106 @@
+"""Generate from a trained LM checkpoint: the inference end of the loop.
+
+Closes the framework's full lifecycle — Parquet → packed batches → train
+steps → :class:`~petastorm_tpu.jax.TrainCheckpointer` (model + data
+position) → restore → KV-cache decode
+(:mod:`petastorm_tpu.models.generate`): greedy, temperature/top-k/top-p
+sampling, EOS stop. The checkpoint layout is exactly what
+:func:`examples.lm.pretrain_example.pretrain` writes, so pretrain and
+generate compose as two CLI invocations over one directory.
+
+Run:
+    python -m examples.lm.pretrain_example --generate \
+        --dataset-url file:///tmp/c4_like --steps 40 \
+        --checkpoint-dir /tmp/lm_ckpt
+    python -m examples.lm.generate_example --checkpoint-dir /tmp/lm_ckpt \
+        --max-new-tokens 32 --temperature 0.8 --top-p 0.9
+"""
+
+import argparse
+
+import numpy as np
+
+from examples.lm.pretrain_example import EOS, SEQ_LEN
+
+
+def generate_from_checkpoint(checkpoint_dir, prompt_tokens=None,
+                             max_new_tokens=32, temperature=0.0, top_k=0,
+                             top_p=0.0, eos_token=EOS, seq_len=SEQ_LEN,
+                             seed=0, log=print):
+    """Restore the latest checkpoint and decode; returns the (B, P+N)
+    token array. ``temperature`` 0 = greedy (``top_k``/``top_p`` then make
+    no sense and are rejected). ``eos_token`` defaults to the packing
+    separator, so decoding stops at the document boundary the model was
+    trained on (None decodes past it)."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from petastorm_tpu.jax import TrainCheckpointer
+    from petastorm_tpu.models.generate import greedy_generate, sample_generate
+    from petastorm_tpu.models.transformer import (
+        TransformerConfig, init_transformer_params,
+    )
+
+    if temperature <= 0 and (top_k or top_p):
+        raise ValueError('top_k/top_p require temperature > 0 (sampling); '
+                         'temperature<=0 decodes greedily')
+    if not os.path.isdir(checkpoint_dir):
+        # check BEFORE constructing the manager: orbax would create an
+        # empty directory tree at a typo'd path as a side effect
+        raise FileNotFoundError(
+            'no checkpoint under %r; run the pretrain example with '
+            '--checkpoint-dir first' % checkpoint_dir)
+
+    config = TransformerConfig(max_seq_len=seq_len)
+    params = init_transformer_params(jax.random.PRNGKey(0), config)
+    optimizer = optax.adam(1e-2)  # template shape only; not stepped here
+    template = (params, optimizer.init(params))
+    with TrainCheckpointer(checkpoint_dir) as ckpt:
+        step = ckpt.latest_step
+        if step is None:
+            raise FileNotFoundError(
+                'no checkpoint under %r; run the pretrain example with '
+                '--checkpoint-dir first' % checkpoint_dir)
+        params, _ = ckpt.restore_state(template)
+    log('restored step %d from %s' % (step, checkpoint_dir))
+
+    if prompt_tokens is None:
+        # EOS-led prompt: "start of a document", the packing separator
+        prompt_tokens = np.full((2, 1), EOS, np.int32)
+    prompt = jnp.asarray(np.asarray(prompt_tokens, np.int32))
+    if temperature <= 0:
+        out = greedy_generate(params, prompt, config, max_new_tokens,
+                              eos_token=eos_token)
+    else:
+        out = sample_generate(params, prompt, config, max_new_tokens,
+                              rng=jax.random.PRNGKey(seed),
+                              temperature=temperature, top_k=top_k,
+                              top_p=top_p, eos_token=eos_token)
+    return np.asarray(out)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--checkpoint-dir', required=True)
+    parser.add_argument('--max-new-tokens', type=int, default=32)
+    parser.add_argument('--temperature', type=float, default=0.0,
+                        help='0 = greedy')
+    parser.add_argument('--top-k', type=int, default=0)
+    parser.add_argument('--top-p', type=float, default=0.0)
+    parser.add_argument('--seed', type=int, default=0)
+    parser.add_argument('--no-eos-stop', action='store_true',
+                        help='decode past document boundaries')
+    args = parser.parse_args(argv)
+    out = generate_from_checkpoint(
+        args.checkpoint_dir, max_new_tokens=args.max_new_tokens,
+        temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
+        eos_token=None if args.no_eos_stop else EOS, seed=args.seed)
+    for row in out:
+        print('generated:', ' '.join(str(t) for t in row.tolist()))
+
+
+if __name__ == '__main__':
+    main()

@@ -151,6 +151,35 @@ class TestLmExample:
                         checkpoint_dir=ckpt_dir) is None
 
     @pytest.mark.slow
+    def test_generate_from_checkpoint(self, tmp_path):
+        # the full lifecycle: train with checkpointing, restore in a
+        # separate call, decode greedily and with nucleus sampling
+        from examples.lm.generate_example import generate_from_checkpoint
+        from examples.lm.pretrain_example import generate_c4_like, pretrain
+        url = 'file://' + str(tmp_path / 'c4_gen')
+        ckpt_dir = str(tmp_path / 'ckpt_gen')
+        generate_c4_like(url, num_docs=96)
+        pretrain(url, batch_size=8, steps=6, checkpoint_dir=ckpt_dir,
+                 checkpoint_every=3)
+        greedy = generate_from_checkpoint(ckpt_dir, max_new_tokens=12,
+                                          log=lambda *a: None)
+        assert greedy.shape == (2, 13)
+        assert ((greedy >= 0) & (greedy < 256)).all()
+        sampled = generate_from_checkpoint(ckpt_dir, max_new_tokens=12,
+                                           temperature=0.9, top_p=0.9,
+                                           log=lambda *a: None)
+        assert sampled.shape == (2, 13)
+        # filters without sampling make no sense and are rejected
+        with pytest.raises(ValueError, match='temperature'):
+            generate_from_checkpoint(ckpt_dir, top_p=0.9,
+                                     log=lambda *a: None)
+        # missing checkpoint dir fails actionably WITHOUT creating it
+        missing = tmp_path / 'nope'
+        with pytest.raises(FileNotFoundError, match='pretrain'):
+            generate_from_checkpoint(str(missing), log=lambda *a: None)
+        assert not missing.exists(), 'probe must not create the directory'
+
+    @pytest.mark.slow
     def test_variable_length_bucketed_training(self, tmp_path):
         # no-packing path: variable-length docs → length buckets → masked
         # train step; multiple bucket shapes must actually occur
