@@ -7,19 +7,36 @@ framework's row-at-a-time reader on an equivalent dataset.
 
 ``extra`` carries the flagship-path numbers the row metric cannot see
 (VERDICT r1 #4): the batched column reader, a jpeg-heavy 224x224x3
-imagenet-style pipeline (rows/sec and decoded MB/s), and the
-host→device-staged JAX path (rows/sec into device HBM + H2D MB/s).
+imagenet-style pipeline (rows/sec and decoded MB/s, native C decoders on vs
+off), and the host→device-staged JAX path (rows/sec into device HBM + H2D
+GB/s with uint8-vs-f32 staging accounting).
 
 A like-for-like run of the reference reader on this machine is not possible:
 its read stack needs long-removed pyarrow APIs (``pyarrow.filesystem``,
 ``pyarrow.hdfs``, the legacy ``ParquetDataset`` pieces API) that pyarrow 25
 no longer ships, so ``vs_baseline`` compares against its published number.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
+Wedge-proofing (VERDICT r3 #1 — round 3 lost its whole perf record to an
+outer-timeout kill, rc=124):
 
-The JAX section runs in a guarded subprocess with a timeout: under the
-driver the default device is the real TPU chip, and a wedged chip/tunnel
-must not hang the whole benchmark (the host-side metrics still report).
+* The cumulative result JSON is printed (flushed) after EVERY section, so a
+  kill at any point still leaves the driver a parseable last line carrying
+  every section that finished. The final line is the complete report.
+* The TPU is probed ONCE up front in a guarded subprocess; if the probe
+  fails (wedged chip/tunnel) all remaining device sections run on the CPU
+  backend immediately — marked ``tpu_unavailable`` — instead of each
+  burning its own subprocess timeout against a dead link.
+* A global wall-clock budget (``BENCH_BUDGET_SECONDS``, default 1100s —
+  chosen to undercut any plausible driver timeout) clamps every section's
+  subprocess timeout to the remaining budget and skips sections that no
+  longer fit, recording them under ``skipped_sections``.
+* ``BENCH_SMOKE=1`` shrinks every dataset/sample count so the whole
+  benchmark finishes in well under a minute on CPU — used by
+  ``tests/test_bench_wedgeproof.py`` to assert the contract above under a
+  poisoned platform.
+
+Reference contract matched: one-shot metrics report, the reference's
+``benchmark/throughput.py:112`` (single process prints a final report).
 """
 
 import json
@@ -34,11 +51,30 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 BASELINE_SAMPLES_PER_SEC = 709.84  # reference: docs/benchmarks_tutorial.rst:20
 
-WARMUP_SAMPLES = 300
-MEASURE_SAMPLES = 3000
+SMOKE = os.environ.get('BENCH_SMOKE') == '1'
 
-IMAGENET_ROWS = 384
+WARMUP_SAMPLES = 50 if SMOKE else 300
+MEASURE_SAMPLES = 300 if SMOKE else 3000
+HELLO_ROWS = 300 if SMOKE else 1000
+
+IMAGENET_ROWS = 96 if SMOKE else 384
 IMAGENET_SHAPE = (224, 224, 3)
+MEDIAN_RUNS = 1 if SMOKE else 3
+
+C4_DOCS = 256 if SMOKE else 2048
+
+BUDGET_SECONDS = float(os.environ.get('BENCH_BUDGET_SECONDS',
+                                      '240' if SMOKE else '1100'))
+_START = time.monotonic()
+
+
+def _remaining():
+    return BUDGET_SECONDS - (time.monotonic() - _START)
+
+
+def _clamp_timeout(default):
+    """A subprocess timeout that can never outlive the global budget."""
+    return max(15, min(default, _remaining() - 10))
 
 
 def _hello_world_schema():
@@ -68,7 +104,7 @@ def _build_hello_world(url):
         'id': i,
         'array_4d': rng.randint(0, 255, (128,), dtype=np.uint8),
         'image1': rng.randint(0, 255, (32, 32, 3), dtype=np.uint8),
-    } for i in range(1000)]
+    } for i in range(HELLO_ROWS)]
     write_dataset(url, _hello_world_schema(), rows,
                   rowgroup_size_rows=100, num_files=4)
 
@@ -123,7 +159,7 @@ def _measure_rows(url):
 
 def _build_c4_like(url):
     from examples.lm.pretrain_example import generate_c4_like
-    generate_c4_like(url, num_docs=2048)
+    generate_c4_like(url, num_docs=C4_DOCS)
 
 
 def _measure_lm_tokens(url, seq_len=128, warmup_rows=64, measure_rows=2048):
@@ -131,6 +167,8 @@ def _measure_lm_tokens(url, seq_len=128, warmup_rows=64, measure_rows=2048):
     ``seq_len`` rows on the decode workers — packed tokens/sec."""
     from examples.lm.pretrain_example import packing_transform
 
+    if SMOKE:
+        warmup_rows, measure_rows = 16, 128
     rate, _ = _measure_batch(url, warmup_rows, measure_rows,
                              transform_spec=packing_transform(seq_len))
     return rate * seq_len
@@ -213,6 +251,42 @@ def _run_json_subprocess(argv, timeout):
         return {'error': 'unparseable output'}
 
 
+_PROBE_SNIPPET = r'''
+import json
+import jax
+d = jax.devices()[0]
+print(json.dumps({"platform": d.platform, "device_kind": d.device_kind}))
+'''
+
+
+def _probe_tpu(extra, timeout=75):
+    """One upfront device probe in a guarded subprocess (VERDICT r3 #1b).
+
+    A wedged chip/tunnel hangs ``jax.devices()`` indefinitely (observed on
+    this box: backend init hung for hours); probing once bounds that cost to
+    ``timeout`` seconds for the WHOLE benchmark instead of every device
+    section burning its own subprocess timeout. On failure the remaining
+    device sections are pinned to the CPU backend via ``BENCH_JAX_PLATFORM``
+    and the run is marked ``tpu_unavailable``.
+    """
+    if os.environ.get('BENCH_JAX_PLATFORM'):
+        extra['forced_platform'] = os.environ['BENCH_JAX_PLATFORM']
+        return
+    result = _run_json_subprocess(
+        [sys.executable, '-c', _PROBE_SNIPPET], _clamp_timeout(timeout))
+    if 'error' in result:
+        os.environ['BENCH_JAX_PLATFORM'] = 'cpu'
+        extra['tpu_unavailable'] = result['error']
+    else:
+        extra['probe_platform'] = result.get('platform')
+        extra['probe_device_kind'] = result.get('device_kind')
+        if result.get('platform') == 'cpu':
+            # default backend IS cpu (no accelerator registered): pin it so
+            # the per-section cpu-retry logic doesn't run everything twice
+            os.environ['BENCH_JAX_PLATFORM'] = 'cpu'
+            extra['tpu_unavailable'] = 'default backend is cpu'
+
+
 def _build_tfrecord(url, timeout=240):
     """Re-encode the parquet dataset's jpeg cells into a TFRecord file.
     Returns the path, or an error string."""
@@ -238,7 +312,7 @@ with tf.io.TFRecordWriter(out) as writer:
     tfrecord_path = root + '.tfrecord'
     _, error = _run_subprocess(
         [sys.executable, '-c', code, tfrecord_path, root + '/*.parquet'],
-        timeout)
+        _clamp_timeout(timeout))
     if error is not None:
         return None, 'tfrecord build: %s' % error
     return tfrecord_path, None
@@ -250,7 +324,7 @@ def _measure_tfdata(tfrecord_path, warmup, measure, timeout=240):
     Runs in a subprocess so TF's runtime never pollutes this process."""
     return _run_json_subprocess(
         [sys.executable, '-c', _TFDATA_SNIPPET, tfrecord_path,
-         str(warmup), str(measure)], timeout)
+         str(warmup), str(measure)], _clamp_timeout(timeout))
 
 
 _JAX_SNIPPET = r'''
@@ -301,30 +375,59 @@ with make_jax_loader(url, batch_size=batch_size, fields=fields,
 import numpy as np
 hosts = [{k: np.array(v) for k, v in b.items()} for _ in range(2)]
 batch_bytes = sum(a.nbytes for a in hosts[0].values())
-reps = max(4, min(64, int(3e8 / max(1, batch_bytes))))
-# warm lazy init AND the fence ops' compiles outside the timed window
-for arr in jax.device_put(hosts[0]).values():
-    np.asarray(arr.ravel()[:1])
-start = time.monotonic()
-put = None
-for i in range(reps):
-    put = jax.device_put(hosts[i %% 2])  # alternate: defeat any caching
+
+
+def raw_h2d_mb(batches, reps_budget_bytes=3e8):
+    """Tight device_put loop over alternating host batches → MB/s."""
+    nbytes = sum(a.nbytes for a in batches[0].values())
+    reps = max(4, min(64, int(reps_budget_bytes / max(1, nbytes))))
+    # warm lazy init AND the fence ops' compiles outside the timed window
+    for arr in jax.device_put(batches[0]).values():
+        np.asarray(arr.ravel()[:1])
+    start = time.monotonic()
+    put = None
+    for i in range(reps):
+        put = jax.device_put(batches[i %% 2])  # alternate: defeat caching
+        for arr in put.values():
+            arr.block_until_ready()
+    # final-rep D2H value reads: transfers execute in dispatch order on the
+    # device, so forcing the LAST rep's arrays to concrete host values
+    # bounds the whole sequence even if intermediate ready-signals fired
+    # early (a per-rep device-op fence would dominate the measurement with
+    # dispatch overhead on fast links)
     for arr in put.values():
-        arr.block_until_ready()
-# final-rep D2H value reads: transfers execute in dispatch order on the
-# device, so forcing the LAST rep's arrays to concrete host values bounds
-# the whole sequence even if intermediate ready-signals fired early (a
-# per-rep device-op fence would dominate the measurement with dispatch
-# overhead on fast links)
-for arr in put.values():
-    np.asarray(arr.ravel()[:1])  # device-side slice: 1-element D2H only
-raw_elapsed = time.monotonic() - start
-raw_mb = reps * batch_bytes / raw_elapsed / 2 ** 20
+        np.asarray(arr.ravel()[:1])  # device-side slice: 1-element D2H only
+    return reps * nbytes / (time.monotonic() - start) / 2 ** 20
+
+
+raw_mb = raw_h2d_mb(hosts)
 loader_mb = nbytes / elapsed / 2 ** 20
-print(json.dumps({"rows_per_sec": seen / elapsed,
-                  "h2d_mb_per_sec": loader_mb,
-                  "raw_h2d_mb_per_sec": raw_mb,
-                  "h2d_efficiency": loader_mb / raw_mb}))
+result = {"rows_per_sec": seen / elapsed,
+          "h2d_mb_per_sec": loader_mb,
+          "h2d_gb_per_sec": loader_mb / 1024,
+          "raw_h2d_mb_per_sec": raw_mb,
+          "raw_h2d_gb_per_sec": raw_mb / 1024,
+          "staged_bytes_per_batch": batch_bytes,
+          "staged_dtypes": sorted({str(a.dtype) for a in hosts[0].values()}),
+          "h2d_efficiency": loader_mb / raw_mb}
+
+# Bytes accounting for the uint8-staging design (VERDICT r3 #3): image
+# pipelines stage uint8 over the link and cast/normalize ON DEVICE
+# (ops/normalize.py), quartering link bytes vs staging f32. Measure the
+# same pixels staged as f32 for the like-for-like rate, and report the
+# f32-EQUIVALENT delivery rate of the uint8 path (pixels that arrive per
+# second, scaled to f32 width) after demonstrating the on-device cast.
+if all(a.dtype == np.uint8 for a in hosts[0].values()):
+    f32_hosts = [{k: v.astype(np.float32) for k, v in h.items()}
+                 for h in hosts]
+    result["raw_h2d_f32_gb_per_sec"] = raw_h2d_mb(f32_hosts) / 1024
+    # prove the on-device cast path runs (bf16 normalize of the staged
+    # uint8 batch) — the f32-equivalent claim is only honest if it does
+    staged = jax.device_put(hosts[0])
+    arr = next(iter(staged.values()))
+    jnp.mean((arr.astype(jnp.bfloat16) - 127.5) / 58.0).block_until_ready()
+    result["f32_equiv_delivery_gb_per_sec"] = 4.0 * raw_mb / 1024
+print(json.dumps(result))
 '''
 
 
@@ -335,7 +438,8 @@ def _measure_jax(url, batch_size, warmup, measure, fields, timeout=150):
         'repo': os.path.dirname(os.path.abspath(__file__)), 'url': url,
         'batch': batch_size, 'warmup': warmup, 'measure': measure,
         'fields': fields}
-    return _run_json_subprocess([sys.executable, '-c', code], timeout)
+    return _run_json_subprocess([sys.executable, '-c', code],
+                                _clamp_timeout(timeout))
 
 
 _LM_TRAIN_SNIPPET = r'''
@@ -601,7 +705,8 @@ def _measure_lm_decode(timeout=600):
     """KV-cache inference throughput on the flagship model family."""
     code = _LM_DECODE_SNIPPET % {
         'repo': os.path.dirname(os.path.abspath(__file__))}
-    return _run_json_subprocess([sys.executable, '-c', code], timeout)
+    return _run_json_subprocess([sys.executable, '-c', code],
+                                _clamp_timeout(timeout))
 
 
 _PP_BF16_SNIPPET = r'''
@@ -655,9 +760,9 @@ def _measure_pp_bf16(timeout=300):
     code = _PP_BF16_SNIPPET % {
         'repo': os.path.dirname(os.path.abspath(__file__))}
     argv = [sys.executable, '-c', code]
-    result = _run_json_subprocess(argv, timeout)
+    result = _run_json_subprocess(argv, _clamp_timeout(timeout))
     if 'error' in result and not os.environ.get('BENCH_JAX_PLATFORM'):
-        result = _run_json_subprocess(argv, timeout)
+        result = _run_json_subprocess(argv, _clamp_timeout(timeout))
     return result
 
 
@@ -668,11 +773,14 @@ def _measure_lm_train(url, batch=8, seq_len=1024, warmup=4, measure=16,
     real optimizer steps on the default device (the TPU chip under the
     driver). Reports MFU and input-bound step utilization — the
     BASELINE.json metric — alongside raw throughput."""
+    if SMOKE:
+        batch, seq_len, warmup, measure = 2, 64, 1, 2
     code = _LM_TRAIN_SNIPPET % {
         'repo': os.path.dirname(os.path.abspath(__file__)), 'url': url,
         'batch': batch, 'seq': seq_len, 'warmup': warmup,
         'measure': measure}
-    return _run_json_subprocess([sys.executable, '-c', code], timeout)
+    return _run_json_subprocess([sys.executable, '-c', code],
+                                _clamp_timeout(timeout))
 
 
 def main():
@@ -681,57 +789,153 @@ def main():
     tmp = tempfile.mkdtemp(prefix='petastorm_tpu_bench_')
     hello_url = 'file://' + tmp + '/hello_world'
     imagenet_url = 'file://' + tmp + '/imagenet_like'
+    c4_url = 'file://' + tmp + '/c4_like'
     extra = {}
-    try:
+    state = {
+        'metric': 'hello_world_read_rate',
+        'value': 0.0,
+        'unit': 'samples/sec',
+        'vs_baseline': 0.0,
+        'extra': extra,
+    }
+
+    def emit():
+        """Cumulative result after every section: a kill at ANY point
+        leaves the driver's last-line parse with everything finished so
+        far (VERDICT r3 #1a). Small single-line writes + flush keep the
+        line intact under an outer SIGKILL."""
+        print(json.dumps(state), flush=True)
+
+    def section(name, min_seconds, fn):
+        """Deadline-gated, exception-isolated benchmark section."""
+        if _remaining() < min_seconds:
+            extra.setdefault('skipped_sections', []).append(name)
+        else:
+            try:
+                fn()
+            except Exception as e:  # noqa: BLE001 - a section must never
+                extra[name + '_error'] = repr(e)[:300]  # kill the report
+        emit()
+
+    def jax_metrics(prefix, *args, fn=_measure_jax):
+        result = fn(*args)
+        if 'error' in result and not os.environ.get('BENCH_JAX_PLATFORM'):
+            # Chip/tunnel wedged mid-run despite a healthy probe: still
+            # record the staging path on the CPU backend, marked as such —
+            # and KEEP the CPU pin for every later section. One wedge means
+            # the link is gone for the run (observed: hours), and unpinning
+            # would make each remaining section re-burn a full subprocess
+            # timeout against the dead link before its own retry.
+            os.environ['BENCH_JAX_PLATFORM'] = 'cpu'
+            extra['tpu_wedged_midrun'] = result['error']
+            cpu_result = fn(*args)
+            if 'error' not in cpu_result:
+                extra['%s_device' % prefix] = 'cpu-fallback'
+                extra['%s_tpu_error' % prefix] = result['error']
+                result = cpu_result
+        for k, v in result.items():
+            if isinstance(v, float):
+                # keep 4 significant digits: rates are O(10^3)+ but
+                # steps/sec and losses are O(1) and would be erased by
+                # fixed 1-decimal rounding
+                v = float('%.4g' % v)
+            extra['%s_%s' % (prefix, k)] = v
+
+    img_state = {}
+
+    def sec_hello_row():
         _build_hello_world(hello_url)
-        _build_imagenet_like(imagenet_url)
-
         rate = _measure_rows(hello_url)
+        state['value'] = round(rate, 2)
+        state['vs_baseline'] = round(rate / BASELINE_SAMPLES_PER_SEC, 3)
 
-        batch_rate, _ = _measure_batch(hello_url, 1000, 8000)
+    def sec_hello_batch():
+        warm, meas = (100, 600) if SMOKE else (1000, 8000)
+        batch_rate, _ = _measure_batch(hello_url, warm, meas)
         extra['hello_world_batch_rows_per_sec'] = round(batch_rate, 1)
 
-        c4_url = 'file://' + tmp + '/c4_like'
+    def sec_lm_tokens():
         _build_c4_like(c4_url)
         extra['lm_packed_tokens_per_sec'] = round(_measure_lm_tokens(c4_url),
                                                   1)
 
+    def sec_imagenet():
+        _build_imagenet_like(imagenet_url)
         img_bytes = int(np.prod(IMAGENET_SHAPE))
-        # median of 3: the shared box is noisy (single runs swing +-10%)
-        # and this is the north-star rate; a median is stable where
-        # best-of-2 was a coin flip
+        # median of MEDIAN_RUNS: the shared box is noisy (single runs
+        # swing +-10%) and this is the north-star rate
         img_runs = sorted(
             (_measure_batch(imagenet_url, IMAGENET_ROWS // 2,
                             IMAGENET_ROWS * 4, bytes_per_row=img_bytes)
-             for _ in range(3)), key=lambda pair: pair[0])
-        img_rate, img_mb = img_runs[1]
+             for _ in range(MEDIAN_RUNS)), key=lambda pair: pair[0])
+        img_rate, img_mb = img_runs[MEDIAN_RUNS // 2]
+        img_state['rate'] = img_rate
         extra['imagenet_batch_rows_per_sec'] = round(img_rate, 1)
         extra['imagenet_decoded_mb_per_sec'] = round(img_mb, 1)
 
-        def jax_metrics(prefix, *args, fn=_measure_jax):
-            result = fn(*args)
-            if 'error' in result and not os.environ.get('BENCH_JAX_PLATFORM'):
-                # chip/tunnel unavailable: still record the staging path on
-                # the CPU backend, marked as such
-                os.environ['BENCH_JAX_PLATFORM'] = 'cpu'
-                try:
-                    cpu_result = fn(*args)
-                finally:
-                    del os.environ['BENCH_JAX_PLATFORM']
-                if 'error' not in cpu_result:
-                    extra['%s_device' % prefix] = 'cpu-fallback'
-                    extra['%s_tpu_error' % prefix] = result['error']
-                    result = cpu_result
-            for k, v in result.items():
-                if isinstance(v, float):
-                    # keep 4 significant digits: rates are O(10^3)+ but
-                    # steps/sec and losses are O(1) and would be erased by
-                    # fixed 1-decimal rounding
-                    v = float('%.4g' % v)
-                extra['%s_%s' % (prefix, k)] = v
+    def sec_imagenet_python_decode():
+        """Native C decoders OFF (pure-Python/cv2 fallback): the native
+        layer's measured win on the same bytes (VERDICT r3 #8). The
+        toggle is live per-call, so an in-process re-run measures the
+        fallback path; the default (native on when built) is what the
+        main imagenet section measured. Only a real comparison is
+        reported: if the main run itself used the fallback (no built
+        jpeg extension, or an ambient kill-switch) a 'speedup' would be
+        ~1.0 noise posing as the native layer's win."""
+        from petastorm_tpu.native import get_jpeg_module
+        if os.environ.get('PETASTORM_TPU_NATIVE', '1').lower() in (
+                '0', 'false', 'off'):
+            extra['native_decode'] = 'disabled-by-env'
+            return
+        if get_jpeg_module() is None:
+            extra['native_decode'] = 'unavailable'
+            return
+        img_bytes = int(np.prod(IMAGENET_SHAPE))
+        saved = os.environ.get('PETASTORM_TPU_NATIVE')
+        os.environ['PETASTORM_TPU_NATIVE'] = '0'
+        try:
+            py_rate, py_mb = _measure_batch(
+                imagenet_url, IMAGENET_ROWS // 2, IMAGENET_ROWS * 4,
+                bytes_per_row=img_bytes)
+        finally:
+            if saved is None:
+                del os.environ['PETASTORM_TPU_NATIVE']
+            else:
+                os.environ['PETASTORM_TPU_NATIVE'] = saved
+        extra['imagenet_python_decode_rows_per_sec'] = round(py_rate, 1)
+        extra['imagenet_python_decode_mb_per_sec'] = round(py_mb, 1)
+        if img_state.get('rate'):
+            extra['native_decode_speedup'] = round(
+                img_state['rate'] / py_rate, 3)
 
-        jax_metrics('hello_world_jax', hello_url, 256, 1024, 8192,
+    def sec_tfdata():
+        # North star (BASELINE.json): ratio vs a tf.data+TFRecord pipeline
+        # decoding the SAME jpeg bytes on the same machine. Target >= 0.9.
+        tfrecord_path, build_error = _build_tfrecord(imagenet_url)
+        if build_error:
+            extra['tfdata_imagenet_error'] = build_error
+            return
+        runs = [_measure_tfdata(tfrecord_path, IMAGENET_ROWS // 2,
+                                IMAGENET_ROWS * 4)
+                for _ in range(MEDIAN_RUNS)]
+        os.unlink(tfrecord_path)
+        ok_rates = sorted(r['rows_per_sec'] for r in runs
+                          if 'rows_per_sec' in r)
+        if ok_rates:
+            import statistics
+            tf_rate = statistics.median(ok_rates)
+            extra['tfdata_imagenet_rows_per_sec'] = round(tf_rate, 1)
+            if img_state.get('rate'):
+                extra['vs_tfdata'] = round(img_state['rate'] / tf_rate, 3)
+        else:
+            extra['tfdata_imagenet_error'] = runs[-1].get('error', 'unknown')
+
+    def sec_jax_hello():
+        warm, meas = (128, 1024) if SMOKE else (1024, 8192)
+        jax_metrics('hello_world_jax', hello_url, 256, warm, meas,
                     ['^id$', '^array_4d$', '^image1$'])
+
+    def sec_jax_imagenet():
         jax_metrics('imagenet_jax', imagenet_url, 64, IMAGENET_ROWS // 2,
                     IMAGENET_ROWS * 3, ['^image$'])
         # Attribution marker: when even a RAW device_put tight loop cannot
@@ -740,21 +944,24 @@ def main():
         # above 1.0 in the same run confirms staging adds nothing on top.
         # Only meaningful when a real device link was measured: the
         # cpu-fallback path records host-to-host rates.
-        raw = extra.get('imagenet_jax_raw_h2d_mb_per_sec')
-        if (raw is not None and raw < 1024
+        raw_gb = extra.get('imagenet_jax_raw_h2d_gb_per_sec')
+        if (raw_gb is not None and raw_gb < 1.0
                 and extra.get('imagenet_jax_device') != 'cpu-fallback'
                 and not os.environ.get('BENCH_JAX_PLATFORM')):
-            # (the env check covers operator-forced CPU runs, where the
-            # auto-fallback marker is never written)
+            # (the env check covers probe-pinned and operator-forced CPU
+            # runs, where no real device link was measured)
             extra['h2d_link_degraded'] = True
 
+    def sec_lm_train():
         # end-to-end TRAINING throughput on the default device: Parquet →
         # packed batches → H2D → real transformer optimizer steps
         jax_metrics('lm_train', c4_url, fn=_measure_lm_train)
 
+    def sec_lm_decode():
         # inference: KV-cache greedy decode rate on the same model family
         jax_metrics('lm_decode', fn=_measure_lm_decode)
 
+    def sec_pp_bf16():
         # bf16 pipelined train step smoke — meaningful on the real chip
         # (the 1-stage shape happens to compile on current XLA:CPU too,
         # so a CPU run must be LABELED as such, not pass as validation)
@@ -763,34 +970,24 @@ def main():
                 and 'pp_bf16_device' not in extra):
             extra['pp_bf16_device'] = 'cpu-fallback'
 
-        # North star (BASELINE.json): ratio vs a tf.data+TFRecord pipeline
-        # decoding the SAME jpeg bytes on the same machine. Target >= 0.9.
-        # Median of 3 for the same noise reason as above.
-        tfrecord_path, build_error = _build_tfrecord(imagenet_url)
-        if build_error:
-            extra['tfdata_imagenet_error'] = build_error
-        else:
-            runs = [_measure_tfdata(tfrecord_path, IMAGENET_ROWS // 2,
-                                    IMAGENET_ROWS * 4) for _ in range(3)]
-            os.unlink(tfrecord_path)
-            ok_rates = sorted(r['rows_per_sec'] for r in runs
-                              if 'rows_per_sec' in r)
-            if ok_rates:
-                import statistics
-                tf_rate = statistics.median(ok_rates)
-                extra['tfdata_imagenet_rows_per_sec'] = round(tf_rate, 1)
-                extra['vs_tfdata'] = round(img_rate / tf_rate, 3)
-            else:
-                extra['tfdata_imagenet_error'] = runs[-1].get('error',
-                                                              'unknown')
-
-        print(json.dumps({
-            'metric': 'hello_world_read_rate',
-            'value': round(rate, 2),
-            'unit': 'samples/sec',
-            'vs_baseline': round(rate / BASELINE_SAMPLES_PER_SEC, 3),
-            'extra': extra,
-        }))
+    try:
+        # Host-only sections first (they cannot wedge on a dead chip and
+        # secure the primary metric + the north-star ratio early), then the
+        # probe, then device sections in decreasing order of importance.
+        section('hello_row', 10, sec_hello_row)
+        section('hello_batch', 5, sec_hello_batch)
+        section('lm_tokens', 10, sec_lm_tokens)
+        section('imagenet', 20, sec_imagenet)
+        section('imagenet_python_decode', 10, sec_imagenet_python_decode)
+        section('tfdata', 30, sec_tfdata)
+        section('probe', 20, lambda: _probe_tpu(extra))
+        section('jax_hello', 30, sec_jax_hello)
+        section('jax_imagenet', 30, sec_jax_imagenet)
+        section('lm_train', 60, sec_lm_train)
+        section('lm_decode', 45, sec_lm_decode)
+        section('pp_bf16', 30, sec_pp_bf16)
+        extra['bench_elapsed_sec'] = round(time.monotonic() - _START, 1)
+        emit()
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 

@@ -95,6 +95,12 @@ def _build_extension(name):
 
 
 def _get_extension(name):
+    # Live kill-switch, checked per call (not cached): lets a benchmark or
+    # an operator A/B the Python fallback against the native path in one
+    # process, and disables a misbehaving native build without a rebuild.
+    if os.environ.get('PETASTORM_TPU_NATIVE', '1').lower() in ('0', 'false',
+                                                               'off'):
+        return None
     if name in _loaded:
         return _loaded[name]
     if name in _attempted:
