@@ -143,8 +143,36 @@ class TrainCheckpointer:
             restored = self._manager.restore(
                 step, args=ocp.args.Composite(**{
                     _LOADER_KEY: ocp.args.JsonRestore()}))
-            loader_state = restored[_LOADER_KEY][str(jax.process_index())]
-        except (KeyError, FileNotFoundError) as e:
+            payload = restored[_LOADER_KEY]
+        except (KeyError, FileNotFoundError, ValueError, TypeError) as e:
+            # Orbax does not contract the exception type for a missing
+            # composite item (KeyError and FileNotFoundError observed;
+            # ValueError/TypeError plausible across versions — ADVICE r2
+            # #3), so the model-only-checkpoint fallback covers all of
+            # them — but ONLY them: transient I/O failures (OSError,
+            # TimeoutError, connection errors) still propagate, because
+            # silently converting a retryable storage hiccup into a fresh
+            # data position would duplicate training data with no hard
+            # failure. When the inventory POSITIVELY said loader state
+            # exists, even these types mean corruption — surface them.
+            if has_loader:
+                raise
+            logger.warning('checkpoint step %s has no restorable loader '
+                           'state (%s: %s); data position starts fresh',
+                           step, type(e).__name__, e)
+            return step
+        if payload is None:
+            # some orbax versions return None for an absent item instead
+            # of raising
+            logger.warning('checkpoint step %s was saved without loader '
+                           'state; data position starts fresh', step)
+            return step
+        try:
+            loader_state = payload[str(jax.process_index())]
+        except (KeyError, TypeError) as e:
+            # loader state exists but not for this process index (e.g. the
+            # pod was resized between save and restore): this host's data
+            # position legitimately starts fresh
             logger.warning('checkpoint step %s has no loader state for this '
                            'process (%s); data position starts fresh',
                            step, e)

@@ -91,7 +91,17 @@ def make_jax_loader(dataset_url_or_urls, batch_size, mesh=None, data_axes=None,
         ``<field>_len`` column keeps true lengths). Emitted shapes are
         static per bucket, so jit compiles one step per bucket and
         padding waste drops from pad-to-max to pad-to-bucket. Composes
-        with ``pad_ragged`` for OTHER fields.
+        with ``pad_ragged`` for OTHER fields. Memory/latency contract:
+        when ``shuffle_rows`` is on, EACH bucket keeps its own shuffle
+        buffer, so host memory scales as ``shuffling_queue_capacity ×
+        len(boundaries)`` — divide the capacity you would use unbucketed
+        by the boundary count to keep the same footprint. A batch emits
+        only when ITS bucket fills, so rows routed to a rarely-hit bucket
+        can be held until the END OF THE STREAM — the final epoch's
+        exhaustion, when every bucket flushes (none are lost); with
+        ``num_epochs=None`` the stream never ends and an unfilled
+        bucket's rows are held indefinitely. Prefer boundaries that match
+        the actual length distribution over a uniform grid.
     :param reader_factory: reader constructor (defaults to
         :func:`petastorm_tpu.reader.make_batch_reader`).
     :param reader_kwargs: forwarded to the reader factory (predicates,
@@ -159,7 +169,6 @@ class JaxLoader:
                 raise ValueError('pad_ragged[%r] must be a positive int or '
                                  'tuple of positive ints; got %r'
                                  % (name, sizes))
-        self._pad_ragged_checked = not self._pad_ragged
         self._bucket_field = None
         self._bucket_bounds = None
         if bucket_boundaries:
@@ -717,13 +726,15 @@ class JaxLoader:
         out = dict(columns)
         for name, targets in self._pad_ragged.items():
             if name not in out:
-                if not self._pad_ragged_checked:
-                    raise ValueError(
-                        'pad_ragged field %r is not in the batch (available: '
-                        '%s); check the name against fields=/the schema'
-                        % (name, sorted(n for n in columns
-                                        if n != _PULL_FIELD)))
-                continue
+                # unconditional (every chunk): readers yield a stable
+                # schema, so a field absent mid-stream is a bug upstream —
+                # silently skipping would emit batches with inconsistent
+                # column sets that fail later with an unrelated error
+                raise ValueError(
+                    'pad_ragged field %r is not in the batch (available: '
+                    '%s); check the name against fields=/the schema'
+                    % (name, sorted(n for n in columns
+                                    if n != _PULL_FIELD)))
             len_name = self._reserve_len_column(out, name, 'pad_ragged')
             col = out[name]
             k = len(targets)
@@ -768,7 +779,6 @@ class JaxLoader:
                     np.asarray(col.shape[1:1 + k], np.int32), (n, k)).copy()
             out[name] = dense
             out[len_name] = lens[:, 0] if k == 1 else lens
-        self._pad_ragged_checked = True
         return out
 
     def _pad(self, host_batch, n):
@@ -893,7 +903,12 @@ class JaxLoader:
         FROM each pass's first delivered batch — the spin-up wait
         (reader/decoder startup) is pipeline latency, not contention, and
         counting it would misattribute compute-bound pipelines as
-        input-bound. Returns
+        input-bound. The baseline re-snapshots at every pass's first
+        delivery, so the report covers the CURRENT pass only — earlier
+        passes' steady-state waits are discarded with their spin-up, which
+        is the right scope for tuning (the current pass reflects the
+        current settings) but means the report is not a whole-run
+        accumulator. Returns
         ``{'bottleneck': 'input'|'compute'|'balanced'|'undetermined',
         'input_stall_fraction': float, 'advice': [str, ...], ...}`` —
         advisory only; nothing is changed."""

@@ -94,12 +94,20 @@ def _build_extension(name):
                            capture_output=True, timeout=120)
 
 
+def native_disabled():
+    """True when the ``PETASTORM_TPU_NATIVE`` kill-switch is off — the ONE
+    owner of the token parse (callers that need to know why native is
+    inactive, e.g. the benchmark's on/off comparison, must use this rather
+    than re-parsing the env var and drifting)."""
+    return os.environ.get('PETASTORM_TPU_NATIVE', '1').lower() in (
+        '0', 'false', 'off')
+
+
 def _get_extension(name):
     # Live kill-switch, checked per call (not cached): lets a benchmark or
     # an operator A/B the Python fallback against the native path in one
     # process, and disables a misbehaving native build without a rebuild.
-    if os.environ.get('PETASTORM_TPU_NATIVE', '1').lower() in ('0', 'false',
-                                                               'off'):
+    if native_disabled():
         return None
     if name in _loaded:
         return _loaded[name]

@@ -109,6 +109,76 @@ def test_model_only_checkpoint_leaves_loader_fresh(tmp_path, scalar_dataset):
     assert rows == 100
 
 
+def test_model_only_fallback_survives_any_orbax_exception_type(
+        tmp_path, scalar_dataset):
+    """ADVICE r2 #3 / VERDICT r3 #6: orbax does not contract the exception
+    type for a missing composite item — a version that raises ValueError
+    (with an inventory probe that is ALSO unsupported) must still hit the
+    documented "data position starts fresh" fallback, not crash."""
+    with TrainCheckpointer(str(tmp_path / 'ckpt')) as ckpt:
+        ckpt.save(2, _state())  # no loader state in the checkpoint
+    with make_jax_loader(scalar_dataset.url, batch_size=20, fields=['^id$'],
+                         num_epochs=1, last_batch='short') as loader:
+        with TrainCheckpointer(str(tmp_path / 'ckpt')) as ckpt:
+            manager = ckpt._manager
+
+            class _FutureOrbaxManager:
+                """latest_step/close pass through; the probe is unsupported
+                and a loader-state restore raises ValueError."""
+
+                def latest_step(self):
+                    return manager.latest_step()
+
+                def item_metadata(self, step):
+                    raise NotImplementedError('no item inventory')
+
+                def restore(self, step, args=None):
+                    raise ValueError(
+                        'Item loader_state was not found in the checkpoint')
+
+                def close(self):
+                    manager.close()
+
+            ckpt._manager = _FutureOrbaxManager()
+            assert ckpt.restore_loader(loader) == 2  # fresh data, no crash
+        rows = sum(len(np.asarray(b['id'])) for b in loader)
+    assert rows == 100
+
+
+def test_confirmed_present_loader_state_restore_failure_raises(
+        tmp_path, scalar_dataset):
+    """When the checkpoint inventory POSITIVELY lists loader state, a
+    failing restore is corruption — it must surface, not be silently
+    swallowed into a fresh data position."""
+    with make_jax_loader(scalar_dataset.url, batch_size=10, fields=['^id$'],
+                         num_epochs=1, last_batch='short') as loader:
+        with TrainCheckpointer(str(tmp_path / 'ckpt')) as ckpt:
+            next(iter(loader))
+            ckpt.save(1, _state(), loader)
+
+    with make_jax_loader(scalar_dataset.url, batch_size=10, fields=['^id$'],
+                         num_epochs=1, last_batch='short') as loader:
+        with TrainCheckpointer(str(tmp_path / 'ckpt')) as ckpt:
+            manager = ckpt._manager
+
+            class _CorruptRestoreManager:
+                def latest_step(self):
+                    return manager.latest_step()
+
+                def item_metadata(self, step):
+                    return manager.item_metadata(step)  # lists loader_state
+
+                def restore(self, step, args=None):
+                    raise ValueError('corrupt loader_state payload')
+
+                def close(self):
+                    manager.close()
+
+            ckpt._manager = _CorruptRestoreManager()
+            with pytest.raises(ValueError, match='corrupt'):
+                ckpt.restore_loader(loader)
+
+
 def test_resume_math_treats_absent_epoch_as_incomplete(scalar_dataset):
     # delivery-order records can contain epoch 1 while epoch 0 still has
     # undelivered row-groups (shuffle buffer pipelining across the epoch
