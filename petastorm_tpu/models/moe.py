@@ -94,9 +94,14 @@ def moe_forward(params, x, config, capacity=None):
                         params['router'].astype(jnp.float32))
     probs = jax.nn.softmax(logits, axis=-1)                     # (T, E)
     expert_idx = jnp.argmax(probs, axis=-1)                     # (T,)
-    gate = jnp.take_along_axis(probs, expert_idx[:, None], axis=1)[:, 0]
-
     onehot = jax.nn.one_hot(expert_idx, c.n_experts, dtype=jnp.float32)
+    # gate = argmax prob, via the one-hot contraction rather than a
+    # take_along_axis GATHER: under a dp×pp×ep mesh (pipe manual via
+    # shard_map, data+expert auto) XLA's SPMD partitioner CHECK-crashes
+    # partitioning that gather (spmd_partitioner_util.cc:495, observed on
+    # XLA:CPU); the contraction is also the MXU-friendly form — no
+    # data-dependent addressing anywhere in the routing path.
+    gate = jnp.sum(probs * onehot, axis=-1)                     # (T,)
     # position of each token within its expert's queue (0-based). Integer
     # cumsum: an f32 running count loses exactness past 2^24 tokens per
     # expert (pod-scale batches), silently merging capacity slots.

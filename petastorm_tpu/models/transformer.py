@@ -503,13 +503,12 @@ def init_pipelined_transformer_params(rng, config, mesh, pipe_axis=None):
     a leading ``(n_stages, layers_per_stage)`` axis pair sharded over
     ``pipe_axis``, composing with tensor-parallel splits over ``'model'``,
     expert parallelism over the config's ``expert_axis`` (MoE configs),
-    and data parallelism over ``'data'`` on the same mesh — dp×pp×tp or
-    pp×ep in one jitted step.
-
-    .. warning:: the VALIDATED MoE compositions are pp×ep and dp×pp
-       (experts replicated). A mesh naming data + pipe + expert together
-       CHECK-crashes XLA:CPU's SPMD partitioner (compiler bug — see
-       docs/troubleshoot.md) and is unvalidated on TPU hardware.
+    and data parallelism over ``'data'`` on the same mesh — dp×pp×tp,
+    pp×ep, or the full dp×pp×ep in one jitted step. (dp×pp×ep used to
+    CHECK-crash XLA's SPMD partitioner on the router's take_along_axis
+    gather; routing is gather-free now — ``models/moe.py`` — and the
+    composition is validated against the layered oracle in
+    ``dryrun_multichip``.)
 
     Requires ``config.n_layers % mesh.shape[pipe_axis] == 0``.
     Seq-parallel composition (pp×sp): DENSE configs with ``seq_axis`` set
