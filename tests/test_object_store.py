@@ -1,0 +1,153 @@
+"""Object-store scheme coverage: ``gs://`` and ``s3://`` end to end with no
+network (VERDICT r3 #7 — de-risking the GCS north star).
+
+The real backends (gcsfs / s3fs) cannot be exercised in this environment
+(zero egress), so each protocol is bound to an in-memory fsspec
+implementation for the duration of a test: everything above the fsspec
+boundary — URL parsing, scheme dispatch, bucket-in-path semantics,
+``storage_options`` plumbing, footer metadata, the batch reader and the JAX
+device stage — runs exactly the code a real ``gs://`` dataset would run;
+only the bytes transport is faked. Reference scheme dispatch:
+``petastorm/fs_utils.py:39-166``.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import fsspec
+from fsspec.implementations.memory import MemoryFileSystem
+
+from petastorm_tpu import make_batch_reader, make_reader
+from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+from petastorm_tpu.etl.dataset_metadata import (
+    count_rows, get_schema_from_dataset_url, write_dataset,
+)
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+SmallSchema = Unischema('SmallSchema', [
+    UnischemaField('id', np.int64, (), ScalarCodec(pa.int64()), False),
+    UnischemaField('vec', np.float32, (4,), NdarrayCodec(), False),
+])
+
+
+def _rows(n):
+    rng = np.random.RandomState(0)
+    return [{'id': i, 'vec': rng.rand(4).astype(np.float32)}
+            for i in range(n)]
+
+
+def _fake_object_store_class(proto):
+    """A MemoryFileSystem bound to ``proto`` with its own store and a
+    record of the ``storage_options`` it was constructed with."""
+
+    class _FakeObjectStore(MemoryFileSystem):
+        protocol = proto
+        store = {}
+        pseudo_dirs = ['']
+        captured_options = []
+        cachable = False  # fresh instance per url_to_fs: options always seen
+
+        def __init__(self, **storage_options):
+            type(self).captured_options.append(dict(storage_options))
+            super().__init__()
+
+        @classmethod
+        def _strip_protocol(cls, path):
+            path = str(path)
+            if path.startswith(cls.protocol + '://'):
+                path = path[len(cls.protocol) + 3:]
+            return '/' + path.lstrip('/')
+
+    return _FakeObjectStore
+
+
+@pytest.fixture(params=['gs', 's3'])
+def object_store(request):
+    """Bind the param protocol to a fresh fake store; restore after."""
+    proto = request.param
+    try:
+        original = fsspec.get_filesystem_class(proto)
+    except (ImportError, ValueError):
+        original = None
+    cls = _fake_object_store_class(proto)
+    fsspec.register_implementation(proto, cls, clobber=True)
+    try:
+        yield proto, cls
+    finally:
+        cls.store.clear()
+        if original is not None:
+            fsspec.register_implementation(proto, original, clobber=True)
+        else:
+            # no real backend installed (e.g. s3fs absent here): drop the
+            # fake binding entirely so later tests get the original
+            # missing-backend ImportError, not a silent empty store
+            from fsspec.registry import _registry
+            _registry.pop(proto, None)
+
+
+def test_write_read_round_trip(object_store):
+    proto, cls = object_store
+    url = proto + '://bucket/datasets/small'
+    write_dataset(url, SmallSchema, _rows(30), rowgroup_size_rows=10,
+                  num_files=2)
+    # footer metadata resolves over the scheme
+    assert set(get_schema_from_dataset_url(url).fields) == {'id', 'vec'}
+    assert count_rows(url) == 30
+    with make_batch_reader(url, num_epochs=1) as reader:
+        got = sorted(i for b in reader for i in b.id.tolist())
+    assert got == list(range(30))
+
+
+def test_row_reader_and_codec_decode(object_store):
+    proto, cls = object_store
+    url = proto + '://bucket/rowds'
+    rows = _rows(12)
+    write_dataset(url, SmallSchema, rows, rowgroup_size_rows=4)
+    with make_reader(url, num_epochs=1) as reader:
+        by_id = {row.id: row.vec for row in reader}
+    assert len(by_id) == 12
+    np.testing.assert_array_almost_equal(by_id[3], rows[3]['vec'])
+
+
+def test_url_list_reads_file_subset(object_store):
+    proto, cls = object_store
+    url = proto + '://bucket/listed'
+    write_dataset(url, SmallSchema, _rows(40), rowgroup_size_rows=10,
+                  num_files=4)
+    fs = fsspec.filesystem(proto)
+    parts = sorted(p.lstrip('/')
+                   for p in fs.ls('/bucket/listed', detail=False)
+                   if p.endswith('.parquet'))
+    assert len(parts) == 4
+    urls = ['%s://%s' % (proto, p) for p in parts[:2]]
+    with make_batch_reader(urls, num_epochs=1) as reader:
+        got = sorted(i for b in reader for i in b.id.tolist())
+    assert len(got) == 20  # exactly the two listed files' rows
+
+
+def test_storage_options_reach_the_filesystem(object_store):
+    proto, cls = object_store
+    url = proto + '://bucket/opts'
+    token = {'token': 'fake-%s-credential' % proto}
+    write_dataset(url, SmallSchema, _rows(8), rowgroup_size_rows=4,
+                  storage_options=token)
+    cls.captured_options.clear()
+    with make_batch_reader(url, num_epochs=1,
+                           storage_options=token) as reader:
+        rows = sum(len(b.id) for b in reader)
+    assert rows == 8
+    assert any(opts.get('token') == token['token']
+               for opts in cls.captured_options), cls.captured_options
+
+
+def test_jax_loader_over_object_store(object_store):
+    proto, cls = object_store
+    from petastorm_tpu.jax import make_jax_loader
+    url = proto + '://bucket/jaxds'
+    write_dataset(url, SmallSchema, _rows(32), rowgroup_size_rows=8)
+    with make_jax_loader(url, batch_size=8, num_epochs=1,
+                         last_batch='short') as loader:
+        batches = list(loader)
+    assert sum(b['id'].shape[0] for b in batches) == 32
+    assert str(batches[0]['vec'].dtype) == 'float32'

@@ -1,0 +1,39 @@
+"""AutoGraph compatibility of the tf.data bridge (reference:
+``petastorm/tests/test_tf_autograph.py``): iterating a
+``make_petastorm_dataset`` inside a ``@tf.function`` is the
+autograph-traced consumption path (a real TF training loop), and the
+generator-backed dataset must neither fail the transform nor change
+results."""
+
+import logging
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.reader import make_batch_reader
+
+tf = pytest.importorskip('tensorflow')
+
+from petastorm_tpu.tf_utils import make_petastorm_dataset  # noqa: E402
+
+
+def test_dataset_iterated_inside_tf_function(scalar_dataset, caplog):
+    @tf.function
+    def consume(ds):
+        total = tf.zeros((), tf.int64)
+        count = tf.zeros((), tf.int64)
+        for batch in ds:  # autograph rewrites this loop into tf.while_loop
+            total += tf.reduce_sum(tf.cast(batch.id, tf.int64))
+            count += tf.cast(tf.shape(batch.id)[0], tf.int64)
+        return total, count
+
+    caplog.clear()
+    with caplog.at_level(logging.WARNING, logger='tensorflow'):
+        with make_batch_reader(scalar_dataset.url, num_epochs=1,
+                               schema_fields=['^id$']) as reader:
+            dataset = make_petastorm_dataset(reader)
+            total, count = consume(dataset)
+    assert int(count) == 100
+    assert int(total) == sum(row['id'] for row in scalar_dataset.data)
+    messages = ' '.join(r.getMessage() for r in caplog.records)
+    assert 'AutoGraph could not transform' not in messages, messages
