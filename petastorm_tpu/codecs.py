@@ -128,6 +128,19 @@ class CompressedImageCodec(DataframeColumnCodec):
     Byte-compatible with the reference codec (``petastorm/codecs.py:58-130``):
     images are RGB at the API boundary and channel-swapped to OpenCV's BGR for
     encode/decode of 3-channel images.
+
+    .. note:: **jpeg decode determinism.** ``decode_batch`` prefers the
+       first-party native decoder, whose DEFAULT uses merged (non-fancy)
+       chroma upsampling for throughput (~1.6x); per-cell ``decode`` and
+       any fallback rows go through cv2, which always uses fancy
+       upsampling. The two differ by small chroma-interpolation deltas
+       (quality vs source within 0.2 dB PSNR), so in the default mode
+       decoded pixels can vary with the path taken — across hosts (native
+       build present or not) and across rows of one batch (oddball-cell
+       fallback). Pipelines that need bit-identical decode everywhere
+       should set env ``PETASTORM_TPU_JPEG_FANCY=1``, which makes the
+       native path bit-identical to cv2. png decode is lossless and
+       path-independent either way.
     """
 
     def __init__(self, image_codec='png', quality=80):
@@ -304,9 +317,13 @@ class CompressedImageCodec(DataframeColumnCodec):
         ``out`` is fully populated.
 
         One C call decodes the whole batch RGB-direct into ``out`` with the
-        GIL released — bit-identical to the cv2 path (jpeg: both are
-        libjpeg-turbo at default settings; png: PNG stores RGB natively)
-        but without per-cell Python dispatch or Mat allocation. On hosts
+        GIL released, without per-cell Python dispatch or Mat allocation.
+        png is bit-identical to the cv2 path (PNG stores RGB natively).
+        jpeg defaults to merged (non-fancy) chroma upsampling — ~1.6x the
+        decode rate, chroma-interpolation differences only, quality vs the
+        source image within 0.2 dB PSNR of the fancy path; set env
+        ``PETASTORM_TPU_JPEG_FANCY=1`` for bit-identical-to-cv2 output
+        (both ride libjpeg-turbo; see ``native/jpeg_batch.c``). On hosts
         with real parallelism the batch is chunked across the shared
         decode pool instead, each chunk one native call. Cells the native
         loop rejects (not a 3-component 8-bit image of the declared shape)

@@ -2,13 +2,20 @@
  *
  * decode_jpeg_batch(cells, out): decode each JPEG cell straight into row i
  * of a preallocated (N, H, W, 3) uint8 batch with libjpeg(-turbo), RGB
- * output, default (ISLOW + fancy upsampling) settings — bit-identical to
- * OpenCV's imdecode on the same bytes, since both ride libjpeg-turbo with
- * the same knobs. The whole loop runs with the GIL RELEASED in one native
- * call: no per-cell Python dispatch, no thread-pool task churn, no
- * intermediate Mat/ndarray per cell — on a low-core host this beats the
- * threaded cv2 fan-out (measured ~7% faster per decode than
- * cv2.imdecode(IMREAD_COLOR_RGB) plus the per-cell overhead it removes).
+ * output, ISLOW DCT (turbo's SIMD path). The whole loop runs with the GIL
+ * RELEASED in one native call: no per-cell Python dispatch, no thread-pool
+ * task churn, no intermediate Mat/ndarray per cell.
+ *
+ * Upsampling policy: by DEFAULT fancy (triangle-filter) chroma upsampling
+ * is DISABLED, which selects turbo's merged upsampling fast path for
+ * 4:2:0/4:2:2 jpegs — measured ~1.6x the decode rate of the fancy path on
+ * 224x224 q90 4:2:0 images (2540 vs 1576 img/s/core on this host, vs
+ * cv2's 2022) at a small chroma-interpolation quality cost that is
+ * irrelevant to ML input pipelines (tf.data commonly goes further and
+ * drops to IFAST DCT). Set PETASTORM_TPU_JPEG_FANCY=1 to restore libjpeg
+ * defaults, which are bit-identical to OpenCV's imdecode on the same
+ * bytes (both ride libjpeg-turbo) — the mode the bit-exactness tests pin.
+ * 4:4:4 jpegs have no upsampling step and decode identically either way.
  *
  * Returns the count of successfully decoded leading cells; a cell that is
  * not an 8-bit 3-component JPEG of exactly the declared (H, W) stops the
@@ -26,6 +33,7 @@
 #include <setjmp.h>
 #include <stddef.h>
 #include <stdio.h>
+#include <string.h>
 #include <jpeglib.h>
 
 struct pt_jpeg_error_mgr {
@@ -64,7 +72,7 @@ pt_emit_message(j_common_ptr cinfo, int msg_level)
 static int
 decode_one(struct jpeg_decompress_struct *cinfo, const unsigned char *buf,
            size_t len, unsigned char *dst, int height, int width,
-           JSAMPROW *rows)
+           JSAMPROW *rows, boolean fancy_upsampling)
 {
     size_t stride = (size_t)width * 3;
     int r;
@@ -80,6 +88,9 @@ decode_one(struct jpeg_decompress_struct *cinfo, const unsigned char *buf,
         return -1;
     }
     cinfo->out_color_space = JCS_RGB;
+    /* FALSE selects merged chroma upsampling (the fast path); see the
+     * module comment for the policy and the env escape hatch */
+    cinfo->do_fancy_upsampling = fancy_upsampling;
     jpeg_start_decompress(cinfo);
     if ((int)cinfo->output_height != height
         || (int)cinfo->output_width != width
@@ -169,6 +180,11 @@ decode_jpeg_batch(PyObject *self, PyObject *args)
         if (rows != NULL) {
             struct jpeg_decompress_struct cinfo;
             struct pt_jpeg_error_mgr jerr;
+            /* value-parsed, not presence-tested: FANCY=0 / FANCY= must
+             * keep the fast default (docs say "set ...=1") */
+            const char *fancy_env = getenv("PETASTORM_TPU_JPEG_FANCY");
+            boolean fancy = (fancy_env != NULL && fancy_env[0] != '\0'
+                             && strcmp(fancy_env, "0") != 0) ? TRUE : FALSE;
             /* mutated between setjmp and a possible longjmp: must be
              * volatile or its post-longjmp value is indeterminate */
             volatile Py_ssize_t done_v = 0;
@@ -184,7 +200,7 @@ decode_jpeg_batch(PyObject *self, PyObject *args)
                                    (const unsigned char *)views[i].buf,
                                    (size_t)views[i].len,
                                    out_base + (size_t)i * row_bytes,
-                                   height, width, rows) != 0)
+                                   height, width, rows, fancy) != 0)
                         break;
                     done_v = done_v + 1;
                 }

@@ -159,8 +159,13 @@ def _jpeg_cells(n, h=48, w=64, seed=0, quality=90):
 
 
 class TestNativeJpegDecoder:
-    def test_bit_exact_with_cv2(self, jpeg_native):
+    def test_bit_exact_with_cv2_under_fancy_env(self, jpeg_native,
+                                                monkeypatch):
+        """PETASTORM_TPU_JPEG_FANCY=1 restores libjpeg defaults, which are
+        bit-identical to cv2's decode of the same bytes (both ride
+        libjpeg-turbo) — the strict-compat escape hatch."""
         import cv2
+        monkeypatch.setenv('PETASTORM_TPU_JPEG_FANCY', '1')
         cells, _ = _jpeg_cells(6)
         out = np.empty((6, 48, 64, 3), np.uint8)
         assert jpeg_native.decode_jpeg_batch(cells, out) == 6
@@ -168,6 +173,21 @@ class TestNativeJpegDecoder:
             ref = cv2.imdecode(np.frombuffer(cell, np.uint8),
                                cv2.IMREAD_COLOR_RGB)
             np.testing.assert_array_equal(out[i], ref)
+
+    def test_default_fast_path_close_to_cv2(self, jpeg_native, monkeypatch):
+        """The default (merged-upsampling) decode differs from cv2 only in
+        chroma interpolation: small mean deviation, never the luma-scale
+        corruption a wrong-stride/wrong-colorspace bug would produce."""
+        import cv2
+        monkeypatch.delenv('PETASTORM_TPU_JPEG_FANCY', raising=False)
+        cells, _ = _jpeg_cells(6)
+        out = np.empty((6, 48, 64, 3), np.uint8)
+        assert jpeg_native.decode_jpeg_batch(cells, out) == 6
+        refs = np.stack([cv2.imdecode(np.frombuffer(c, np.uint8),
+                                      cv2.IMREAD_COLOR_RGB) for c in cells])
+        diff = np.abs(out.astype(int) - refs.astype(int))
+        assert diff.mean() < 8.0, diff.mean()
+        assert np.percentile(diff, 99) < 48, np.percentile(diff, 99)
 
     def test_corrupt_cell_stops_prefix(self, jpeg_native):
         cells, _ = _jpeg_cells(5)
@@ -205,8 +225,9 @@ class TestNativeJpegDecoder:
 
 
 class TestJpegCodecIntegration:
-    def test_codec_batch_bit_exact_with_per_cell(self):
+    def test_codec_batch_bit_exact_with_per_cell(self, monkeypatch):
         from petastorm_tpu.codecs import CompressedImageCodec
+        monkeypatch.setenv('PETASTORM_TPU_JPEG_FANCY', '1')  # strict mode
         codec = CompressedImageCodec('jpeg', quality=92)
         field = UnischemaField('im', np.uint8, (48, 64, 3), codec, False)
         cells = [codec.encode(field, img)
@@ -234,11 +255,13 @@ class TestJpegCodecIntegration:
         assert decoded[2].shape == (48, 64)
         assert decoded[0].shape == (48, 64, 3)
 
-    def test_mid_batch_png_cell_keeps_native_tail(self):
+    def test_mid_batch_png_cell_keeps_native_tail(self, monkeypatch):
         # a PNG cell in a jpeg-codec batch: native rejects it, cv2 decodes
         # it into its row, and the native loop RE-ENTERS for the tail (the
-        # dense array comes back fully populated, not a list)
+        # dense array comes back fully populated, not a list). Strict mode
+        # so the jpeg rows compare exactly against per-cell decode.
         import cv2
+        monkeypatch.setenv('PETASTORM_TPU_JPEG_FANCY', '1')
         from petastorm_tpu.codecs import CompressedImageCodec
         codec = CompressedImageCodec('jpeg')
         field = UnischemaField('im', np.uint8, (48, 64, 3), codec, False)
