@@ -72,13 +72,22 @@ def expert_capacity(n_tokens, n_experts, capacity_factor):
     return max(1, int(np.ceil(n_tokens / n_experts * capacity_factor)))
 
 
-def moe_forward(params, x, config, capacity=None):
+def moe_forward(params, x, config, capacity=None, seq_axis=None):
     """Apply the MoE layer.
 
     :param x: (..., d_model) activations; leading axes are flattened into a
         token axis for routing and restored on return.
     :param capacity: per-expert token slots (default from
         :func:`expert_capacity`). Must be static under jit.
+    :param seq_axis: name of a mesh axis this call is ALREADY MANUAL over
+        (shard_map) with the token/sequence dim sharded across it — the
+        pp×sp×ep pipeline. Routing and the capacity budget are then LOCAL
+        to each shard's tokens (the standard sharded-MoE estimator: under
+        ample capacity identical to global routing, and drops partition
+        per-shard otherwise), while the aux statistics are psum-averaged
+        over the axis so the load-balancing loss equals the full-sequence
+        statistic exactly (equal-size shards). Leave None under auto
+        sharding — XLA already computes global semantics there.
     :return: (y, aux_loss) — y shaped like ``x``; aux_loss the scalar f32
         Switch load-balancing loss.
     """
@@ -115,6 +124,13 @@ def moe_forward(params, x, config, capacity=None):
     # Computed BEFORE the capacity drop — it penalizes the router's intent.
     fraction = onehot.mean(axis=0)
     mean_prob = probs.mean(axis=0)
+    if seq_axis is not None:
+        # manual seq sharding: average the per-shard statistics BEFORE the
+        # nonlinear product, so the loss is the exact full-sequence value,
+        # not a mean of per-shard losses
+        n_shards = jax.lax.psum(1, seq_axis)
+        fraction = jax.lax.psum(fraction, seq_axis) / n_shards
+        mean_prob = jax.lax.psum(mean_prob, seq_axis) / n_shards
     aux_loss = c.n_experts * jnp.sum(fraction * mean_prob)
 
     # --- dense dispatch/combine (GShard): (T, E, C) one-hots

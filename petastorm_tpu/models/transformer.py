@@ -286,14 +286,20 @@ def _block_forward(block, x, config, mesh=None, seq_manual=False,
     return _block_dense_ffn_half(block, x, config, seq_manual=seq_manual)
 
 
-def _block_moe_half(block, x, config, seq=None):
+def _block_moe_half(block, x, config, seq=None, seq_manual=False):
     """MoE FFN sublayer (RMSNorm → Switch MoE → constrained residual) —
     shared by the layered forward and the pipeline stage executor.
-    Returns ``(x, aux)``."""
+    ``seq_manual``: inside a shard_map manual over ``config.seq_axis``
+    (pp×sp×ep) — routing goes local-per-shard with psum'd aux statistics
+    (see :func:`petastorm_tpu.models.moe.moe_forward`), and the sharding
+    constraint (a manual axis) is skipped. Returns ``(x, aux)``."""
     from petastorm_tpu.models.moe import moe_forward
     h = _rmsnorm(x, block['ln2'])
-    ffn_out, aux = moe_forward(block['moe'], h, config.moe_config())
-    return _constrain(x + ffn_out.astype(config.dtype), seq), aux
+    ffn_out, aux = moe_forward(block['moe'], h, config.moe_config(),
+                               seq_axis=config.seq_axis if seq_manual
+                               else None)
+    return _constrain(x + ffn_out.astype(config.dtype),
+                      None if seq_manual else seq), aux
 
 
 def transformer_forward_with_aux(params, tokens, config, mesh=None):
@@ -511,22 +517,18 @@ def init_pipelined_transformer_params(rng, config, mesh, pipe_axis=None):
     ``dryrun_multichip``.)
 
     Requires ``config.n_layers % mesh.shape[pipe_axis] == 0``.
-    Seq-parallel composition (pp×sp): DENSE configs with ``seq_axis`` set
-    pipeline with the sequence sharded over that axis — the pipeline
-    shard_map goes manual over both axes and attention runs the
-    ring/Ulysses per-device body (``ops/ring_attention.py:48``,
-    ``ops/ulysses_attention.py:33``) inside each stage. MoE does not
-    compose with seq sharding (the Switch router's capacity partition is
-    per full sequence).
+    Seq-parallel composition (pp×sp, and pp×sp×ep for MoE configs):
+    configs with ``seq_axis`` set pipeline with the sequence sharded over
+    that axis — the pipeline shard_map goes manual over both axes,
+    attention runs the ring/Ulysses per-device body
+    (``ops/ring_attention.py:48``, ``ops/ulysses_attention.py:33``)
+    inside each stage, and Switch routing goes local-per-shard with
+    exact psum'd aux statistics (``moe_forward(seq_axis=...)``).
     """
     from petastorm_tpu.parallel.mesh import PIPE_AXIS
     if pipe_axis is None:
         pipe_axis = PIPE_AXIS
     c = config
-    if c.seq_axis is not None and c.n_experts > 0:
-        raise NotImplementedError('pipelined transformer composes '
-                                  'dp×pp×tp, dp×pp×ep and pp×sp; '
-                                  'seq-parallel MoE is not supported')
     n_stages = mesh.shape[pipe_axis]
     if c.n_layers % n_stages:
         raise ValueError('n_layers=%d not divisible into %d pipeline stages'
@@ -600,8 +602,10 @@ def _pipelined_features_with_aux(params, tokens, config, mesh,
             block = jax.tree_util.tree_map(lambda leaf: leaf[layer],
                                            stage_params)
             if moe:
-                x = _block_attention_half(block, x, c)
-                x, aux = _block_moe_half(block, x, c)
+                x = _block_attention_half(block, x, c,
+                                          seq_manual=seq is not None)
+                x, aux = _block_moe_half(block, x, c,
+                                         seq_manual=seq is not None)
                 aux_total = aux_total + aux
             else:
                 x = _block_forward(block, x, c, seq_manual=seq is not None)
@@ -611,7 +615,7 @@ def _pipelined_features_with_aux(params, tokens, config, mesh,
         x, aux = pipeline_apply(stage_fn, params['stages'], x, mesh,
                                 axis_name=pipe_axis,
                                 n_microbatches=n_microbatches,
-                                with_aux=True)
+                                with_aux=True, seq_axis=seq)
     else:
         x = pipeline_apply(stage_fn, params['stages'], x, mesh,
                            axis_name=pipe_axis,

@@ -148,7 +148,10 @@ def pipeline_apply(stage_fn, stage_params, x, mesh, axis_name=PIPE_AXIS,
         sequence blocks and must run collective attention over
         ``seq_axis`` itself (e.g. the ring/Ulysses per-device bodies);
         position-wise ops need no change. Requires ``x.shape[1]``
-        divisible by the axis size. Not composed with ``with_aux``.
+        divisible by the axis size. Composes with ``with_aux``
+        (pp×sp×ep): the stage's aux must come back seq-INVARIANT — psum
+        its per-shard statistics over ``seq_axis`` itself, as
+        ``moe_forward(seq_axis=...)`` does.
     :return: (batch, ...) output, replicated over the pipe axis — equal to
         sequentially applying the stages; plus the aux scalar when
         ``with_aux``.
@@ -164,9 +167,6 @@ def pipeline_apply(stage_fn, stage_params, x, mesh, axis_name=PIPE_AXIS,
     axis_names = {axis_name}
     x_spec = P()
     if seq_axis is not None:
-        if with_aux:
-            raise NotImplementedError('seq_axis does not compose with '
-                                      'with_aux (pp×sp is dense-only)')
         n_seq = mesh.shape[seq_axis]
         if x.ndim < 2 or x.shape[1] % n_seq:
             raise ValueError('x dim 1 (%s) not divisible over %d seq '
@@ -193,7 +193,10 @@ def pipeline_apply(stage_fn, stage_params, x, mesh, axis_name=PIPE_AXIS,
     # as usual — this is what lets pp compose with the other axes in ONE
     # jitted step.
     from jax import shard_map
-    out_specs = (P(), P()) if with_aux else x_spec
+    # the aux scalar leaves replicated over EVERY manual axis: psum'd over
+    # pipe in _pipeline_local, and (for pp×sp×ep) made seq-invariant by
+    # the stage's own psum of its routing statistics over seq_axis
+    out_specs = (x_spec, P()) if with_aux else x_spec
     fn = shard_map(body, mesh=mesh, in_specs=(param_specs, x_spec),
                    out_specs=out_specs, axis_names=axis_names,
                    check_vma=True)

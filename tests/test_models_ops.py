@@ -619,6 +619,45 @@ class TestSequenceParallelTransformer:
         assert np.isfinite(float(loss))
         assert float(loss) < first
 
+    def test_layered_seq_parallel_moe_matches_unsharded(self):
+        """Layered sp×ep / dp×sp×ep: the MoE forward under auto sharding
+        with a seq-sharded sequence must equal the unsharded oracle —
+        routing semantics are global (XLA partitions the dispatch; the
+        gather-free router keeps the partitioner happy)."""
+        import dataclasses
+
+        from jax.sharding import NamedSharding, PartitionSpec
+        from petastorm_tpu.models.transformer import (
+            init_transformer_params, transformer_forward_with_aux,
+        )
+        from petastorm_tpu.parallel.mesh import make_named_mesh
+        for axes in ({'seq': 4, 'expert': 2},
+                     {'data': 2, 'seq': 2, 'expert': 2}):
+            mesh = make_named_mesh(dict(axes))
+            config = self._config(seq_axis='seq', n_heads=4, n_experts=4,
+                                  capacity_factor=8.0)
+            with mesh:
+                params = init_transformer_params(jax.random.PRNGKey(0),
+                                                 config, mesh=mesh)
+                tokens = jax.device_put(
+                    jnp.asarray(np.random.RandomState(1)
+                                .randint(0, 32, (4, 16), np.int32)),
+                    NamedSharding(mesh, PartitionSpec(
+                        'data' if 'data' in axes else None, None)))
+                logits, aux = jax.jit(
+                    lambda p, t: transformer_forward_with_aux(
+                        p, t, config, mesh))(params, tokens)
+            host = jax.tree_util.tree_map(
+                lambda leaf: jnp.asarray(np.asarray(leaf)), params)
+            want, want_aux = transformer_forward_with_aux(
+                host, jnp.asarray(np.asarray(tokens)),
+                dataclasses.replace(config, seq_axis=None))
+            np.testing.assert_allclose(np.asarray(logits),
+                                       np.asarray(want),
+                                       atol=2e-4, rtol=2e-4)
+            np.testing.assert_allclose(float(aux), float(want_aux),
+                                       rtol=1e-4)
+
     def test_invalid_seq_impl_rejected_at_construction(self):
         # a typo'd strategy must fail at config time, even when seq_axis
         # is unset (it would otherwise silently train dense)

@@ -275,14 +275,67 @@ def test_seq_parallel_pipelined_train_step_matches_oracle(seq_impl):
                                atol=2e-4, rtol=2e-4)
 
 
-def test_seq_parallel_moe_pipelining_rejected():
-    # the Switch router's capacity partition is per full sequence; sp×ep
-    # does not compose
-    mesh = make_named_mesh({'pipe': 2, 'seq': 4})
-    config = _config(n_layers=2, seq_axis='seq', n_experts=4)
-    with pytest.raises(NotImplementedError, match='seq-parallel MoE'):
-        init_pipelined_transformer_params(jax.random.PRNGKey(0), config,
-                                          mesh)
+def test_seq_parallel_moe_pipelined_matches_layered():
+    """pp×sp×ep: Switch routing goes local-per-seq-shard (exact under
+    ample capacity) and the aux statistics psum over the seq axis, so at
+    one microbatch BOTH logits and aux equal the layered full-sequence
+    oracle exactly."""
+    from petastorm_tpu.models.transformer import (
+        pipelined_transformer_forward_with_aux, transformer_forward_with_aux,
+    )
+    import dataclasses
+    mesh = make_named_mesh({'pipe': 2, 'seq': 2, 'expert': 2})
+    config = _config(n_layers=4, seq_axis='seq', n_experts=4,
+                     capacity_factor=8.0)
+    with mesh:
+        pipelined = init_pipelined_transformer_params(jax.random.PRNGKey(0),
+                                                      config, mesh)
+        tokens = jnp.asarray(np.random.RandomState(0)
+                             .randint(0, 32, (4, 8), np.int32))
+        logits, aux = jax.jit(
+            lambda p, t: pipelined_transformer_forward_with_aux(
+                p, t, config, mesh, n_microbatches=1))(pipelined, tokens)
+    layered = _restack_as_layered(config, pipelined)
+    want_logits, want_aux = transformer_forward_with_aux(
+        _as_jnp(layered), tokens,
+        dataclasses.replace(config, seq_axis=None))
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(want_logits),
+                               atol=2e-4, rtol=2e-4)
+    np.testing.assert_allclose(float(aux), float(want_aux), rtol=1e-5)
+
+
+def test_seq_parallel_moe_pipelined_train_step_matches_oracle():
+    # gradients flow through ppermute (pipe), the ring-attention seq
+    # collectives AND the psum of the routing statistics over 'seq': at
+    # one microbatch with ample capacity, loss and updated params must
+    # equal the sequential layered model's (a mis-scaled cotangent
+    # through the aux psum would show here, not just as a finite loss)
+    import dataclasses
+    from petastorm_tpu.models.transformer import transformer_train_step
+    mesh = make_named_mesh({'pipe': 2, 'seq': 2, 'expert': 2})
+    config = _config(n_layers=2, seq_axis='seq', n_experts=4,
+                     capacity_factor=8.0)
+    optimizer = optax.adamw(1e-3)
+    with mesh:
+        pipelined = init_pipelined_transformer_params(jax.random.PRNGKey(1),
+                                                      config, mesh)
+        step = pipelined_transformer_train_step(config, optimizer, mesh,
+                                                n_microbatches=1)
+        # post-shift seq = 8, divisible by the 2-way seq axis
+        tokens = jnp.asarray(np.random.RandomState(2)
+                             .randint(0, 32, (4, 9), np.int32))
+        p2, _, loss = step(pipelined, optimizer.init(pipelined), tokens)
+    layered = _as_jnp(_restack_as_layered(config, pipelined))
+    oracle_cfg = dataclasses.replace(config, seq_axis=None)
+    oracle_step = transformer_train_step(oracle_cfg, optimizer)
+    lp2, _, want_loss = oracle_step(layered, optimizer.init(layered), tokens)
+    np.testing.assert_allclose(float(loss), float(want_loss), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(p2['lm_head']),
+                               np.asarray(lp2['lm_head']),
+                               atol=2e-4, rtol=2e-4)
+    np.testing.assert_allclose(np.asarray(p2['embed']),
+                               np.asarray(lp2['embed']),
+                               atol=2e-4, rtol=2e-4)
 
 
 def test_stage_and_tp_shardings_land():
