@@ -1,0 +1,81 @@
+"""Worker for the REAL 2-process distributed-checkpoint test.
+
+Launched by ``tests/test_multihost.py`` (never run as a pytest module):
+each worker joins a 2-process JAX distributed runtime and exercises
+``TrainCheckpointer``'s multi-host loader-state path for real — the
+allgather that stores EVERY host's data position keyed by process index
+(``jax/checkpoint.py:_gather_per_process``) and the per-host pick on
+restore. Two phases, each its own 2-process run:
+
+* ``save``: consume part of the epoch, then every process calls
+  ``ckpt.save(step, state, loader)`` (orbax coordinates the write).
+* ``restore``: a FRESH loader in a fresh runtime; ``restore_loader``
+  repositions each host to ITS OWN checkpointed position; the worker
+  consumes the rest of the epoch.
+
+The parent asserts per-host coverage (union before/after == the host's
+shard, at-least-once), cross-host disjointness, and that the resume was
+real (not a from-scratch replay) on BOTH hosts.
+"""
+
+import json
+import os
+import sys
+
+
+def main():
+    (coordinator, process_id, num_processes, url, ckpt_dir, phase,
+     out_path) = (sys.argv[1], int(sys.argv[2]), int(sys.argv[3]),
+                  sys.argv[4], sys.argv[5], sys.argv[6], sys.argv[7])
+
+    os.environ['JAX_PLATFORMS'] = 'cpu'
+    os.environ.setdefault(
+        'XLA_FLAGS', '--xla_force_host_platform_device_count=4')
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import jax
+
+    jax.config.update('jax_platforms', 'cpu')
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    from petastorm_tpu.jax import TrainCheckpointer, make_jax_loader
+
+    batch = 10
+    # the train state must be a GLOBAL (here fully-replicated) array:
+    # orbax refuses host-local single-device arrays in a multi-host save
+    mesh = Mesh(np.array(jax.devices()), ('data',))
+    state = {'w': jax.device_put(jnp.zeros((2,), jnp.float32),
+                                 NamedSharding(mesh, PartitionSpec()))}
+    ids = []
+    with make_jax_loader(url, batch_size=batch, fields=['^id$'],
+                         num_epochs=1, shuffle_row_groups=False,
+                         last_batch='short') as loader:
+        with TrainCheckpointer(ckpt_dir) as ckpt:
+            if phase == 'save':
+                it = iter(loader)
+                for _ in range(2):
+                    ids.append(sorted(
+                        int(x) for x in np.asarray(next(it)['id'])))
+                ckpt.save(2, state, loader)
+            else:
+                restored_step = ckpt.restore_loader(loader)
+                assert restored_step == 2, restored_step
+                for step_batch in loader:
+                    ids.append(sorted(
+                        int(x) for x in np.asarray(step_batch['id'])))
+        shard = (loader.reader.cur_shard, loader.reader.shard_count)
+
+    with open(out_path, 'w') as f:
+        json.dump({'process_id': process_id, 'phase': phase,
+                   'cur_shard': shard[0], 'shard_count': shard[1],
+                   'ids_per_step': ids}, f)
+
+
+if __name__ == '__main__':
+    main()
