@@ -139,7 +139,9 @@ class CompressedImageCodec(DataframeColumnCodec):
        build present or not) and across rows of one batch (oddball-cell
        fallback). Pipelines that need bit-identical decode everywhere
        should set env ``PETASTORM_TPU_JPEG_FANCY=1``, which makes the
-       native path bit-identical to cv2. png decode is lossless and
+       native path bit-identical to cv2 (provided the DCT method stays at
+       its ``islow`` default — ``PETASTORM_TPU_JPEG_DCT=ifast`` trades
+       that bit-identity away). png decode is lossless and
        path-independent either way.
     """
 
@@ -323,7 +325,8 @@ class CompressedImageCodec(DataframeColumnCodec):
         decode rate, chroma-interpolation differences only, quality vs the
         source image within 0.2 dB PSNR of the fancy path; set env
         ``PETASTORM_TPU_JPEG_FANCY=1`` for bit-identical-to-cv2 output
-        (both ride libjpeg-turbo; see ``native/jpeg_batch.c``). On hosts
+        (both ride libjpeg-turbo; see ``native/jpeg_batch.c``; requires
+        the default ``islow`` DCT — not ``PETASTORM_TPU_JPEG_DCT=ifast``). On hosts
         with real parallelism the batch is chunked across the shared
         decode pool instead, each chunk one native call. Cells the native
         loop rejects (not a 3-component 8-bit image of the declared shape)

@@ -189,6 +189,27 @@ class TestNativeJpegDecoder:
         assert diff.mean() < 8.0, diff.mean()
         assert np.percentile(diff, 99) < 48, np.percentile(diff, 99)
 
+    def test_ifast_dct_close_to_default(self, jpeg_native, monkeypatch):
+        """PETASTORM_TPU_JPEG_DCT=ifast opts into turbo's fast integer DCT
+        (for builds whose ISLOW has no SIMD path); output stays a faithful
+        decode — tiny deviation from the default-path decode, no
+        corruption."""
+        monkeypatch.delenv('PETASTORM_TPU_JPEG_FANCY', raising=False)
+        cells, _ = _jpeg_cells(4)
+        default_out = np.empty((4, 48, 64, 3), np.uint8)
+        monkeypatch.delenv('PETASTORM_TPU_JPEG_DCT', raising=False)
+        assert jpeg_native.decode_jpeg_batch(cells, default_out) == 4
+        ifast_out = np.empty((4, 48, 64, 3), np.uint8)
+        monkeypatch.setenv('PETASTORM_TPU_JPEG_DCT', 'ifast')
+        assert jpeg_native.decode_jpeg_batch(cells, ifast_out) == 4
+        # the knob must actually take effect: IFAST and ISLOW provably
+        # differ on q90 4:2:0 cells, so identical output means the env
+        # parse is dead and both runs decoded ISLOW
+        assert (ifast_out != default_out).any()
+        diff = np.abs(ifast_out.astype(int) - default_out.astype(int))
+        assert diff.mean() < 4.0, diff.mean()
+        assert diff.max() < 64, diff.max()
+
     def test_corrupt_cell_stops_prefix(self, jpeg_native):
         cells, _ = _jpeg_cells(5)
         cells[2] = cells[2][:40]
