@@ -16,6 +16,8 @@ Re-design of ``petastorm/etl/dataset_metadata.py`` without Spark:
   per-file footer scan.
 """
 
+import collections
+import itertools
 import json
 import logging
 import posixpath
@@ -492,9 +494,18 @@ class DatasetWriter:
 
     def __init__(self, dataset_url, schema, rowgroup_size_rows=1000,
                  partition_by=(), file_prefix='part', storage_options=None,
-                 rowgroup_size_mb=None, compression='auto'):
+                 rowgroup_size_mb=None, compression='auto',
+                 workers_count=None):
+        """``workers_count``: >1 encodes :meth:`write_row_dicts` batches in
+        a thread pool (codec encode — jpeg/png via cv2, ``np.save`` — is
+        the write path's CPU cost and releases the GIL), the first-party
+        stand-in for the reference's Spark-executor-parallel write
+        (``etl/dataset_metadata.py:52``). Row order is preserved.
+        ``None``/0/1 encode serially."""
         self.schema = schema
         self._compression = compression
+        self._workers_count = int(workers_count or 0)
+        self._encode_pool = None
         self.rowgroup_size_rows = rowgroup_size_rows
         self.rowgroup_size_bytes = (rowgroup_size_mb * 1024 * 1024
                                     if rowgroup_size_mb else None)
@@ -578,7 +589,9 @@ class DatasetWriter:
         return total
 
     def write_row_dict(self, row_dict):
-        encoded = dict_to_encoded_row(self.schema, row_dict)
+        self._append_encoded(dict_to_encoded_row(self.schema, row_dict))
+
+    def _append_encoded(self, encoded):
         part_dir = self._partition_dir(encoded)
         self._writer_for(part_dir)
         buf = self._buffers[part_dir]
@@ -592,8 +605,44 @@ class DatasetWriter:
                 self._flush(part_dir)
 
     def write_row_dicts(self, row_dicts):
-        for row in row_dicts:
-            self.write_row_dict(row)
+        if self._workers_count > 1:
+            for encoded in self._encode_parallel(row_dicts):
+                self._append_encoded(encoded)
+        else:
+            for row in row_dicts:
+                self.write_row_dict(row)
+
+    def _encode_parallel(self, row_dicts):
+        """Encoded rows in input order, encoded ``workers_count``-wide.
+
+        Streaming: ``row_dicts`` may be a generator — at most
+        ``workers_count + 2`` chunks of raw rows are in flight, so memory
+        stays O(chunks), matching the serial path's streaming contract.
+        Chunked so scalar-heavy schemas don't drown in per-task dispatch;
+        an encode error (bad shape/dtype) surfaces here exactly as it
+        would serially, just possibly a chunk early."""
+        if self._encode_pool is None:
+            self._encode_pool = ThreadPoolExecutor(
+                max_workers=self._workers_count,
+                thread_name_prefix='pt-encode')
+
+        def encode_chunk(part):
+            return [dict_to_encoded_row(self.schema, r) for r in part]
+
+        rows_iter = iter(row_dicts)
+        pending = collections.deque()
+        while True:
+            while len(pending) < self._workers_count + 2:
+                part = list(itertools.islice(rows_iter, 64))
+                if not part:
+                    break
+                pending.append(self._encode_pool.submit(encode_chunk, part))
+            if not pending:
+                break
+            # FIFO completion keeps input order; .result() re-raises an
+            # encode error just as the serial path would
+            for encoded in pending.popleft().result():
+                yield encoded
 
     def new_file(self):
         """Close current files; subsequent rows open fresh parquet files."""
@@ -622,6 +671,9 @@ class DatasetWriter:
             self._files_written += 1
 
     def close(self):
+        if self._encode_pool is not None:
+            self._encode_pool.shutdown(wait=True)
+            self._encode_pool = None
         if self._files_written == 0 and not self._writers and not self.partition_by:
             # Zero-row dataset: still produce one (empty) parquet file so the
             # dataset is a valid, readable store rather than a footer error.
@@ -637,13 +689,14 @@ class DatasetWriter:
 
 def write_dataset(dataset_url, schema, rows, rowgroup_size_rows=1000,
                   num_files=1, partition_by=(), storage_options=None,
-                  rowgroup_size_mb=None):
+                  rowgroup_size_mb=None, workers_count=None):
     """One-call materialization: write ``rows`` and the metadata footer."""
     rows = list(rows)
     with materialize_dataset(dataset_url, schema, storage_options=storage_options):
         with DatasetWriter(dataset_url, schema, rowgroup_size_rows,
                            partition_by, storage_options=storage_options,
-                           rowgroup_size_mb=rowgroup_size_mb) as writer:
+                           rowgroup_size_mb=rowgroup_size_mb,
+                           workers_count=workers_count) as writer:
             if num_files <= 1:
                 writer.write_row_dicts(rows)
             else:
