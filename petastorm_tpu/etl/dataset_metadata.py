@@ -94,10 +94,11 @@ class ParquetDatasetInfo:
     hive-partition parse. Paths are stored fs-relative (no scheme).
     """
 
-    def __init__(self, dataset_url_or_urls, storage_options=None, validate=True):
+    def __init__(self, dataset_url_or_urls, storage_options=None, validate=True,
+                 filesystem=None):
         self.url = dataset_url_or_urls
         fs, path_or_paths = get_filesystem_and_path_or_paths(
-            dataset_url_or_urls, storage_options)
+            dataset_url_or_urls, storage_options, filesystem=filesystem)
         self.fs = fs
         if isinstance(path_or_paths, list):
             self.root_path = posixpath.dirname(path_or_paths[0])

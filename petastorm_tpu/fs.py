@@ -35,16 +35,46 @@ def get_dataset_path(url):
     return parsed.path
 
 
-def get_filesystem_and_path_or_paths(url_or_urls, storage_options=None):
+def get_filesystem_and_path_or_paths(url_or_urls, storage_options=None,
+                                     filesystem=None):
     """Resolve one URL (or a homogeneous list of URLs) to (fsspec_fs, path(s)).
 
     All URLs in a list must share scheme and netloc
     (reference: ``petastorm/fs_utils.py:202-232``).
+
+    :param filesystem: an already-constructed fsspec filesystem to use
+    	instead of resolving one from the URL scheme (reference
+    	``reader.py``'s ``filesystem=`` kwarg) — e.g. a pre-authenticated
+    	``gcsfs``/``s3fs`` instance. URLs are stripped to fs-native paths
+    	via the filesystem's own protocol rules. Mutually exclusive with
+    	``storage_options`` (options belong to the construction this
+    	bypasses).
     """
     urls = url_or_urls if isinstance(url_or_urls, list) else [url_or_urls]
     parsed = [urlparse(u) for u in urls]
     if len({(p.scheme, p.netloc) for p in parsed}) != 1:
         raise ValueError('All dataset URLs must share scheme and netloc: %r' % urls)
+    if filesystem is not None:
+        if storage_options:
+            raise ValueError('filesystem and storage_options are mutually '
+                             'exclusive: the explicit filesystem was already '
+                             'constructed, so the options cannot apply')
+        scheme = parsed[0].scheme
+        protocols = (filesystem.protocol if isinstance(filesystem.protocol,
+                                                       (tuple, list))
+                     else (filesystem.protocol,))
+        # a mismatched scheme would be silently mangled by _strip_protocol
+        # (e.g. LocalFileSystem turns 'gs://b/x' into '<cwd>/gs:/b/x') and
+        # surface as a baffling not-found error far downstream — reject it
+        # here, where the scheme is known. Scheme-less bare paths are
+        # allowed: there is nothing to check them against.
+        if scheme and scheme not in protocols:
+            raise ValueError(
+                'URL scheme %r does not match the explicit filesystem '
+                '(protocol %r)' % (scheme, filesystem.protocol))
+        paths = [filesystem._strip_protocol(u) for u in urls]
+        return (filesystem, paths if isinstance(url_or_urls, list)
+                else paths[0])
     if parsed[0].scheme == 'hdfs':
         # HA nameservice expansion + namenode failover
         from petastorm_tpu.hdfs import connect_hdfs_url

@@ -43,7 +43,7 @@ def make_reader(dataset_url, schema_fields=None, reader_pool_type='thread',
                 shard_count=None, seed=0, cache_type='null', cache_location=None,
                 cache_size_limit=None, cache_row_size_estimate=None,
                 transform_spec=None, ngram=None, filters=None,
-                storage_options=None):
+                storage_options=None, filesystem=None):
     """Reader over a petastorm_tpu/petastorm materialized dataset, iterating
     rows as namedtuples with all codecs decoded.
 
@@ -54,8 +54,13 @@ def make_reader(dataset_url, schema_fields=None, reader_pool_type='thread',
         an OR-list of such AND-lists). Row-groups that provably cannot match
         (hive partition values + parquet min/max statistics) are skipped
         without any I/O; surviving rows are filtered exactly on the workers.
+    :param filesystem: an already-constructed fsspec filesystem (e.g. a
+        pre-authenticated gcsfs/s3fs instance) used instead of resolving
+        one from the URL scheme; mutually exclusive with
+        ``storage_options`` (reference ``reader.py:61`` ``filesystem=``).
     """
-    info = ParquetDatasetInfo(dataset_url, storage_options)
+    info = ParquetDatasetInfo(dataset_url, storage_options,
+                              filesystem=filesystem)
     try:
         from petastorm_tpu.etl.dataset_metadata import get_schema
         get_schema(info)
@@ -86,14 +91,15 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None,
                       shard_count=None, seed=0, cache_type='null',
                       cache_location=None, cache_size_limit=None,
                       cache_row_size_estimate=None, transform_spec=None,
-                      filters=None, storage_options=None):
+                      filters=None, storage_options=None, filesystem=None):
     """Reader yielding whole row-groups as namedtuples of column arrays.
 
     Works on any Parquet store, petastorm metadata or not
-    (parity: ``petastorm/reader.py:198-328``). ``filters`` as in
-    :func:`make_reader`.
+    (parity: ``petastorm/reader.py:198-328``). ``filters`` and
+    ``filesystem`` as in :func:`make_reader`.
     """
-    info = ParquetDatasetInfo(dataset_url_or_urls, storage_options)
+    info = ParquetDatasetInfo(dataset_url_or_urls, storage_options,
+                              filesystem=filesystem)
     return Reader(info, schema_fields=schema_fields,
                   reader_pool_type=reader_pool_type, workers_count=workers_count,
                   results_queue_size=results_queue_size,

@@ -75,6 +75,53 @@ class TestMemoryFilesystem:
         assert total == 30
 
 
+class TestExplicitFilesystem:
+    """``filesystem=`` passthrough: an already-constructed fsspec filesystem
+    is used as-is instead of URL-scheme resolution (reference
+    ``reader.py:61``'s kwarg; e.g. a pre-authenticated gcsfs instance)."""
+
+    def test_reader_uses_explicit_instance(self):
+        import fsspec
+        url = 'memory://explicit_fs_ds'
+        write_dataset(url, SmallSchema, _rows(20), rowgroup_size_rows=5)
+        # skip_instance_cache: fsspec's memory fs is normally a cached
+        # singleton, so URL resolution would return the SAME object and a
+        # dropped passthrough would be invisible — a distinct instance
+        # makes the identity assertions below meaningful
+        fs = fsspec.filesystem('memory', skip_instance_cache=True)
+        with make_reader(url, shuffle_row_groups=False,
+                         filesystem=fs) as reader:
+            assert reader.dataset_info.fs is fs
+            assert sorted(r.id for r in reader) == list(range(20))
+        with make_batch_reader(url, filesystem=fs) as reader:
+            assert reader.dataset_info.fs is fs
+            assert sum(len(b.id) for b in reader) == 20
+
+    def test_scheme_mismatch_rejected(self):
+        import fsspec
+        fs = fsspec.filesystem('memory', skip_instance_cache=True)
+        with pytest.raises(ValueError, match='does not match'):
+            get_filesystem_and_path_or_paths('gs://bucket/ds', filesystem=fs)
+
+    def test_resolver_returns_instance_and_stripped_paths(self):
+        import fsspec
+        fs = fsspec.filesystem('memory')
+        got_fs, path = get_filesystem_and_path_or_paths(
+            'memory://some/ds', filesystem=fs)
+        assert got_fs is fs
+        assert path == fs._strip_protocol('memory://some/ds')
+        got_fs, paths = get_filesystem_and_path_or_paths(
+            ['memory://a/1.parquet', 'memory://a/2.parquet'], filesystem=fs)
+        assert got_fs is fs and len(paths) == 2
+
+    def test_mutually_exclusive_with_storage_options(self):
+        import fsspec
+        with pytest.raises(ValueError, match='mutually exclusive'):
+            get_filesystem_and_path_or_paths(
+                'memory://ds', storage_options={'foo': 1},
+                filesystem=fsspec.filesystem('memory'))
+
+
 class TestUrlListReads:
     @pytest.fixture(scope='class')
     def dataset(self, tmp_path_factory):
