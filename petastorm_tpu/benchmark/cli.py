@@ -33,6 +33,16 @@ def build_parser():
                         choices=['python', 'batch', 'jax'])
     parser.add_argument('--batch-size', type=int, default=128,
                         help="batch size for read-method 'jax'")
+    parser.add_argument('--write', action='store_true',
+                        help='measure the WRITE path instead: synthetic '
+                             'image rows through DatasetWriter '
+                             '(codec encode + parquet) to dataset_url; '
+                             'reader flags (-w/-m/-p/-l/-r/--reader/'
+                             '--spawn-new-process) do not apply')
+    parser.add_argument('--write-rows', type=int, default=512)
+    parser.add_argument('--write-workers', type=int, default=None,
+                        help='parallel-encode threads for --write '
+                             '(default: serial)')
     parser.add_argument('--no-shuffle', action='store_true')
     parser.add_argument('--spawn-new-process', action='store_true',
                         help='measure in a fresh process for clean RSS')
@@ -45,6 +55,16 @@ def main(argv=None):
     args = parser.parse_args(argv)
     if args.verbose:
         logging.basicConfig(level=logging.DEBUG)
+    if args.write:
+        if args.dataset_url is None:
+            parser.error('dataset_url is required with --write')
+        if args.spawn_new_process:
+            parser.error('--spawn-new-process applies to read '
+                         'measurements only, not --write')
+        from petastorm_tpu.benchmark.throughput import write_throughput
+        print(write_throughput(args.dataset_url, rows=args.write_rows,
+                               workers_count=args.write_workers))
+        return 0
     if args.dataset_url is None and args.reader != 'dummy':
         parser.error('dataset_url is required unless --reader dummy')
     import numpy as np

@@ -43,12 +43,12 @@ def get_filesystem_and_path_or_paths(url_or_urls, storage_options=None,
     (reference: ``petastorm/fs_utils.py:202-232``).
 
     :param filesystem: an already-constructed fsspec filesystem to use
-    	instead of resolving one from the URL scheme (reference
-    	``reader.py``'s ``filesystem=`` kwarg) — e.g. a pre-authenticated
-    	``gcsfs``/``s3fs`` instance. URLs are stripped to fs-native paths
-    	via the filesystem's own protocol rules. Mutually exclusive with
-    	``storage_options`` (options belong to the construction this
-    	bypasses).
+        instead of resolving one from the URL scheme (reference
+        ``reader.py``'s ``filesystem=`` kwarg) — e.g. a pre-authenticated
+        ``gcsfs``/``s3fs`` instance. URLs are stripped to fs-native paths
+        via the filesystem's own protocol rules. Mutually exclusive with
+        ``storage_options`` (options belong to the construction this
+        bypasses).
     """
     urls = url_or_urls if isinstance(url_or_urls, list) else [url_or_urls]
     parsed = [urlparse(u) for u in urls]

@@ -44,6 +44,23 @@ class TestThroughput:
                      '-w', '5', '-m', '10', '-l', '2']) == 0
         assert 'samples/sec' in capsys.readouterr().out
 
+    def test_write_throughput(self, tmp_path):
+        from petastorm_tpu.benchmark.throughput import write_throughput
+        url = 'file://' + str(tmp_path / 'wb')
+        result = write_throughput(url, rows=24, image_hw=(32, 32),
+                                  rowgroup_size_rows=8, workers_count=2)
+        assert result.samples == 24
+        assert result.samples_per_second > 0
+        # the written store must be a real readable dataset
+        with make_reader(url, shuffle_row_groups=False) as reader:
+            assert sum(1 for _ in reader) == 24
+
+    def test_cli_write_mode(self, tmp_path, capsys):
+        from petastorm_tpu.benchmark.cli import main
+        url = 'file://' + str(tmp_path / 'wb_cli')
+        assert main([url, '--write', '--write-rows', '12']) == 0
+        assert 'samples/sec' in capsys.readouterr().out
+
 
 class TestDummyReader:
     """Calibration mode: synthetic zero-I/O readers through the same
