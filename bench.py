@@ -59,7 +59,10 @@ HELLO_ROWS = 300 if SMOKE else 1000
 
 IMAGENET_ROWS = 96 if SMOKE else 384
 IMAGENET_SHAPE = (224, 224, 3)
-MEDIAN_RUNS = 1 if SMOKE else 3
+# 5 runs per side of the north-star ratio: single runs on this shared box
+# swing ±10%, and the ratio of two medians-of-5 is decisively tighter than
+# medians-of-3 for ~20s more wall (well inside the budget)
+MEDIAN_RUNS = 1 if SMOKE else 5
 
 C4_DOCS = 256 if SMOKE else 2048
 
@@ -853,8 +856,12 @@ def main():
     img_state = {}
 
     def sec_hello_row():
+        import statistics
         _build_hello_world(hello_url)
-        rate = _measure_rows(hello_url)
+        # the PRIMARY metric: median of MEDIAN_RUNS like the other headline
+        # rates (a single draw on the shared box risks recording a stall)
+        rate = statistics.median(
+            _measure_rows(hello_url) for _ in range(MEDIAN_RUNS))
         state['value'] = round(rate, 2)
         state['vs_baseline'] = round(rate / BASELINE_SAMPLES_PER_SEC, 3)
 

@@ -197,9 +197,20 @@ def write_throughput(dataset_url, rows=512, image_hw=(224, 224),
 
     from petastorm_tpu.codecs import CompressedImageCodec, ScalarCodec
     from petastorm_tpu.etl.dataset_metadata import (
-        DatasetWriter, materialize_dataset,
+        DatasetWriter, ParquetDatasetInfo, materialize_dataset,
     )
+    from petastorm_tpu.fs import get_filesystem_and_path_or_paths
     from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    # Refuse a non-empty target: DatasetWriter restarts part numbering at
+    # 0, so writing over a previous (larger) run would leave a mixed
+    # dataset AND count the stale files' bytes against this run's elapsed
+    # time, silently inflating encoded MB/s.
+    fs, root = get_filesystem_and_path_or_paths(dataset_url)
+    if fs.exists(root) and fs.glob(root.rstrip('/') + '/*.parquet'):
+        raise ValueError('write benchmark target %r already contains '
+                         'parquet files; point it at a fresh directory'
+                         % dataset_url)
 
     h, w = image_hw
     schema = Unischema('WriteBench', [
@@ -226,7 +237,6 @@ def write_throughput(dataset_url, rows=512, image_hw=(224, 224),
                            workers_count=workers_count) as writer:
             writer.write_row_dicts(row_stream())
     elapsed = time.monotonic() - start
-    from petastorm_tpu.etl.dataset_metadata import ParquetDatasetInfo
     info = ParquetDatasetInfo(dataset_url)
     encoded_bytes = sum(info.fs.size(f) for f in info.file_paths)
     return BenchmarkResult(

@@ -55,6 +55,14 @@ class TestThroughput:
         with make_reader(url, shuffle_row_groups=False) as reader:
             assert sum(1 for _ in reader) == 24
 
+    def test_write_throughput_refuses_nonempty_target(self, tmp_path):
+        from petastorm_tpu.benchmark.throughput import write_throughput
+        url = 'file://' + str(tmp_path / 'wb_dirty')
+        write_throughput(url, rows=8, image_hw=(32, 32),
+                         rowgroup_size_rows=8)
+        with pytest.raises(ValueError, match='fresh directory'):
+            write_throughput(url, rows=8, image_hw=(32, 32))
+
     def test_cli_write_mode(self, tmp_path, capsys):
         from petastorm_tpu.benchmark.cli import main
         url = 'file://' + str(tmp_path / 'wb_cli')
