@@ -25,7 +25,10 @@ outer-timeout kill, rc=124):
 * The TPU is probed ONCE up front in a guarded subprocess; if the probe
   fails (wedged chip/tunnel) all remaining device sections run on the CPU
   backend immediately — marked ``tpu_unavailable`` — instead of each
-  burning its own subprocess timeout against a dead link.
+  burning its own subprocess timeout against a dead link. A MID-RUN wedge
+  likewise pins the rest of the run to CPU, except for one cheap re-probe
+  right before ``lm_train`` (wedges are observed to clear within minutes;
+  the MFU capture is worth one ~75s gamble — marked ``tpu_reprobe``).
 * A global wall-clock budget (``BENCH_BUDGET_SECONDS``, default 1100s —
   chosen to undercut any plausible driver timeout) clamps every section's
   subprocess timeout to the remaining budget and skips sections that no
@@ -59,10 +62,15 @@ HELLO_ROWS = 300 if SMOKE else 1000
 
 IMAGENET_ROWS = 96 if SMOKE else 384
 IMAGENET_SHAPE = (224, 224, 3)
-# 5 runs per side of the north-star ratio: single runs on this shared box
-# swing ±10%, and the ratio of two medians-of-5 is decisively tighter than
-# medians-of-3 for ~20s more wall (well inside the budget)
+# 5 runs for the cheap in-process rates (hello row, imagenet batch):
+# single runs on this shared box swing ±10%, and a median-of-5 is
+# decisively tighter for a few seconds more wall. The tf.data side pays a
+# fresh TF subprocess (import + runtime startup) per run, so it stays at
+# median-of-3 — medians of unequal sample counts are still unbiased on
+# both sides of the ratio, and the ~2 extra TF startups (up to minutes on
+# a loaded box) are exactly the budget the late device sections need.
 MEDIAN_RUNS = 1 if SMOKE else 5
+TFDATA_RUNS = 1 if SMOKE else 3
 
 C4_DOCS = 256 if SMOKE else 2048
 
@@ -932,7 +940,7 @@ def main():
             return
         runs = [_measure_tfdata(tfrecord_path, IMAGENET_ROWS // 2,
                                 IMAGENET_ROWS * 4)
-                for _ in range(MEDIAN_RUNS)]
+                for _ in range(TFDATA_RUNS)]
         os.unlink(tfrecord_path)
         ok_rates = sorted(r['rows_per_sec'] for r in runs
                           if 'rows_per_sec' in r)
@@ -967,9 +975,38 @@ def main():
             # runs, where no real device link was measured)
             extra['h2d_link_degraded'] = True
 
+    def maybe_reprobe_tpu():
+        """One chance to recover the chip for the flagship training metric.
+
+        A mid-run wedge pins every later section to CPU (retrying a dead
+        link would burn each section's full timeout), but wedges are
+        OBSERVED to also clear within minutes on this box — and lm_train
+        is the single most valuable device capture (MFU, input-bound
+        util). So spend one cheap guarded probe (~75s worst case) right
+        before it: healthy again → unpin; still wedged → stay on CPU."""
+        if (extra.get('tpu_wedged_midrun') is None
+                or os.environ.get('BENCH_JAX_PLATFORM') != 'cpu'
+                or 'forced_platform' in extra
+                or 'tpu_unavailable' in extra):
+            return
+        if _remaining() < 300:
+            # the gamble is only worth it when a still-wedged probe (up
+            # to 75s) would still leave lm_train a real CPU-fallback shot
+            extra['tpu_reprobe'] = 'skipped-low-budget'
+            return
+        result = _run_json_subprocess(
+            [sys.executable, '-c', _PROBE_SNIPPET], _clamp_timeout(75))
+        if result.get('platform') == 'tpu':
+            del os.environ['BENCH_JAX_PLATFORM']
+            extra['tpu_reprobe'] = 'recovered'
+        else:
+            extra['tpu_reprobe'] = result.get(
+                'error', 'platform=%s' % result.get('platform'))
+
     def sec_lm_train():
         # end-to-end TRAINING throughput on the default device: Parquet →
         # packed batches → H2D → real transformer optimizer steps
+        maybe_reprobe_tpu()
         jax_metrics('lm_train', c4_url, fn=_measure_lm_train)
 
     def sec_lm_decode():
