@@ -480,13 +480,17 @@ from examples.lm.pretrain_example import packing_transform
 url, batch, seq_len, warmup, measure = (
     %(url)r, %(batch)d, %(seq)d, %(warmup)d, %(measure)d)
 warmup = max(1, warmup)  # the impl-selection step below consumes one batch
-# Realistically-sized decoder (~185M params): large enough that the
-# per-step matmuls tile the MXU and MFU is meaningful (BASELINE.json metric;
-# a toy model would measure dispatch latency, not feeding capacity). On a
-# CPU backend (chip-unavailable fallback) that model would blow the
-# subprocess timeout by an order of magnitude, so fall back to a small
-# config — the loader-vs-synthetic ratio stays meaningful, MFU does not
-# (no 'peak' for CPU, so it is omitted anyway).
+# Realistically-sized decoder (~278M params, 252M in matmul weights):
+# large enough that the per-step matmuls tile the MXU and MFU is meaningful (BASELINE.json metric;
+# a toy model would measure dispatch latency, not feeding capacity). The
+# d_model=1536/8-layer shape was picked by measurement on the v5e: it
+# reaches ~0.40 MFU where the earlier d_model=1024/12-layer 185M config
+# measured ~0.29 (wider matmuls tile the MXU better at the same FLOP
+# budget), and one more layer (or batch 12) exceeds the chip's 16 GB with
+# adamw state. On a CPU backend (chip-unavailable fallback) any such model
+# would blow the subprocess timeout by an order of magnitude, so fall back
+# to a small config — the loader-vs-synthetic ratio stays meaningful, MFU
+# does not (no 'peak' for CPU, so it is omitted anyway).
 on_cpu = jax.default_backend() == 'cpu'
 if on_cpu:
     # seq 1024 attention alone is ~minutes/step on CPU; shrink the whole
@@ -499,8 +503,8 @@ if on_cpu:
 else:
     # loss_chunk: the (B, S, V) logits at this vocab are ~0.5 GB f32;
     # chunked CE keeps peak loss memory at one 256-position chunk
-    model_kw = dict(vocab_size=16384, d_model=1024, n_heads=16,
-                    n_layers=12, d_ff=4096, max_seq_len=seq_len,
+    model_kw = dict(vocab_size=16384, d_model=1536, n_heads=16,
+                    n_layers=8, d_ff=6144, max_seq_len=seq_len,
                     loss_chunk=256)
 config = TransformerConfig(**model_kw)
 params = init_transformer_params(jax.random.PRNGKey(0), config)
@@ -680,8 +684,8 @@ if on_cpu:
               d_ff=512, max_seq_len=160)
     batch, prompt_len, n_lo, n_hi = 4, 16, 8, 32
 else:
-    kw = dict(vocab_size=16384, d_model=1024, n_heads=16, n_layers=12,
-              d_ff=4096, max_seq_len=1024)
+    kw = dict(vocab_size=16384, d_model=1536, n_heads=16, n_layers=8,
+              d_ff=6144, max_seq_len=1024)  # = the lm_train flagship shape
     batch, prompt_len, n_lo, n_hi = 8, 128, 64, 256
 config = TransformerConfig(**kw)
 params = init_transformer_params(jax.random.PRNGKey(0), config)
@@ -788,7 +792,7 @@ def _measure_pp_bf16(timeout=300):
 
 def _measure_lm_train(url, batch=8, seq_len=1024, warmup=4, measure=16,
                       timeout=900):
-    """END-TO-END training throughput on a realistically-sized (~185M
+    """END-TO-END training throughput on a realistically-sized (~278M
     param) transformer: Parquet docs → packed batches → device staging →
     real optimizer steps on the default device (the TPU chip under the
     driver). Reports MFU and input-bound step utilization — the
