@@ -74,6 +74,12 @@ TFDATA_RUNS = 1 if SMOKE else 3
 
 C4_DOCS = 256 if SMOKE else 2048
 
+# The ONE flagship LM shape (~335M params), interpolated into BOTH the
+# lm_train and lm_decode subprocess snippets so the decode benchmark can
+# never silently measure a different model than the training one.
+FLAGSHIP_LM_KW = dict(vocab_size=16384, d_model=1536, n_heads=16,
+                      n_layers=10, d_ff=6144)
+
 BUDGET_SECONDS = float(os.environ.get('BENCH_BUDGET_SECONDS',
                                       '240' if SMOKE else '1100'))
 _START = time.monotonic()
@@ -480,17 +486,18 @@ from examples.lm.pretrain_example import packing_transform
 url, batch, seq_len, warmup, measure = (
     %(url)r, %(batch)d, %(seq)d, %(warmup)d, %(measure)d)
 warmup = max(1, warmup)  # the impl-selection step below consumes one batch
-# Realistically-sized decoder (~278M params, 252M in matmul weights):
-# large enough that the per-step matmuls tile the MXU and MFU is meaningful (BASELINE.json metric;
-# a toy model would measure dispatch latency, not feeding capacity). The
-# d_model=1536/8-layer shape was picked by measurement on the v5e: it
-# reaches ~0.40 MFU where the earlier d_model=1024/12-layer 185M config
-# measured ~0.29 (wider matmuls tile the MXU better at the same FLOP
-# budget), and one more layer (or batch 12) exceeds the chip's 16 GB with
-# adamw state. On a CPU backend (chip-unavailable fallback) any such model
-# would blow the subprocess timeout by an order of magnitude, so fall back
-# to a small config — the loader-vs-synthetic ratio stays meaningful, MFU
-# does not (no 'peak' for CPU, so it is omitted anyway).
+# Realistically-sized decoder (~335M params, 308M in matmul weights):
+# large enough that the per-step matmuls tile the MXU and MFU is
+# meaningful (BASELINE.json metric; a toy model would measure dispatch
+# latency, not feeding capacity). The d_model=1536/10-layer shape was
+# picked by measurement on the v5e-16GB: with the donated train state it
+# reaches ~0.435 MFU, vs 0.406 for 1536/8 and 0.29 for the original
+# 1024/12 config (wider matmuls tile the MXU better at the same FLOP
+# budget); deeper/wider or batch>8 exhausts HBM with adamw state. On a
+# CPU backend (chip-unavailable fallback) any such model would blow the
+# subprocess timeout by an order of magnitude, so fall back to a small
+# config — the loader-vs-synthetic ratio stays meaningful, MFU does not
+# (no 'peak' for CPU, so it is omitted anyway).
 on_cpu = jax.default_backend() == 'cpu'
 if on_cpu:
     # seq 1024 attention alone is ~minutes/step on CPU; shrink the whole
@@ -502,15 +509,18 @@ if on_cpu:
                     n_layers=4, d_ff=512, max_seq_len=seq_len)
 else:
     # loss_chunk: the (B, S, V) logits at this vocab are ~0.5 GB f32;
-    # chunked CE keeps peak loss memory at one 256-position chunk
-    model_kw = dict(vocab_size=16384, d_model=1536, n_heads=16,
-                    n_layers=8, d_ff=6144, max_seq_len=seq_len,
-                    loss_chunk=256)
+    # chunked CE keeps peak loss memory at one 256-position chunk.
+    # 10 layers fit (vs 8 undonated) because the step donates the train
+    # state — measured MFU 0.435 at this shape vs 0.406 for L8.
+    model_kw = dict(max_seq_len=seq_len, loss_chunk=256,
+                    **%(flagship)r)
 config = TransformerConfig(**model_kw)
 params = init_transformer_params(jax.random.PRNGKey(0), config)
 optimizer = optax.adamw(1e-3)
 opt_state = optimizer.init(params)
-step = transformer_train_step(config, optimizer)
+# donate=True: the train state updates in place (the whole measured loop
+# is state = step(state, ...)), freeing a params+opt_state copy of HBM
+step = transformer_train_step(config, optimizer, donate=True)
 
 # Analytic matmul FLOPs per optimizer step (fwd 2 FLOP/MAC, bwd 2x fwd):
 # parameter matmuls 6*N_matmul*tokens + attention scores 12*L*B*S^2*d.
@@ -584,12 +594,17 @@ with make_jax_loader(url, batch_size=batch, num_epochs=None,
     if kernel_supported(seq_len):
         # try the fused Pallas flash-attention step first (no HBM score
         # tensor -> higher MFU); an unsupported kernel on this chip just
-        # falls back to the dense step, params untouched (functional).
-        # kernel_supported is the wrapper module's own gate, so 'flash'
-        # in the output always means the fused kernel actually ran.
+        # falls back to the dense step. kernel_supported is the wrapper
+        # module's own gate, so 'flash' in the output always means the
+        # fused kernel actually ran. The steps DONATE the train state, so
+        # the except path re-inits rather than reusing possibly-donated
+        # buffers (compile failures leave them intact, but a runtime
+        # failure after dispatch would not — re-init is deterministic and
+        # cheap next to the step compile itself).
         try:
             flash_cfg = TransformerConfig(attn_impl='flash', **model_kw)
-            flash_step = transformer_train_step(flash_cfg, optimizer)
+            flash_step = transformer_train_step(flash_cfg, optimizer,
+                                                donate=True)
             p2, o2, l2 = flash_step(params, opt_state, first)
             float(l2)
             config, step, attn_impl = flash_cfg, flash_step, 'flash'
@@ -597,6 +612,8 @@ with make_jax_loader(url, batch_size=batch, num_epochs=None,
         except Exception as e:
             print('flash attention unavailable, dense fallback: %%r' %% (e,),
                   file=sys.stderr)
+            params = init_transformer_params(jax.random.PRNGKey(0), config)
+            opt_state = optimizer.init(params)
             params, opt_state, loss = step(params, opt_state, first)
     else:
         params, opt_state, loss = step(params, opt_state, first)
@@ -684,8 +701,7 @@ if on_cpu:
               d_ff=512, max_seq_len=160)
     batch, prompt_len, n_lo, n_hi = 4, 16, 8, 32
 else:
-    kw = dict(vocab_size=16384, d_model=1536, n_heads=16, n_layers=8,
-              d_ff=6144, max_seq_len=1024)  # = the lm_train flagship shape
+    kw = dict(max_seq_len=1024, **%(flagship)r)  # = the lm_train shape
     batch, prompt_len, n_lo, n_hi = 8, 128, 64, 256
 config = TransformerConfig(**kw)
 params = init_transformer_params(jax.random.PRNGKey(0), config)
@@ -728,7 +744,8 @@ print(json.dumps({
 def _measure_lm_decode(timeout=600):
     """KV-cache inference throughput on the flagship model family."""
     code = _LM_DECODE_SNIPPET % {
-        'repo': os.path.dirname(os.path.abspath(__file__))}
+        'repo': os.path.dirname(os.path.abspath(__file__)),
+        'flagship': FLAGSHIP_LM_KW}
     return _run_json_subprocess([sys.executable, '-c', code],
                                 _clamp_timeout(timeout))
 
@@ -792,7 +809,7 @@ def _measure_pp_bf16(timeout=300):
 
 def _measure_lm_train(url, batch=8, seq_len=1024, warmup=4, measure=16,
                       timeout=900):
-    """END-TO-END training throughput on a realistically-sized (~278M
+    """END-TO-END training throughput on a realistically-sized (~335M
     param) transformer: Parquet docs → packed batches → device staging →
     real optimizer steps on the default device (the TPU chip under the
     driver). Reports MFU and input-bound step utilization — the
@@ -802,7 +819,7 @@ def _measure_lm_train(url, batch=8, seq_len=1024, warmup=4, measure=16,
     code = _LM_TRAIN_SNIPPET % {
         'repo': os.path.dirname(os.path.abspath(__file__)), 'url': url,
         'batch': batch, 'seq': seq_len, 'warmup': warmup,
-        'measure': measure}
+        'measure': measure, 'flagship': FLAGSHIP_LM_KW}
     return _run_json_subprocess([sys.executable, '-c', code],
                                 _clamp_timeout(timeout))
 

@@ -684,14 +684,23 @@ def pipelined_transformer_train_step(config, optimizer, mesh,
     return step
 
 
-def transformer_train_step(config, optimizer, mesh=None):
+def transformer_train_step(config, optimizer, mesh=None, donate=False):
     """Jittable ``(params, opt_state, tokens) -> (params, opt_state, loss)``.
 
-    ``mesh`` is required for sequence-parallel configs (``seq_axis``)."""
+    ``mesh`` is required for sequence-parallel configs (``seq_axis``).
+
+    ``donate=True`` donates the params/opt_state buffers to the step
+    (``jax.jit(donate_argnums=(0, 1))``): XLA updates the train state in
+    place, cutting peak HBM by roughly a params+opt_state copy — measured
+    on a v5e-16GB, it admits a model two layers deeper at the same batch.
+    The caller must then never touch the PASSED-IN state after the call
+    (the standard ``state = step(state, ...)`` training-loop pattern);
+    off by default because oracle tests and examples legitimately reuse
+    the old params for comparisons."""
 
     import optax
 
-    @partial(jax.jit, static_argnums=())
+    @partial(jax.jit, donate_argnums=(0, 1) if donate else ())
     def step(params, opt_state, tokens):
         loss, grads = jax.value_and_grad(transformer_loss)(params, tokens,
                                                            config, mesh)
