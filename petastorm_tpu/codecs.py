@@ -39,6 +39,82 @@ _IMAGE_POOL = None
 _IMAGE_POOL_DISABLED = object()
 _IMAGE_POOL_LOCK = threading.Lock()
 
+# Calibrated jpeg chroma-upsampling mode (1 fancy / 0 merged), or None until
+# the first sizeable batch decides it; see _jpeg_upsampling_mode.
+_JPEG_FANCY_MODE = None
+_JPEG_FANCY_LOCK = threading.Lock()
+_JPEG_FANCY_ATTEMPTS = 0
+_JPEG_FANCY_MAX_ATTEMPTS = 5
+
+
+def _jpeg_upsampling_mode(decode_fn, cells, image_shape):
+    """Pick the faster libjpeg chroma-upsampling mode for THIS host.
+
+    Which of libjpeg's two 4:2:0 paths wins depends on the host's libjpeg
+    build (turbo SIMD-vectorizes the fancy upsampler; its merged RGB path
+    is scalar on some configurations — see ``native/jpeg_batch.c``), so
+    instead of hardcoding a loser, time both modes once per process on the
+    first real batch and cache the winner. Shared boxes drift by 2x over
+    seconds, so the timing is INTERLEAVED (mode order alternating within
+    each round, median per mode) — back-to-back per-mode loops would just
+    measure which mode ran during the quiet period. A set (non-empty)
+    ``PETASTORM_TPU_JPEG_FANCY`` disables calibration and defers to the C
+    module's env parse (returns -1), preserving the bit-exactness escape
+    hatch (=1 is bit-identical to cv2).
+
+    Cost: ~8 x min(n, 8) single-image decodes, once per process. A wrong
+    pick on pathological timing costs only decode rate, never correctness
+    — both modes are faithful decodes of the same bytes.
+    """
+    global _JPEG_FANCY_MODE, _JPEG_FANCY_ATTEMPTS
+    if os.environ.get('PETASTORM_TPU_JPEG_FANCY'):
+        return -1
+    if _JPEG_FANCY_MODE is not None:
+        return _JPEG_FANCY_MODE
+    if len(cells) < 4:
+        return -1  # too small to time; env default, keep calibration open
+    with _JPEG_FANCY_LOCK:
+        if _JPEG_FANCY_MODE is not None:
+            return _JPEG_FANCY_MODE
+        import statistics
+        import time
+        sample = cells[:8]
+        scratch = np.empty((len(sample),) + tuple(image_shape), np.uint8)
+        try:
+            # zero-length signature probe: ONLY a stale .so predating the
+            # mode argument can raise TypeError here (oddball cells are
+            # prefix-skipped by the C loop, never raised)
+            decode_fn([], scratch[:0], 0)
+        except TypeError:
+            _JPEG_FANCY_MODE = -1  # env default forever
+            return -1
+        timings = {0: [], 1: []}
+        for mode in (0, 1):
+            decode_fn(sample, scratch, mode)  # warm (page-in, caches)
+        for round_idx in range(3):
+            order = (0, 1) if round_idx % 2 == 0 else (1, 0)
+            for mode in order:
+                start = time.perf_counter()
+                done = decode_fn(sample, scratch, mode)
+                timings[mode].append(time.perf_counter() - start)
+                if done != len(sample):
+                    # non-jpeg/oddball cells: timing would compare
+                    # different work; env default for now, and retry on a
+                    # later batch — but only a bounded number of times
+                    # (a dataset whose every batch leads with an oddball
+                    # must not pay a calibration attempt per batch)
+                    _JPEG_FANCY_ATTEMPTS += 1
+                    if _JPEG_FANCY_ATTEMPTS >= _JPEG_FANCY_MAX_ATTEMPTS:
+                        _JPEG_FANCY_MODE = -1
+                    return -1
+        medians = {m: statistics.median(t) for m, t in timings.items()}
+        _JPEG_FANCY_MODE = min(medians, key=medians.get)
+        logger.debug(
+            'jpeg upsampling calibrated: %s (merged %.1f img/s, fancy '
+            '%.1f img/s)', 'fancy' if _JPEG_FANCY_MODE else 'merged',
+            len(sample) / medians[0], len(sample) / medians[1])
+        return _JPEG_FANCY_MODE
+
 
 def _image_decode_pool():
     """Shared small thread pool for batched image decode, or None.
@@ -130,19 +206,21 @@ class CompressedImageCodec(DataframeColumnCodec):
     encode/decode of 3-channel images.
 
     .. note:: **jpeg decode determinism.** ``decode_batch`` prefers the
-       first-party native decoder, whose DEFAULT uses merged (non-fancy)
-       chroma upsampling for throughput (~1.6x); per-cell ``decode`` and
-       any fallback rows go through cv2, which always uses fancy
-       upsampling. The two differ by small chroma-interpolation deltas
-       (quality vs source within 0.2 dB PSNR), so in the default mode
-       decoded pixels can vary with the path taken — across hosts (native
-       build present or not) and across rows of one batch (oddball-cell
-       fallback). Pipelines that need bit-identical decode everywhere
-       should set env ``PETASTORM_TPU_JPEG_FANCY=1``, which makes the
-       native path bit-identical to cv2 (provided the DCT method stays at
-       its ``islow`` default — ``PETASTORM_TPU_JPEG_DCT=ifast`` trades
-       that bit-identity away). png decode is lossless and
-       path-independent either way.
+       first-party native decoder, whose DEFAULT chroma-upsampling mode
+       (merged vs fancy) is auto-calibrated once per process to whichever
+       this host decodes faster (see ``_jpeg_upsampling_mode``); per-cell
+       ``decode`` and any fallback rows go through cv2, which always uses
+       fancy upsampling. The two modes differ by small
+       chroma-interpolation deltas (quality vs source within 0.2 dB
+       PSNR), so in the default mode decoded pixels can vary with the
+       path taken — across hosts (native build present or not, and which
+       mode calibration picked) and across rows of one batch
+       (oddball-cell fallback). Pipelines that need bit-identical decode
+       everywhere should set env ``PETASTORM_TPU_JPEG_FANCY=1``, which
+       forces fancy upsampling and makes the native path bit-identical to
+       cv2 (provided the DCT method stays at its ``islow`` default —
+       ``PETASTORM_TPU_JPEG_DCT=ifast`` trades that bit-identity away).
+       png decode is lossless and path-independent either way.
     """
 
     def __init__(self, image_codec='png', quality=80):
@@ -321,12 +399,14 @@ class CompressedImageCodec(DataframeColumnCodec):
         One C call decodes the whole batch RGB-direct into ``out`` with the
         GIL released, without per-cell Python dispatch or Mat allocation.
         png is bit-identical to the cv2 path (PNG stores RGB natively).
-        jpeg defaults to merged (non-fancy) chroma upsampling — ~1.6x the
-        decode rate, chroma-interpolation differences only, quality vs the
-        source image within 0.2 dB PSNR of the fancy path; set env
-        ``PETASTORM_TPU_JPEG_FANCY=1`` for bit-identical-to-cv2 output
-        (both ride libjpeg-turbo; see ``native/jpeg_batch.c``; requires
-        the default ``islow`` DCT — not ``PETASTORM_TPU_JPEG_DCT=ifast``). On hosts
+        jpeg chroma upsampling is auto-calibrated per process — merged vs
+        fancy, whichever THIS host decodes faster (the winner is
+        host-dependent; see ``_jpeg_upsampling_mode``); the two differ
+        only in chroma interpolation (within 0.2 dB PSNR vs the source).
+        Set env ``PETASTORM_TPU_JPEG_FANCY=1`` to force fancy, which is
+        bit-identical-to-cv2 output (both ride libjpeg-turbo; see
+        ``native/jpeg_batch.c``; requires the default ``islow`` DCT — not
+        ``PETASTORM_TPU_JPEG_DCT=ifast``), or ``=0`` to force merged. On hosts
         with real parallelism the batch is chunked across the shared
         decode pool instead, each chunk one native call. Cells the native
         loop rejects (not a 3-component 8-bit image of the declared shape)
@@ -335,10 +415,15 @@ class CompressedImageCodec(DataframeColumnCodec):
         """
         if out.dtype != np.uint8 or out.ndim != 4 or out.shape[3] != 3:
             return False
+        decode_args = ()
         if self._image_codec in ('.jpeg', '.jpg'):
             from petastorm_tpu.native import get_jpeg_module
             native_mod = get_jpeg_module()
             decode_fn = getattr(native_mod, 'decode_jpeg_batch', None)
+            if decode_fn is not None:
+                mode = _jpeg_upsampling_mode(decode_fn, cells, out.shape[1:])
+                if mode >= 0:
+                    decode_args = (mode,)
         elif self._image_codec == '.png':
             from petastorm_tpu.native import get_png_module
             native_mod = get_png_module()
@@ -354,7 +439,7 @@ class CompressedImageCodec(DataframeColumnCodec):
             # native loop on the tail (one oddball must not demote the
             # whole remaining chunk to per-cell decode)
             while lo < hi:
-                done = decode_fn(cells[lo:hi], out[lo:hi])
+                done = decode_fn(cells[lo:hi], out[lo:hi], *decode_args)
                 lo += done
                 if lo < hi:
                     self._decode_into(unischema_field, cells[lo], out[lo])

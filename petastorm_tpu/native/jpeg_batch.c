@@ -6,16 +6,22 @@
  * RELEASED in one native call: no per-cell Python dispatch, no thread-pool
  * task churn, no intermediate Mat/ndarray per cell.
  *
- * Upsampling policy: by DEFAULT fancy (triangle-filter) chroma upsampling
- * is DISABLED, which selects turbo's merged upsampling fast path for
- * 4:2:0/4:2:2 jpegs — measured ~1.6x the decode rate of the fancy path on
- * 224x224 q90 4:2:0 images (2540 vs 1576 img/s/core on this host, vs
- * cv2's 2022) at a small chroma-interpolation quality cost that is
- * irrelevant to ML input pipelines (tf.data commonly goes further and
- * drops to IFAST DCT). Set PETASTORM_TPU_JPEG_FANCY=1 to restore libjpeg
- * defaults, which are bit-identical to OpenCV's imdecode on the same
- * bytes (both ride libjpeg-turbo) — the mode the bit-exactness tests pin.
- * 4:4:4 jpegs have no upsampling step and decode identically either way.
+ * Upsampling policy: WHICH of libjpeg's two 4:2:0/4:2:2 chroma paths is
+ * faster depends on the host's libjpeg build — merged upsampling skips a
+ * pass, but libjpeg-turbo SIMD-vectorizes the fancy (triangle-filter)
+ * upsampler while its merged RGB path is scalar on some configurations.
+ * (Single-run timings on the shared dev boxes running this file have
+ * shown BOTH orderings by large factors, which interleaved re-measurement
+ * exposed as machine noise — hence measure-don't-assume, and measure
+ * robustly.) The optional third argument `fancy` selects the mode
+ * explicitly: 1 = fancy (bit-identical to OpenCV's imdecode of the same
+ * bytes — both ride libjpeg; the mode the bit-exactness tests pin),
+ * 0 = merged, and -1 (default) defers to the PETASTORM_TPU_JPEG_FANCY
+ * env var (unset or 0 = merged). The Python caller
+ * (codecs._native_image_batch) times both modes interleaved once per
+ * process on the first real batch and passes the winner; direct C
+ * callers keep the env-driven contract. 4:4:4 jpegs have no upsampling
+ * step and decode identically either way.
  *
  * Returns the count of successfully decoded leading cells; a cell that is
  * not an 8-bit 3-component JPEG of exactly the declared (H, W) stops the
@@ -120,9 +126,10 @@ decode_jpeg_batch(PyObject *self, PyObject *args)
     Py_ssize_t n, i, decoded;
     Py_buffer *views = NULL;
     int height, width;
+    int fancy_arg = -1;
 
     (void)self;
-    if (!PyArg_ParseTuple(args, "OO", &cells, &out_obj))
+    if (!PyArg_ParseTuple(args, "OO|i", &cells, &out_obj, &fancy_arg))
         return NULL;
     /* C-contiguous + ND so shape[] is populated (a plain "w*" request
      * yields a 1-D view with no shape information) */
@@ -182,11 +189,17 @@ decode_jpeg_batch(PyObject *self, PyObject *args)
         if (rows != NULL) {
             struct jpeg_decompress_struct cinfo;
             struct pt_jpeg_error_mgr jerr;
-            /* value-parsed, not presence-tested: FANCY=0 / FANCY= must
-             * keep the fast default (docs say "set ...=1") */
-            const char *fancy_env = getenv("PETASTORM_TPU_JPEG_FANCY");
-            boolean fancy = (fancy_env != NULL && fancy_env[0] != '\0'
-                             && strcmp(fancy_env, "0") != 0) ? TRUE : FALSE;
+            boolean fancy;
+            if (fancy_arg >= 0) {
+                /* caller-selected mode (the Python calibration path) */
+                fancy = fancy_arg ? TRUE : FALSE;
+            } else {
+                /* value-parsed, not presence-tested: FANCY=0 / FANCY=
+                 * must keep the merged default (docs say "set ...=1") */
+                const char *fancy_env = getenv("PETASTORM_TPU_JPEG_FANCY");
+                fancy = (fancy_env != NULL && fancy_env[0] != '\0'
+                         && strcmp(fancy_env, "0") != 0) ? TRUE : FALSE;
+            }
             /* DCT selector: "ifast" opts into turbo's fast integer DCT
              * (a further ~few-%% rate win at a small accuracy cost some
              * tf.data imagenet pipelines also take via INTEGER_FAST);
@@ -235,8 +248,10 @@ decode_jpeg_batch(PyObject *self, PyObject *args)
 
 static PyMethodDef jpeg_batch_methods[] = {
     {"decode_jpeg_batch", decode_jpeg_batch, METH_VARARGS,
-     "Batched RGB JPEG decode into a preallocated (N,H,W,3) uint8 array; "
-     "returns the decoded prefix count"},
+     "decode_jpeg_batch(cells, out, fancy=-1): batched RGB JPEG decode "
+     "into a preallocated (N,H,W,3) uint8 array; returns the decoded "
+     "prefix count. fancy: 1 = fancy upsampling (cv2-bit-identical), "
+     "0 = merged, -1 = PETASTORM_TPU_JPEG_FANCY env default"},
     {NULL, NULL, 0, NULL}
 };
 

@@ -225,7 +225,7 @@ class TestRuntimeFailover:
         monkeypatch.setattr(
             'petastorm_tpu.etl.dataset_metadata.'
             'get_filesystem_and_path_or_paths',
-            lambda url, storage_options=None: (proxy, root))
+            lambda url, storage_options=None, filesystem=None: (proxy, root))
 
         with make_batch_reader('hdfs://myns' + root,
                                shuffle_row_groups=False) as reader:

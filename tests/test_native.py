@@ -244,6 +244,30 @@ class TestNativeJpegDecoder:
             jpeg_native.decode_jpeg_batch(cells,
                                           np.empty((1, 4, 4, 4), np.uint8))
 
+    def test_explicit_mode_argument(self, jpeg_native, monkeypatch):
+        """decode_jpeg_batch(cells, out, fancy) overrides the env parse:
+        1 is bit-identical to cv2 (fancy), 0 (merged) provably differs on
+        4:2:0 cells, and -1 defers to the env default."""
+        import cv2
+        monkeypatch.delenv('PETASTORM_TPU_JPEG_FANCY', raising=False)
+        cells, _ = _jpeg_cells(4)
+        fancy_out = np.empty((4, 48, 64, 3), np.uint8)
+        merged_out = np.empty((4, 48, 64, 3), np.uint8)
+        env_out = np.empty((4, 48, 64, 3), np.uint8)
+        assert jpeg_native.decode_jpeg_batch(cells, fancy_out, 1) == 4
+        assert jpeg_native.decode_jpeg_batch(cells, merged_out, 0) == 4
+        assert jpeg_native.decode_jpeg_batch(cells, env_out, -1) == 4
+        refs = np.stack([cv2.imdecode(np.frombuffer(c, np.uint8),
+                                      cv2.IMREAD_COLOR_RGB) for c in cells])
+        np.testing.assert_array_equal(fancy_out, refs)
+        assert (merged_out != fancy_out).any()
+        # env unset: -1 means the historical merged default
+        np.testing.assert_array_equal(env_out, merged_out)
+        # explicit mode wins over a set env var, in both directions
+        monkeypatch.setenv('PETASTORM_TPU_JPEG_FANCY', '1')
+        assert jpeg_native.decode_jpeg_batch(cells, merged_out, 0) == 4
+        assert (merged_out != fancy_out).any()
+
 
 class TestJpegCodecIntegration:
     def test_codec_batch_bit_exact_with_per_cell(self, monkeypatch):
@@ -275,6 +299,43 @@ class TestJpegCodecIntegration:
         assert isinstance(decoded, list) and len(decoded) == 6
         assert decoded[2].shape == (48, 64)
         assert decoded[0].shape == (48, 64, 3)
+
+    def test_upsampling_auto_calibration(self, jpeg_native, monkeypatch):
+        """With the env unset, the first sizeable batch calibrates the
+        chroma-upsampling mode (times both, caches the winner) and the
+        decoded batch matches that mode's direct native decode exactly."""
+        from petastorm_tpu import codecs
+        from petastorm_tpu.codecs import CompressedImageCodec
+        monkeypatch.delenv('PETASTORM_TPU_JPEG_FANCY', raising=False)
+        monkeypatch.setattr(codecs, '_JPEG_FANCY_MODE', None)
+        codec = CompressedImageCodec('jpeg')
+        field = UnischemaField('im', np.uint8, (48, 64, 3), codec, False)
+        cells = [codec.encode(field, img)
+                 for img in _jpeg_cells(8, seed=6)[1]]
+        batch = codec.decode_batch(field, cells)
+        assert isinstance(batch, np.ndarray) and batch.shape == (8, 48, 64, 3)
+        assert codecs._JPEG_FANCY_MODE in (0, 1)
+        ref = np.empty_like(batch)
+        assert jpeg_native.decode_jpeg_batch(cells, ref,
+                                             codecs._JPEG_FANCY_MODE) == 8
+        np.testing.assert_array_equal(batch, ref)
+
+    def test_forced_env_skips_calibration(self, monkeypatch):
+        """A set PETASTORM_TPU_JPEG_FANCY disables calibration entirely
+        (the C env parse keeps authority) and =1 stays bit-identical to
+        per-cell cv2 decode."""
+        from petastorm_tpu import codecs
+        from petastorm_tpu.codecs import CompressedImageCodec
+        monkeypatch.setenv('PETASTORM_TPU_JPEG_FANCY', '1')
+        monkeypatch.setattr(codecs, '_JPEG_FANCY_MODE', None)
+        codec = CompressedImageCodec('jpeg')
+        field = UnischemaField('im', np.uint8, (48, 64, 3), codec, False)
+        cells = [codec.encode(field, img)
+                 for img in _jpeg_cells(8, seed=7)[1]]
+        batch = codec.decode_batch(field, cells)
+        assert codecs._JPEG_FANCY_MODE is None  # calibration never ran
+        for i, cell in enumerate(cells):
+            np.testing.assert_array_equal(batch[i], codec.decode(field, cell))
 
     def test_mid_batch_png_cell_keeps_native_tail(self, monkeypatch):
         # a PNG cell in a jpeg-codec batch: native rejects it, cv2 decodes
