@@ -26,9 +26,9 @@ outer-timeout kill, rc=124):
   fails (wedged chip/tunnel) all remaining device sections run on the CPU
   backend immediately — marked ``tpu_unavailable`` — instead of each
   burning its own subprocess timeout against a dead link. A MID-RUN wedge
-  likewise pins the rest of the run to CPU, except for one cheap re-probe
-  right before ``lm_train`` (wedges are observed to clear within minutes;
-  the MFU capture is worth one ~75s gamble — marked ``tpu_reprobe``).
+  likewise pins the rest of the run to CPU. ``lm_train`` (the MFU /
+  input-bound-util capture, the most valuable device number) runs
+  immediately after the probe so it sees the freshest possible link.
 * A global wall-clock budget (``BENCH_BUDGET_SECONDS``, default 1100s —
   chosen to undercut any plausible driver timeout) clamps every section's
   subprocess timeout to the remaining budget and skips sections that no
@@ -996,38 +996,11 @@ def main():
             # runs, where no real device link was measured)
             extra['h2d_link_degraded'] = True
 
-    def maybe_reprobe_tpu():
-        """One chance to recover the chip for the flagship training metric.
-
-        A mid-run wedge pins every later section to CPU (retrying a dead
-        link would burn each section's full timeout), but wedges are
-        OBSERVED to also clear within minutes on this box — and lm_train
-        is the single most valuable device capture (MFU, input-bound
-        util). So spend one cheap guarded probe (~75s worst case) right
-        before it: healthy again → unpin; still wedged → stay on CPU."""
-        if (extra.get('tpu_wedged_midrun') is None
-                or os.environ.get('BENCH_JAX_PLATFORM') != 'cpu'
-                or 'forced_platform' in extra
-                or 'tpu_unavailable' in extra):
-            return
-        if _remaining() < 300:
-            # the gamble is only worth it when a still-wedged probe (up
-            # to 75s) would still leave lm_train a real CPU-fallback shot
-            extra['tpu_reprobe'] = 'skipped-low-budget'
-            return
-        result = _run_json_subprocess(
-            [sys.executable, '-c', _PROBE_SNIPPET], _clamp_timeout(75))
-        if result.get('platform') == 'tpu':
-            del os.environ['BENCH_JAX_PLATFORM']
-            extra['tpu_reprobe'] = 'recovered'
-        else:
-            extra['tpu_reprobe'] = result.get(
-                'error', 'platform=%s' % result.get('platform'))
-
     def sec_lm_train():
         # end-to-end TRAINING throughput on the default device: Parquet →
-        # packed batches → H2D → real transformer optimizer steps
-        maybe_reprobe_tpu()
+        # packed batches → H2D → real transformer optimizer steps. Runs
+        # immediately after the probe, so the chip's health is at most
+        # one section old when the most valuable capture starts.
         jax_metrics('lm_train', c4_url, fn=_measure_lm_train)
 
     def sec_lm_decode():
@@ -1044,19 +1017,25 @@ def main():
             extra['pp_bf16_device'] = 'cpu-fallback'
 
     try:
-        # Host-only sections first (they cannot wedge on a dead chip and
-        # secure the primary metric + the north-star ratio early), then the
-        # probe, then device sections in decreasing order of importance.
+        # Cumulative emission means finished sections are never lost, so
+        # the order IS the value ranking under budget pressure: the cheap
+        # host sections that secure the primary metric (and build the
+        # datasets later sections read), then the probe, then lm_train
+        # FIRST among the expensive sections — the MFU / input-bound-util
+        # capture is the single most valuable device number (VERDICT r3
+        # #2) and must not queue behind tf.data subprocess startups that
+        # can eat minutes each on a loaded box. tfdata (the north-star
+        # ratio) follows, then the H2D story, decode, pp smoke.
         section('hello_row', 10, sec_hello_row)
         section('hello_batch', 5, sec_hello_batch)
         section('lm_tokens', 10, sec_lm_tokens)
         section('imagenet', 20, sec_imagenet)
-        section('imagenet_python_decode', 10, sec_imagenet_python_decode)
-        section('tfdata', 30, sec_tfdata)
         section('probe', 20, lambda: _probe_tpu(extra))
-        section('jax_hello', 30, sec_jax_hello)
-        section('jax_imagenet', 30, sec_jax_imagenet)
         section('lm_train', 60, sec_lm_train)
+        section('tfdata', 30, sec_tfdata)
+        section('imagenet_python_decode', 10, sec_imagenet_python_decode)
+        section('jax_imagenet', 30, sec_jax_imagenet)
+        section('jax_hello', 30, sec_jax_hello)
         section('lm_decode', 45, sec_lm_decode)
         section('pp_bf16', 30, sec_pp_bf16)
         extra['bench_elapsed_sec'] = round(time.monotonic() - _START, 1)
