@@ -4,7 +4,9 @@ The training side (:mod:`petastorm_tpu.models.transformer`) recomputes
 attention over the full sequence each step; generation would be O(S²) per
 token that way. This module adds the inference half TPU-first:
 
-* a **static-shape KV cache** ``(B, max_seq_len, H, Dh)`` per layer —
+* a **static-shape KV cache** ``(B, max_seq_len, KV, Dh)`` per layer
+  (``KV = config.kv_heads`` — with grouped-query attention the cache and
+  its per-token reads shrink by the query-group factor) —
   XLA-friendly: the cache is updated in place with
   ``lax.dynamic_update_slice`` at a traced position, no growing arrays;
 * **prefill** runs the prompt through the blocks once, recording K/V;
@@ -25,7 +27,7 @@ import numpy as np
 from jax import lax
 
 from petastorm_tpu.models.transformer import (
-    _block_dense_ffn_half, _rmsnorm,
+    _block_dense_ffn_half, _rmsnorm, _split_qkv,
 )
 
 
@@ -35,33 +37,42 @@ def _split_heads(t, n_heads):
 
 
 def _block_kv(block, x, config):
-    """One block's normalized-input QKV projection → (q, k, v) in
-    (B, S, H, Dh) — the same math as the training ``_attention`` entry."""
+    """One block's normalized-input QKV projection → q (B, S, H, Dh),
+    k/v (B, S, KV, Dh) — the same math as the training ``_attention``
+    entry; with GQA (``kv_heads < n_heads``) K/V stay at their shared
+    head count, which is exactly what the cache stores."""
     h = _rmsnorm(x, block['ln1'])
     qkv = jnp.einsum('bsd,de->bse', h, block['qkv'].astype(config.dtype),
                      preferred_element_type=jnp.float32).astype(config.dtype)
-    q, k, v = jnp.split(qkv, 3, axis=-1)
-    n = config.n_heads
-    return _split_heads(q, n), _split_heads(k, n), _split_heads(v, n)
+    n, kv = config.n_heads, config.kv_heads
+    q, k, v = _split_qkv(qkv, n, kv, config.d_model // n)
+    return _split_heads(q, n), _split_heads(k, kv), _split_heads(v, kv)
 
 
 def _attend(q, keys, values, valid_mask, out_w, config):
-    """q (B, S_q, H, Dh) over ``keys``/``values`` (B, S_k, H, Dh), masked
-    by ``valid_mask`` (B, S_q, S_k). The score scaling is the IDENTICAL
-    op to the training path's (``transformer.py`` dense attention,
-    ``scores / np.sqrt(head_dim)``) — a mathematically-equal ``* dh**-.5``
-    differs in the last ulp and would make the exact-parity contract with
-    the oracle seed-dependent."""
+    """q (B, S_q, H, Dh) over ``keys``/``values`` (B, S_k, KV, Dh), masked
+    by ``valid_mask`` (B, S_q, S_k). With GQA the query heads are grouped
+    over their shared K/V head in the einsum itself — the cache is read
+    at KV width, never materialized at H width (that per-token expansion
+    would cost the exact HBM reads the smaller cache saves). Per output
+    element the contraction is identical to the training path's
+    expanded-heads dense attention, so oracle exactness holds. The score
+    scaling is the IDENTICAL op to the training path's
+    (``scores / np.sqrt(head_dim)``) — a mathematically-equal
+    ``* dh**-.5`` differs in the last ulp and would make the exact-parity
+    contract with the oracle seed-dependent."""
     dtype = config.dtype
-    dh = q.shape[-1]
-    scores = jnp.einsum('bqhd,bkhd->bhqk', q, keys,
+    b, s_q, n, dh = q.shape
+    kv = keys.shape[2]
+    group = n // kv
+    qg = q.reshape(b, s_q, kv, group, dh)
+    scores = jnp.einsum('bqkgd,bskd->bkgqs', qg, keys,
                         preferred_element_type=jnp.float32)
     scores = scores / np.sqrt(dh)
-    scores = jnp.where(valid_mask[:, None], scores, -1e30)
+    scores = jnp.where(valid_mask[:, None, None], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
-    ctx = jnp.einsum('bhqk,bkhd->bqhd', probs, values,
+    ctx = jnp.einsum('bkgqs,bskd->bqkgd', probs, values,
                      preferred_element_type=jnp.float32).astype(dtype)
-    b, s_q = ctx.shape[:2]
     ctx = ctx.reshape(b, s_q, -1)
     return jnp.einsum('bsd,de->bse', ctx, out_w.astype(dtype),
                       preferred_element_type=jnp.float32).astype(dtype)
@@ -155,7 +166,10 @@ def _generate(params, prompt, config, max_new_tokens, rng,
     # which matters when max_seq_len >> prompt)
     x = params['embed'][prompt].astype(c.dtype)
     x = x + params['pos_embed'][:p].astype(c.dtype)
-    k_cache = jnp.zeros((n_layers, b, length, c.n_heads, dh), c.dtype)
+    # GQA: the cache is (…, kv_heads, Dh) — the group factor is the whole
+    # point (smaller cache HBM and per-token reads); _attend groups the
+    # query heads over it without expansion
+    k_cache = jnp.zeros((n_layers, b, length, c.kv_heads, dh), c.dtype)
     v_cache = jnp.zeros_like(k_cache)
     causal = jnp.broadcast_to(jnp.tril(jnp.ones((p, p), bool))[None],
                               (b, p, p))

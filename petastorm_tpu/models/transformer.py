@@ -59,6 +59,14 @@ class TransformerConfig:
     # off-TPU so the same config tests on the CPU mesh). Ignored when
     # seq_axis is set — ring/Ulysses own the sharded-sequence case.
     attn_impl: str = 'dense'
+    # grouped-query attention: n_kv_heads < n_heads shares each K/V head
+    # across a group of n_heads // n_kv_heads query heads (GQA; = 1 is
+    # MQA). None means full multi-head (n_kv_heads == n_heads). Training
+    # math is exactly MHA with the shared K/V repeated per group; the win
+    # is the KV cache — models/generate.py stores and reads only
+    # n_kv_heads, shrinking decode cache HBM (and its per-token reads) by
+    # the group factor.
+    n_kv_heads: int = None
     # loss memory: 0 materializes the full (B, S, V) logits in the loss
     # (exact, simple); N > 0 computes head matmul + cross-entropy in
     # position chunks of N under jax.checkpoint, so peak HBM for the loss
@@ -76,6 +84,19 @@ class TransformerConfig:
         if self.attn_impl not in ('dense', 'flash'):
             raise ValueError("attn_impl must be 'dense' or 'flash'; got %r"
                              % (self.attn_impl,))
+        if self.n_kv_heads is not None:
+            if not 1 <= self.n_kv_heads <= self.n_heads:
+                raise ValueError('n_kv_heads must be in [1, n_heads=%d]; '
+                                 'got %r' % (self.n_heads, self.n_kv_heads))
+            if self.n_heads % self.n_kv_heads != 0:
+                raise ValueError('n_heads (%d) must be a multiple of '
+                                 'n_kv_heads (%d)' % (self.n_heads,
+                                                      self.n_kv_heads))
+
+    @property
+    def kv_heads(self):
+        """Effective K/V head count (n_kv_heads, defaulting to n_heads)."""
+        return self.n_kv_heads if self.n_kv_heads is not None else self.n_heads
 
     def moe_config(self):
         from petastorm_tpu.models.moe import MoEConfig
@@ -130,9 +151,11 @@ def init_transformer_params(rng, config, mesh=None):
         'ln_f': jnp.ones((c.d_model,), jnp.float32),
         'lm_head': dense(next(k), (c.d_model, c.vocab_size), 0.02),
     }
+    head_dim = c.d_model // c.n_heads
+    qkv_width = (c.n_heads + 2 * c.kv_heads) * head_dim
     for _ in range(c.n_layers):
         block = {
-            'qkv': dense(next(k), (c.d_model, 3 * c.d_model),
+            'qkv': dense(next(k), (c.d_model, qkv_width),
                          c.d_model ** -0.5),
             'attn_out': dense(next(k), (c.d_model, c.d_model),
                               c.d_model ** -0.5),
@@ -173,17 +196,48 @@ def _rmsnorm(x, gain):
     return (x.astype(jnp.float32) * jax.lax.rsqrt(var + 1e-6) * gain).astype(x.dtype)
 
 
+def _split_qkv(qkv, n_heads, kv_heads, head_dim):
+    """Split the fused projection (…, (H + 2·KV)·Dh) → q (…, H·Dh),
+    k/v (…, KV·Dh). With kv_heads == n_heads this is the classic
+    third-split."""
+    q_w = n_heads * head_dim
+    kv_w = kv_heads * head_dim
+    return (qkv[..., :q_w], qkv[..., q_w:q_w + kv_w],
+            qkv[..., q_w + kv_w:])
+
+
+def _expand_kv_heads(t_bshd, n_heads):
+    """(B, S, KV, Dh) → (B, S, H, Dh): repeat each shared K/V head across
+    its query-head group. GQA's training math IS this expansion — done
+    once here, every full-head attention impl (dense/flash/ring/Ulysses)
+    runs unchanged; only the decode cache path avoids it (grouped einsum
+    over the un-expanded cache, models/generate.py)."""
+    kv = t_bshd.shape[2]
+    if kv == n_heads:
+        return t_bshd
+    return jnp.repeat(t_bshd, n_heads // kv, axis=2)
+
+
 def _attention(x, qkv_w, out_w, n_heads, dtype, seq_axis=None, mesh=None,
                seq_impl='ring', attn_impl='dense', seq_manual=False,
-               causal=True):
+               causal=True, kv_heads=None):
     if not causal and attn_impl == 'flash':
         raise ValueError('the fused flash kernel is causal-only; '
                          "bidirectional attention needs attn_impl='dense'")
     b, s, d = x.shape
     head_dim = d // n_heads
+    kv_heads = n_heads if kv_heads is None else kv_heads
     qkv = jnp.einsum('bsd,de->bse', x, qkv_w.astype(dtype),
                      preferred_element_type=jnp.float32).astype(dtype)
-    q, k_, v = jnp.split(qkv, 3, axis=-1)
+    q, k_, v = _split_qkv(qkv, n_heads, kv_heads, head_dim)
+    if kv_heads != n_heads:
+        # GQA: expand shared K/V per query-head group once, then every
+        # full-head impl below runs unchanged (the decode path keeps the
+        # un-expanded cache instead — models/generate.py)
+        k_ = _expand_kv_heads(k_.reshape(b, s, kv_heads, head_dim),
+                              n_heads).reshape(b, s, d)
+        v = _expand_kv_heads(v.reshape(b, s, kv_heads, head_dim),
+                             n_heads).reshape(b, s, d)
 
     if seq_axis is not None and seq_manual:
         # already INSIDE a shard_map manual over seq_axis (the pipelined
@@ -261,7 +315,8 @@ def _block_attention_half(block, x, config, mesh=None, seq_manual=False,
     x = x + _attention(h, block['qkv'], block['attn_out'], config.n_heads,
                        config.dtype, seq_axis=config.seq_axis, mesh=mesh,
                        seq_impl=config.seq_impl, attn_impl=config.attn_impl,
-                       seq_manual=seq_manual, causal=causal)
+                       seq_manual=seq_manual, causal=causal,
+                       kv_heads=config.kv_heads)
     return _constrain(x, None if seq_manual else config.seq_axis)
 
 

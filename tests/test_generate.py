@@ -203,3 +203,36 @@ def test_top_p_out_of_range_rejected():
     with pytest.raises(ValueError, match='top_p'):
         sample_generate(params, jnp.zeros((1, 4), jnp.int32), config, 2,
                         rng=jax.random.PRNGKey(0), top_p=1.5)
+
+
+def test_gqa_decode_matches_recompute_oracle_exactly():
+    # GQA (2 query heads per shared K/V head): the grouped-einsum cache
+    # path must equal the training forward's expanded-heads math token
+    # for token
+    config, params = _setup(n_heads=4, n_kv_heads=2)
+    prompt = jnp.asarray(
+        np.random.RandomState(1).randint(0, 32, (2, 5), np.int32))
+    got = greedy_generate(params, prompt, config, max_new_tokens=8)
+    want = reference_greedy_generate(params, prompt, config,
+                                     max_new_tokens=8)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_mqa_decode_matches_recompute_oracle_exactly():
+    # the n_kv_heads=1 extreme (multi-query attention)
+    config, params = _setup(n_heads=4, n_kv_heads=1)
+    prompt = jnp.asarray(
+        np.random.RandomState(2).randint(0, 32, (2, 4), np.int32))
+    got = greedy_generate(params, prompt, config, max_new_tokens=6)
+    want = reference_greedy_generate(params, prompt, config,
+                                     max_new_tokens=6)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_gqa_cache_is_kv_heads_sized():
+    # the point of GQA: the qkv projection (and so the cache the decode
+    # builds from it) carries n_kv_heads K/V head blocks, not n_heads
+    config, params = _setup(n_heads=4, n_kv_heads=2)
+    head_dim = config.d_model // config.n_heads
+    expected = (config.n_heads + 2 * 2) * head_dim
+    assert params['blocks'][0]['qkv'].shape == (config.d_model, expected)
