@@ -27,7 +27,7 @@ import numpy as np
 from jax import lax
 
 from petastorm_tpu.models.transformer import (
-    _block_dense_ffn_half, _rmsnorm, _split_qkv,
+    _block_dense_ffn_half, _rmsnorm, _rope_rotate, _split_qkv,
 )
 
 
@@ -36,17 +36,24 @@ def _split_heads(t, n_heads):
     return t.reshape(b, s, n_heads, d // n_heads)
 
 
-def _block_kv(block, x, config):
+def _block_kv(block, x, config, positions=None):
     """One block's normalized-input QKV projection → q (B, S, H, Dh),
     k/v (B, S, KV, Dh) — the same math as the training ``_attention``
     entry; with GQA (``kv_heads < n_heads``) K/V stay at their shared
-    head count, which is exactly what the cache stores."""
+    head count, which is exactly what the cache stores. With rope,
+    ``positions`` (S,) rotates q/k here, so the cache stores ROTATED
+    keys (the standard layout: each key's rotation is fixed at its own
+    position, queries rotate at theirs as they arrive)."""
     h = _rmsnorm(x, block['ln1'])
     qkv = jnp.einsum('bsd,de->bse', h, block['qkv'].astype(config.dtype),
                      preferred_element_type=jnp.float32).astype(config.dtype)
     n, kv = config.n_heads, config.kv_heads
     q, k, v = _split_qkv(qkv, n, kv, config.d_model // n)
-    return _split_heads(q, n), _split_heads(k, kv), _split_heads(v, kv)
+    q, k, v = _split_heads(q, n), _split_heads(k, kv), _split_heads(v, kv)
+    if config.pos_encoding == 'rope':
+        q = _rope_rotate(q, positions, config.rope_theta)
+        k = _rope_rotate(k, positions, config.rope_theta)
+    return q, k, v
 
 
 def _attend(q, keys, values, valid_mask, out_w, config):
@@ -165,7 +172,8 @@ def _generate(params, prompt, config, max_new_tokens, rng,
     # causal mask — not over the full static cache (O(p²), not O(p·L),
     # which matters when max_seq_len >> prompt)
     x = params['embed'][prompt].astype(c.dtype)
-    x = x + params['pos_embed'][:p].astype(c.dtype)
+    if c.pos_encoding == 'learned':
+        x = x + params['pos_embed'][:p].astype(c.dtype)
     # GQA: the cache is (…, kv_heads, Dh) — the group factor is the whole
     # point (smaller cache HBM and per-token reads); _attend groups the
     # query heads over it without expansion
@@ -173,8 +181,9 @@ def _generate(params, prompt, config, max_new_tokens, rng,
     v_cache = jnp.zeros_like(k_cache)
     causal = jnp.broadcast_to(jnp.tril(jnp.ones((p, p), bool))[None],
                               (b, p, p))
+    prefill_positions = jnp.arange(p, dtype=jnp.int32)
     for i, block in enumerate(params['blocks']):
-        q, k, v = _block_kv(block, x, c)
+        q, k, v = _block_kv(block, x, c, positions=prefill_positions)
         k_cache = k_cache.at[i, :, :p].set(k)
         v_cache = v_cache.at[i, :, :p].set(v)
         x = x + _attend(q, k, v, causal, block['attn_out'], c)
@@ -194,14 +203,15 @@ def _generate(params, prompt, config, max_new_tokens, rng,
 
     def step(carry, step_rng):
         k_cache, v_cache, token, pos, done = carry
-        x = (params['embed'][token].astype(c.dtype)
-             + lax.dynamic_index_in_dim(
-                 params['pos_embed'], pos, keepdims=False).astype(c.dtype))
+        x = params['embed'][token].astype(c.dtype)
+        if c.pos_encoding == 'learned':
+            x = x + lax.dynamic_index_in_dim(
+                params['pos_embed'], pos, keepdims=False).astype(c.dtype)
         x = x[:, None, :]  # (B, 1, D)
         valid = (jnp.arange(length) <= pos)[None, None, :]  # (1, 1, L)
         valid = jnp.broadcast_to(valid, (b, 1, length))
         for i, block in enumerate(params['blocks']):
-            q, k, v = _block_kv(block, x, c)
+            q, k, v = _block_kv(block, x, c, positions=pos[None])
             k_cache = lax.dynamic_update_slice(
                 k_cache, k[None], (i, 0, pos, 0, 0))
             v_cache = lax.dynamic_update_slice(

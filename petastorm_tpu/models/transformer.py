@@ -67,6 +67,14 @@ class TransformerConfig:
     # n_kv_heads, shrinking decode cache HBM (and its per-token reads) by
     # the group factor.
     n_kv_heads: int = None
+    # position encoding: 'learned' adds a trained (max_seq_len, d_model)
+    # table at the embedding (the classic GPT-2 layout); 'rope' rotates
+    # q/k per head-dim pair by position-dependent angles (no table — the
+    # 'pos_embed' parameter disappears; positions are global even under
+    # sequence parallelism, where each shard rotates by its global
+    # offsets). rope_theta is the standard wavelength base.
+    pos_encoding: str = 'learned'
+    rope_theta: float = 10000.0
     # loss memory: 0 materializes the full (B, S, V) logits in the loss
     # (exact, simple); N > 0 computes head matmul + cross-entropy in
     # position chunks of N under jax.checkpoint, so peak HBM for the loss
@@ -92,6 +100,13 @@ class TransformerConfig:
                 raise ValueError('n_heads (%d) must be a multiple of '
                                  'n_kv_heads (%d)' % (self.n_heads,
                                                       self.n_kv_heads))
+        if self.pos_encoding not in ('learned', 'rope'):
+            raise ValueError("pos_encoding must be 'learned' or 'rope'; "
+                             'got %r' % (self.pos_encoding,))
+        if (self.pos_encoding == 'rope'
+                and (self.d_model // self.n_heads) % 2 != 0):
+            raise ValueError('rope needs an even head_dim; got %d'
+                             % (self.d_model // self.n_heads))
 
     @property
     def kv_heads(self):
@@ -123,13 +138,15 @@ def _param_specs(config):
     else:
         block['mlp_in'] = P(None, MODEL_AXIS)
         block['mlp_out'] = P(MODEL_AXIS, None)
-    return {
+    specs = {
         'embed': P(None, None),
-        'pos_embed': P(None, None),
         'blocks': [dict(block) for _ in range(config.n_layers)],
         'ln_f': P(None),
         'lm_head': P(None, MODEL_AXIS),
     }
+    if config.pos_encoding == 'learned':
+        specs['pos_embed'] = P(None, None)
+    return specs
 
 
 def init_transformer_params(rng, config, mesh=None):
@@ -146,11 +163,15 @@ def init_transformer_params(rng, config, mesh=None):
 
     params = {
         'embed': dense(next(k), (c.vocab_size, c.d_model), 0.02),
-        'pos_embed': dense(next(k), (c.max_seq_len, c.d_model), 0.02),
         'blocks': [],
         'ln_f': jnp.ones((c.d_model,), jnp.float32),
-        'lm_head': dense(next(k), (c.d_model, c.vocab_size), 0.02),
     }
+    if c.pos_encoding == 'learned':
+        params['pos_embed'] = dense(next(k), (c.max_seq_len, c.d_model),
+                                    0.02)
+    else:
+        next(k)  # rope has no table; keep downstream key assignment stable
+    params['lm_head'] = dense(next(k), (c.d_model, c.vocab_size), 0.02)
     head_dim = c.d_model // c.n_heads
     qkv_width = (c.n_heads + 2 * c.kv_heads) * head_dim
     for _ in range(c.n_layers):
@@ -206,6 +227,27 @@ def _split_qkv(qkv, n_heads, kv_heads, head_dim):
             qkv[..., q_w + kv_w:])
 
 
+def _rope_rotate(t_bshd, positions, theta):
+    """Rotary position embedding (GPT-NeoX split-half convention):
+    rotate each head-dim pair ``(i, i + Dh/2)`` of ``t`` (B, S, H, Dh) by
+    ``positions``-dependent angles. ``positions`` is (S,) int — GLOBAL
+    token positions, which is what makes the same function correct under
+    sequence parallelism (the caller supplies the shard's global offsets).
+    Rotation is per-(position, head-dim) and head-independent, so it
+    commutes with GQA's K/V head expansion."""
+    dh = t_bshd.shape[-1]
+    half = dh // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions.astype(jnp.float32)[:, None] * freqs  # (S, half)
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    t1 = t_bshd[..., :half].astype(jnp.float32)
+    t2 = t_bshd[..., half:].astype(jnp.float32)
+    return jnp.concatenate([t1 * cos - t2 * sin,
+                            t1 * sin + t2 * cos],
+                           axis=-1).astype(t_bshd.dtype)
+
+
 def _expand_kv_heads(t_bshd, n_heads):
     """(B, S, KV, Dh) → (B, S, H, Dh): repeat each shared K/V head across
     its query-head group. GQA's training math IS this expansion — done
@@ -220,7 +262,7 @@ def _expand_kv_heads(t_bshd, n_heads):
 
 def _attention(x, qkv_w, out_w, n_heads, dtype, seq_axis=None, mesh=None,
                seq_impl='ring', attn_impl='dense', seq_manual=False,
-               causal=True, kv_heads=None):
+               causal=True, kv_heads=None, rope_theta=None):
     if not causal and attn_impl == 'flash':
         raise ValueError('the fused flash kernel is causal-only; '
                          "bidirectional attention needs attn_impl='dense'")
@@ -230,6 +272,18 @@ def _attention(x, qkv_w, out_w, n_heads, dtype, seq_axis=None, mesh=None,
     qkv = jnp.einsum('bsd,de->bse', x, qkv_w.astype(dtype),
                      preferred_element_type=jnp.float32).astype(dtype)
     q, k_, v = _split_qkv(qkv, n_heads, kv_heads, head_dim)
+    if rope_theta is not None:
+        # rotate q/k by GLOBAL positions before any attention impl or
+        # K/V expansion (rotation is head-independent, so it commutes
+        # with both). Inside a seq-manual shard_map region `s` is the
+        # LOCAL length — each shard's offset comes from its axis index.
+        positions = jnp.arange(s, dtype=jnp.int32)
+        if seq_manual and seq_axis is not None:
+            positions = positions + s * jax.lax.axis_index(seq_axis)
+        q = _rope_rotate(q.reshape(b, s, n_heads, head_dim), positions,
+                         rope_theta).reshape(b, s, -1)
+        k_ = _rope_rotate(k_.reshape(b, s, kv_heads, head_dim), positions,
+                          rope_theta).reshape(b, s, -1)
     if kv_heads != n_heads:
         # GQA: expand shared K/V per query-head group once, then every
         # full-head impl below runs unchanged (the decode path keeps the
@@ -316,7 +370,10 @@ def _block_attention_half(block, x, config, mesh=None, seq_manual=False,
                        config.dtype, seq_axis=config.seq_axis, mesh=mesh,
                        seq_impl=config.seq_impl, attn_impl=config.attn_impl,
                        seq_manual=seq_manual, causal=causal,
-                       kv_heads=config.kv_heads)
+                       kv_heads=config.kv_heads,
+                       rope_theta=(config.rope_theta
+                                   if config.pos_encoding == 'rope'
+                                   else None))
     return _constrain(x, None if seq_manual else config.seq_axis)
 
 
@@ -429,7 +486,8 @@ def _features_with_aux(params, tokens, config, mesh=None):
                          'collective over that axis)' % (seq,))
     aux_total = jnp.zeros((), jnp.float32)
     x = params['embed'][tokens].astype(c.dtype)
-    x = x + params['pos_embed'][:tokens.shape[1]].astype(c.dtype)
+    if c.pos_encoding == 'learned':
+        x = x + params['pos_embed'][:tokens.shape[1]].astype(c.dtype)
     x = _constrain(x, seq)
     for block in params['blocks']:
         if c.n_experts > 0:
@@ -618,6 +676,7 @@ def init_pipelined_transformer_params(rng, config, mesh, pipe_axis=None):
             NamedSharding(mesh, _restrict_spec_to_mesh(top_specs[name],
                                                        mesh)))
         for name in ('embed', 'pos_embed', 'ln_f', 'lm_head')
+        if name in params
     }
     placed['stages'] = stages
     return placed
@@ -648,7 +707,8 @@ def _pipelined_features_with_aux(params, tokens, config, mesh,
     seq = c.seq_axis
 
     x = params['embed'][tokens].astype(dtype)
-    x = x + params['pos_embed'][:tokens.shape[1]].astype(dtype)
+    if c.pos_encoding == 'learned':
+        x = x + params['pos_embed'][:tokens.shape[1]].astype(dtype)
     x = _constrain(x, seq)
 
     def stage_fn(stage_params, x):

@@ -236,3 +236,28 @@ def test_gqa_cache_is_kv_heads_sized():
     head_dim = config.d_model // config.n_heads
     expected = (config.n_heads + 2 * 2) * head_dim
     assert params['blocks'][0]['qkv'].shape == (config.d_model, expected)
+
+
+def test_rope_decode_matches_recompute_oracle_exactly():
+    # rope: the cache stores position-rotated keys; decode must equal the
+    # training forward (which rotates per global position) token for token
+    config, params = _setup(pos_encoding='rope')
+    assert 'pos_embed' not in params
+    prompt = jnp.asarray(
+        np.random.RandomState(3).randint(0, 32, (2, 5), np.int32))
+    got = greedy_generate(params, prompt, config, max_new_tokens=8)
+    want = reference_greedy_generate(params, prompt, config,
+                                     max_new_tokens=8)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_rope_gqa_decode_matches_recompute_oracle_exactly():
+    # rope and GQA interact (rotation before the grouped-cache attend):
+    # pin the combination, not just each feature alone
+    config, params = _setup(n_heads=4, n_kv_heads=2, pos_encoding='rope')
+    prompt = jnp.asarray(
+        np.random.RandomState(4).randint(0, 32, (2, 6), np.int32))
+    got = greedy_generate(params, prompt, config, max_new_tokens=7)
+    want = reference_greedy_generate(params, prompt, config,
+                                     max_new_tokens=7)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))

@@ -36,7 +36,8 @@ def _restack_as_layered(config, pipelined_params):
             blocks.append(jax.tree_util.tree_map(
                 lambda leaf: np.asarray(leaf[s, l]), stages))
     out = {name: np.asarray(pipelined_params[name])
-           for name in ('embed', 'pos_embed', 'ln_f', 'lm_head')}
+           for name in ('embed', 'pos_embed', 'ln_f', 'lm_head')
+           if name in pipelined_params}  # rope configs carry no pos_embed
     out['blocks'] = blocks
     return out
 
@@ -441,3 +442,28 @@ def test_bf16_pipelined_step_on_pipe_mesh():
             NamedSharding(mesh, P(None, None)))
         _, _, loss = step(params, optimizer.init(params), tokens)
     assert np.isfinite(float(loss))
+
+
+@pytest.mark.parametrize('seq_impl', ['ring', 'ulysses'])
+def test_rope_seq_parallel_pipelined_matches_dense_oracle(seq_impl):
+    # rope + pp×sp: inside the pipeline's manual region each seq shard
+    # sees only LOCAL positions, so the rotation must add the shard's
+    # global offset (lax.axis_index) — this oracle comparison is exactly
+    # the test that catches a local-positions bug.
+    import dataclasses
+    mesh = make_named_mesh({'pipe': 2, 'seq': 2},
+                           devices=jax.devices()[:4])
+    config = _config(n_layers=2, seq_axis='seq', seq_impl=seq_impl,
+                     pos_encoding='rope')
+    with mesh:
+        pipelined = init_pipelined_transformer_params(
+            jax.random.PRNGKey(0), config, mesh)
+        tokens = jnp.asarray(np.random.RandomState(0)
+                             .randint(0, 32, (4, 8), np.int32))
+        got = jax.jit(lambda p, t: pipelined_transformer_forward(
+            p, t, config, mesh, n_microbatches=2))(pipelined, tokens)
+    layered = _restack_as_layered(config, pipelined)
+    oracle_cfg = dataclasses.replace(config, seq_axis=None)
+    want = transformer_forward(_as_jnp(layered), tokens, oracle_cfg)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-4, rtol=2e-4)
