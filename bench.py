@@ -893,6 +893,23 @@ def main():
             _measure_rows(hello_url) for _ in range(MEDIAN_RUNS))
         state['value'] = round(rate, 2)
         state['vs_baseline'] = round(rate / BASELINE_SAMPLES_PER_SEC, 3)
+        # The reference's tool reports rate + RSS + CPU% together (its
+        # published hello-world row carries 217 MB / 136%). Reuse this
+        # repo's throughput tool with its fresh-process mode — measuring
+        # RSS on THIS long-lived driver process would report harness +
+        # dataset-build memory, not the reader's footprint (the same
+        # reason the reference re-spawns, throughput.py:144-149). One
+        # owner of the metric definition; optional: a failure never
+        # touches the primary rate above.
+        try:
+            from petastorm_tpu.benchmark.throughput import reader_throughput
+            r = reader_throughput(hello_url, warmup_cycles=WARMUP_SAMPLES,
+                                  measure_cycles=MEASURE_SAMPLES,
+                                  spawn_new_process=True)
+            extra['hello_world_rss_mb'] = round(r.memory_rss_mb, 1)
+            extra['hello_world_cpu_percent'] = round(r.cpu_percent, 1)
+        except Exception as e:  # noqa: BLE001 - accounting is optional
+            extra['hello_world_rss_error'] = repr(e)[:200]
 
     def sec_hello_batch():
         warm, meas = (100, 600) if SMOKE else (1000, 8000)
