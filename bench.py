@@ -527,7 +527,12 @@ step = transformer_train_step(config, optimizer, donate=True)
 # The train step next-token-shifts to S-1 positions.
 c = config
 s_eff = seq_len - 1
-n_matmul = (c.n_layers * (4 * c.d_model ** 2 + 2 * c.d_model * c.d_ff)
+# qkv projection width varies with GQA; FFN matrix count with swiglu
+qkv_params = c.d_model * (c.n_heads + 2 * c.kv_heads) * (c.d_model
+                                                         // c.n_heads)
+ffn_mats = 3 if c.ffn == 'swiglu' else 2
+n_matmul = (c.n_layers * (qkv_params + c.d_model ** 2
+                          + ffn_mats * c.d_model * c.d_ff)
             + c.d_model * c.vocab_size)
 flops_per_step = (6 * n_matmul * batch * s_eff
                   + 12 * c.n_layers * batch * s_eff ** 2 * c.d_model)

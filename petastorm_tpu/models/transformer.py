@@ -75,6 +75,14 @@ class TransformerConfig:
     # offsets). rope_theta is the standard wavelength base.
     pos_encoding: str = 'learned'
     rope_theta: float = 10000.0
+    # dense-FFN activation: 'gelu' is the classic 2-matrix MLP; 'swiglu'
+    # gates it — silu(x @ W_gate) * (x @ W_in) @ W_out, adding a third
+    # (d_model, d_ff) matrix. Note d_ff keeps its literal meaning (the
+    # hidden width), so a swiglu block holds 1.5x the FFN params of a
+    # gelu block at the same d_ff — scale d_ff by ~2/3 for param parity
+    # (the standard LLaMA sizing). Dense blocks only; MoE experts own
+    # their FFN (n_experts > 0 rejects this knob).
+    ffn: str = 'gelu'
     # loss memory: 0 materializes the full (B, S, V) logits in the loss
     # (exact, simple); N > 0 computes head matmul + cross-entropy in
     # position chunks of N under jax.checkpoint, so peak HBM for the loss
@@ -107,6 +115,13 @@ class TransformerConfig:
                 and (self.d_model // self.n_heads) % 2 != 0):
             raise ValueError('rope needs an even head_dim; got %d'
                              % (self.d_model // self.n_heads))
+        if self.ffn not in ('gelu', 'swiglu'):
+            raise ValueError("ffn must be 'gelu' or 'swiglu'; got %r"
+                             % (self.ffn,))
+        if self.ffn != 'gelu' and self.n_experts > 0:
+            raise ValueError('ffn=%r applies to dense blocks only; MoE '
+                             'configs (n_experts > 0) own their expert '
+                             'FFN' % (self.ffn,))
 
     @property
     def kv_heads(self):
@@ -138,6 +153,11 @@ def _param_specs(config):
     else:
         block['mlp_in'] = P(None, MODEL_AXIS)
         block['mlp_out'] = P(MODEL_AXIS, None)
+        if config.ffn == 'swiglu':
+            # a separate gate matrix (not fused into mlp_in) keeps the
+            # Megatron column split even: both shard (d, d_ff/tp) and the
+            # silu(gate)*up product stays shard-local
+            block['mlp_gate'] = P(None, MODEL_AXIS)
     specs = {
         'embed': P(None, None),
         'blocks': [dict(block) for _ in range(config.n_layers)],
@@ -153,7 +173,8 @@ def init_transformer_params(rng, config, mesh=None):
     """Initialize parameters; with a mesh, each leaf is placed with its
     tensor-parallel sharding so no later reshard is needed."""
     c = config
-    keys_per_layer = 3 if c.n_experts > 0 else 4
+    keys_per_layer = (3 if c.n_experts > 0
+                      else 5 if c.ffn == 'swiglu' else 4)
     keys = jax.random.split(rng, 3 + keys_per_layer * c.n_layers)
     k = iter(range(len(keys)))
 
@@ -189,6 +210,9 @@ def init_transformer_params(rng, config, mesh=None):
         else:
             block['mlp_in'] = dense(next(k), (c.d_model, c.d_ff),
                                     c.d_model ** -0.5)
+            if c.ffn == 'swiglu':
+                block['mlp_gate'] = dense(next(k), (c.d_model, c.d_ff),
+                                          c.d_model ** -0.5)
             block['mlp_out'] = dense(next(k), (c.d_ff, c.d_model),
                                      c.d_ff ** -0.5)
         params['blocks'].append(block)
@@ -378,12 +402,18 @@ def _block_attention_half(block, x, config, mesh=None, seq_manual=False,
 
 
 def _block_dense_ffn_half(block, x, config, seq_manual=False):
-    """Pre-norm dense-FFN sublayer with residual + sharding constraint."""
+    """Pre-norm dense-FFN sublayer with residual + sharding constraint:
+    gelu MLP, or the gated silu variant when ``config.ffn == 'swiglu'``."""
     dtype = config.dtype
     h = _rmsnorm(x, block['ln2'])
-    h = jnp.einsum('bsd,df->bsf', h, block['mlp_in'].astype(dtype),
-                   preferred_element_type=jnp.float32)
-    h = jax.nn.gelu(h.astype(jnp.float32)).astype(dtype)
+    up = jnp.einsum('bsd,df->bsf', h, block['mlp_in'].astype(dtype),
+                    preferred_element_type=jnp.float32)
+    if config.ffn == 'swiglu':
+        gate = jnp.einsum('bsd,df->bsf', h, block['mlp_gate'].astype(dtype),
+                          preferred_element_type=jnp.float32)
+        h = (jax.nn.silu(gate) * up).astype(dtype)
+    else:
+        h = jax.nn.gelu(up.astype(jnp.float32)).astype(dtype)
     x = x + jnp.einsum('bsf,fd->bsd', h, block['mlp_out'].astype(dtype),
                        preferred_element_type=jnp.float32).astype(dtype)
     return _constrain(x, None if seq_manual else config.seq_axis)
