@@ -7,8 +7,8 @@ from petastorm_tpu.jax.loader import (  # noqa: F401
 
 
 def __getattr__(name):
-    # TrainCheckpointer imports orbax; keep that off the base import path
-    if name == 'TrainCheckpointer':
-        from petastorm_tpu.jax.checkpoint import TrainCheckpointer
-        return TrainCheckpointer
+    # checkpoint.py imports orbax; keep that off the base import path
+    if name in ('TrainCheckpointer', 'merge_loader_states'):
+        from petastorm_tpu.jax import checkpoint
+        return getattr(checkpoint, name)
     raise AttributeError(name)

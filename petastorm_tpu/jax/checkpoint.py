@@ -28,6 +28,13 @@ write). Each host's reader holds a DIFFERENT row-group shard, so loader
 states are allgathered and stored keyed by process index — on restore every
 host picks its own entry (orbax's JSON handler alone would persist only the
 primary host's state, silently giving every host shard 0's position).
+
+**Elastic resume**: when the pod is RESIZED between save and restore
+(N writers, M readers), ``restore_loader`` merges all N shards' progress
+via :func:`merge_loader_states` — states carry shard-independent item
+identities, so the union re-localizes under the new M-shard layout.
+At-least-once throughout: the resume epoch is the earliest any old shard
+was still in; nothing is lost, in-flight rows are re-read.
 """
 
 import logging
@@ -36,6 +43,72 @@ logger = logging.getLogger(__name__)
 
 _STATE_KEY = 'train_state'
 _LOADER_KEY = 'loader_state'
+
+
+def merge_loader_states(states):
+    """Merge per-process loader states into one ELASTIC resume state.
+
+    Used when a checkpoint written by N data-parallel processes is
+    restored on M != N (a pod resize): each saved state carries its
+    shard's consumed work as shard-independent ``(piece_index, drop)``
+    identities (``items_global``), so the union re-expresses global
+    progress that any new shard layout can re-localize
+    (``Reader.load_state_dict`` with ``consumed_global``).
+
+    Semantics stay **at-least-once**: the resume epoch is the EARLIEST
+    epoch any old shard was still in (a shard already past it consumed
+    its whole item set there); rows in flight anywhere are re-read,
+    none are lost. Requires every state to carry ``items_global`` —
+    states from before elastic support raise ``ValueError`` (callers
+    fall back to fresh-start).
+    """
+    states = list(states)
+    if not states:
+        raise ValueError('no loader states to merge')
+    if any('items_global' not in s for s in states):
+        raise ValueError('loader state(s) predate elastic resume '
+                         '(no items_global); cannot merge')
+    # integrity: the states must be one complete shard family — every
+    # shard exactly once, all agreeing on the count (a duplicated or
+    # dropped entry would silently mark the missing shard's rows consumed
+    # or double-count another's)
+    shard_counts = {s.get('shard_count') for s in states}
+    shards = [s.get('cur_shard') for s in states]
+    if None not in shard_counts:
+        if len(shard_counts) != 1:
+            raise ValueError('loader states disagree on shard_count: %s'
+                             % sorted(shard_counts))
+        (count,) = shard_counts
+        if sorted(shards) != list(range(count)):
+            raise ValueError('loader states are not one complete shard '
+                             'family: got shards %s of %s'
+                             % (sorted(shards), count))
+    epoch = min(s['epoch'] for s in states)
+    consumed = set()
+    for s in states:
+        idents = [tuple(ident) for ident in s['items_global']]
+        if s['epoch'] > epoch:
+            # this shard finished the resume epoch entirely
+            consumed.update(idents)
+        else:
+            consumed.update(idents[i] for i in s['consumed_items'])
+    if any(s['iterations_remaining'] is None for s in states):
+        iterations_remaining = None  # infinite epochs
+    else:
+        # epoch + remaining = total configured epochs on every shard;
+        # max() is the conservative (re-read, never lose) choice if the
+        # shards ever disagreed
+        iterations_remaining = max(
+            s['epoch'] + s['iterations_remaining'] for s in states) - epoch
+    return {
+        'version': 1,
+        'seed': states[0]['seed'],
+        'epoch': epoch,
+        'iterations_remaining': iterations_remaining,
+        # JSON-shaped (lists, not tuples): the state may round-trip
+        # through orbax's JSON handler before any reader localizes it
+        'consumed_global': [list(ident) for ident in sorted(consumed)],
+    }
 
 
 def _gather_per_process(state):
@@ -167,12 +240,34 @@ class TrainCheckpointer:
             logger.warning('checkpoint step %s was saved without loader '
                            'state; data position starts fresh', step)
             return step
+        if (isinstance(payload, dict)
+                and len(payload) != jax.process_count()):
+            # Pod resized between save and restore (N writers, M readers):
+            # merge every shard's globally-identified progress and let this
+            # reader re-localize it under the NEW shard layout — the
+            # elastic path; at-least-once, nothing lost. Pre-elastic
+            # checkpoints (no items_global) keep the documented
+            # starts-fresh fallback.
+            try:
+                merged = merge_loader_states(payload.values())
+                loader.load_state_dict(merged)
+                logger.info(
+                    'checkpoint step %s: loader state merged from %d '
+                    'processes onto %d (elastic resume, epoch %s)',
+                    step, len(payload), jax.process_count(),
+                    merged['epoch'])
+                return step
+            except ValueError as e:
+                logger.warning('checkpoint step %s: cannot merge resized '
+                               'loader state (%s); data position starts '
+                               'fresh', step, e)
+                return step
         try:
             loader_state = payload[str(jax.process_index())]
         except (KeyError, TypeError) as e:
-            # loader state exists but not for this process index (e.g. the
-            # pod was resized between save and restore): this host's data
-            # position legitimately starts fresh
+            # loader state exists but not for this process index and the
+            # count matches (malformed payload): this host's data position
+            # legitimately starts fresh
             logger.warning('checkpoint step %s has no loader state for this '
                            'process (%s); data position starts fresh',
                            step, e)

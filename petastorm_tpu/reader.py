@@ -278,6 +278,18 @@ class Reader:
         # Per-epoch sets of fully-consumed item indices (for exact resume).
         self._consumed_by_epoch = {}
         self._num_items = len(items)
+        # Shard-independent identity of each local item — (global piece
+        # index, drop partition, drop partition COUNT). This is what makes
+        # a checkpoint portable across a pod resize: consumed work can be
+        # re-expressed globally and re-localized under a different
+        # shard_count (elastic resume). The count is part of the identity
+        # because (piece, drop) only names the same ROWS at the same k: a
+        # restore under a different shuffle_row_drop_partitions must not
+        # match (the old drop's rows are a different subset), making the
+        # piece re-read in full — at-least-once, never silent loss.
+        self._items_identity = [
+            (it['piece_index'],) + tuple(it['shuffle_row_drop_partition'])
+            for it in items]
 
     # -- construction helpers ------------------------------------------------
 
@@ -509,13 +521,43 @@ class Reader:
             'epoch': resume_epoch,
             'iterations_remaining': iterations_remaining,
             'consumed_items': consumed,
+            # shard-independent identities: (global piece index, drop,
+            # drop count) per LOCAL item, enabling cross-shard-count merge
+            # (elastic resume after a pod resize —
+            # see jax/checkpoint.merge_loader_states)
+            'items_global': [list(ident) for ident in self._items_identity],
+            'shard_count': self.shard_count,
+            'cur_shard': self.cur_shard,
         }
 
+    def _localize_state(self, state):
+        """Normalize a possibly-rescaled state to LOCAL ``consumed_items``.
+
+        A merged (elastic) state carries ``consumed_global`` — shard-
+        independent ``(piece_index, drop)`` identities of consumed items —
+        instead of local indices. Identities belonging to other shards
+        under THIS reader's assignment are simply absent from
+        ``_items_identity`` and drop out, which is exactly right: each new
+        shard skips the consumed subset of its own items.
+        """
+        if 'consumed_global' not in state:
+            return state
+        consumed = {tuple(ident) for ident in state['consumed_global']}
+        local = [i for i, ident in enumerate(self._items_identity)
+                 if ident in consumed]
+        state = dict(state)
+        state['consumed_items'] = local
+        return state
+
     def load_state_dict(self, state):
-        """Reposition the iteration before the first read."""
+        """Reposition the iteration before the first read. Accepts a
+        per-shard state from ``state_dict`` or a merged elastic state
+        (``consumed_global``) from
+        :func:`petastorm_tpu.jax.checkpoint.merge_loader_states`."""
         if self._started:
             raise RuntimeError('load_state_dict must be called before iteration '
                                'starts')
+        state = self._localize_state(state)
         self._ventilator.load_state_dict({
             'epoch': state['epoch'],
             'cursor': 0,
@@ -538,6 +580,7 @@ class Reader:
         epoch holding its already-consumed items. Shared with the
         JaxLoader's delivery-accurate record, which must be seeded the same
         way on restore."""
+        state = self._localize_state(state)
         record = {e: set(range(self._num_items))
                   for e in range(state['epoch'])}
         record[state['epoch']] = set(state['consumed_items'])
