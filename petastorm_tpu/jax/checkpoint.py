@@ -50,8 +50,10 @@ def merge_loader_states(states):
 
     Used when a checkpoint written by N data-parallel processes is
     restored on M != N (a pod resize): each saved state carries its
-    shard's consumed work as shard-independent ``(piece_index, drop)``
-    identities (``items_global``), so the union re-expresses global
+    shard's consumed work as shard-independent
+    ``(piece_index, drop, drop_count)`` identities (``items_global`` —
+    the drop-partition count is part of the identity, see
+    ``Reader._items_identity``), so the union re-expresses global
     progress that any new shard layout can re-localize
     (``Reader.load_state_dict`` with ``consumed_global``).
 
@@ -65,6 +67,12 @@ def merge_loader_states(states):
     states = list(states)
     if not states:
         raise ValueError('no loader states to merge')
+    if any(not isinstance(s, dict) for s in states):
+        # a malformed payload entry (partially written checkpoint) must
+        # surface as ValueError so restore_loader's starts-fresh fallback
+        # catches it, not as a TypeError that aborts the whole restore
+        raise ValueError('malformed loader state entries: %s'
+                         % sorted({type(s).__name__ for s in states}))
     if any('items_global' not in s for s in states):
         raise ValueError('loader state(s) predate elastic resume '
                          '(no items_global); cannot merge')

@@ -534,7 +534,8 @@ class Reader:
         """Normalize a possibly-rescaled state to LOCAL ``consumed_items``.
 
         A merged (elastic) state carries ``consumed_global`` — shard-
-        independent ``(piece_index, drop)`` identities of consumed items —
+        independent ``(piece_index, drop, drop_count)`` identities of
+        consumed items (the 3-tuple shape of ``_items_identity``) —
         instead of local indices. Identities belonging to other shards
         under THIS reader's assignment are simply absent from
         ``_items_identity`` and drop out, which is exactly right: each new

@@ -225,3 +225,12 @@ class TestIdentityAndValidation:
             reader.load_state_dict(merged)
             seen = _drain_ids(reader)
         assert set(seen) == set(range(100))  # nothing skipped
+
+    def test_malformed_entry_rejected_as_value_error(self):
+        # restore_loader's starts-fresh fallback catches ValueError only:
+        # a None/non-dict payload entry must surface as that, never as a
+        # TypeError that would abort the whole training restore
+        good = {'epoch': 0, 'seed': 0, 'iterations_remaining': 1,
+                'consumed_items': [], 'items_global': [[0, 0, 1]]}
+        with pytest.raises(ValueError, match='malformed'):
+            merge_loader_states([good, None])

@@ -32,7 +32,9 @@ def _free_port_address():
 
 
 def _run_two_processes(argv_builder, tmp_names, timeout=300):
-    """Launch 2 coordinated worker processes; return their JSON outputs."""
+    """Launch ``len(tmp_names)`` coordinated worker processes (2 for the
+    classic tests; the elastic-resume test restores with 1) and return
+    their JSON outputs."""
     coordinator = _free_port_address()
     env = dict(os.environ,
                XLA_FLAGS='--xla_force_host_platform_device_count=4')
@@ -40,7 +42,7 @@ def _run_two_processes(argv_builder, tmp_names, timeout=300):
     procs = [subprocess.Popen(argv_builder(coordinator, pid, tmp_names[pid]),
                               env=env, stdout=subprocess.PIPE,
                               stderr=subprocess.PIPE, text=True)
-             for pid in range(2)]
+             for pid in range(len(tmp_names))]
     errs = []
     for p in procs:
         try:
@@ -164,3 +166,39 @@ def test_two_process_checkpoint_resume(tmp_path):
     # the two hosts' shards partition the dataset, both phases disjoint
     assert not (host_unions[0] & host_unions[1])
     assert host_unions[0] | host_unions[1] == set(range(100))
+
+
+@pytest.mark.slow
+def test_elastic_resume_two_processes_to_one(tmp_path):
+    """ELASTIC resume for real: save with 2 ``jax.distributed`` processes,
+    restore with ONE fresh process. ``restore_loader`` must detect the
+    writer/reader count mismatch, merge both shards' allgathered states
+    (``merge_loader_states``), and reposition the single loader so it
+    reads the unconsumed remainder — at-least-once, nothing lost, and
+    decisively not a from-scratch epoch."""
+    from tests.test_common import create_test_scalar_dataset
+
+    url = 'file://' + str(tmp_path / 'mh_elastic_ds')
+    create_test_scalar_dataset(url, num_rows=100, num_files=4)
+    ckpt_dir = str(tmp_path / 'ckpt')
+
+    def build(phase, nproc):
+        def argv(coordinator, pid, out):
+            return [sys.executable, _CKPT_WORKER, coordinator, str(pid),
+                    str(nproc), url, ckpt_dir, phase, out]
+        return argv
+
+    before = _run_two_processes(
+        build('save', 2),
+        [str(tmp_path / ('eb%d.json' % i)) for i in range(2)])
+    after = _run_two_processes(build('restore', 1),
+                               [str(tmp_path / 'ea0.json')])
+
+    ids_before = {x for r in before
+                  for step in r['ids_per_step'] for x in step}
+    ids_after = {x for step in after[0]['ids_per_step'] for x in step}
+    assert len(ids_before) == 40  # 2 hosts x 2 batches of 10
+    # union covers the dataset; the resumed single process skipped the
+    # row-groups both old shards had fully consumed
+    assert ids_before | ids_after == set(range(100))
+    assert len(ids_after) < 100
