@@ -83,6 +83,15 @@ class TransformerConfig:
     # (the standard LLaMA sizing). Dense blocks only; MoE experts own
     # their FFN (n_experts > 0 rejects this knob).
     ffn: str = 'gelu'
+    # rematerialization: True wraps every block's forward in
+    # jax.checkpoint, so the backward recomputes block activations
+    # instead of keeping them in HBM — peak activation memory drops from
+    # O(n_layers) to O(1) blocks (+ sqrt-ish recompute cost), the
+    # standard lever for deeper models / longer sequences. Numerically
+    # identical (the recompute replays the same ops). Applies to the
+    # layered AND pipelined forwards; composes with loss_chunk (which
+    # already remats the head).
+    remat: bool = False
     # loss memory: 0 materializes the full (B, S, V) logits in the loss
     # (exact, simple); N > 0 computes head matmul + cross-entropy in
     # position chunks of N under jax.checkpoint, so peak HBM for the loss
@@ -428,6 +437,28 @@ def _block_forward(block, x, config, mesh=None, seq_manual=False,
     return _block_dense_ffn_half(block, x, config, seq_manual=seq_manual)
 
 
+def _make_block_runner(config, mesh=None, seq_manual=False):
+    """``(block, x) -> (x, aux_or_None)`` for one transformer block —
+    the ONE place the MoE/dense branch and the ``config.remat`` wrap
+    live, so the layered and pipelined forwards cannot diverge. With
+    ``remat``, the whole block recomputes in the backward
+    (``jax.checkpoint``): activation memory O(1) blocks."""
+    c = config
+    if c.n_experts > 0:
+        def run_block(block, x):
+            x = _block_attention_half(block, x, c, mesh=mesh,
+                                      seq_manual=seq_manual)
+            return _block_moe_half(block, x, c, seq=c.seq_axis,
+                                   seq_manual=seq_manual)
+    else:
+        def run_block(block, x):
+            return _block_forward(block, x, c, mesh=mesh,
+                                  seq_manual=seq_manual), None
+    if c.remat:
+        run_block = jax.checkpoint(run_block)
+    return run_block
+
+
 def _block_moe_half(block, x, config, seq=None, seq_manual=False):
     """MoE FFN sublayer (RMSNorm → Switch MoE → constrained residual) —
     shared by the layered forward and the pipeline stage executor.
@@ -519,13 +550,12 @@ def _features_with_aux(params, tokens, config, mesh=None):
     if c.pos_encoding == 'learned':
         x = x + params['pos_embed'][:tokens.shape[1]].astype(c.dtype)
     x = _constrain(x, seq)
+
+    run_block = _make_block_runner(c, mesh=mesh)
     for block in params['blocks']:
-        if c.n_experts > 0:
-            x = _block_attention_half(block, x, c, mesh=mesh)
-            x, aux = _block_moe_half(block, x, c, seq=seq)
+        x, aux = run_block(block, x)
+        if aux is not None:
             aux_total = aux_total + aux
-        else:
-            x = _block_forward(block, x, c, mesh=mesh)
     return _rmsnorm(x, params['ln_f']), aux_total
 
 
@@ -741,19 +771,16 @@ def _pipelined_features_with_aux(params, tokens, config, mesh,
         x = x + params['pos_embed'][:tokens.shape[1]].astype(dtype)
     x = _constrain(x, seq)
 
+    run_block = _make_block_runner(c, seq_manual=seq is not None)
+
     def stage_fn(stage_params, x):
         aux_total = jnp.zeros((), jnp.float32)
         for layer in range(per_stage):
             block = jax.tree_util.tree_map(lambda leaf: leaf[layer],
                                            stage_params)
-            if moe:
-                x = _block_attention_half(block, x, c,
-                                          seq_manual=seq is not None)
-                x, aux = _block_moe_half(block, x, c,
-                                         seq_manual=seq is not None)
+            x, aux = run_block(block, x)
+            if aux is not None:
                 aux_total = aux_total + aux
-            else:
-                x = _block_forward(block, x, c, seq_manual=seq is not None)
         return (x, aux_total) if moe else x
 
     if moe:
@@ -829,7 +856,8 @@ def pipelined_transformer_train_step(config, optimizer, mesh,
     return step
 
 
-def transformer_train_step(config, optimizer, mesh=None, donate=False):
+def transformer_train_step(config, optimizer, mesh=None, donate=False,
+                           accum_steps=1):
     """Jittable ``(params, opt_state, tokens) -> (params, opt_state, loss)``.
 
     ``mesh`` is required for sequence-parallel configs (``seq_axis``).
@@ -841,14 +869,54 @@ def transformer_train_step(config, optimizer, mesh=None, donate=False):
     The caller must then never touch the PASSED-IN state after the call
     (the standard ``state = step(state, ...)`` training-loop pattern);
     off by default because oracle tests and examples legitimately reuse
-    the old params for comparisons."""
+    the old params for comparisons.
+
+    ``accum_steps=k`` gradient-accumulates: the (B, S) batch is split
+    into k microbatches of B/k rows (B divisible by k), gradients are
+    averaged over a ``lax.scan`` of per-microbatch backwards, and ONE
+    optimizer update applies — the arithmetic of a B-row step at the
+    activation memory of a B/k-row step. EXACT for dense configs (every
+    position carries a target, so the microbatch mean equals the
+    full-batch mean — pinned by test); for MoE configs the Switch aux
+    loss becomes the mean of per-microbatch statistics, an estimator of
+    (not identical to) the full-batch aux — the same semantics the
+    pipelined step's microbatching has. Composes with ``config.remat``
+    (which shrinks the per-microbatch activations further) and
+    ``donate``."""
 
     import optax
 
+    if accum_steps < 1:
+        raise ValueError('accum_steps must be >= 1; got %r' % (accum_steps,))
+
     @partial(jax.jit, donate_argnums=(0, 1) if donate else ())
     def step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(transformer_loss)(params, tokens,
-                                                           config, mesh)
+        if accum_steps == 1:
+            loss, grads = jax.value_and_grad(transformer_loss)(
+                params, tokens, config, mesh)
+        else:
+            b = tokens.shape[0]
+            if b % accum_steps:
+                raise ValueError('batch size %d not divisible by '
+                                 'accum_steps %d' % (b, accum_steps))
+            chunks = tokens.reshape(accum_steps, b // accum_steps,
+                                    tokens.shape[1])
+
+            def body(carry, chunk):
+                loss_sum, grad_sum = carry
+                loss, grads = jax.value_and_grad(transformer_loss)(
+                    params, chunk, config, mesh)
+                return (loss_sum + loss,
+                        jax.tree_util.tree_map(jnp.add, grad_sum, grads)),\
+                    None
+
+            zeros = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params)
+            (loss_sum, grad_sum), _ = jax.lax.scan(
+                body, (jnp.zeros((), jnp.float32), zeros), chunks)
+            loss = loss_sum / accum_steps
+            grads = jax.tree_util.tree_map(lambda g: g / accum_steps,
+                                           grad_sum)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 
