@@ -296,9 +296,6 @@ def _expand_kv_heads(t_bshd, n_heads):
 def _attention(x, qkv_w, out_w, n_heads, dtype, seq_axis=None, mesh=None,
                seq_impl='ring', attn_impl='dense', seq_manual=False,
                causal=True, kv_heads=None, rope_theta=None):
-    if not causal and attn_impl == 'flash':
-        raise ValueError('the fused flash kernel is causal-only; '
-                         "bidirectional attention needs attn_impl='dense'")
     b, s, d = x.shape
     head_dim = d // n_heads
     kv_heads = n_heads if kv_heads is None else kv_heads
@@ -364,10 +361,10 @@ def _attention(x, qkv_w, out_w, n_heads, dtype, seq_axis=None, mesh=None,
                             causal=causal, batch_axis=batch_axis)
         ctx = ctx.reshape(b, s, d)
     elif attn_impl == 'flash':
-        from petastorm_tpu.ops.flash_attention import flash_causal_attention
+        from petastorm_tpu.ops.flash_attention import flash_attention_fused
         bshd = (b, s, n_heads, head_dim)
-        ctx = flash_causal_attention(q.reshape(bshd), k_.reshape(bshd),
-                                     v.reshape(bshd))
+        ctx = flash_attention_fused(q.reshape(bshd), k_.reshape(bshd),
+                                    v.reshape(bshd), causal=causal)
         ctx = ctx.reshape(b, s, d)
     else:
         def heads(t):

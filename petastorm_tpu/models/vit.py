@@ -41,11 +41,20 @@ class ViTConfig:
     n_layers: int = 6
     d_ff: int = 1024
     dtype: object = jnp.bfloat16
+    # attention implementation for the shared blocks: 'dense' or 'flash'
+    # (the fused kernel runs bidirectional too; it engages only when
+    # n_patches is a multiple of its 128 block — e.g. 32x32 patch grids —
+    # and falls back to exact dense otherwise)
+    attn_impl: str = 'dense'
 
     def __post_init__(self):
         if self.image_size % self.patch_size:
             raise ValueError('image_size=%d not divisible by patch_size=%d'
                              % (self.image_size, self.patch_size))
+        if self.attn_impl not in ('dense', 'flash'):
+            # fail where the typo is made, not later inside block_config
+            raise ValueError("attn_impl must be 'dense' or 'flash'; got %r"
+                             % (self.attn_impl,))
 
     @property
     def n_patches(self):
@@ -61,7 +70,8 @@ class ViTConfig:
             vocab_size=2,  # unused: ViT has no token embedding
             d_model=self.d_model, n_heads=self.n_heads,
             n_layers=self.n_layers, d_ff=self.d_ff,
-            max_seq_len=self.n_patches, dtype=self.dtype)
+            max_seq_len=self.n_patches, dtype=self.dtype,
+            attn_impl=self.attn_impl)
 
 
 def init_vit_params(rng, config, mesh=None):

@@ -1,11 +1,12 @@
-"""Fused causal attention on the MXU: the Pallas flash-attention kernel.
+"""Fused attention on the MXU: the Pallas flash-attention kernel.
 
 The dense attention path materializes the full ``(B, H, S, S)`` score
 tensor in HBM — at seq 1024+ that is the transformer's HBM-bandwidth
 hot spot and the ceiling on single-chip MFU. This wraps jax's shipped
 Pallas TPU flash-attention kernel (blockwise online-softmax; scores only
 ever live in VMEM tiles) behind this framework's ``(B, S, H, D)`` layout,
-with two fallbacks so the SAME model code runs everywhere:
+causal (LM) or bidirectional (ViT/encoder) alike, with two fallbacks so
+the SAME model code runs everywhere:
 
 * real TPU → the Pallas kernel;
 * any other backend → the exact dense reference (tests oracle against it;
@@ -55,10 +56,13 @@ def kernel_supported(seq_len):
         and seq_len % _FLASH_BLOCK == 0
 
 
-def flash_causal_attention(q, k, v, sm_scale=None, force_kernel=False):
-    """Causal self-attention, fused when the backend supports it.
+def flash_attention_fused(q, k, v, causal=True, sm_scale=None,
+                          force_kernel=False):
+    """Self-attention, fused when the backend supports it.
 
     :param q, k, v: ``(B, S, H, D)`` activations (the framework layout).
+    :param causal: lower-triangular mask (LM) vs full bidirectional
+        attention (ViT/encoder) — both ride the same fused kernel.
     :param sm_scale: score scale; default ``1/sqrt(D)``.
     :param force_kernel: run the Pallas kernel even off-TPU (interpret
         mode — slow, for kernel-correctness tests only).
@@ -69,7 +73,7 @@ def flash_causal_attention(q, k, v, sm_scale=None, force_kernel=False):
         sm_scale = 1.0 / np.sqrt(d)
     use_kernel = force_kernel or kernel_supported(s)
     if not use_kernel:
-        return reference_causal_attention(q, k, v, sm_scale)
+        return reference_attention(q, k, v, causal=causal, scale=sm_scale)
 
     from jax.experimental.pallas.ops.tpu.flash_attention import (
         flash_attention,
@@ -78,7 +82,7 @@ def flash_causal_attention(q, k, v, sm_scale=None, force_kernel=False):
     def run():
         # kernel layout is (B, H, S, D)
         bhsd = lambda t: t.transpose(0, 2, 1, 3)  # noqa: E731
-        out = flash_attention(bhsd(q), bhsd(k), bhsd(v), causal=True,
+        out = flash_attention(bhsd(q), bhsd(k), bhsd(v), causal=causal,
                               sm_scale=float(sm_scale))
         return out.transpose(0, 2, 1, 3).astype(q.dtype)
 
@@ -87,3 +91,10 @@ def flash_causal_attention(q, k, v, sm_scale=None, force_kernel=False):
         with pltpu.force_tpu_interpret_mode():
             return run()
     return run()
+
+
+def flash_causal_attention(q, k, v, sm_scale=None, force_kernel=False):
+    """Causal flash attention — :func:`flash_attention_fused` with the LM
+    mask (the original public name, kept for callers)."""
+    return flash_attention_fused(q, k, v, causal=True, sm_scale=sm_scale,
+                                 force_kernel=force_kernel)

@@ -85,3 +85,16 @@ def test_invalid_attn_impl_rejected():
     from petastorm_tpu.models.transformer import TransformerConfig
     with pytest.raises(ValueError, match='attn_impl'):
         TransformerConfig(attn_impl='fused')
+
+
+@slow
+def test_bidirectional_kernel_matches_dense_oracle():
+    # the fused kernel's non-causal mode (ViT/encoder attention)
+    from petastorm_tpu.ops.flash_attention import flash_attention_fused
+    from petastorm_tpu.ops.ring_attention import reference_attention
+    q, k, v = _qkv(seed=3)
+    want = reference_attention(q, k, v, causal=False,
+                               scale=1.0 / np.sqrt(64))
+    got = flash_attention_fused(q, k, v, causal=False, force_kernel=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)

@@ -236,7 +236,12 @@ class TestViT:
         ca2 = np.asarray(_block_forward(block, x2, cfg, causal=True))
         np.testing.assert_allclose(ca1[0, 0], ca2[0, 0], atol=1e-6)
 
-    def test_flash_rejects_bidirectional(self):
+    def test_flash_bidirectional_matches_dense(self):
+        # the fused kernel runs bidirectional too; off-TPU (and below the
+        # 128 block) it takes the exact dense fallback — same math as the
+        # inline dense path up to scale-application order (x*scale vs
+        # x/sqrt differ in the last ulp), so tight allclose, not bitwise
+        import dataclasses
         from petastorm_tpu.models.transformer import (
             TransformerConfig, _block_forward, init_transformer_params,
         )
@@ -245,9 +250,28 @@ class TestViT:
                                 dtype=jnp.float32, attn_impl='flash')
         block = init_transformer_params(jax.random.PRNGKey(0),
                                         cfg)['blocks'][0]
-        with pytest.raises(ValueError, match='causal-only'):
-            _block_forward(block, jnp.zeros((1, 4, 16), jnp.float32), cfg,
-                           causal=False)
+        x = jnp.asarray(np.random.RandomState(0).randn(1, 4, 16),
+                        jnp.float32)
+        got = _block_forward(block, x, cfg, causal=False)
+        dense_cfg = dataclasses.replace(cfg, attn_impl='dense')
+        want = _block_forward(block, x, dense_cfg, causal=False)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-5, rtol=1e-5)
+
+    @pytest.mark.slow
+    def test_vit_flash_config_matches_dense(self):
+        from petastorm_tpu.models.vit import init_vit_params, vit_forward
+        dense_c = self._config()
+        flash_c = self._config(attn_impl='flash')
+        params = init_vit_params(jax.random.PRNGKey(0), dense_c)
+        images = jnp.asarray(
+            np.random.RandomState(0).rand(2, dense_c.image_size,
+                                          dense_c.image_size, 3),
+            jnp.float32)
+        want = vit_forward(params, images, dense_c)
+        got = vit_forward(params, images, flash_c)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-5, rtol=1e-5)
 
     def test_bad_patch_size_rejected(self):
         with pytest.raises(ValueError, match='divisible'):
@@ -1097,3 +1121,10 @@ class TestAccumEdgeCases:
         _, _, l_acc = accum(params, optimizer.init(params), tokens)
         assert np.isfinite(float(l_acc))
         np.testing.assert_allclose(float(l_full), float(l_acc), rtol=0.1)
+
+
+class TestViTConfigValidation:
+    def test_bad_attn_impl_rejected_eagerly(self):
+        from petastorm_tpu.models.vit import ViTConfig
+        with pytest.raises(ValueError, match='attn_impl'):
+            ViTConfig(image_size=16, patch_size=4, attn_impl='fused')
