@@ -1,0 +1,97 @@
+"""A LLaMA-style pretrain recipe: every modern model knob composed.
+
+Same Parquet→packed-batches pipeline as :mod:`pretrain_example`, but the
+model is configured the way current LMs actually ship, exercising the
+whole knob set end to end:
+
+* ``pos_encoding='rope'`` — rotary positions, no learned table;
+* ``n_kv_heads`` — grouped-query attention (the decode KV cache and its
+  per-token HBM reads shrink by the query-group factor; measured 1.62×
+  decode rate on a v5e at the flagship bench shape);
+* ``ffn='swiglu'`` — gated-silu MLP;
+* ``remat=True`` — per-block rematerialization (O(1)-block activation
+  memory);
+* ``transformer_train_step(accum_steps=..., donate=True)`` — gradient
+  accumulation under one optimizer update, train state updated in place.
+
+After training it greedy-decodes a continuation from the grouped KV
+cache — the same parameters serve both phases.
+
+Run:
+    python -m examples.lm.modern_example --generate \
+        --dataset-url file:///tmp/c4_like --steps 20
+"""
+
+import argparse
+
+from examples.lm.pretrain_example import (
+    SEQ_LEN, generate_c4_like, packing_transform,
+)
+
+
+def modern_pretrain(dataset_url, batch_size=8, steps=12, accum_steps=2,
+                    learning_rate=1e-2, seq_len=SEQ_LEN,
+                    decode_tokens=8):
+    """Train the modern-config model; returns (final_loss, decoded_ids)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from petastorm_tpu.jax import make_jax_loader
+    from petastorm_tpu.models.generate import greedy_generate
+    from petastorm_tpu.models.transformer import (
+        TransformerConfig, init_transformer_params, transformer_train_step,
+    )
+
+    config = TransformerConfig(
+        vocab_size=256, d_model=64, n_heads=4, n_kv_heads=2, n_layers=2,
+        d_ff=128, max_seq_len=seq_len, dtype=jnp.float32,
+        pos_encoding='rope', ffn='swiglu', remat=True)
+    params = init_transformer_params(jax.random.PRNGKey(0), config)
+    optimizer = optax.adam(learning_rate)
+    opt_state = optimizer.init(params)
+    # donate: the train state updates in place (state = step(state, ...))
+    step = transformer_train_step(config, optimizer, donate=True,
+                                  accum_steps=accum_steps)
+
+    loss = None
+    with make_jax_loader(dataset_url, batch_size=batch_size,
+                         num_epochs=None, shuffle_row_groups=True,
+                         transform_spec=packing_transform(seq_len)) as loader:
+        it = loader.iter_steps(steps)
+        for batch in it:
+            params, opt_state, loss = step(params, opt_state,
+                                           batch['tokens'])
+    final_loss = float(loss)
+
+    # inference from the SAME params: the decode cache stores only the
+    # grouped K/V heads
+    prompt = jnp.asarray(
+        np.random.RandomState(0).randint(2, 256, (2, 8), np.int32))
+    decoded = greedy_generate(params, prompt, config,
+                              max_new_tokens=decode_tokens)
+    return final_loss, np.asarray(decoded)
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--dataset-url', default='file:///tmp/c4_like')
+    parser.add_argument('--generate', action='store_true',
+                        help='write the synthetic dataset first')
+    parser.add_argument('--steps', type=int, default=20)
+    parser.add_argument('--batch-size', type=int, default=8)
+    parser.add_argument('--accum-steps', type=int, default=2)
+    args = parser.parse_args()
+    if args.generate:
+        generate_c4_like(args.dataset_url)
+    loss, decoded = modern_pretrain(args.dataset_url,
+                                    batch_size=args.batch_size,
+                                    steps=args.steps,
+                                    accum_steps=args.accum_steps)
+    print('final loss: %.4f' % loss)
+    print('decoded continuation (first row): %s' % decoded[0].tolist())
+
+
+if __name__ == '__main__':
+    main()

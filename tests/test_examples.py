@@ -131,6 +131,20 @@ class TestLmExample:
         assert np.isfinite(loss)
 
     @pytest.mark.slow
+    def test_modern_recipe_trains_and_decodes(self, tmp_path):
+        # the LLaMA-style composition: rope + GQA + swiglu + remat +
+        # gradient accumulation + donated state, trained from Parquet,
+        # then greedy decode from the grouped KV cache
+        from examples.lm.modern_example import modern_pretrain
+        from examples.lm.pretrain_example import generate_c4_like
+        url = 'file://' + str(tmp_path / 'c4_modern')
+        generate_c4_like(url, num_docs=128)
+        loss, decoded = modern_pretrain(url, batch_size=8, steps=6,
+                                        accum_steps=2, decode_tokens=6)
+        assert np.isfinite(loss)
+        assert decoded.shape == (2, 14)  # 8 prompt + 6 new
+
+    @pytest.mark.slow
     def test_pretrain_checkpoint_resume(self, tmp_path):
         # interrupt after 8 of 12 steps, rerun: training resumes from the
         # checkpoint (model + data position together), ending with 12 total
