@@ -43,7 +43,9 @@ class WeightedSamplingReader:
         self._readers = readers
         self._cum = np.cumsum(np.asarray(probabilities, dtype=np.float64))
         self._cum /= self._cum[-1]
+        self._seed = seed
         self._rng = np.random.RandomState(seed)
+        self._draws = 0  # mux RNG cursor (for checkpoint/resume)
 
     # The mix exposes the shared reader surface.
     @property
@@ -70,10 +72,49 @@ class WeightedSamplingReader:
     def __next__(self):
         choice = int(np.searchsorted(self._cum, self._rng.random_sample(),
                                      side='right'))
+        self._draws += 1
         return next(self._readers[min(choice, len(self._readers) - 1)])
 
     def next(self):
         return self.__next__()
+
+    def state_dict(self):
+        """Joint data position of the mix: every source reader's
+        row-group-granular state plus the mux RNG cursor, so a restored
+        mix continues the SAME choice sequence (beyond the reference,
+        whose mix has no checkpoint story — like its readers). Sources
+        restore with their own at-least-once semantics; the choice
+        sequence replays exactly when the mix was constructed with an
+        explicit ``seed`` (with ``seed=None`` the sources still restore,
+        but the mux draws are unreproducible by construction)."""
+        return {'version': 1, 'seed': self._seed, 'draws': self._draws,
+                'readers': [r.state_dict() for r in self._readers]}
+
+    def load_state_dict(self, state):
+        """Reposition every source and the mux cursor (call before
+        iteration starts, like the readers' own ``load_state_dict``)."""
+        if len(state['readers']) != len(self._readers):
+            raise ValueError(
+                'checkpoint has %d reader states, this mix has %d readers'
+                % (len(state['readers']), len(self._readers)))
+        for reader, sub_state in zip(self._readers, state['readers']):
+            reader.load_state_dict(sub_state)
+        # Adopt the CHECKPOINT's seed (not the constructor's): a later
+        # state_dict of this restored mix must record the stream it is
+        # actually on, or a second-generation restore would replay a
+        # different choice sequence than the real run took.
+        self._seed = state.get('seed', self._seed)
+        self._rng = np.random.RandomState(self._seed)
+        # replay the mux RNG to the saved cursor in bounded chunks: one
+        # random_sample(draws) call would materialize an 8*draws-byte
+        # throwaway array — a multi-GB allocation at exactly the resume
+        # moment of a long-lived infinite loader
+        remaining = state['draws']
+        while remaining > 0:
+            chunk = min(remaining, 1_000_000)
+            self._rng.random_sample(chunk)
+            remaining -= chunk
+        self._draws = state['draws']
 
     def reset(self):
         """Restart the mix for another pass (the consumer contract
