@@ -47,6 +47,30 @@ _JPEG_FANCY_ATTEMPTS = 0
 _JPEG_FANCY_MAX_ATTEMPTS = 5
 
 
+def _jpeg_mode_cache_path(decode_fn):
+    """Per-host cache file for the calibrated mode, keyed by the native
+    jpeg module build (path+size+mtime): the winner depends only on the
+    libjpeg build linked into that .so, so caching it makes the pick
+    stable run-to-run on a host instead of re-flipping on machine noise
+    (advisor r4). Returns None when the build can't be identified."""
+    import hashlib
+    import sys
+    import tempfile
+    module = sys.modules.get(getattr(decode_fn, '__module__', None))
+    so_path = getattr(module, '__file__', None)
+    if not so_path:
+        return None
+    try:
+        st = os.stat(so_path)
+    except OSError:
+        return None
+    key = hashlib.md5(('%s:%d:%d' % (so_path, st.st_size, st.st_mtime_ns))
+                      .encode('utf-8')).hexdigest()[:12]
+    return os.path.join(tempfile.gettempdir(),
+                        'petastorm_tpu_jpeg_fancy_%d_%s'
+                        % (os.getuid(), key))
+
+
 def _jpeg_upsampling_mode(decode_fn, cells, image_shape):
     """Pick the faster libjpeg chroma-upsampling mode for THIS host.
 
@@ -76,6 +100,20 @@ def _jpeg_upsampling_mode(decode_fn, cells, image_shape):
     with _JPEG_FANCY_LOCK:
         if _JPEG_FANCY_MODE is not None:
             return _JPEG_FANCY_MODE
+        cache_path = _jpeg_mode_cache_path(decode_fn)
+        if cache_path is not None:
+            try:
+                with open(cache_path) as f:
+                    cached = f.read().strip()
+                if cached in ('0', '1'):
+                    _JPEG_FANCY_MODE = int(cached)
+                    logger.info(
+                        'jpeg upsampling mode: %s (host cache %s)',
+                        'fancy' if _JPEG_FANCY_MODE else 'merged',
+                        cache_path)
+                    return _JPEG_FANCY_MODE
+            except OSError:
+                pass
         import statistics
         import time
         sample = cells[:8]
@@ -109,10 +147,20 @@ def _jpeg_upsampling_mode(decode_fn, cells, image_shape):
                     return -1
         medians = {m: statistics.median(t) for m, t in timings.items()}
         _JPEG_FANCY_MODE = min(medians, key=medians.get)
-        logger.debug(
+        # INFO so run artifacts record which mode produced the pixels
+        # (the two modes are both faithful decodes but not bit-identical)
+        logger.info(
             'jpeg upsampling calibrated: %s (merged %.1f img/s, fancy '
             '%.1f img/s)', 'fancy' if _JPEG_FANCY_MODE else 'merged',
             len(sample) / medians[0], len(sample) / medians[1])
+        if cache_path is not None:
+            try:
+                tmp_path = cache_path + '.%d' % os.getpid()
+                with open(tmp_path, 'w') as f:
+                    f.write(str(_JPEG_FANCY_MODE))
+                os.replace(tmp_path, cache_path)
+            except OSError:
+                pass  # stability cache only; calibration already decided
         return _JPEG_FANCY_MODE
 
 

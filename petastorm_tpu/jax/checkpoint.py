@@ -82,15 +82,35 @@ def merge_loader_states(states):
     # or double-count another's)
     shard_counts = {s.get('shard_count') for s in states}
     shards = [s.get('cur_shard') for s in states]
-    if None not in shard_counts:
+    if shard_counts == {None}:
+        pass  # unsharded loaders carry no family to validate
+    elif None in shard_counts:
+        # one legacy/malformed entry must not bypass the family check for
+        # the rest: ValueError lands in the starts-fresh fallback
+        raise ValueError('loader states mix sharded and unsharded '
+                         'entries; cannot merge')
+    else:
         if len(shard_counts) != 1:
             raise ValueError('loader states disagree on shard_count: %s'
                              % sorted(shard_counts))
         (count,) = shard_counts
+        if any(not isinstance(sh, int) for sh in shards):
+            # a missing/null cur_shard must land in the same ValueError
+            # starts-fresh fallback as every other malformed payload, not
+            # escape as a TypeError from sorting None against ints
+            raise ValueError('loader state(s) carry shard_count without '
+                             'an integer cur_shard: %s' % shards)
         if sorted(shards) != list(range(count)):
             raise ValueError('loader states are not one complete shard '
                              'family: got shards %s of %s'
                              % (sorted(shards), count))
+    # The payload arrives as dict.values() of a JSON object — entry order
+    # is arbitrary, so a first-wins seed pick would be nondeterministic.
+    # Shards can legitimately disagree (readers built with seed=None draw
+    # an independent uint32 per process, workers/ventilator.py:77-79), and
+    # at-least-once resume needs no particular seed — any deterministic
+    # pick serves; repr-sort handles None mixed with ints.
+    seed = sorted({s.get('seed') for s in states}, key=repr)[0]
     epoch = min(s['epoch'] for s in states)
     consumed = set()
     for s in states:
@@ -110,7 +130,7 @@ def merge_loader_states(states):
             s['epoch'] + s['iterations_remaining'] for s in states) - epoch
     return {
         'version': 1,
-        'seed': states[0]['seed'],
+        'seed': seed,
         'epoch': epoch,
         'iterations_remaining': iterations_remaining,
         # JSON-shaped (lists, not tuples): the state may round-trip

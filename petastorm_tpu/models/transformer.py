@@ -17,6 +17,7 @@ softmax/norm statistics.
 
 import dataclasses
 from functools import partial
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -51,7 +52,7 @@ class TransformerConfig:
     # rotation — works for any head count, O(S/N) score memory) or
     # 'ulysses' (all-to-all head split — fewer collectives, needs
     # n_heads % n_seq_shards == 0).
-    seq_axis: str = None
+    seq_axis: Optional[str] = None
     seq_impl: str = 'ring'
     # single-chip attention implementation: 'dense' materializes the
     # (B,H,S,S) scores (exact, runs anywhere); 'flash' uses the fused
@@ -66,7 +67,7 @@ class TransformerConfig:
     # is the KV cache — models/generate.py stores and reads only
     # n_kv_heads, shrinking decode cache HBM (and its per-token reads) by
     # the group factor.
-    n_kv_heads: int = None
+    n_kv_heads: Optional[int] = None
     # position encoding: 'learned' adds a trained (max_seq_len, d_model)
     # table at the embedding (the classic GPT-2 layout); 'rope' rotates
     # q/k per head-dim pair by position-dependent angles (no table — the

@@ -84,10 +84,17 @@ class WeightedSamplingReader:
         mix continues the SAME choice sequence (beyond the reference,
         whose mix has no checkpoint story — like its readers). Sources
         restore with their own at-least-once semantics; the choice
-        sequence replays exactly when the mix was constructed with an
-        explicit ``seed`` (with ``seed=None`` the sources still restore,
-        but the mux draws are unreproducible by construction)."""
+        sequence continues exactly for ANY mix — ``rng_state`` carries
+        the generator state itself, so even ``seed=None`` mixes restore
+        onto their actual stream (pre-``rng_state`` checkpoints replay
+        ``seed``+``draws`` instead, which needs an explicit seed)."""
+        # the Mersenne-Twister state itself (JSON-shaped) makes restore
+        # O(1); 'draws' stays as a diagnostic and as the replay cursor
+        # for checkpoints written before rng_state existed
+        kind, keys, pos, has_gauss, cached = self._rng.get_state()
         return {'version': 1, 'seed': self._seed, 'draws': self._draws,
+                'rng_state': [kind, [int(k) for k in keys], int(pos),
+                              int(has_gauss), float(cached)],
                 'readers': [r.state_dict() for r in self._readers]}
 
     def load_state_dict(self, state):
@@ -105,15 +112,22 @@ class WeightedSamplingReader:
         # different choice sequence than the real run took.
         self._seed = state.get('seed', self._seed)
         self._rng = np.random.RandomState(self._seed)
-        # replay the mux RNG to the saved cursor in bounded chunks: one
-        # random_sample(draws) call would materialize an 8*draws-byte
-        # throwaway array — a multi-GB allocation at exactly the resume
-        # moment of a long-lived infinite loader
-        remaining = state['draws']
-        while remaining > 0:
-            chunk = min(remaining, 1_000_000)
-            self._rng.random_sample(chunk)
-            remaining -= chunk
+        if 'rng_state' in state:
+            # O(1) restore: adopt the saved Mersenne-Twister state
+            # directly — replaying billions of draws would stall resume
+            # for minutes on a long-lived infinite mix
+            kind, keys, pos, has_gauss, cached = state['rng_state']
+            self._rng.set_state((kind, np.asarray(keys, dtype=np.uint32),
+                                 int(pos), int(has_gauss), float(cached)))
+        else:
+            # pre-rng_state checkpoints: replay the mux RNG to the saved
+            # cursor in bounded chunks — one random_sample(draws) call
+            # would materialize an 8*draws-byte throwaway array
+            remaining = state['draws']
+            while remaining > 0:
+                chunk = min(remaining, 1_000_000)
+                self._rng.random_sample(chunk)
+                remaining -= chunk
         self._draws = state['draws']
 
     def reset(self):

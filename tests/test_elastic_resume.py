@@ -43,7 +43,7 @@ class TestMergeLoaderStates:
         s_behind = {'epoch': 0, 'seed': 7, 'iterations_remaining': 3,
                     'consumed_items': [1],
                     'items_global': [[0, 0], [2, 0], [4, 0]]}
-        s_ahead = {'epoch': 1, 'seed': 9, 'iterations_remaining': 2,
+        s_ahead = {'epoch': 1, 'seed': 7, 'iterations_remaining': 2,
                    'consumed_items': [0],
                    'items_global': [[1, 0], [3, 0]]}
         merged = merge_loader_states([s_behind, s_ahead])
@@ -59,6 +59,42 @@ class TestMergeLoaderStates:
         s = {'epoch': 2, 'seed': 0, 'iterations_remaining': None,
              'consumed_items': [], 'items_global': [[0, 0]]}
         assert merge_loader_states([s, s])['iterations_remaining'] is None
+
+    def test_merge_seed_pick_is_order_independent(self):
+        # seed=None shard families carry an independent random uint32 per
+        # process (ventilator), and the merge payload arrives in arbitrary
+        # dict order — the merged seed must not depend on entry order
+        base = {'epoch': 0, 'iterations_remaining': 1,
+                'consumed_items': [], 'items_global': [[0, 0]]}
+        a, b = dict(base, seed=9), dict(base, seed=2)
+        assert (merge_loader_states([a, b])['seed']
+                == merge_loader_states([b, a])['seed'])
+        # None mixed with ints must not crash the deterministic pick
+        c = dict(base, seed=None)
+        assert (merge_loader_states([a, c])['seed']
+                == merge_loader_states([c, a])['seed'])
+
+    def test_merge_rejects_mixed_sharded_unsharded(self):
+        # one entry without shard_count must not bypass the
+        # complete-family validation for the rest
+        base = {'epoch': 0, 'seed': 0, 'iterations_remaining': 1,
+                'consumed_items': [], 'items_global': [[0, 0]]}
+        sharded = dict(base, shard_count=2, cur_shard=0)
+        legacy = dict(base)
+        with pytest.raises(ValueError, match='mix sharded'):
+            merge_loader_states([sharded, legacy])
+        # while a complete family still validates (and passes)
+        family = [dict(base, shard_count=2, cur_shard=0),
+                  dict(base, shard_count=2, cur_shard=1)]
+        assert merge_loader_states(family)['epoch'] == 0
+        # and an incomplete/duplicated family still raises
+        with pytest.raises(ValueError, match='complete shard'):
+            merge_loader_states([sharded, dict(sharded)])
+        # shard_count present but cur_shard missing/null: ValueError (the
+        # starts-fresh fallback), never a TypeError from sorting None
+        with pytest.raises(ValueError, match='integer cur_shard'):
+            merge_loader_states([sharded,
+                                 dict(base, shard_count=2)])
 
 
 class TestReaderRescale:

@@ -308,6 +308,8 @@ class TestJpegCodecIntegration:
         from petastorm_tpu.codecs import CompressedImageCodec
         monkeypatch.delenv('PETASTORM_TPU_JPEG_FANCY', raising=False)
         monkeypatch.setattr(codecs, '_JPEG_FANCY_MODE', None)
+        # hermetic: never read/write the real per-host mode cache
+        monkeypatch.setattr(codecs, '_jpeg_mode_cache_path', lambda fn: None)
         codec = CompressedImageCodec('jpeg')
         field = UnischemaField('im', np.uint8, (48, 64, 3), codec, False)
         cells = [codec.encode(field, img)
@@ -319,6 +321,44 @@ class TestJpegCodecIntegration:
         assert jpeg_native.decode_jpeg_batch(cells, ref,
                                              codecs._JPEG_FANCY_MODE) == 8
         np.testing.assert_array_equal(batch, ref)
+
+    def test_calibration_host_cache_round_trip(self, jpeg_native,
+                                               monkeypatch, tmp_path):
+        """The calibrated winner persists to a per-host cache file keyed
+        by the native build, and a later process (fresh module state)
+        restores it without re-timing — run-to-run pixel stability on a
+        host (advisor r4)."""
+        from petastorm_tpu import codecs
+        from petastorm_tpu.codecs import CompressedImageCodec
+        monkeypatch.delenv('PETASTORM_TPU_JPEG_FANCY', raising=False)
+        cache_file = str(tmp_path / 'jpeg_mode_cache')
+        monkeypatch.setattr(codecs, '_jpeg_mode_cache_path',
+                            lambda fn: cache_file)
+        monkeypatch.setattr(codecs, '_JPEG_FANCY_MODE', None)
+        codec = CompressedImageCodec('jpeg')
+        field = UnischemaField('im', np.uint8, (48, 64, 3), codec, False)
+        cells = [codec.encode(field, img)
+                 for img in _jpeg_cells(8, seed=9)[1]]
+        codec.decode_batch(field, cells)
+        first = codecs._JPEG_FANCY_MODE
+        assert first in (0, 1)
+        with open(cache_file) as f:
+            assert f.read().strip() == str(first)
+        # a "new process": poison the cache with the OTHER mode and clear
+        # module state — restore must adopt the cached pick, proving no
+        # re-calibration happened (timing would likely re-pick `first`)
+        with open(cache_file, 'w') as f:
+            f.write(str(1 - first))
+        monkeypatch.setattr(codecs, '_JPEG_FANCY_MODE', None)
+        codec.decode_batch(field, cells)
+        assert codecs._JPEG_FANCY_MODE == 1 - first
+
+    def test_cache_path_keyed_by_native_build(self, jpeg_native):
+        from petastorm_tpu import codecs
+        path = codecs._jpeg_mode_cache_path(jpeg_native.decode_jpeg_batch)
+        assert path is not None and 'petastorm_tpu_jpeg_fancy' in path
+        # unidentifiable builds opt out of caching rather than colliding
+        assert codecs._jpeg_mode_cache_path(len) is None
 
     def test_forced_env_skips_calibration(self, monkeypatch):
         """A set PETASTORM_TPU_JPEG_FANCY disables calibration entirely
