@@ -1085,6 +1085,11 @@ class TestGraftEntry:
         assert 'FULL dp x pp x tp train step' in out      # 3D
         assert 'pipeline matches the sequential oracle' in out
         assert 'ring + Ulysses attention' in out          # sp, both
+        # the ingest path (VERDICT r4 #2): loader over the mesh, shard
+        # coverage, elastic resume
+        assert 'make_jax_loader staged' in out
+        assert 'partitions the dataset exactly' in out
+        assert 'resumed on 4 shards' in out
 
 
 class TestAccumEdgeCases:
