@@ -151,3 +151,141 @@ def test_jax_loader_over_object_store(object_store):
         batches = list(loader)
     assert sum(b['id'].shape[0] for b in batches) == 32
     assert str(batches[0]['vec'].dtype) == 'float32'
+
+
+def _strict_remote_store_class(proto):
+    """Fake store that ENFORCES remote semantics (VERDICT r4 #6):
+
+    * every path must keep its bucket — a path that lost it (os.path
+      mangling, local-path leakage) raises instead of silently resolving;
+    * localizing APIs (``get``/``download``/``open_local``) are forbidden
+      — a remote pipeline streams, it never stages to local disk;
+    * read opens and seeks are recorded, so a test can assert the data
+      really moved through seekable fsspec file objects (the footer-last
+      parquet read discipline), not some side channel.
+    """
+    base = _fake_object_store_class(proto)
+
+    class _StrictRemoteStore(base):
+        reads = []
+        seeks = []
+
+        @classmethod
+        def _strip_protocol(cls, path):
+            p = super()._strip_protocol(path)
+            if not (p == '/' or p.startswith('/bucket')):
+                raise AssertionError(
+                    'non-bucket path reached the object store: %r' % (path,))
+            return p
+
+        def _forbidden(self, *a, **kw):
+            raise AssertionError('localizing API used on a remote store')
+
+        get = get_file = download = open_local = _forbidden
+
+        def _open(self, path, mode='rb', **kw):
+            f = super()._open(path, mode=mode, **kw)
+            if 'r' in mode:
+                cls = type(self)
+                cls.reads.append(path)
+                orig_seek = f.seek
+
+                def recording_seek(pos, whence=0):
+                    cls.seeks.append((path, pos, whence))
+                    return orig_seek(pos, whence)
+
+                f.seek = recording_seek
+            return f
+
+    return _StrictRemoteStore
+
+
+@pytest.fixture
+def strict_gs_store():
+    try:
+        original = fsspec.get_filesystem_class('gs')
+    except (ImportError, ValueError):
+        original = None
+    cls = _strict_remote_store_class('gs')
+    fsspec.register_implementation('gs', cls, clobber=True)
+    try:
+        yield cls
+    finally:
+        cls.store.clear()
+        if original is not None:
+            fsspec.register_implementation('gs', original, clobber=True)
+        else:
+            from fsspec.registry import _registry
+            _registry.pop('gs', None)
+
+
+def test_strict_store_rejects_local_paths_and_localizing_apis(
+        strict_gs_store):
+    cls = strict_gs_store
+    fs = fsspec.filesystem('gs')
+    with pytest.raises(AssertionError, match='non-bucket'):
+        fs.ls('gs://tmp/not-a-bucket-path')
+    with pytest.raises(AssertionError, match='localizing'):
+        fs.get('gs://bucket/x', '/tmp/x')
+
+
+def test_e2e_train_loop_from_gs_url(strict_gs_store):
+    """The whole product path against remote-semantics storage, zero
+    network: write to gs://, read back via a URL LIST + storage_options
+    through make_batch_reader/make_jax_loader, and run a real optimizer
+    loop on the staged batches. Asserts the bytes moved through seekable
+    fsspec reads and that training actually descended."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from petastorm_tpu.jax import make_jax_loader
+
+    cls = strict_gs_store
+    token = {'token': 'fake-gcs-credential'}
+    url = 'gs://bucket/train/e2e'
+    write_dataset(url, SmallSchema, _rows(64), rowgroup_size_rows=8,
+                  num_files=2, storage_options=token)
+
+    # URL-list flavor: read the two part files listed over the scheme
+    fs = fsspec.filesystem('gs')
+    parts = sorted(p for p in fs.ls('/bucket/train/e2e', detail=False)
+                   if p.endswith('.parquet'))
+    assert len(parts) == 2
+    urls = ['gs://%s' % p.lstrip('/') for p in parts]
+
+    cls.reads.clear()
+    cls.seeks.clear()
+    w = jnp.zeros((4,), jnp.float32)
+    opt = optax.adam(0.2)
+    opt_state = opt.init(w)
+
+    @jax.jit
+    def train_step(w, opt_state, vec, target):
+        def loss_fn(w):
+            pred = vec @ w
+            return jnp.mean((pred - target) ** 2)
+        loss, grads = jax.value_and_grad(loss_fn)(w)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(w, updates), opt_state, loss
+
+    losses = []
+    for _ in range(4):  # four epochs by re-building over the same urls
+        with make_jax_loader(urls, batch_size=8, num_epochs=1,
+                             storage_options=token) as loader:
+            for batch in loader:
+                vec = batch['vec']
+                # a learnable target: project vec onto fixed weights
+                target = vec @ jnp.asarray([1.0, -2.0, 0.5, 3.0])
+                w, opt_state, loss = train_step(w, opt_state, vec, target)
+                losses.append(float(loss))
+    assert len(losses) == 32  # 64 rows / batch 8, four epochs
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0] / 10, losses  # it really descended
+
+    # the bytes went through seekable remote reads (parquet footer
+    # discipline), through THIS store, with the credential visible
+    assert any(p.endswith('.parquet') for p in cls.reads), cls.reads
+    assert cls.seeks, 'no seek ever recorded: reads were not ranged'
+    assert any(opts.get('token') == token['token']
+               for opts in cls.captured_options)
