@@ -1044,6 +1044,69 @@ print(json.dumps(result), flush=True)
 BREAKDOWN_BATCH, BREAKDOWN_SEQ = (2, 64) if SMOKE else (8, 1024)
 
 
+def compute_staging_shares(real_rows_s, dummy_rows_s, raw_h2d_mb_s,
+                           bytes_per_batch, batch_size):
+    """Three-way split of the real pipeline's sec/row (VERDICT r4 #4):
+
+    * ``jax_h2d_share`` — the link cost of the staged bytes, capped at
+      the dummy path's whole time: on a degraded tunnel the loader's
+      overlapped H2D beats the raw tight loop (sec_dummy < sec_h2d), and
+      attributing MORE than the dummy time to the link would make the
+      three shares sum past 1;
+    * ``jax_framework_share`` — what the dummy-fed loader adds on top of
+      the (capped) link term: staging machinery; 0 in the overlap regime;
+    * ``jax_io_decode_share`` — the remainder: parquet I/O + decode.
+
+    The three shares partition sec/row(real) by construction (sum 1,
+    up to rounding). Returns None unless all inputs are positive.
+    """
+    if not (real_rows_s and dummy_rows_s and raw_h2d_mb_s
+            and bytes_per_batch):
+        return None
+    sec_real = 1.0 / real_rows_s
+    sec_dummy = min(1.0 / dummy_rows_s, sec_real)
+    sec_h2d = min((bytes_per_batch / batch_size)
+                  / (raw_h2d_mb_s * 2 ** 20), sec_dummy)
+    return {
+        'jax_h2d_share': round(sec_h2d / sec_real, 4),
+        'jax_framework_share': round(
+            (sec_dummy - sec_h2d) / sec_real, 4),
+        'jax_io_decode_share': round(
+            (sec_real - sec_dummy) / sec_real, 4),
+    }
+
+
+def compute_mfu_breakdown(steps_per_sec, input_bound_util, tflops,
+                          part_ms, flagship=None, batch=None, seq=None):
+    """Combine measured part-times (ms) with the step rate into shares
+    of the COMPUTE step (VERDICT r4 #3). ``tflops`` (lm_train's matmul
+    calibration) adds the ideal-time term for the parameter matmuls
+    outside the measured parts; ``input_wait_of_step`` reports the
+    loader's share of the WALL step when input_bound_util > 1. Returns
+    None without a step rate or any measured part."""
+    measured = {key: v for key, v in part_ms.items() if v is not None}
+    if not steps_per_sec or not measured:
+        return None
+    if tflops:
+        k = flagship or FLAGSHIP_LM_KW
+        d = k['d_model']
+        b = batch or BREAKDOWN_BATCH
+        s_eff = (seq or BREAKDOWN_SEQ) - 1
+        no_head = k['n_layers'] * (3 * d * d + d * d
+                                   + 2 * d * k['d_ff'])
+        measured['param_matmul_ideal'] = (
+            6 * no_head * b * s_eff / (tflops * 1e12) * 1e3)
+    step_ms = 1000.0 / steps_per_sec
+    util = input_bound_util
+    compute_ms = step_ms / util if util and util > 1 else step_ms
+    shares = {key: round(v / compute_ms, 4) for key, v in measured.items()}
+    if len(measured) == 4:  # all parts present: close the sum
+        shares['other'] = round(max(0.0, 1.0 - sum(shares.values())), 4)
+    if util and util > 1:
+        shares['input_wait_of_step'] = round(1.0 - 1.0 / util, 4)
+    return shares
+
+
 def _measure_mfu_breakdown(timeout=480):
     """Part-times of the flagship step's big consumers, for the
     ``lm_train_mfu_breakdown`` shares computed in ``sec_mfu_breakdown``."""
@@ -1462,26 +1525,21 @@ def main():
                                                IMAGENET_ROWS * 3)
         jax_metrics('imagenet_jax_dummy', IMAGENET_JAX_BATCH, warm, meas,
                     IMAGENET_SHAPE, fn=_measure_jax_dummy)
-        real = extra.get('imagenet_jax_rows_per_sec')
-        dummy = extra.get('imagenet_jax_dummy_rows_per_sec')
-        raw_mb = extra.get('imagenet_jax_raw_h2d_mb_per_sec')
-        bpb = extra.get('imagenet_jax_staged_bytes_per_batch')
         if (extra.get('imagenet_jax_device')
                 != extra.get('imagenet_jax_dummy_device')):
             # a mid-run wedge put the two runs on DIFFERENT devices (one
             # real, one cpu-fallback): subtracting their rates would mix
             # devices into a bogus headline decomposition
             extra['jax_share_skipped'] = 'device mismatch'
-        elif real and dummy and raw_mb and bpb:
-            sec_real = 1.0 / real
-            sec_dummy = 1.0 / dummy
-            sec_h2d = (bpb / IMAGENET_JAX_BATCH) / (raw_mb * 2 ** 20)
-            extra['jax_h2d_share'] = round(
-                min(1.0, sec_h2d / sec_real), 4)
-            extra['jax_framework_share'] = round(
-                max(0.0, sec_dummy - sec_h2d) / sec_real, 4)
-            extra['jax_io_decode_share'] = round(
-                max(0.0, sec_real - sec_dummy) / sec_real, 4)
+        else:
+            shares = compute_staging_shares(
+                extra.get('imagenet_jax_rows_per_sec'),
+                extra.get('imagenet_jax_dummy_rows_per_sec'),
+                extra.get('imagenet_jax_raw_h2d_mb_per_sec'),
+                extra.get('imagenet_jax_staged_bytes_per_batch'),
+                IMAGENET_JAX_BATCH)
+            if shares:
+                extra.update(shares)
 
     def sec_vit_train():
         # image-family silicon throughput (VERDICT r4 #7): ViT-Base-dims
@@ -1501,37 +1559,14 @@ def main():
         # time and matmul calibration combine into shares of the COMPUTE
         # step (input wait reported separately from input_bound_util).
         jax_metrics('mfu_parts', fn=_measure_mfu_breakdown)
-        sps = extra.get('lm_train_steps_per_sec')
-        util = extra.get('lm_train_input_bound_util')
-        tflops = extra.get('lm_train_measured_matmul_tflops')
-        parts = {
-            'attn_measured': extra.get('mfu_parts_attn_total_ms'),
-            'norms_measured': extra.get('mfu_parts_norm_total_ms'),
-            'loss_head_measured': extra.get('mfu_parts_loss_head_ms'),
-        }
-        measured = {key: v for key, v in parts.items() if v is not None}
-        if sps and measured:
-            if tflops:
-                # ideal time of the parameter matmuls OUTSIDE the
-                # measured parts (attention internals and the lm_head
-                # live inside their measured terms), at lm_train's own
-                # calibrated rate
-                k = FLAGSHIP_LM_KW
-                d = k['d_model']
-                batch, s_eff = BREAKDOWN_BATCH, BREAKDOWN_SEQ - 1
-                no_head = k['n_layers'] * (3 * d * d + d * d
-                                           + 2 * d * k['d_ff'])
-                measured['param_matmul_ideal'] = (
-                    6 * no_head * batch * s_eff / (tflops * 1e12) * 1e3)
-            step_ms = 1000.0 / sps
-            compute_ms = step_ms / util if util and util > 1 else step_ms
-            shares = {key: round(v / compute_ms, 4)
-                      for key, v in measured.items()}
-            if len(measured) == 4:  # all parts present: close the sum
-                shares['other'] = round(
-                    max(0.0, 1.0 - sum(shares.values())), 4)
-            if util and util > 1:
-                shares['input_wait_of_step'] = round(1.0 - 1.0 / util, 4)
+        shares = compute_mfu_breakdown(
+            extra.get('lm_train_steps_per_sec'),
+            extra.get('lm_train_input_bound_util'),
+            extra.get('lm_train_measured_matmul_tflops'),
+            {'attn_measured': extra.get('mfu_parts_attn_total_ms'),
+             'norms_measured': extra.get('mfu_parts_norm_total_ms'),
+             'loss_head_measured': extra.get('mfu_parts_loss_head_ms')})
+        if shares:
             extra['lm_train_mfu_breakdown'] = shares
 
     def sec_lm_train_tuned():
@@ -1541,9 +1576,13 @@ def main():
         # the activation HBM that capped the flagship at batch 8, and the
         # larger per-core batch amortizes the non-MXU per-step work the
         # breakdown section quantifies.
+        # bounded timeout: a pathological compile here must not starve
+        # the sections that follow (lm_train's own 900s is for the single
+        # most valuable capture; this is the experiment, not the record)
         jax_metrics('lm_train_tuned', c4_url,
                     fn=lambda url: _measure_lm_train(
-                        url, batch=16, overrides=dict(remat=True)))
+                        url, batch=16, overrides=dict(remat=True),
+                        timeout=420))
 
     def sec_lm_decode():
         # inference: KV-cache greedy decode rate on the same model family

@@ -149,3 +149,73 @@ def test_poisoned_platform_full_smoke():
     # every intermediate line is itself a complete cumulative report
     for line in parsed:
         assert line['metric'] == 'hello_world_read_rate'
+
+
+class TestShareMath:
+    """The share computations behind jax_framework_share and
+    lm_train_mfu_breakdown are pure functions — the TPU sections feed
+    them; these tests pin the arithmetic and the clamps."""
+
+    def test_staging_shares_partition_the_real_sec_per_row(self):
+        import bench
+        # real 300 rows/s, dummy 3000 rows/s, link at 500 MB/s for
+        # 150 KB/row batches of 64 (link faster than the dummy path, as
+        # physics requires — the dummy run includes the same H2D)
+        shares = bench.compute_staging_shares(
+            300.0, 3000.0, 500.0, 64 * 150 * 1024, 64)
+        assert shares is not None
+        total = (shares['jax_h2d_share'] + shares['jax_framework_share']
+                 + shares['jax_io_decode_share'])
+        assert abs(total - 1.0) < 0.01, shares
+        # I/O+decode dominates: dummy is 10x faster than real
+        assert shares['jax_io_decode_share'] > 0.8
+
+    def test_staging_shares_clamp_on_overlapping_link(self):
+        import bench
+        # degraded tunnel: the loader's overlapped H2D (dummy 60 rows/s)
+        # beats the raw loop (50 MB/s for 1.5 MB rows => ~33 rows/s) —
+        # framework share must clamp to 0, not go negative
+        shares = bench.compute_staging_shares(
+            50.0, 60.0, 50.0, 64 * 1536 * 1024, 64)
+        assert shares['jax_framework_share'] == 0.0
+        assert 0.0 <= shares['jax_h2d_share'] <= 1.0
+        # the partition property must hold in the clamped regime too:
+        # the link term is capped at the dummy path's whole time
+        total = (shares['jax_h2d_share'] + shares['jax_framework_share']
+                 + shares['jax_io_decode_share'])
+        assert abs(total - 1.0) < 0.01, shares
+
+    def test_staging_shares_missing_inputs(self):
+        import bench
+        assert bench.compute_staging_shares(None, 1.0, 1.0, 1, 64) is None
+        assert bench.compute_staging_shares(1.0, 1.0, 0.0, 1, 64) is None
+
+    def test_mfu_breakdown_shares_close_and_split_input_wait(self):
+        import bench
+        flagship = dict(vocab_size=16384, d_model=1536, n_heads=16,
+                        n_layers=10, d_ff=6144)
+        # 5 steps/s wall with util 1.05 => compute step ~190.5 ms
+        shares = bench.compute_mfu_breakdown(
+            5.0, 1.05, 193.0,
+            {'attn_measured': 40.0, 'norms_measured': 5.0,
+             'loss_head_measured': 25.0},
+            flagship=flagship, batch=8, seq=1024)
+        assert shares is not None
+        # the ideal param-matmul term landed (~78 ms at 193 TF/s)
+        assert 0.3 < shares['param_matmul_ideal'] < 0.5, shares
+        keyed = ['attn_measured', 'norms_measured', 'loss_head_measured',
+                 'param_matmul_ideal', 'other']
+        assert abs(sum(shares[k] for k in keyed) - 1.0) < 0.01, shares
+        assert abs(shares['input_wait_of_step'] - (1 - 1 / 1.05)) < 1e-3
+
+    def test_mfu_breakdown_partial_parts_no_other(self):
+        import bench
+        shares = bench.compute_mfu_breakdown(
+            5.0, None, None, {'attn_measured': 40.0,
+                              'norms_measured': None,
+                              'loss_head_measured': None})
+        assert set(shares) == {'attn_measured'}
+        assert bench.compute_mfu_breakdown(
+            None, None, None, {'attn_measured': 1.0}) is None
+        assert bench.compute_mfu_breakdown(
+            5.0, None, None, {'attn_measured': None}) is None
