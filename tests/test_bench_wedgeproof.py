@@ -141,7 +141,11 @@ def test_poisoned_platform_full_smoke():
     skipped = extra.get('skipped_sections', [])
     for prefix, sec in [('hello_world_jax', 'jax_hello'),
                         ('imagenet_jax', 'jax_imagenet'),
+                        ('imagenet_jax_dummy', 'jax_dummy'),
+                        ('vit_train', 'vit_train'),
                         ('lm_train', 'lm_train'),
+                        ('lm_train_tuned', 'lm_train_tuned'),
+                        ('mfu_parts', 'mfu_breakdown'),
                         ('lm_decode', 'lm_decode'),
                         ('pp_bf16', 'pp_bf16')]:
         assert ('%s_error' % prefix in extra or sec in skipped), (
