@@ -25,8 +25,17 @@ def build_parser():
                         help='regex patterns selecting fields to read')
     parser.add_argument('-w', '--warmup-cycles', type=int, default=200)
     parser.add_argument('-m', '--measure-cycles', type=int, default=1000)
-    parser.add_argument('-p', '--pool-type', default='thread',
-                        choices=['thread', 'process', 'dummy'])
+    parser.add_argument('-p', '--pool', '--pool-type', dest='pool_type',
+                        default='thread',
+                        choices=['thread', 'process', 'dummy', 'service'],
+                        help="'service' measures the disaggregated decode "
+                             'path: localhost worker servers are spawned '
+                             'automatically unless '
+                             'PETASTORM_TPU_SERVICE_DISPATCHER points at an '
+                             'existing dispatcher endpoint with an external '
+                             'fleet (docs/service.md), so thread/process/'
+                             'service throughput is comparable from one '
+                             'command')
     parser.add_argument('-l', '--loaders-count', type=int, default=None,
                         help='decode workers; default auto-sizes to the host')
     parser.add_argument('-r', '--read-method', default='python',

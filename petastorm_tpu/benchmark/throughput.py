@@ -87,7 +87,7 @@ def reader_throughput(dataset_url, field_regex=None, warmup_cycles=200,
     elif read_method == 'jax':
         counter = _measure_jax(dataset_url, field_regex, warmup_cycles,
                                measure_cycles, shuffle_row_groups, batch_size,
-                               loaders_count,
+                               loaders_count, pool_type,
                                dummy=dummy, use_dummy=reader_type == 'dummy')
     else:
         raise ValueError("read_method must be 'python', 'batch' or 'jax'; "
@@ -150,7 +150,7 @@ def _measure_batches(url, field_regex, warmup, measure, pool_type, workers,
 
 
 def _measure_jax(url, field_regex, warmup, measure, shuffle, batch_size,
-                 workers, dummy=None, use_dummy=False):
+                 workers, pool_type='thread', dummy=None, use_dummy=False):
     from petastorm_tpu.jax import make_jax_loader
     kwargs = {}
     if use_dummy:
@@ -163,6 +163,7 @@ def _measure_jax(url, field_regex, warmup, measure, shuffle, batch_size,
     else:
         kwargs['workers_count'] = workers
         kwargs['shuffle_row_groups'] = shuffle
+        kwargs['reader_pool_type'] = pool_type
     with make_jax_loader(url, batch_size=batch_size, fields=field_regex,
                          num_epochs=None, **kwargs) as loader:
         it = iter(loader)

@@ -938,6 +938,25 @@ class JaxLoader:
                 "GIL-heavy transforms to reader_pool_type='process'"
                 % (frac * 100),
             ]
+            # tf.data-service-style escalation: when the host's own CPUs
+            # are the wall, scale the DECODE FLEET, not this host
+            pool_diag = {}
+            try:
+                pool_diag = dict(self._reader.diagnostics)
+            except Exception:  # noqa: BLE001 - custom readers may lack it
+                pass
+            if 'workers_registered' in pool_diag:
+                report['advice'].append(
+                    'the remote decode fleet (%d live worker server(s)) is '
+                    'the lagging stage: start more worker servers — they '
+                    'register with the running dispatcher, no restart '
+                    'needed (docs/service.md)'
+                    % pool_diag.get('workers_alive', 0))
+            else:
+                report['advice'].append(
+                    'if this host is out of CPU, disaggregate decode to '
+                    "remote CPU hosts with reader_pool_type='service' "
+                    '(docs/service.md)')
         elif frac < 0.33:
             report['bottleneck'] = 'compute'
             report['advice'] = [

@@ -126,21 +126,54 @@ def _make_cache(cache_type, location, size_limit, row_size_estimate):
 
 
 def _make_pool(reader_pool_type, workers_count, results_queue_size):
+    import os
+    if not isinstance(reader_pool_type, str):
+        # A pre-built pool instance (any object honoring the pool contract):
+        # lets callers configure endpoints/timeouts a string cannot carry,
+        # e.g. Reader(..., reader_pool_type=ServicePool(endpoint=...)).
+        pool = reader_pool_type
+        missing = [m for m in ('start', 'ventilate', 'get_results', 'stop',
+                               'join', 'workers_count', 'diagnostics')
+                   if not hasattr(pool, m)]
+        if missing:
+            raise ValueError('reader_pool_type instance %r lacks pool '
+                             'contract member(s) %s' % (pool, missing))
+        return pool
     if workers_count is None:
         # Auto-size to the host: decode is CPU-bound (cv2/numpy release the
         # GIL but still need a core each), so extra workers on a small box
         # only thrash. 4 matches the previous fixed default on TPU VMs.
-        import os
         workers_count = max(1, min(4, os.cpu_count() or 1))
     if reader_pool_type == 'thread':
         return ThreadPool(workers_count, results_queue_size)
     if reader_pool_type == 'process':
         from petastorm_tpu.workers.process_pool import ProcessPool
         return ProcessPool(workers_count, results_queue_size)
+    if reader_pool_type == 'service':
+        # Disaggregated decode over tcp:// (docs/service.md). With the env
+        # var set, the dispatcher binds there and an externally-started
+        # worker-server fleet registers with it; without it, a localhost
+        # fleet of workers_count servers is spawned (same shape as
+        # 'process', but through the full network stack).
+        from petastorm_tpu.service import ServicePool
+        endpoint = os.environ.get('PETASTORM_TPU_SERVICE_DISPATCHER')
+        if endpoint:
+            # workers_count deliberately does NOT feed expected_workers: it
+            # sizes LOCAL decode parallelism, while the external fleet size
+            # is the operator's (default: start as soon as one worker
+            # registers; more join live — docs/env_knobs.md).
+            expected = os.environ.get('PETASTORM_TPU_SERVICE_WORKERS')
+            return ServicePool(endpoint=endpoint,
+                               expected_workers=int(expected) if expected
+                               else None,
+                               results_queue_size=results_queue_size)
+        return ServicePool(spawn_local_workers=workers_count,
+                           results_queue_size=results_queue_size)
     if reader_pool_type == 'dummy':
         return DummyPool()
     raise ValueError("reader_pool_type must be one of 'thread', 'process', "
-                     "'dummy'; got %r" % reader_pool_type)
+                     "'service', 'dummy' (or a pool instance); got %r"
+                     % reader_pool_type)
 
 
 class Reader:
@@ -248,10 +281,13 @@ class Reader:
                               'item_index': len(items)})
         self._pool = _make_pool(reader_pool_type, workers_count, results_queue_size)
         self._num_epochs = num_epochs
+        # The bound is a callable so pools whose fleet grows at runtime
+        # (service pool: worker servers can register with a RUNNING job)
+        # get proportionally more row-groups in flight without a restart.
         self._ventilator = ConcurrentVentilator(
             self._pool.ventilate, items, iterations=num_epochs,
-            max_ventilation_queue_size=self._pool.workers_count
-            + _VENTILATE_EXTRA_ROWGROUPS,
+            max_ventilation_queue_size=lambda: (
+                self._pool.workers_count + _VENTILATE_EXTRA_ROWGROUPS),
             randomize_item_order=shuffle_row_groups, random_seed=seed,
             pass_epoch=True)
 

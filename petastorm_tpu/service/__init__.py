@@ -1,0 +1,29 @@
+"""Disaggregated decode service: a remote worker pool over ZMQ ``tcp://``.
+
+The local pools (:mod:`petastorm_tpu.workers`) decode Parquet row-groups with
+the consumer host's own CPUs — on a TPU VM those are scarce, and "tf.data
+service" (PAPERS.md) shows that moving input processing onto separate CPU
+hosts is the single biggest lever for input-bound accelerator jobs. This
+package is that lever for petastorm_tpu:
+
+* :mod:`~petastorm_tpu.service.dispatcher` — item scheduler that registers
+  worker servers, hands out ventilated row-group items with per-worker
+  credit, and **re-ventilates** items owned by workers whose heartbeats
+  lapse (fault tolerance = every item delivered exactly once).
+* :mod:`~petastorm_tpu.service.worker_server` — a standalone process
+  (``python -m petastorm_tpu.service.worker_server``) that runs the existing
+  :class:`~petastorm_tpu.workers.worker_base.WorkerBase` decode workers and
+  streams results back over ``tcp://``.
+* :class:`~petastorm_tpu.service.service_pool.ServicePool` — the client,
+  implementing the same pool contract as
+  :class:`~petastorm_tpu.workers.thread_pool.ThreadPool` /
+  :class:`~petastorm_tpu.workers.process_pool.ProcessPool`, so
+  ``Reader(..., reader_pool_type='service')`` and ``make_jax_loader(...)``
+  work unchanged.
+
+See ``docs/service.md`` for the topology, the heartbeat/re-ventilation
+semantics, and when to disaggregate (keyed to
+``JaxLoader.autotune_report()``).
+"""
+
+from petastorm_tpu.service.service_pool import ServicePool  # noqa: F401

@@ -1,0 +1,378 @@
+"""Dispatcher: the service's item scheduler and liveness tracker.
+
+Runs as a single thread that owns the ROUTER socket (ZMQ sockets are not
+thread-safe; every socket operation happens here). Other threads interact
+through three thread-safe surfaces only: :meth:`submit` (the ventilator
+hands in work items), the ``deliver`` callback (results flow out to the
+:class:`~petastorm_tpu.service.service_pool.ServicePool`'s bounded queue),
+and :meth:`stats` (gauges).
+
+Scheduling is credit-based: each live, READY worker server holds at most
+``max_inflight_per_worker`` assigned items, so a slow worker never hoards
+the queue and back-pressure composes with the ventilator's own in-flight
+bound.
+
+Fault tolerance — the exactly-once core:
+
+* Every ventilated item gets a monotonically increasing id; ownership
+  (``item id -> worker identity``) is recorded at assignment.
+* A worker whose heartbeat lapses past ``liveness_timeout_s`` is
+  deregistered and its in-flight items go back to the FRONT of the pending
+  queue (**re-ventilation**) for reassignment.
+* Completions are deduplicated by item id: a lapsed-but-actually-alive
+  worker (GC pause, network stall) racing its replacement can produce two
+  DONEs for one item — the first wins and is delivered, the second is
+  dropped. Worker servers buffer an item's results and send them in a
+  single DONE, so a worker killed mid-item has delivered nothing for it
+  and the re-run is not a duplicate. Together: every item's row set reaches
+  the consumer exactly once.
+"""
+
+import collections
+import logging
+import threading
+import time
+
+from petastorm_tpu.service import protocol as proto
+
+logger = logging.getLogger(__name__)
+
+_POLL_INTERVAL_MS = 50
+_STOP_BROADCASTS = 3
+
+
+class _WorkerState:
+    __slots__ = ('identity', 'last_heartbeat', 'ready', 'inflight')
+
+    def __init__(self, identity, now):
+        self.identity = identity
+        self.last_heartbeat = now
+        self.ready = False
+        self.inflight = set()
+
+
+class Dispatcher:
+    """Single-threaded scheduler loop behind a :class:`ServicePool`.
+
+    :param endpoint: ``tcp://host:port`` to bind; port ``0`` binds a random
+        free port (the resolved endpoint appears as :attr:`endpoint` once
+        :meth:`wait_bound` returns).
+    :param job_spec_payload: :func:`protocol.dump_job_spec` bytes replied to
+        every REGISTER.
+    :param deliver: NON-BLOCKING callable ``(kind, payload) -> bool``
+        pushing ``('result', bytes)`` / ``('error', exc)`` /
+        ``('marker', None)`` entries to the consumer; returns False when
+        the consumer queue is momentarily full (the entry is then kept in
+        an internal backlog and retried) and True when accepted or the
+        pool is stopping. It must never block: this thread also acks
+        worker heartbeats, and a consumer pause (recompile, checkpoint
+        save) must quiesce the fleet, not starve its liveness protocol.
+    :param stop_event: shared :class:`threading.Event`; setting it makes
+        :meth:`run` broadcast STOP to all workers and exit.
+    """
+
+    def __init__(self, endpoint, job_spec_payload, deliver, stop_event,
+                 heartbeat_interval_s=1.0, liveness_timeout_s=4.0,
+                 max_inflight_per_worker=2, no_workers_timeout_s=30.0):
+        self._requested_endpoint = endpoint
+        self._job_spec_payload = job_spec_payload
+        self._deliver = deliver
+        self._stop_event = stop_event
+        self._heartbeat_interval_s = heartbeat_interval_s
+        self._liveness_timeout_s = liveness_timeout_s
+        self._max_inflight_per_worker = max_inflight_per_worker
+        self._no_workers_timeout_s = no_workers_timeout_s
+
+        self.endpoint = None
+        self._bound = threading.Event()
+        self._lock = threading.Lock()
+        self._pending = collections.deque()   # (item_id, payload)
+        self._pending_ids = set()
+        self._next_item_id = 0
+        self._workers = {}                    # identity -> _WorkerState
+        self._inflight = {}                   # item_id -> (identity, payload)
+        # Completion dedup applies ONLY to items that were ever
+        # re-ventilated: a single-assignment item produces exactly one DONE
+        # (one WORK message -> one completion), so keeping every finished id
+        # would leak memory across an infinite-epoch stream for nothing.
+        # _risky_ids marks re-ventilated items; _done records their
+        # completions. Both stay bounded by failure churn, not stream length.
+        self._risky_ids = set()
+        self._done = set()
+        # Results awaiting consumer-queue space. Bounded in steady state:
+        # while it is non-empty no new items are assigned, so it can never
+        # exceed the completions already in flight when the consumer
+        # stalled (≈ max_inflight_per_worker × workers).
+        self._out_backlog = collections.deque()
+        self._completed_count = 0
+        self._reventilated_count = 0
+        self._workers_seen = 0
+        self._fatal_error = None
+        self._no_workers_since = None
+
+    # -- thread-safe surface (called from pool / ventilator threads) ---------
+
+    def submit(self, payload):
+        """Enqueue one dill-framed work item; returns its item id."""
+        with self._lock:
+            item_id = self._next_item_id
+            self._next_item_id += 1
+            self._pending.append((item_id, payload))
+            self._pending_ids.add(item_id)
+            return item_id
+
+    def wait_bound(self, timeout):
+        """Block until the ROUTER socket is bound (or binding failed)."""
+        if not self._bound.wait(timeout):
+            raise RuntimeError('Dispatcher did not bind %r within %.1fs'
+                               % (self._requested_endpoint, timeout))
+        if self._fatal_error is not None:
+            raise self._fatal_error
+
+    @property
+    def fatal_error(self):
+        return self._fatal_error
+
+    def registered_workers(self):
+        return len(self._workers)
+
+    def stats(self):
+        with self._lock:
+            pending = len(self._pending)
+        # list() snapshots the dict at C level (atomic under the GIL):
+        # the dispatcher thread may register/deregister workers while a
+        # consumer thread polls diagnostics.
+        workers = list(self._workers.values())
+        live = sum(1 for w in workers
+                   if time.monotonic() - w.last_heartbeat
+                   <= self._liveness_timeout_s)
+        return {
+            'workers_alive': live,
+            'workers_registered': len(self._workers),
+            'workers_seen': self._workers_seen,
+            'items_assigned': len(self._inflight),
+            'items_pending': pending,
+            'items_reventilated': self._reventilated_count,
+        }
+
+    # -- dispatcher thread ---------------------------------------------------
+
+    def run(self):
+        import zmq
+
+        context = zmq.Context()
+        sock = context.socket(zmq.ROUTER)
+        try:
+            if self._requested_endpoint.endswith(':0'):
+                base = self._requested_endpoint.rsplit(':', 1)[0]
+                port = sock.bind_to_random_port(base)
+                self.endpoint = '%s:%d' % (base, port)
+            else:
+                sock.bind(self._requested_endpoint)
+                self.endpoint = self._requested_endpoint
+        except Exception as e:  # noqa: BLE001 - surfaced to start()
+            self._fatal_error = RuntimeError(
+                'Dispatcher failed to bind %r: %s'
+                % (self._requested_endpoint, e))
+            self._bound.set()
+            sock.close(linger=0)
+            context.term()
+            return
+        self._bound.set()
+
+        last_sweep = time.monotonic()
+        try:
+            while not self._stop_event.is_set():
+                self._flush_backlog()
+                if sock.poll(_POLL_INTERVAL_MS):
+                    # Drain everything queued before scheduling: completions
+                    # free credit that the assignment pass below can use.
+                    while True:
+                        try:
+                            frames = sock.recv_multipart(zmq.NOBLOCK)
+                        except zmq.Again:
+                            break
+                        self._handle(sock, frames)
+                self._assign(sock)
+                now = time.monotonic()
+                if now - last_sweep >= self._heartbeat_interval_s:
+                    last_sweep = now
+                    self._sweep(now)
+        except Exception as e:  # noqa: BLE001 - fatal for the whole pool
+            logger.exception('Dispatcher loop died')
+            self._fatal_error = e
+        finally:
+            for _ in range(_STOP_BROADCASTS):
+                for identity in list(self._workers):
+                    try:
+                        sock.send_multipart([identity, proto.MSG_STOP],
+                                            flags=zmq.NOBLOCK)
+                    except Exception:  # noqa: BLE001 - peer may be gone
+                        pass
+                time.sleep(_POLL_INTERVAL_MS / 1000.0)
+            sock.close(linger=500)
+            context.term()
+
+    # -- message handling ----------------------------------------------------
+
+    def _handle(self, sock, frames):
+        identity, msg = frames[0], frames[1]
+        now = time.monotonic()
+        if msg == proto.MSG_REGISTER:
+            if identity not in self._workers:
+                self._workers[identity] = _WorkerState(identity, now)
+                self._workers_seen += 1
+                logger.info('Worker %s registered (%d registered)',
+                            identity, len(self._workers))
+            else:
+                self._workers[identity].last_heartbeat = now
+            sock.send_multipart([identity, proto.MSG_SPEC,
+                                 self._job_spec_payload])
+        elif msg == proto.MSG_READY:
+            worker = self._workers.get(identity)
+            if worker is not None:
+                worker.ready = True
+                worker.last_heartbeat = now
+        elif msg == proto.MSG_HEARTBEAT:
+            worker = self._workers.get(identity)
+            if worker is None:
+                # A lapsed worker resurfacing (its items were already
+                # re-ventilated): re-admit it with a clean slate — it
+                # already holds the spec and a live decode worker.
+                worker = _WorkerState(identity, now)
+                worker.ready = True
+                self._workers[identity] = worker
+                logger.info('Worker %s re-admitted after lapse', identity)
+            else:
+                worker.last_heartbeat = now
+            sock.send_multipart([identity, proto.MSG_HEARTBEAT_ACK])
+        elif msg == proto.MSG_DONE:
+            item_id = proto.unpack_item_id(frames[2])
+            self._complete(identity, item_id, ('result', frames[3:]), now)
+        elif msg == proto.MSG_ERROR:
+            item_id = proto.unpack_item_id(frames[2])
+            exc = proto.load_exception(frames[3])
+            self._complete(identity, item_id, ('error', exc), now)
+        elif msg == proto.MSG_BYE:
+            self._deregister(identity, 'said goodbye')
+        else:
+            logger.warning('Unknown service message type %r from %s',
+                           msg, identity)
+
+    def _complete(self, identity, item_id, outcome, now):
+        worker = self._workers.get(identity)
+        if worker is not None:
+            worker.last_heartbeat = now
+            worker.inflight.discard(item_id)
+        if item_id in self._done:
+            # Duplicate completion from a lapsed-then-reassigned race; the
+            # first DONE already delivered this item's rows.
+            logger.debug('Dropping duplicate completion of item %d from %s',
+                         item_id, identity)
+            return
+        entry = self._inflight.pop(item_id, None)
+        if entry is None:
+            # Ghost completion: the item lapsed back onto the pending queue
+            # but its original owner finished after all. Accept the result
+            # and withdraw the pending copy so it is not run twice.
+            with self._lock:
+                if item_id not in self._pending_ids:
+                    logger.warning('Completion of unknown item %d from %s '
+                                   'dropped', item_id, identity)
+                    return
+                self._pending_ids.discard(item_id)
+                self._pending = collections.deque(
+                    (i, p) for i, p in self._pending if i != item_id)
+        else:
+            owner = self._workers.get(entry[0])
+            if owner is not None:
+                owner.inflight.discard(item_id)
+        if item_id in self._risky_ids:
+            self._done.add(item_id)
+        self._completed_count += 1
+        kind, payload = outcome
+        if kind == 'result':
+            for result_frame in payload:
+                self._emit(('result', result_frame))
+        else:
+            self._emit(('error', payload))
+        self._emit(('marker', item_id))
+
+    def _emit(self, entry):
+        """Hand one entry toward the consumer, preserving order: direct
+        only while the backlog is empty AND the queue has room."""
+        if self._out_backlog or not self._deliver(entry):
+            self._out_backlog.append(entry)
+
+    def _flush_backlog(self):
+        while self._out_backlog:
+            if not self._deliver(self._out_backlog[0]):
+                return
+            self._out_backlog.popleft()
+
+    # -- scheduling ----------------------------------------------------------
+
+    def _assign(self, sock):
+        if self._out_backlog:
+            # The consumer is stalled; assigning more work would just grow
+            # the backlog unboundedly. Workers idle (heartbeating, acked)
+            # until the consumer drains — quiescence, not decay.
+            return
+        # Least-loaded first, so a fresh (or re-admitted) worker fills up
+        # before busy ones receive more.
+        workers = sorted((w for w in self._workers.values() if w.ready),
+                         key=lambda w: len(w.inflight))
+        for worker in workers:
+            while len(worker.inflight) < self._max_inflight_per_worker:
+                with self._lock:
+                    if not self._pending:
+                        return
+                    item_id, payload = self._pending.popleft()
+                    self._pending_ids.discard(item_id)
+                if item_id in self._done:
+                    continue
+                sock.send_multipart([worker.identity, proto.MSG_WORK,
+                                     proto.pack_item_id(item_id), payload])
+                self._inflight[item_id] = (worker.identity, payload)
+                worker.inflight.add(item_id)
+
+    def _sweep(self, now):
+        for identity, worker in list(self._workers.items()):
+            if now - worker.last_heartbeat > self._liveness_timeout_s:
+                self._deregister(
+                    identity, 'heartbeat lapsed (%.1fs > %.1fs)'
+                    % (now - worker.last_heartbeat, self._liveness_timeout_s))
+        with self._lock:
+            outstanding = bool(self._pending) or bool(self._inflight)
+        if outstanding and not self._workers:
+            if self._no_workers_since is None:
+                self._no_workers_since = now
+            elif now - self._no_workers_since > self._no_workers_timeout_s:
+                raise RuntimeError(
+                    'No live worker servers for %.1fs with work outstanding; '
+                    'is the dispatcher endpoint (%s) reachable from the '
+                    'workers?' % (self._no_workers_timeout_s, self.endpoint))
+        else:
+            self._no_workers_since = None
+
+    def _deregister(self, identity, reason):
+        worker = self._workers.pop(identity, None)
+        if worker is None:
+            return
+        reventilated = 0
+        for item_id in worker.inflight:
+            entry = self._inflight.pop(item_id, None)
+            if entry is None or item_id in self._done:
+                continue
+            with self._lock:
+                # Front of the queue: lapsed work is the oldest and gates
+                # epoch completion through the ventilator's in-flight bound.
+                self._pending.appendleft((item_id, entry[1]))
+                self._pending_ids.add(item_id)
+            # From here the item can complete twice (ghost + reassigned
+            # copy); only such items need completion dedup.
+            self._risky_ids.add(item_id)
+            reventilated += 1
+        self._reventilated_count += reventilated
+        logger.warning('Worker %s deregistered (%s); re-ventilated %d '
+                       'in-flight item(s)', identity, reason, reventilated)

@@ -1,0 +1,87 @@
+"""Wire protocol of the disaggregated decode service.
+
+One ZMQ ROUTER socket on the dispatcher; one DEALER per worker server. All
+messages are multipart frames; the first payload frame is the message type.
+The DEALER side sends ``[TYPE, ...]``; the ROUTER side sees
+``[identity, TYPE, ...]`` and addresses replies with the same identity.
+
+    worker ──► dispatcher                 dispatcher ──► worker
+    REGISTER                              SPEC <job payload>
+    READY                                 WORK <item id> <item payload>
+    HEARTBEAT                             HEARTBEAT_ACK
+    DONE <item id> <result payload>*      STOP
+    ERROR <item id> <exc payload>
+    BYE
+
+Payload encodings reuse the local pools' codecs: work items and the job spec
+ride dill (same framing the :class:`~petastorm_tpu.workers.process_pool
+.ProcessPool` uses for its work channel); result payloads ride the pluggable
+:mod:`~petastorm_tpu.serializers` codec named in the job spec
+(:class:`~petastorm_tpu.serializers.PickleSerializer` by default).
+
+Trust model: payloads are dill/pickle — arbitrary code execution by design
+(the job spec IS code). Bind the dispatcher to loopback or a private
+cluster network only, exactly like the tf.data service's gRPC workers.
+"""
+
+import dill
+
+# worker -> dispatcher
+MSG_REGISTER = b'REG'
+MSG_READY = b'RDY'
+MSG_HEARTBEAT = b'HB'
+MSG_DONE = b'DONE'
+MSG_ERROR = b'ERR'
+MSG_BYE = b'BYE'
+
+# dispatcher -> worker
+MSG_SPEC = b'SPEC'
+MSG_WORK = b'WORK'
+MSG_STOP = b'STOP'
+MSG_HEARTBEAT_ACK = b'HBACK'
+
+
+def pack_item_id(item_id):
+    return b'%d' % item_id
+
+
+def unpack_item_id(frame):
+    return int(frame)
+
+
+def dump_job_spec(worker_class, worker_args, serializer):
+    """The payload a worker server needs to become this job's decode worker."""
+    return dill.dumps((worker_class, worker_args, serializer))
+
+
+def load_job_spec(payload):
+    return dill.loads(payload)
+
+
+def dump_work_item(args, kwargs):
+    return dill.dumps((args, kwargs))
+
+
+def load_work_item(payload):
+    return dill.loads(payload)
+
+
+def dump_exception(exc):
+    try:
+        return dill.dumps(exc)
+    except Exception:  # noqa: BLE001 - unpicklable exception
+        return dill.dumps(RuntimeError('%s: %s' % (type(exc).__name__, exc)))
+
+
+def load_exception(payload):
+    return dill.loads(payload)
+
+
+def free_tcp_port(host='127.0.0.1'):
+    """A currently-free TCP port on ``host`` (small bind race accepted;
+    used by tests and the benchmark CLI to pre-agree an endpoint)."""
+    import socket
+
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]

@@ -1,0 +1,327 @@
+"""ServicePool: the disaggregated service's client-side pool.
+
+Implements the exact pool contract of
+:class:`~petastorm_tpu.workers.thread_pool.ThreadPool` /
+:class:`~petastorm_tpu.workers.process_pool.ProcessPool`
+(``start / ventilate / get_results / stop / join / diagnostics``), so
+``Reader(..., reader_pool_type='service')`` and ``make_jax_loader(...)``
+work unchanged — the decode fleet just lives on other hosts.
+
+Two deployment modes:
+
+* **External fleet** (production): pass ``endpoint='tcp://0.0.0.0:7777'``
+  (or set ``PETASTORM_TPU_SERVICE_DISPATCHER``); the pool hosts the
+  dispatcher at that address and worker servers started anywhere
+  (``python -m petastorm_tpu.service.worker_server --endpoint ...``)
+  register with it, with retry/backoff, before or after the pool starts.
+* **Local fleet** (tests, benchmarks, single-host use): pass
+  ``spawn_local_workers=N``; the pool binds a random loopback port and
+  spawns N worker-server processes itself (spawn-not-fork, pinned to
+  ``JAX_PLATFORMS=cpu`` like the process pool's workers), reaping them on
+  ``join()``.
+
+Back-pressure is layered: the consumer-facing results queue is bounded
+(``results_queue_size``), the dispatcher stops reading completions when it
+is full, and each worker server holds at most ``max_inflight_per_worker``
+assigned items — so a stalled consumer quiesces the whole remote fleet
+instead of buffering unboundedly.
+"""
+
+import logging
+import os
+import queue
+import threading
+import time
+
+from petastorm_tpu.serializers import PickleSerializer
+from petastorm_tpu.service import protocol as proto
+from petastorm_tpu.service.dispatcher import Dispatcher
+from petastorm_tpu.workers import (
+    EmptyResultError, TimeoutWaitingForResultError,
+)
+
+logger = logging.getLogger(__name__)
+
+_POLL_INTERVAL_S = 0.05
+_BIND_TIMEOUT_S = 10.0
+_JOIN_TIMEOUT_S = 10.0
+# Ventilator-sizing hint before any worker has registered (external fleets
+# announce themselves only at runtime).
+_WORKERS_COUNT_HINT = 4
+
+
+class ServicePool:
+    """Client pool backed by remote worker servers over ``tcp://``."""
+
+    def __init__(self, endpoint=None, expected_workers=None,
+                 spawn_local_workers=None, results_queue_size=50,
+                 serializer=None, heartbeat_interval_s=1.0,
+                 liveness_timeout_s=None, connect_timeout_s=30.0,
+                 no_workers_timeout_s=30.0, max_inflight_per_worker=2,
+                 worker_ack_timeout_s=None):
+        """
+        :param endpoint: ``tcp://host:port`` the dispatcher binds (port 0 =
+            random). Default: random loopback port (local fleet mode).
+        :param expected_workers: block ``start()`` until this many worker
+            servers registered (default: the spawned count, else 1).
+        :param spawn_local_workers: spawn this many localhost worker-server
+            processes owned by this pool.
+        :param liveness_timeout_s: heartbeat silence after which a worker is
+            declared dead and its items re-ventilated (default 4 heartbeat
+            intervals).
+        :param connect_timeout_s: how long ``start()`` waits for the
+            expected registrations before failing.
+        :param no_workers_timeout_s: runtime failure threshold — work
+            outstanding but zero live workers for this long.
+        :param worker_ack_timeout_s: spawned-fleet only — how long a
+            worker server tolerates missing dispatcher heartbeat acks
+            before abandoning the job (default: the server's own
+            ``max(10 * heartbeat_interval, 10s)``).
+        """
+        self._endpoint_requested = endpoint or 'tcp://127.0.0.1:0'
+        self._expected_workers = expected_workers
+        self._spawn_local_workers = spawn_local_workers
+        self._results_queue_size = results_queue_size
+        self._serializer = serializer or PickleSerializer()
+        self._heartbeat_interval_s = heartbeat_interval_s
+        self._liveness_timeout_s = (liveness_timeout_s
+                                    if liveness_timeout_s is not None
+                                    else 4.0 * heartbeat_interval_s)
+        self._connect_timeout_s = connect_timeout_s
+        self._no_workers_timeout_s = no_workers_timeout_s
+        self._max_inflight_per_worker = max_inflight_per_worker
+        self._worker_ack_timeout_s = worker_ack_timeout_s
+
+        self._results_queue = queue.Queue(maxsize=results_queue_size)
+        self._stop_event = threading.Event()
+        self._counter_lock = threading.Lock()
+        self._ventilated_items = 0
+        self._processed_items = 0
+        self._ventilator = None
+        self._dispatcher = None
+        self._dispatcher_thread = None
+        self._local_procs = []
+        self._error = None
+        self._joined = False
+
+    @property
+    def workers_count(self):
+        """Live fleet size (never below the configured floor). The reader's
+        ventilator re-reads this for its in-flight bound, so worker servers
+        joining a RUNNING job genuinely raise parallelism — the scale-out
+        path autotune_report advises."""
+        base = self._spawn_local_workers or self._expected_workers or 0
+        registered = (self._dispatcher.registered_workers()
+                      if self._dispatcher is not None else 0)
+        return max(base, registered) or _WORKERS_COUNT_HINT
+
+    @property
+    def dispatcher_endpoint(self):
+        """The resolved ``tcp://`` endpoint (after a random-port bind)."""
+        return self._dispatcher.endpoint if self._dispatcher else None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self, worker_class, worker_args=None, ventilator=None,
+              start_ventilator=True):
+        if self._dispatcher is not None:
+            raise RuntimeError('ServicePool already started')
+        job_spec = proto.dump_job_spec(worker_class, worker_args,
+                                       self._serializer)
+        self._dispatcher = Dispatcher(
+            self._endpoint_requested, job_spec, self._deliver,
+            self._stop_event,
+            heartbeat_interval_s=self._heartbeat_interval_s,
+            liveness_timeout_s=self._liveness_timeout_s,
+            max_inflight_per_worker=self._max_inflight_per_worker,
+            no_workers_timeout_s=self._no_workers_timeout_s)
+        self._dispatcher_thread = threading.Thread(
+            target=self._dispatcher.run, daemon=True,
+            name='service-dispatcher')
+        self._dispatcher_thread.start()
+        self._dispatcher.wait_bound(_BIND_TIMEOUT_S)
+
+        if self._spawn_local_workers:
+            self._spawn_workers()
+        self._await_registrations()
+
+        self._ventilator = ventilator
+        if ventilator is not None and start_ventilator:
+            ventilator.start()
+
+    def _spawn_workers(self):
+        from petastorm_tpu.service.worker_server import serve
+        from petastorm_tpu.workers.exec_in_new_process import (
+            exec_in_new_process,
+        )
+
+        for worker_id in range(self._spawn_local_workers):
+            proc = exec_in_new_process(
+                serve, self._dispatcher.endpoint, worker_id=worker_id,
+                heartbeat_interval_s=self._heartbeat_interval_s,
+                ack_timeout_s=self._worker_ack_timeout_s,
+                parent_pid=os.getpid(), once=True,
+                register_timeout_s=self._connect_timeout_s)
+            self._local_procs.append(proc)
+
+    def _await_registrations(self):
+        need = (self._expected_workers or self._spawn_local_workers or 1)
+        deadline = time.monotonic() + self._connect_timeout_s
+        while self._dispatcher.registered_workers() < need:
+            if self._dispatcher.fatal_error is not None:
+                self._abort_startup()
+                raise self._dispatcher.fatal_error
+            # ANY exit before registration is fatal here — including a
+            # clean one (registration window closed, parent-death check):
+            # the fleet will never reach the expected size.
+            dead = [p.pid for p in self._local_procs if p.poll() is not None]
+            if dead:
+                self._abort_startup()
+                raise RuntimeError(
+                    'Service worker server process(es) %s exited during '
+                    'startup — see their stderr for the reason' % dead)
+            if time.monotonic() > deadline:
+                registered = self._dispatcher.registered_workers()
+                self._abort_startup()
+                raise RuntimeError(
+                    'Only %d of %d worker servers registered with the '
+                    'dispatcher at %s within %.1fs (workers retry with '
+                    'backoff — check endpoint reachability and that the '
+                    'servers are running)'
+                    % (registered, need, self._dispatcher.endpoint,
+                       self._connect_timeout_s))
+            time.sleep(_POLL_INTERVAL_S)
+
+    def _abort_startup(self):
+        self._stop_event.set()
+        if self._dispatcher_thread is not None:
+            self._dispatcher_thread.join(_JOIN_TIMEOUT_S)
+        self._reap_local_procs()
+
+    # -- data path ----------------------------------------------------------
+
+    def ventilate(self, *args, **kwargs):
+        with self._counter_lock:
+            self._ventilated_items += 1
+        self._dispatcher.submit(proto.dump_work_item(args, kwargs))
+
+    def _deliver(self, entry):
+        """Dispatcher-thread side of the results queue: NON-BLOCKING put.
+        False = momentarily full (the dispatcher backlogs and retries);
+        the dispatcher thread must stay free to ack worker heartbeats, so
+        a stalled consumer quiesces the fleet instead of starving its
+        liveness protocol. On stop the entry is dropped (True): accounting
+        no longer matters and the backlog must not pin shutdown."""
+        if self._stop_event.is_set():
+            return True
+        try:
+            self._results_queue.put_nowait(entry)
+            return True
+        except queue.Full:
+            return False
+
+    def get_results(self, timeout=None):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if self._error is not None:
+                raise self._error
+            try:
+                kind, payload = self._results_queue.get(
+                    timeout=_POLL_INTERVAL_S)
+            except queue.Empty:
+                if self._stop_event.is_set():
+                    raise EmptyResultError()
+                fatal = (self._dispatcher.fatal_error
+                         if self._dispatcher else None)
+                if fatal is None and self._local_procs and \
+                        all(p.poll() is not None for p in self._local_procs):
+                    with self._counter_lock:
+                        outstanding = (self._ventilated_items
+                                       != self._processed_items)
+                    if outstanding:
+                        fatal = RuntimeError(
+                            'All spawned service worker servers died '
+                            'unexpectedly: %s'
+                            % [p.pid for p in self._local_procs])
+                if fatal is not None:
+                    self._error = fatal
+                    self.stop()
+                    self.join()
+                    raise self._error
+                with self._counter_lock:
+                    all_done = (self._ventilated_items
+                                == self._processed_items)
+                if all_done and (self._ventilator is None
+                                 or self._ventilator.completed()):
+                    raise EmptyResultError()
+                if deadline is not None and time.monotonic() > deadline:
+                    raise TimeoutWaitingForResultError()
+                continue
+            if kind == 'marker':
+                with self._counter_lock:
+                    self._processed_items += 1
+                if self._ventilator is not None:
+                    self._ventilator.processed_item()
+                continue
+            if kind == 'error':
+                self._error = payload
+                self.stop()
+                self.join()
+                raise self._error
+            return self._serializer.deserialize(payload)
+
+    def stop(self):
+        if self._ventilator is not None:
+            self._ventilator.stop()
+        self._stop_event.set()
+
+    def join(self):
+        if not self._stop_event.is_set():
+            raise RuntimeError('Must call stop() before join()')
+        if self._joined:
+            return
+        self._joined = True
+        if self._dispatcher_thread is not None:
+            # run() broadcasts STOP to every registered worker on its way out
+            self._dispatcher_thread.join(_JOIN_TIMEOUT_S)
+        self._reap_local_procs()
+
+    def _reap_local_procs(self):
+        deadline = time.monotonic() + _JOIN_TIMEOUT_S
+        for proc in self._local_procs:
+            remaining = max(0.1, deadline - time.monotonic())
+            try:
+                proc.wait(timeout=remaining)
+            except Exception:  # noqa: BLE001 - ignored stop; escalate
+                proc.terminate()
+                try:
+                    proc.wait(timeout=2)
+                except Exception:  # noqa: BLE001
+                    proc.kill()
+                    proc.wait()
+        self._local_procs = []
+
+    # -- observability ------------------------------------------------------
+
+    @property
+    def diagnostics(self):
+        with self._counter_lock:
+            ventilated = self._ventilated_items
+            processed = self._processed_items
+        diag = {
+            'items_ventilated': ventilated,
+            'items_processed': processed,
+            'items_inflight': ventilated - processed,
+            'output_queue_size': self._results_queue.qsize(),
+        }
+        if self._dispatcher is not None:
+            diag.update(self._dispatcher.stats())
+        else:
+            diag.update({'workers_alive': 0, 'workers_registered': 0,
+                         'workers_seen': 0, 'items_assigned': 0,
+                         'items_pending': 0, 'items_reventilated': 0})
+        return diag
+
+    @property
+    def results_qsize(self):
+        return self._results_queue.qsize()

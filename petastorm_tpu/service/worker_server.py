@@ -1,0 +1,264 @@
+"""Worker server: a standalone decode host process for the service pool.
+
+Runs any :class:`~petastorm_tpu.workers.worker_base.WorkerBase` against work
+items streamed from a dispatcher over ``tcp://``:
+
+    python -m petastorm_tpu.service.worker_server \\
+        --endpoint tcp://10.0.0.5:7777 --worker-id 0
+
+Design points:
+
+* **Registration with retry/backoff**: REGISTER is re-sent on an
+  exponential backoff until the dispatcher answers with the job SPEC, so
+  worker servers can start before the dispatcher exists (ZMQ reconnects
+  transparently underneath).
+* **Network loop owns the socket**: the main thread polls, heartbeats, and
+  ships buffered results; a single executor thread runs ``process()``.
+  Heartbeats therefore keep flowing during a long decode — a busy worker
+  never reads as dead.
+* **Atomic item results**: ``publish_func`` appends to a per-item buffer;
+  the whole buffer ships in ONE ``DONE`` message after ``process()``
+  returns. A worker killed mid-item has delivered nothing for that item, so
+  the dispatcher's re-ventilation re-runs it without duplicating rows.
+* **Persistence**: after a job ends (STOP, or the dispatcher vanishes —
+  no HEARTBEAT_ACK for ``ack_timeout``) the server shuts the worker down
+  and goes back to registering, tf.data-service style, so one fleet of
+  worker servers outlives any number of reader lifetimes. ``--once`` (or a
+  dead ``--parent-pid``) exits instead.
+"""
+
+import argparse
+import logging
+import os
+import queue
+import sys
+import threading
+import time
+import uuid
+
+from petastorm_tpu.service import protocol as proto
+
+logger = logging.getLogger(__name__)
+
+_POLL_INTERVAL_MS = 50
+_REGISTER_BACKOFF_MAX_S = 2.0
+_EXECUTOR_JOIN_TIMEOUT_S = 5.0
+
+
+def _parent_died(parent_pid):
+    if parent_pid is None:
+        return False
+    import psutil
+
+    return not psutil.pid_exists(parent_pid)
+
+
+def _register(sock, parent_pid, register_timeout_s):
+    """REGISTER with exponential backoff until the SPEC arrives.
+
+    Returns the spec payload, or None when the server should exit
+    (orphaned, or the registration window closed).
+    """
+    backoff_s = 0.1
+    deadline = (None if register_timeout_s is None
+                else time.monotonic() + register_timeout_s)
+    last_parent_check = 0.0
+    while True:
+        sock.send_multipart([proto.MSG_REGISTER])
+        poll_deadline = time.monotonic() + backoff_s
+        while time.monotonic() < poll_deadline:
+            if sock.poll(_POLL_INTERVAL_MS):
+                frames = sock.recv_multipart()
+                if frames[0] == proto.MSG_SPEC:
+                    return frames[1]
+                # STOP/stray frames during registration are meaningless
+                continue
+            now = time.monotonic()
+            if now - last_parent_check > 1.0:
+                last_parent_check = now
+                if _parent_died(parent_pid):
+                    logger.info('Parent %s died; exiting', parent_pid)
+                    return None
+            if deadline is not None and now > deadline:
+                logger.error('No dispatcher answered REGISTER within %.1fs',
+                             register_timeout_s)
+                return None
+        backoff_s = min(backoff_s * 2, _REGISTER_BACKOFF_MAX_S)
+
+
+def _run_job(sock, spec_payload, worker_id, heartbeat_interval_s,
+             ack_timeout_s, parent_pid):
+    """One job lifetime: build the worker, stream items until STOP or the
+    dispatcher vanishes. Returns True if the server should serve again."""
+    worker_class, worker_args, serializer = proto.load_job_spec(spec_payload)
+
+    buffer = []
+    worker = worker_class(worker_id, buffer.append, worker_args)
+    worker.initialize()
+
+    work_queue = queue.Queue()
+    out_queue = queue.Queue()
+    stop_flag = threading.Event()
+
+    def executor():
+        while not stop_flag.is_set():
+            try:
+                item_id, payload = work_queue.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            del buffer[:]
+            try:
+                args, kwargs = proto.load_work_item(payload)
+                worker.process(*args, **kwargs)
+                frames = ([proto.MSG_DONE, proto.pack_item_id(item_id)]
+                          + [serializer.serialize(v) for v in buffer])
+            except Exception as e:  # noqa: BLE001 - forwarded to consumer
+                logger.debug('Worker %d forwarding exception', worker_id,
+                             exc_info=True)
+                frames = [proto.MSG_ERROR, proto.pack_item_id(item_id),
+                          proto.dump_exception(e)]
+            out_queue.put(frames)
+
+    executor_thread = threading.Thread(target=executor, daemon=True)
+    executor_thread.start()
+
+    sock.send_multipart([proto.MSG_READY])
+    now = time.monotonic()
+    last_heartbeat_sent = 0.0
+    last_ack = now
+    last_parent_check = now
+    serve_again = True
+    try:
+        while True:
+            now = time.monotonic()
+            if now - last_heartbeat_sent >= heartbeat_interval_s:
+                last_heartbeat_sent = now
+                sock.send_multipart([proto.MSG_HEARTBEAT])
+            while True:
+                try:
+                    sock.send_multipart(out_queue.get_nowait())
+                except queue.Empty:
+                    break
+            if sock.poll(_POLL_INTERVAL_MS):
+                frames = sock.recv_multipart()
+                msg = frames[0]
+                if msg == proto.MSG_WORK:
+                    work_queue.put((proto.unpack_item_id(frames[1]),
+                                    frames[2]))
+                elif msg == proto.MSG_STOP:
+                    logger.info('Dispatcher sent STOP; job over')
+                    break
+                elif msg == proto.MSG_HEARTBEAT_ACK:
+                    last_ack = now
+                elif msg == proto.MSG_SPEC:
+                    pass  # duplicate reply to a re-sent REGISTER
+            if now - last_ack > ack_timeout_s:
+                logger.warning('No dispatcher heartbeat ack for %.1fs; '
+                               'abandoning job', ack_timeout_s)
+                break
+            if now - last_parent_check > 1.0:
+                last_parent_check = now
+                if _parent_died(parent_pid):
+                    logger.info('Parent %s died; exiting', parent_pid)
+                    serve_again = False
+                    break
+    finally:
+        stop_flag.set()
+        executor_thread.join(_EXECUTOR_JOIN_TIMEOUT_S)
+        if executor_thread.is_alive():
+            # A decode is wedged past the join budget: shutting the worker
+            # down under the live process() call would close its resources
+            # mid-use, and re-registering would stack a second worker on a
+            # core the first still burns. Exit the process instead and let
+            # the OS reclaim everything.
+            logger.warning('Decode still running %.0fs after job end; '
+                           'exiting instead of re-registering',
+                           _EXECUTOR_JOIN_TIMEOUT_S)
+            serve_again = False
+        else:
+            try:
+                worker.shutdown()
+            except Exception:  # noqa: BLE001 - best-effort shutdown
+                pass
+    return serve_again
+
+
+def serve(endpoint, worker_id=0, heartbeat_interval_s=1.0,
+          ack_timeout_s=None, parent_pid=None, once=False,
+          register_timeout_s=None):
+    """Serve decode jobs from the dispatcher at ``endpoint`` until orphaned
+    (``parent_pid`` died), the registration window closes, or — with
+    ``once`` — the first job ends."""
+    import zmq
+
+    if ack_timeout_s is None:
+        ack_timeout_s = max(10 * heartbeat_interval_s, 10.0)
+    while True:
+        # Fresh socket (and identity) per job lifetime: a stale DEALER can
+        # hold buffered frames from the previous dispatcher incarnation.
+        context = zmq.Context()
+        sock = context.socket(zmq.DEALER)
+        sock.setsockopt(zmq.IDENTITY,
+                        ('worker-%d-%s' % (worker_id, uuid.uuid4().hex[:8]))
+                        .encode())
+        sock.setsockopt(zmq.LINGER, 500)
+        sock.connect(endpoint)
+        try:
+            spec_payload = _register(sock, parent_pid, register_timeout_s)
+            if spec_payload is None:
+                return
+            serve_again = _run_job(sock, spec_payload, worker_id,
+                                   heartbeat_interval_s, ack_timeout_s,
+                                   parent_pid)
+            try:
+                sock.send_multipart([proto.MSG_BYE])
+            except Exception:  # noqa: BLE001 - dispatcher may be gone
+                pass
+        finally:
+            sock.close(linger=500)
+            context.term()
+        if once or not serve_again:
+            return
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description='petastorm_tpu decode worker server')
+    parser.add_argument('--endpoint', required=True,
+                        help='dispatcher tcp:// endpoint to register with')
+    parser.add_argument('--worker-id', type=int, default=0)
+    parser.add_argument('--heartbeat-interval', type=float, default=1.0,
+                        help='seconds between heartbeats; the dispatcher '
+                             'declares a worker dead after its liveness '
+                             'timeout without one')
+    parser.add_argument('--ack-timeout', type=float, default=None,
+                        help='exit the current job after this long without '
+                             'a dispatcher heartbeat ack '
+                             '(default max(10*interval, 10s))')
+    parser.add_argument('--parent-pid', type=int, default=None,
+                        help='exit when this process dies (for locally '
+                             'spawned fleets)')
+    parser.add_argument('--once', action='store_true',
+                        help='exit after the first job instead of '
+                             're-registering')
+    parser.add_argument('--register-timeout', type=float, default=None,
+                        help='give up when no dispatcher answers within '
+                             'this many seconds (default: retry forever)')
+    parser.add_argument('-v', '--verbose', action='store_true')
+    args = parser.parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format='%(asctime)s worker-server[%(process)d] %(message)s')
+    # Decode workers must never grab the TPU chip a trainer owns — hard
+    # override, exactly like exec_in_new_process: trainer hosts commonly
+    # export JAX_PLATFORMS=tpu and the inherited value must not win.
+    os.environ['JAX_PLATFORMS'] = 'cpu'
+    serve(args.endpoint, worker_id=args.worker_id,
+          heartbeat_interval_s=args.heartbeat_interval,
+          ack_timeout_s=args.ack_timeout, parent_pid=args.parent_pid,
+          once=args.once, register_timeout_s=args.register_timeout)
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

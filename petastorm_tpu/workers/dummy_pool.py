@@ -67,11 +67,17 @@ class DummyPool:
     def join(self):
         if self._worker is not None:
             self._worker.shutdown()
+            # workers_alive must read 0 after join, like the other pools
+            self._worker = None
 
     @property
     def diagnostics(self):
         return {'pending_work_items': len(self._work_items),
-                'pending_results': len(self._results)}
+                'pending_results': len(self._results),
+                # shared gauge names (work runs lazily on the caller's
+                # thread, so "in flight" is exactly the undrained backlog)
+                'items_inflight': len(self._work_items),
+                'workers_alive': 1 if self._worker is not None else 0}
 
     @property
     def results_qsize(self):

@@ -252,9 +252,15 @@ class ProcessPool:
 
     @property
     def diagnostics(self):
+        # Counters are mutated by two different threads (ventilator /
+        # consumer); snapshot into locals and clamp so a torn read can
+        # never report a negative in-flight gauge.
+        ventilated = self._ventilated_items
+        processed = self._processed_items
         return {
-            'items_ventilated': self._ventilated_items,
-            'items_processed': self._processed_items,
+            'items_ventilated': ventilated,
+            'items_processed': processed,
+            'items_inflight': max(0, ventilated - processed),
             'workers_alive': sum(1 for p in self._processes if p.poll() is None),
         }
 

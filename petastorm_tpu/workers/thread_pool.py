@@ -146,10 +146,17 @@ class ThreadPool:
 
     @property
     def diagnostics(self):
+        with self._counter_lock:
+            ventilated = self._ventilated_items
+            processed = self._processed_items
         return {
             'output_queue_size': self._results_queue.qsize(),
-            'items_ventilated': self._ventilated_items,
-            'items_processed': self._processed_items,
+            'items_ventilated': ventilated,
+            'items_processed': processed,
+            # gauge names shared with ProcessPool/ServicePool so dashboards
+            # and autotune advice read identically across pool flavors
+            'items_inflight': ventilated - processed,
+            'workers_alive': sum(1 for t in self._threads if t.is_alive()),
         }
 
     @property

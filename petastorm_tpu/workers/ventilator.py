@@ -53,7 +53,9 @@ class ConcurrentVentilator(Ventilator):
     :param items_to_ventilate: list of dicts (kwargs for ``ventilate_fn``).
     :param iterations: number of epochs over the item list; None = infinite.
     :param max_ventilation_queue_size: in-flight bound (back-pressure);
-        defaults to one full epoch.
+        defaults to one full epoch. May be a zero-arg callable, re-read on
+        every wait cycle — a pool whose worker fleet grows at runtime (the
+        service pool's remote worker servers) raises the bound live.
     :param randomize_item_order: reshuffle item order at each epoch start.
     :param random_seed: seed for the per-epoch permutations. Epoch ``e`` uses
         ``seed + e`` so every shard/host can reproduce the order
@@ -70,7 +72,8 @@ class ConcurrentVentilator(Ventilator):
         self._items = list(items_to_ventilate)
         self._initial_iterations = iterations
         self._iterations_remaining = iterations
-        self._max_queue_size = max_ventilation_queue_size or max(1, len(self._items))
+        self._max_queue_size = (max_ventilation_queue_size
+                                or max(1, len(self._items)))
         self._randomize = randomize_item_order
         # None = nondeterministic: draw once so the epoch/reset arithmetic
         # (`seed + epoch`, reset stride) always has an int to work with.
@@ -176,6 +179,10 @@ class ConcurrentVentilator(Ventilator):
 
     # -- internals ----------------------------------------------------------
 
+    def _current_max_queue_size(self):
+        size = self._max_queue_size
+        return size() if callable(size) else size
+
     def _epoch_order(self, epoch):
         if not self._randomize:
             return list(range(len(self._items)))
@@ -196,7 +203,7 @@ class ConcurrentVentilator(Ventilator):
                 self._exclude_once = frozenset()
             while self._cursor < len(order):
                 with self._cv:
-                    while (self._in_flight >= self._max_queue_size
+                    while (self._in_flight >= self._current_max_queue_size()
                            and not self._stop_requested):
                         self._cv.wait(_VENTILATION_INTERVAL_S)
                     if self._stop_requested:

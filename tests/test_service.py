@@ -1,0 +1,247 @@
+"""Disaggregated decode service tests: dispatcher, worker servers,
+ServicePool, and the Reader('service') acceptance path.
+
+Every test spawns real worker-server subprocesses over ``tcp://`` loopback.
+There is no pytest-timeout in this environment, so hangs are bounded
+internally: every ``get_results`` call carries a timeout, registration
+waits carry ``connect_timeout_s``, and fleets are reaped in ``finally``.
+"""
+
+import collections
+import contextlib
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from petastorm_tpu.service import ServicePool
+from petastorm_tpu.service.protocol import free_tcp_port
+from petastorm_tpu.workers import EmptyResultError
+from tests.stub_workers import ExceptionOnFiveWorker, SleepyIdentityWorker
+
+pytestmark = pytest.mark.service
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Tight-but-safe timing for kill/re-ventilation tests: lapse detection in
+# well under a second, generous outer deadlines so slow CI never flakes.
+_FAST = dict(heartbeat_interval_s=0.15, liveness_timeout_s=0.75,
+             connect_timeout_s=60, no_workers_timeout_s=20)
+
+
+def _drain(pool, per_result_timeout_s=60):
+    out = []
+    while True:
+        try:
+            out.append(pool.get_results(timeout=per_result_timeout_s))
+        except EmptyResultError:
+            return out
+
+
+@contextlib.contextmanager
+def _external_worker_servers(endpoint, count, heartbeat_interval_s=0.2):
+    """Spawn a fleet the way an operator would: the __main__ CLI."""
+    # tests/ must be importable too: dill ships this module's transform
+    # functions by reference, and pytest imports test files as TOP-LEVEL
+    # modules (test_service, not tests.test_service)
+    env = dict(os.environ,
+               PYTHONPATH=os.pathsep.join(
+                   [_REPO_ROOT, os.path.join(_REPO_ROOT, 'tests')]),
+               JAX_PLATFORMS='cpu')
+    procs = [
+        subprocess.Popen(
+            [sys.executable, '-m', 'petastorm_tpu.service.worker_server',
+             '--endpoint', endpoint,
+             '--heartbeat-interval', str(heartbeat_interval_s),
+             '--worker-id', str(i),
+             '--parent-pid', str(os.getpid())],
+            env=env)
+        for i in range(count)
+    ]
+    try:
+        yield procs
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+
+
+def test_sigkill_worker_mid_read_reventilates_exactly_once():
+    """The robustness core: hard-kill one worker server while it owns
+    in-flight items; the dispatcher's heartbeat sweep must re-ventilate
+    them and the full item set must arrive exactly once (a multiset
+    mismatch would expose either loss or duplication)."""
+    pool = ServicePool(spawn_local_workers=2, **_FAST)
+    pool.start(SleepyIdentityWorker)
+    try:
+        for i in range(40):
+            pool.ventilate(i, sleep_s=0.05)
+        results = [pool.get_results(timeout=60) for _ in range(5)]
+        os.kill(pool._local_procs[0].pid, signal.SIGKILL)
+        results.extend(_drain(pool))
+        assert sorted(results) == list(range(40))
+        diag = pool.diagnostics
+        assert diag['items_reventilated'] >= 1
+        assert diag['workers_alive'] == 1
+        assert diag['items_inflight'] == 0
+    finally:
+        pool.stop()
+        pool.join()
+
+
+def test_stalled_consumer_quiesces_fleet_without_killing_it():
+    """A consumer pause longer than the workers' ack timeout, with the
+    results queue full, must NOT lose the fleet: the dispatcher thread
+    keeps acking heartbeats while delivery backlogs (regression for the
+    blocking-_deliver starvation bug)."""
+    pool = ServicePool(spawn_local_workers=2, results_queue_size=4,
+                       worker_ack_timeout_s=1.5, **_FAST)
+    pool.start(SleepyIdentityWorker)
+    try:
+        for i in range(30):
+            pool.ventilate(i, sleep_s=0.01)
+        results = [pool.get_results(timeout=60) for _ in range(2)]
+        # stall well past worker_ack_timeout_s with the queue saturated
+        time.sleep(4.0)
+        assert pool.diagnostics['workers_alive'] == 2
+        results.extend(_drain(pool))
+        assert sorted(results) == list(range(30))
+    finally:
+        pool.stop()
+        pool.join()
+
+
+def test_worker_error_propagates_and_pool_cleans_up():
+    pool = ServicePool(spawn_local_workers=2, **_FAST)
+    pool.start(ExceptionOnFiveWorker)
+    try:
+        for i in range(10):
+            pool.ventilate(i)
+        with pytest.raises(ValueError, match='value was 5'):
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                pool.get_results(timeout=60)
+        # the error path stops and joins internally: the fleet is reaped
+        assert all(p.poll() is not None for p in pool._local_procs) or \
+            not pool._local_procs
+    finally:
+        pool.stop()
+        pool.join()
+
+
+def test_registration_timeout_fails_fast_with_clear_error():
+    pool = ServicePool(expected_workers=1, connect_timeout_s=1.5)
+    with pytest.raises(RuntimeError, match='registered with the dispatcher'):
+        pool.start(SleepyIdentityWorker)
+
+
+def test_dispatcher_endpoint_resolves_random_port():
+    pool = ServicePool(spawn_local_workers=1, **_FAST)
+    pool.start(SleepyIdentityWorker)
+    try:
+        assert pool.dispatcher_endpoint.startswith('tcp://127.0.0.1:')
+        assert not pool.dispatcher_endpoint.endswith(':0')
+    finally:
+        pool.stop()
+        pool.join()
+
+
+def _slow_batch_identity(df):
+    # Per-row-group brake so a killed worker server reliably owns
+    # in-flight row-groups when the SIGKILL lands.
+    time.sleep(0.05)
+    return df
+
+
+@pytest.fixture
+def many_rowgroup_scalar_dataset(tmp_path):
+    """10 single-row-group files: enough ventilated items that a mid-epoch
+    worker kill always leaves undelivered work to re-ventilate."""
+    from tests.test_common import create_test_scalar_dataset
+    url = 'file://' + str(tmp_path / 'dataset')
+    create_test_scalar_dataset(url, num_rows=100, num_files=10)
+    return url
+
+
+def _read_id_multiset(url, reader_pool_type, kill_proc_after_first=None,
+                      transform_spec=None):
+    """All 'id' values (as a multiset) read through make_batch_reader;
+    optionally SIGKILL a worker-server process after the first batch."""
+    from petastorm_tpu.reader import make_batch_reader
+    ids = collections.Counter()
+    with make_batch_reader(url, reader_pool_type=reader_pool_type,
+                           num_epochs=1, shuffle_row_groups=False,
+                           transform_spec=transform_spec) as reader:
+        first = True
+        for batch in reader:
+            ids.update(int(x) for x in batch.id)
+            if first and kill_proc_after_first is not None:
+                os.kill(kill_proc_after_first.pid, signal.SIGKILL)
+                first = False
+    return ids
+
+
+def test_reader_service_pool_is_drop_in_for_thread_pool(
+        many_rowgroup_scalar_dataset, monkeypatch):
+    """Acceptance: ``Reader(url, reader_pool_type='service')`` against 2
+    localhost worker servers returns the identical multiset of rows as
+    ``'thread'`` — including a second job on the SAME long-lived fleet
+    (worker servers re-register after a job ends, tf.data-service style)."""
+    url = many_rowgroup_scalar_dataset
+    expected = _read_id_multiset(url, 'thread')
+    assert sum(expected.values()) == 100
+
+    endpoint = 'tcp://127.0.0.1:%d' % free_tcp_port()
+    with _external_worker_servers(endpoint, 2):
+        monkeypatch.setenv('PETASTORM_TPU_SERVICE_DISPATCHER', endpoint)
+        monkeypatch.setenv('PETASTORM_TPU_SERVICE_WORKERS', '2')
+        assert _read_id_multiset(url, 'service') == expected
+        # the fleet outlives the first reader: second job, same servers
+        assert _read_id_multiset(url, 'service') == expected
+
+
+def test_reader_survives_worker_server_sigkill_mid_epoch(
+        many_rowgroup_scalar_dataset):
+    """Acceptance: kill one of two worker servers mid-epoch; re-ventilation
+    must deliver every row exactly once (multiset equality vs the thread
+    pool proves no loss AND no duplication)."""
+    from petastorm_tpu.transform import TransformSpec
+    url = many_rowgroup_scalar_dataset
+    spec = TransformSpec(_slow_batch_identity)
+    expected = _read_id_multiset(url, 'thread', transform_spec=spec)
+
+    endpoint = 'tcp://127.0.0.1:%d' % free_tcp_port()
+    with _external_worker_servers(endpoint, 2) as procs:
+        # pool instance (not env) so the kill test runs with tight
+        # heartbeat/liveness instead of the production defaults
+        pool = ServicePool(endpoint=endpoint, expected_workers=2, **_FAST)
+        got = _read_id_multiset(url, pool, kill_proc_after_first=procs[0],
+                                transform_spec=spec)
+        assert got == expected
+
+
+def test_service_pool_diagnostics_gauges(many_rowgroup_scalar_dataset):
+    """Liveness/ownership gauges surface through Reader.diagnostics with
+    the same names the local pools expose (plus service-only extras)."""
+    from petastorm_tpu.reader import make_batch_reader
+    pool = ServicePool(spawn_local_workers=2, **_FAST)
+    with make_batch_reader(many_rowgroup_scalar_dataset,
+                           reader_pool_type=pool, num_epochs=1,
+                           shuffle_row_groups=False) as reader:
+        next(iter(reader))
+        diag = reader.diagnostics
+        assert diag['workers_alive'] == 2
+        assert diag['workers_registered'] == 2
+        for gauge in ('items_inflight', 'items_pending', 'items_assigned',
+                      'items_reventilated', 'items_ventilated',
+                      'items_processed'):
+            assert gauge in diag, gauge

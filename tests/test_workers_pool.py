@@ -1,11 +1,13 @@
 """Runtime tests across pool flavors (model: workers_pool/tests/test_workers_pool.py,
 test_ventilator.py)."""
 
+import contextlib
 import threading
 import time
 
 import pytest
 
+from petastorm_tpu.service import ServicePool
 from petastorm_tpu.workers import EmptyResultError
 from petastorm_tpu.workers.dummy_pool import DummyPool
 from petastorm_tpu.workers.thread_pool import ThreadPool
@@ -16,87 +18,116 @@ from tests.stub_workers import (
 
 from petastorm_tpu.workers.process_pool import ProcessPool
 
-POOLS = [lambda: ThreadPool(1), lambda: ThreadPool(4), lambda: DummyPool(),
-         lambda: ProcessPool(2)]
-POOL_IDS = ['thread-1', 'thread-4', 'dummy', 'process-2']
+
+def _service_pool():
+    # Localhost worker-server fleet over real tcp://: the drop-in contract
+    # proof for the disaggregated pool (docs/service.md).
+    return ServicePool(spawn_local_workers=2, heartbeat_interval_s=0.25,
+                       connect_timeout_s=60, no_workers_timeout_s=20)
+
+
+POOLS = [
+    pytest.param(lambda: ThreadPool(1), id='thread-1'),
+    pytest.param(lambda: ThreadPool(4), id='thread-4'),
+    pytest.param(lambda: DummyPool(), id='dummy'),
+    pytest.param(lambda: ProcessPool(2), id='process-2'),
+    pytest.param(_service_pool, id='service-2', marks=pytest.mark.service),
+]
+
+
+# No pytest-timeout in this environment: every get_results in the pool
+# matrix carries an internal deadline so a wedged pool FAILS fast instead
+# of hanging the quick tier-1 profile (the contract promised by the
+# `service` marker note in pytest.ini).
+_RESULT_TIMEOUT_S = 60
 
 
 def _drain(pool):
     out = []
     while True:
         try:
-            out.append(pool.get_results())
+            out.append(pool.get_results(timeout=_RESULT_TIMEOUT_S))
         except EmptyResultError:
             return out
 
 
-@pytest.mark.parametrize('make_pool', POOLS, ids=POOL_IDS)
+@contextlib.contextmanager
+def _stopped_on_exit(pool):
+    """stop()/join() even when an assertion fails mid-test: a leaked
+    service pool would keep spawned worker-server subprocesses and a bound
+    tcp port alive for the rest of the pytest run. Safe after an error
+    path that already stopped the pool (join is idempotent)."""
+    try:
+        yield pool
+    finally:
+        pool.stop()
+        pool.join()
+
+
+@pytest.mark.parametrize('make_pool', POOLS)
 def test_identity_roundtrip(make_pool):
-    pool = make_pool()
-    pool.start(IdentityWorker)
-    for i in range(20):
-        pool.ventilate(i)
-    results = sorted(_drain(pool))
-    assert results == list(range(20))
-    pool.stop()
-    pool.join()
+    with _stopped_on_exit(make_pool()) as pool:
+        pool.start(IdentityWorker)
+        for i in range(20):
+            pool.ventilate(i)
+        results = sorted(_drain(pool))
+        assert results == list(range(20))
+        # gauge-name parity across every pool flavor: dashboards and the
+        # autotune advice read the same keys whether decode is local or
+        # remote
+        diag = pool.diagnostics
+        assert diag['items_inflight'] == 0
+        assert diag['workers_alive'] >= 1
 
 
-@pytest.mark.parametrize('make_pool', POOLS, ids=POOL_IDS)
+@pytest.mark.parametrize('make_pool', POOLS)
 def test_worker_args(make_pool):
-    pool = make_pool()
-    pool.start(MultiplyingWorker, worker_args={'factor': 3})
-    for i in range(5):
-        pool.ventilate(i)
-    assert sorted(_drain(pool)) == [0, 3, 6, 9, 12]
-    pool.stop()
-    pool.join()
+    with _stopped_on_exit(make_pool()) as pool:
+        pool.start(MultiplyingWorker, worker_args={'factor': 3})
+        for i in range(5):
+            pool.ventilate(i)
+        assert sorted(_drain(pool)) == [0, 3, 6, 9, 12]
 
 
-@pytest.mark.parametrize('make_pool', POOLS, ids=POOL_IDS)
+@pytest.mark.parametrize('make_pool', POOLS)
 def test_exception_propagates_to_consumer(make_pool):
-    pool = make_pool()
-    pool.start(ExceptionOnFiveWorker)
-    for i in range(10):
-        pool.ventilate(i)
-    with pytest.raises(ValueError, match='value was 5'):
-        while True:
-            pool.get_results()
+    with _stopped_on_exit(make_pool()) as pool:
+        pool.start(ExceptionOnFiveWorker)
+        for i in range(10):
+            pool.ventilate(i)
+        with pytest.raises(ValueError, match='value was 5'):
+            while True:
+                pool.get_results(timeout=_RESULT_TIMEOUT_S)
 
 
-@pytest.mark.parametrize('make_pool', POOLS, ids=POOL_IDS)
+@pytest.mark.parametrize('make_pool', POOLS)
 def test_empty_pool_raises_empty_result(make_pool):
-    pool = make_pool()
-    pool.start(IdentityWorker)
-    with pytest.raises(EmptyResultError):
-        pool.get_results()
-    pool.stop()
-    pool.join()
+    with _stopped_on_exit(make_pool()) as pool:
+        pool.start(IdentityWorker)
+        with pytest.raises(EmptyResultError):
+            pool.get_results(timeout=_RESULT_TIMEOUT_S)
 
 
-@pytest.mark.parametrize('make_pool', POOLS, ids=POOL_IDS)
+@pytest.mark.parametrize('make_pool', POOLS)
 def test_with_ventilator_single_epoch(make_pool):
-    pool = make_pool()
-    vent = ConcurrentVentilator(pool.ventilate,
-                                [{'value': i} for i in range(30)],
-                                iterations=1, max_ventilation_queue_size=4)
-    pool.start(IdentityWorker, ventilator=vent)
-    assert sorted(_drain(pool)) == list(range(30))
-    pool.stop()
-    pool.join()
+    with _stopped_on_exit(make_pool()) as pool:
+        vent = ConcurrentVentilator(pool.ventilate,
+                                    [{'value': i} for i in range(30)],
+                                    iterations=1, max_ventilation_queue_size=4)
+        pool.start(IdentityWorker, ventilator=vent)
+        assert sorted(_drain(pool)) == list(range(30))
 
 
-@pytest.mark.parametrize('make_pool', POOLS, ids=POOL_IDS)
+@pytest.mark.parametrize('make_pool', POOLS)
 def test_with_ventilator_multiple_epochs(make_pool):
-    pool = make_pool()
-    vent = ConcurrentVentilator(pool.ventilate,
-                                [{'value': i} for i in range(7)], iterations=3)
-    pool.start(IdentityWorker, ventilator=vent)
-    results = _drain(pool)
-    assert len(results) == 21
-    assert sorted(results) == sorted(list(range(7)) * 3)
-    pool.stop()
-    pool.join()
+    with _stopped_on_exit(make_pool()) as pool:
+        vent = ConcurrentVentilator(pool.ventilate,
+                                    [{'value': i} for i in range(7)],
+                                    iterations=3)
+        pool.start(IdentityWorker, ventilator=vent)
+        results = _drain(pool)
+        assert len(results) == 21
+        assert sorted(results) == sorted(list(range(7)) * 3)
 
 
 def test_ventilator_randomizes_order_per_epoch():
@@ -131,6 +162,42 @@ def test_ventilator_deterministic_given_seed():
 
     assert collect(3) == collect(3)
     assert collect(3) != collect(4)
+
+
+def test_ventilator_callable_bound_reread_live():
+    # A callable max_ventilation_queue_size is re-read every wait cycle:
+    # the reader passes `pool.workers_count + extra`, so a service fleet
+    # that grows mid-job raises ventilation parallelism with no restart.
+    lock = threading.Lock()
+    outstanding = [0]
+    high_water = [0]
+    bound = [2]
+
+    def tracked(value):
+        with lock:
+            outstanding[0] += 1
+            high_water[0] = max(high_water[0], outstanding[0])
+
+    vent = ConcurrentVentilator(tracked, [{'value': i} for i in range(60)],
+                                iterations=1,
+                                max_ventilation_queue_size=lambda: bound[0])
+    vent.start()
+    deadline = time.monotonic() + 10
+    grew_at = None
+    while not vent.completed() and time.monotonic() < deadline:
+        time.sleep(0.002)
+        with lock:
+            if outstanding[0] > 0:
+                outstanding[0] -= 1
+                vent.processed_item()
+            ventilated_so_far = high_water[0]
+        if grew_at is None and ventilated_so_far >= 2:
+            bound[0] = 6   # "4 more workers registered"
+            grew_at = ventilated_so_far
+    assert vent.completed()
+    assert grew_at is not None
+    assert high_water[0] > 2   # the raised bound took effect mid-run
+    assert high_water[0] <= 6
 
 
 def test_ventilator_backpressure_bounds_in_flight():
