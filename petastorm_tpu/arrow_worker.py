@@ -20,6 +20,7 @@ import pyarrow.parquet as pq
 
 from petastorm_tpu.cache import NullCache
 from petastorm_tpu.codecs import CompressedImageCodec, decode_batch_with_nulls
+from petastorm_tpu.telemetry import span
 from petastorm_tpu.workers.worker_base import WorkerBase
 
 _ALL_ROWS = slice(None)
@@ -223,7 +224,8 @@ class RowGroupWorker(WorkerBase):
         else:
             keep = None
 
-        table = pf.read_row_group(piece.row_group, columns=file_columns)
+        with span('io'):
+            table = pf.read_row_group(piece.row_group, columns=file_columns)
         num_rows = table.num_rows
         row_indices = np.arange(num_rows) if keep is None else np.flatnonzero(keep)
 
@@ -235,10 +237,12 @@ class RowGroupWorker(WorkerBase):
         select_all = row_indices.size == num_rows
 
         columns = {}
-        for name in file_columns:
-            arrow_col = table.column(name)
-            selected = arrow_col if select_all else arrow_col.take(row_indices)
-            columns[name] = self._decode_column(name, selected)
+        with span('decode'):
+            for name in file_columns:
+                arrow_col = table.column(name)
+                selected = (arrow_col if select_all
+                            else arrow_col.take(row_indices))
+                columns[name] = self._decode_column(name, selected)
         for name in partition_keys:
             field = self._stored_schema.fields.get(name)
             value = self._typed_partition_value(field, piece.partition_values[name])
@@ -248,7 +252,8 @@ class RowGroupWorker(WorkerBase):
 
         batch = ColumnBatch(columns, row_indices.size)
         if self._transform_spec is not None:
-            batch = self._apply_transform(batch)
+            with span('transform'):
+                batch = self._apply_transform(batch)
         return batch
 
     def _predicate_mask(self, pf, piece, predicate):
@@ -261,28 +266,36 @@ class RowGroupWorker(WorkerBase):
         if missing:
             raise ValueError('Predicate references unknown fields: %s' % missing)
         file_fields = [f for f in pred_fields if f not in piece.partition_values]
-        pred_table = pf.read_row_group(piece.row_group, columns=file_fields)
-        decoded = {name: self._decode_column(name, pred_table.column(name))
-                   for name in file_fields}
+        with span('io'):
+            pred_table = pf.read_row_group(piece.row_group,
+                                           columns=file_fields)
+        with span('decode'):
+            decoded = {name: self._decode_column(name,
+                                                 pred_table.column(name))
+                       for name in file_fields}
         n = pred_table.num_rows
         for name in pred_fields:
             if name in piece.partition_values:
                 field = self._stored_schema.fields.get(name)
                 value = self._typed_partition_value(field, piece.partition_values[name])
                 decoded[name] = np.full(n, value, dtype=object)
-        mask = predicate.do_include_batch({f: decoded[f] for f in pred_fields})
-        if mask is not None:
-            mask = np.asarray(mask, dtype=bool)
-            if mask.shape != (n,):
-                raise ValueError(
-                    'Predicate %s.do_include_batch returned mask of shape %s '
-                    'for %d rows' % (type(predicate).__name__, mask.shape, n))
-            return mask
-        # fallback: per-row loop for predicates without a columnar form
-        # (e.g. in_lambda), matching the reference's evaluation exactly
-        mask = np.empty(n, dtype=bool)
-        for i in range(n):
-            mask[i] = predicate.do_include({f: decoded[f][i] for f in pred_fields})
+        with span('filter'):
+            mask = predicate.do_include_batch(
+                {f: decoded[f] for f in pred_fields})
+            if mask is not None:
+                mask = np.asarray(mask, dtype=bool)
+                if mask.shape != (n,):
+                    raise ValueError(
+                        'Predicate %s.do_include_batch returned mask of '
+                        'shape %s for %d rows'
+                        % (type(predicate).__name__, mask.shape, n))
+                return mask
+            # fallback: per-row loop for predicates without a columnar form
+            # (e.g. in_lambda), matching the reference's evaluation exactly
+            mask = np.empty(n, dtype=bool)
+            for i in range(n):
+                mask[i] = predicate.do_include(
+                    {f: decoded[f][i] for f in pred_fields})
         return mask
 
     @staticmethod

@@ -55,8 +55,26 @@ def build_parser():
     parser.add_argument('--no-shuffle', action='store_true')
     parser.add_argument('--spawn-new-process', action='store_true',
                         help='measure in a fresh process for clean RSS')
+    parser.add_argument('--metrics-out', default=None, metavar='PATH',
+                        help='append one JSONL telemetry snapshot (full '
+                             'metrics registry + per-stage pipeline '
+                             'report) after the run — the machine-readable '
+                             'twin of the printed report '
+                             '(docs/telemetry.md)')
     parser.add_argument('-v', '--verbose', action='store_true')
     return parser
+
+
+def _write_metrics(path, result):
+    """One JSONL line: registry snapshot + run metadata + the measure
+    window's pipeline report (when the run produced one)."""
+    from petastorm_tpu.telemetry import write_jsonl_snapshot
+    extra = {'samples_per_second': result.samples_per_second,
+             'samples': result.samples,
+             'elapsed_s': result.elapsed_s}
+    if getattr(result, 'pipeline', None) is not None:
+        extra['pipeline_report'] = result.pipeline
+    write_jsonl_snapshot(path, extra=extra)
 
 
 def main(argv=None):
@@ -71,8 +89,11 @@ def main(argv=None):
             parser.error('--spawn-new-process applies to read '
                          'measurements only, not --write')
         from petastorm_tpu.benchmark.throughput import write_throughput
-        print(write_throughput(args.dataset_url, rows=args.write_rows,
-                               workers_count=args.write_workers))
+        result = write_throughput(args.dataset_url, rows=args.write_rows,
+                                  workers_count=args.write_workers)
+        print(result)
+        if args.metrics_out:
+            _write_metrics(args.metrics_out, result)
         return 0
     if args.dataset_url is None and args.reader != 'dummy':
         parser.error('dataset_url is required unless --reader dummy')
@@ -88,6 +109,8 @@ def main(argv=None):
         reader_type=args.reader,
         dummy_fields={'test': ((args.dummy_dim,), np.float32)})
     print(result)
+    if args.metrics_out:
+        _write_metrics(args.metrics_out, result)
     return 0
 
 

@@ -12,6 +12,8 @@ import dataclasses
 import logging
 import time
 
+from petastorm_tpu.telemetry import get_registry, pipeline_report, span
+
 logger = logging.getLogger(__name__)
 
 
@@ -24,6 +26,11 @@ class BenchmarkResult:
     elapsed_s: float
     #: write benchmark only: encoded bytes landed on storage per second
     encoded_mb_per_second: float = None
+    #: read benchmarks: telemetry.pipeline_report over the measure window
+    #: (per-stage seconds/shares vs measured wall, stall attribution) —
+    #: registry reads replace the hand-rolled timers the benchmark once
+    #: needed for stage breakdowns
+    pipeline: dict = None
 
     def __str__(self):
         text = ('%.2f samples/sec; RSS %.1f MB; CPU %.1f%%'
@@ -31,7 +38,27 @@ class BenchmarkResult:
                    self.cpu_percent))
         if self.encoded_mb_per_second is not None:
             text += '; encoded %.1f MB/sec' % self.encoded_mb_per_second
+        if self.pipeline is not None:
+            from petastorm_tpu.telemetry import format_pipeline_report
+            text += '\n' + format_pipeline_report(self.pipeline)
         return text
+
+
+def _measure_window(fn):
+    """Run one measure loop under a scoped telemetry window: snapshot the
+    registry (stage-counter baseline) AND reset the stall attributor, so
+    both the per-stage shares and the stall verdict cover exactly the
+    measured interval — warmup/spin-up waits (reader startup blocking the
+    first pulls) would otherwise misattribute a balanced steady state as
+    producer-bound. Returns ``(samples, elapsed, report)``."""
+    from petastorm_tpu.telemetry import get_attributor
+    baseline = get_registry().snapshot()
+    get_attributor().reset()
+    start = time.monotonic()
+    samples = fn()
+    elapsed = time.monotonic() - start
+    report = pipeline_report(wall_time_s=elapsed, baseline=baseline)
+    return samples, elapsed, report
 
 
 def reader_throughput(dataset_url, field_regex=None, warmup_cycles=200,
@@ -93,13 +120,14 @@ def reader_throughput(dataset_url, field_regex=None, warmup_cycles=200,
         raise ValueError("read_method must be 'python', 'batch' or 'jax'; "
                          'got %r' % read_method)
 
-    samples, elapsed = counter
+    samples, elapsed, report = counter
     return BenchmarkResult(
         samples_per_second=samples / elapsed if elapsed else float('inf'),
         memory_rss_mb=process.memory_info().rss / 2 ** 20,
         cpu_percent=process.cpu_percent(),
         samples=samples,
-        elapsed_s=elapsed)
+        elapsed_s=elapsed,
+        pipeline=report)
 
 
 def _measure_rows(url, field_regex, warmup, measure, pool_type, workers,
@@ -116,10 +144,25 @@ def _measure_rows(url, field_regex, warmup, measure, pool_type, workers,
     with reader_cm as reader:
         for _ in range(warmup):
             next(reader)
-        start = time.monotonic()
-        for _ in range(measure):
-            next(reader)
-        return measure, time.monotonic() - start
+
+        def loop():
+            # a real Reader records queue_wait itself (_pull_result); the
+            # synthetic reader has no internal spans, so its pull loop is
+            # wrapped HERE — ONE span over the whole loop, not one per
+            # row: a dummy row serves in single-digit µs, so per-row span
+            # bookkeeping would dwarf the thing measured and the report
+            # could never attribute the wall it is asserted to attribute
+            if use_dummy:
+                with span('queue_wait'):
+                    for _ in range(measure):
+                        next(reader)
+            else:
+                for _ in range(measure):
+                    next(reader)
+            return measure
+
+        samples, elapsed, report = _measure_window(loop)
+        return samples, elapsed, report
 
 
 def _measure_batches(url, field_regex, warmup, measure, pool_type, workers,
@@ -140,13 +183,26 @@ def _measure_batches(url, field_regex, warmup, measure, pool_type, workers,
             seen += len(next(iter(batch._asdict().values())))
             if seen >= warmup:
                 break
-        seen = 0
-        start = time.monotonic()
-        for batch in reader:
-            seen += len(next(iter(batch._asdict().values())))
-            if seen >= measure:
-                break
-        return seen, time.monotonic() - start
+
+        def loop():
+            seen = 0
+            it = iter(reader)
+            if use_dummy:
+                # one span over the loop (see _measure_rows): the
+                # synthetic batch serve is too cheap for per-pull spans
+                with span('queue_wait'):
+                    while seen < measure:
+                        batch = next(it)
+                        seen += len(next(iter(
+                            batch._asdict().values())))
+            else:
+                while seen < measure:
+                    batch = next(it)
+                    seen += len(next(iter(batch._asdict().values())))
+            return seen
+
+        samples, elapsed, report = _measure_window(loop)
+        return samples, elapsed, report
 
 
 def _measure_jax(url, field_regex, warmup, measure, shuffle, batch_size,
@@ -171,14 +227,19 @@ def _measure_jax(url, field_regex, warmup, measure, shuffle, batch_size,
         while seen < warmup:
             seen += batch_size
             next(it)
-        seen = 0
-        start = time.monotonic()
-        while seen < measure:
-            batch = next(it)
-            # block on the transfer so we measure staged rows, not enqueues
-            next(iter(batch.values())).block_until_ready()
-            seen += batch_size
-        return seen, time.monotonic() - start
+
+        def loop():
+            seen = 0
+            while seen < measure:
+                batch = next(it)
+                # block on the transfer so we measure staged rows, not
+                # enqueues
+                next(iter(batch.values())).block_until_ready()
+                seen += batch_size
+            return seen
+
+        samples, elapsed, report = _measure_window(loop)
+        return samples, elapsed, report
 
 
 def write_throughput(dataset_url, rows=512, image_hw=(224, 224),

@@ -14,6 +14,15 @@ from abc import ABCMeta, abstractmethod
 
 logger = logging.getLogger(__name__)
 
+# telemetry counter names (read back by telemetry.pipeline_report's cache
+# section); a worker process's increments ride the pool delta channel
+CACHE_HITS = 'petastorm_tpu_cache_hits_total'
+CACHE_MISSES = 'petastorm_tpu_cache_misses_total'
+CACHE_EVICTIONS = 'petastorm_tpu_cache_evictions_total'
+CACHE_BYTES_WRITTEN = 'petastorm_tpu_cache_bytes_written_total'
+CACHE_BYTES_EVICTED = 'petastorm_tpu_cache_bytes_evicted_total'
+CACHE_SIZE_BYTES = 'petastorm_tpu_cache_size_bytes'
+
 
 class CacheBase(metaclass=ABCMeta):
     @abstractmethod
@@ -79,15 +88,31 @@ class LocalDiskCache(CacheBase):
         shard = digest[:2]
         return os.path.join(self._path, shard, digest + '.pkl')
 
+    @staticmethod
+    def _registry():
+        from petastorm_tpu.telemetry import get_registry
+        return get_registry()
+
+    def _size_gauge(self):
+        # labeled per process so last-writer-wins gauge merges from
+        # different workers don't interleave into flicker. Every process's
+        # running total covers the WHOLE shared cache directory, so the
+        # consumer aggregates these series with max (freshest estimate of
+        # the one directory), never sum — see telemetry.export's cache
+        # section.
+        return self._registry().gauge(CACHE_SIZE_BYTES, pid=str(os.getpid()))
+
     def get(self, key, fill_cache_func):
         entry = self._entry_path(key)
         try:
             with open(entry, 'rb') as f:
                 value = pickle.load(f)
             os.utime(entry)  # LRU touch
+            self._registry().counter(CACHE_HITS).inc()
             return value
         except (OSError, pickle.UnpicklingError, EOFError):
             pass
+        self._registry().counter(CACHE_MISSES).inc()
         value = fill_cache_func()
         try:
             os.makedirs(os.path.dirname(entry), exist_ok=True)
@@ -95,10 +120,20 @@ class LocalDiskCache(CacheBase):
             with open(tmp, 'wb') as f:
                 pickle.dump(value, f, protocol=pickle.HIGHEST_PROTOCOL)
             size = os.stat(tmp).st_size
+            # An overwrite (re-fill after a truncated/corrupt entry)
+            # replaces the old bytes; forgetting to subtract them would
+            # inflate the running total until the next full rescan and
+            # trigger premature evictions.
+            try:
+                replaced = os.stat(entry).st_size
+            except OSError:
+                replaced = 0
             os.replace(tmp, entry)
+            self._registry().counter(CACHE_BYTES_WRITTEN).inc(size)
             with self._lock:
-                self._total += size
+                self._total += size - replaced
                 over_limit = self._total > self._size_limit
+            self._size_gauge().set(self._total)
             if over_limit:
                 self._maybe_evict()
         except OSError:
@@ -106,6 +141,8 @@ class LocalDiskCache(CacheBase):
         return value
 
     def _maybe_evict(self):
+        evictions = 0
+        bytes_evicted = 0
         with self._lock:
             entries = []
             total = 0
@@ -116,21 +153,33 @@ class LocalDiskCache(CacheBase):
                         st = os.stat(p)
                     except OSError:
                         continue
-                    entries.append((st.st_atime, st.st_size, p))
+                    entries.append((st.st_atime, p))
                     total += st.st_size
             if total <= self._size_limit:
                 self._total = total
-                return
-            entries.sort()  # oldest access first
-            for _, size, p in entries:
-                try:
-                    os.remove(p)
-                    total -= size
-                except OSError:
-                    pass
-                if total <= self._size_limit:
-                    break
-            self._total = total
+            else:
+                entries.sort()  # oldest access first
+                for _, p in entries:
+                    try:
+                        # Size measured at EVICTION time, not insert/scan
+                        # time: another process may have re-written the
+                        # entry since (atomic rename), and accounting the
+                        # stale size would drift the running total.
+                        size = os.stat(p).st_size
+                        os.remove(p)
+                        total -= size
+                        evictions += 1
+                        bytes_evicted += size
+                    except OSError:
+                        pass
+                    if total <= self._size_limit:
+                        break
+                self._total = total
+        if evictions:
+            registry = self._registry()
+            registry.counter(CACHE_EVICTIONS).inc(evictions)
+            registry.counter(CACHE_BYTES_EVICTED).inc(bytes_evicted)
+        self._size_gauge().set(self._total)
 
     def cleanup(self):
         if self._cleanup_on_exit:

@@ -26,6 +26,11 @@ import time
 
 import numpy as np
 
+from petastorm_tpu.telemetry import (
+    STALL_NOTE_FLOOR_S, StallAttributor, note_consumer_wait,
+    note_producer_wait, span,
+)
+
 logger = logging.getLogger(__name__)
 
 _SENTINEL_END = object()
@@ -233,11 +238,12 @@ class JaxLoader:
         # staging gauges (see diagnostics): who is waiting on whom?
         self._consumer_wait_s = 0.0   # consumer blocked on get → input-bound
         self._stage_blocked_s = 0.0   # producer blocked on put → compute-bound
-        # steady-state baseline for autotune_report: the wait clocks up to
-        # (and including) each pass's FIRST delivered batch are spin-up
-        # (reader/decoder startup), not contention — snapshotting them out
-        # keeps the attribution honest
-        self._wait_baseline = (0.0, 0.0)
+        # loader-local stall attributor: the same two clocks, bucketed into
+        # sampling windows (telemetry.StallAttributor) — what
+        # autotune_report classifies from. Reset at each pass's FIRST
+        # delivered batch, so spin-up (reader/decoder startup) latency
+        # never misattributes a compute-bound pipeline as input-bound.
+        self._attributor = StallAttributor()
         self._awaiting_first_delivery = True
         self._batches_delivered = 0
 
@@ -349,7 +355,7 @@ class JaxLoader:
             self._exhausted = False
             self._epoch += 1
             # each pass's spin-up wait is excluded from autotune's
-            # steady-state attribution (baseline re-snapshots at the new
+            # steady-state attribution (the attributor resets at the new
             # pass's first delivery)
             self._awaiting_first_delivery = True
             # reset() restarts the reader's epoch numbering from 0; stale
@@ -384,7 +390,14 @@ class JaxLoader:
                     try:
                         item = self._out_queue.get(timeout=0.1)
                     finally:
-                        self._consumer_wait_s += time.monotonic() - t0
+                        waited = time.monotonic() - t0
+                        self._consumer_wait_s += waited
+                        if waited > STALL_NOTE_FLOOR_S:
+                            # queue_wait is a canonical stage AND
+                            # producer-bound evidence (both the loader's
+                            # own attributor and the process-wide one)
+                            self._attributor.note_consumer_wait(waited)
+                            note_consumer_wait(waited)
                 except queue.Empty:
                     if self._stage_error is not None:
                         raise self._stage_error
@@ -415,8 +428,9 @@ class JaxLoader:
                 self._record_delivery(pull_counts)
             self._batches_delivered += 1
             if self._awaiting_first_delivery:
-                self._wait_baseline = (self._consumer_wait_s,
-                                       self._stage_blocked_s)
+                # spin-up over: drop the startup waits so autotune's
+                # attribution covers steady state of the current pass only
+                self._attributor.reset()
                 self._awaiting_first_delivery = False
             return batch
 
@@ -538,24 +552,29 @@ class JaxLoader:
                 return
             buf = self._make_buffer()
             for columns in self._pull_batches():
-                if self._pad_ragged:
+                with span('collate'):
                     # densify BEFORE the buffer: a variable field arrives
                     # as a dense (n, ...) array from a uniform row-group
                     # but as an object array from a ragged one, and the
                     # buffers cannot mix the two forms (nor two dense
                     # widths); after this, every chunk has ONE static
                     # shape and the shuffle buffer preallocates correctly
-                    columns = self._densify_ragged(columns)
-                buf.add_many(columns)
+                    if self._pad_ragged:
+                        columns = self._densify_ragged(columns)
+                    buf.add_many(columns)
                 while buf.can_retrieve:
-                    self._emit(buf.retrieve())
+                    with span('collate'):
+                        batch = buf.retrieve()
+                    self._emit(batch)
                     if self._stop_event.is_set():
                         return
                 if self._stop_event.is_set():
                     return
             buf.finish()
             while buf.can_retrieve:
-                self._emit(buf.retrieve())
+                with span('collate'):
+                    batch = buf.retrieve()
+                self._emit(batch)
                 if self._stop_event.is_set():
                     return
         except Exception as e:  # noqa: BLE001 - surfaced to consumer
@@ -582,15 +601,20 @@ class JaxLoader:
         pad-to-bucket."""
         buffers = {}
         for columns in self._pull_batches():
-            if self._pad_ragged:
-                columns = self._densify_ragged(columns)
-            for bound, subcols in self._split_by_bucket(columns):
+            with span('collate'):
+                if self._pad_ragged:
+                    columns = self._densify_ragged(columns)
+                split = list(self._split_by_bucket(columns))
+            for bound, subcols in split:
                 buf = buffers.get(bound)
                 if buf is None:
                     buf = buffers[bound] = self._make_buffer()
-                buf.add_many(subcols)
+                with span('collate'):
+                    buf.add_many(subcols)
                 while buf.can_retrieve:
-                    self._emit(buf.retrieve())
+                    with span('collate'):
+                        batch = buf.retrieve()
+                    self._emit(batch)
                     if self._stop_event.is_set():
                         return
             if self._stop_event.is_set():
@@ -598,7 +622,9 @@ class JaxLoader:
         for buf in buffers.values():
             buf.finish()
             while buf.can_retrieve:
-                self._emit(buf.retrieve())
+                with span('collate'):
+                    batch = buf.retrieve()
+                self._emit(batch)
                 if self._stop_event.is_set():
                     return
 
@@ -692,25 +718,30 @@ class JaxLoader:
             yield bound, subcols
 
     def _emit(self, host_batch):
-        host_batch = dict(host_batch)
-        pull_col = host_batch.pop(_PULL_FIELD, None)
-        n = len(next(iter(host_batch.values())))
-        if n < self._batch_size:
-            if self._last_batch == 'drop':
-                return  # dropped rows: their pulls stay incomplete (sound)
-            if self._last_batch == 'pad':
-                host_batch = self._pad(host_batch, n)
-            # 'short': ship as-is
-        elif self._last_batch == 'pad':
-            host_batch[MASK_FIELD] = np.ones(n, dtype=bool)
-        if pull_col is None:
-            pull_counts = None
-        else:
-            ids, counts = np.unique(np.asarray(pull_col), return_counts=True)
-            pull_counts = dict(zip(ids.tolist(), counts.tolist()))
+        with span('collate'):
+            host_batch = dict(host_batch)
+            pull_col = host_batch.pop(_PULL_FIELD, None)
+            n = len(next(iter(host_batch.values())))
+            if n < self._batch_size:
+                if self._last_batch == 'drop':
+                    # dropped rows: their pulls stay incomplete (sound)
+                    return
+                if self._last_batch == 'pad':
+                    host_batch = self._pad(host_batch, n)
+                # 'short': ship as-is
+            elif self._last_batch == 'pad':
+                host_batch[MASK_FIELD] = np.ones(n, dtype=bool)
+            if pull_col is None:
+                pull_counts = None
+            else:
+                ids, counts = np.unique(np.asarray(pull_col),
+                                        return_counts=True)
+                pull_counts = dict(zip(ids.tolist(), counts.tolist()))
+        with span('h2d'):
+            device_batch = self._to_device(host_batch)
         # provenance rides the queue as a sidecar: rows count as delivered
         # only when the consumer actually receives this item in __next__
-        self._put_blocking((self._to_device(host_batch), pull_counts))
+        self._put_blocking((device_batch, pull_counts))
 
     def _densify_ragged(self, columns):
         """Apply the ``pad_ragged`` policy to one reader chunk: variable
@@ -832,7 +863,11 @@ class JaxLoader:
         finally:
             # time the producer spent blocked on a full queue: back-pressure
             # from a consumer that is NOT input-bound
-            self._stage_blocked_s += time.monotonic() - start
+            blocked = time.monotonic() - start
+            self._stage_blocked_s += blocked
+            if blocked > STALL_NOTE_FLOOR_S:
+                self._attributor.note_producer_wait(blocked)
+                note_producer_wait(blocked)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -893,33 +928,53 @@ class JaxLoader:
         })
         return diag
 
+    def pipeline_report(self, wall_time_s=None):
+        """Process-wide per-stage breakdown + stall attribution
+        (:func:`petastorm_tpu.telemetry.pipeline_report`); includes the
+        reader's worker stages for every pool flavor via the pools' metric
+        delta channels."""
+        from petastorm_tpu.telemetry import pipeline_report
+        return pipeline_report(wall_time_s=wall_time_s)
+
     def autotune_report(self):
         """Bottleneck attribution + concrete tuning advice, tf.data-style
         (its AUTOTUNE observes the same signals: who waits on whom).
 
-        Built from the two wait clocks :attr:`diagnostics` already
-        tracks: consumer time blocked on the prefetch queue (input-bound)
-        vs stage time blocked pushing into it (compute-bound), measured
-        FROM each pass's first delivered batch — the spin-up wait
-        (reader/decoder startup) is pipeline latency, not contention, and
-        counting it would misattribute compute-bound pipelines as
-        input-bound. The baseline re-snapshots at every pass's first
-        delivery, so the report covers the CURRENT pass only — earlier
-        passes' steady-state waits are discarded with their spin-up, which
-        is the right scope for tuning (the current pass reflects the
-        current settings) but means the report is not a whole-run
-        accumulator. Returns
+        Consumes this loader's stall-attribution windows
+        (:class:`~petastorm_tpu.telemetry.StallAttributor`): the two wait
+        clocks — consumer blocked on the prefetch queue (input-bound
+        evidence) vs stage blocked pushing into it (compute-bound
+        evidence) — bucketed into sampling windows and classified per
+        window. The attributor resets at each pass's first delivered
+        batch, so spin-up (reader/decoder startup) is pipeline latency,
+        never contention, and the report covers the CURRENT pass's steady
+        state only — the right scope for tuning (the current pass reflects
+        the current settings) but not a whole-run accumulator. Returns
         ``{'bottleneck': 'input'|'compute'|'balanced'|'undetermined',
-        'input_stall_fraction': float, 'advice': [str, ...], ...}`` —
-        advisory only; nothing is changed."""
-        base_consumer, base_stage = self._wait_baseline
-        consumer = max(self._consumer_wait_s - base_consumer, 0.0)
-        stage = max(self._stage_blocked_s - base_stage, 0.0)
+        'input_stall_fraction': float, 'window_verdicts': {verdict: n},
+        'advice': [str, ...], ...}`` — advisory only; nothing is
+        changed."""
+        from petastorm_tpu.telemetry import (
+            BALANCED, CONSUMER_BOUND, PRODUCER_BOUND,
+        )
+        from petastorm_tpu.telemetry.stall import classify_window
+        # everything below reads ONE source — the attributor's window set
+        # (bounded deque, so a very long pass reports its recent ~minutes)
+        # — so the fraction, the verdict and the advice can never
+        # contradict each other
+        windows = self._attributor.windows()
+        stage = sum(w['producer_wait_s'] for w in windows)
+        consumer = sum(w['consumer_wait_s'] for w in windows)
         total = consumer + stage
+        verdict_counts = {}
+        for w in windows:
+            verdict_counts[w['verdict']] = \
+                verdict_counts.get(w['verdict'], 0) + 1
         report = {
             'consumer_wait_s': round(consumer, 3),
             'stage_backpressure_s': round(stage, 3),
             'batches_delivered': self._batches_delivered,
+            'window_verdicts': verdict_counts,
         }
         if self._batches_delivered < 4 or total < 0.05:
             report['bottleneck'] = 'undetermined'
@@ -929,7 +984,11 @@ class JaxLoader:
             return report
         frac = consumer / total
         report['input_stall_fraction'] = round(frac, 3)
-        if frac > 0.66:
+        # aggregate verdict over the same windows (summed clocks with the
+        # attributor's dominance threshold — robust to one noisy window)
+        verdict = classify_window(
+            stage, consumer, self._attributor.window_s * len(windows))
+        if verdict == PRODUCER_BOUND:
             report['bottleneck'] = 'input'
             report['advice'] = [
                 'the consumer waits on data %.0f%% of contended time: add '
@@ -957,7 +1016,7 @@ class JaxLoader:
                     'if this host is out of CPU, disaggregate decode to '
                     "remote CPU hosts with reader_pool_type='service' "
                     '(docs/service.md)')
-        elif frac < 0.33:
+        elif verdict == CONSUMER_BOUND:
             report['bottleneck'] = 'compute'
             report['advice'] = [
                 'the training step is the bottleneck (staging blocked '
@@ -966,6 +1025,7 @@ class JaxLoader:
                 % ((1 - frac) * 100),
             ]
         else:
+            assert verdict == BALANCED
             report['bottleneck'] = 'balanced'
             report['advice'] = ['producer and consumer are balanced; '
                                 'tune the model step first']

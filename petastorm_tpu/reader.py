@@ -15,9 +15,11 @@ with these deliberate differences:
 """
 
 import logging
+import time
 import warnings
 
 from petastorm_tpu.arrow_worker import RowGroupWorker
+from petastorm_tpu.telemetry import note_consumer_wait, span
 from petastorm_tpu.cache import LocalDiskCache, NullCache
 from petastorm_tpu.errors import MetadataError, NoDataAvailableError
 from petastorm_tpu.etl.dataset_metadata import (
@@ -34,6 +36,15 @@ logger = logging.getLogger(__name__)
 # Extra row-groups ventilated beyond worker count: bounds host memory while
 # keeping workers busy (reference: ``reader.py:44-46``).
 _VENTILATE_EXTRA_ROWGROUPS = 2
+
+# Stall-note floor for reader pulls, higher than the generic
+# STALL_NOTE_FLOOR_S: a pool's get_results includes per-result work even
+# when a result was already queued (process/service pools deserialize the
+# payload inside the call — easily >1ms for image batches), and billing
+# that as starvation would steadily inflate producer-bound evidence in a
+# pipeline that is actually keeping up. Genuine starvation blocks in
+# poll-interval (≥50ms) chunks, far above this floor.
+_PULL_NOTE_FLOOR_S = 0.01
 
 
 def make_reader(dataset_url, schema_fields=None, reader_pool_type='thread',
@@ -390,6 +401,19 @@ class Reader:
             self._ventilator.start()
             self._started = True
 
+    def _pull_result(self):
+        """One pool result under the ``queue_wait`` stage span; blocked
+        time above the noise floor feeds the stall attributor as consumer
+        wait (= producer-bound evidence)."""
+        with span('queue_wait'):
+            t0 = time.monotonic()
+            try:
+                return self._pool.get_results()
+            finally:
+                waited = time.monotonic() - t0
+                if waited > _PULL_NOTE_FLOOR_S:
+                    note_consumer_wait(waited)
+
     def __next__(self):
         if self._stopped:
             raise RuntimeError('Trying to read a sample from a stopped reader')
@@ -403,7 +427,7 @@ class Reader:
                 # across the process pool); namedtuple-ization happens here on
                 # the consumer, as in the reference
                 # (py_dict_reader_worker.py:91).
-                wrapped = self._pool.get_results()
+                wrapped = self._pull_result()
             except EmptyResultError:
                 self.last_row_consumed = True
                 raise StopIteration from None
@@ -416,7 +440,7 @@ class Reader:
             if self._current_batch is not None:
                 self._mark_consumed(self._current_batch)
             try:
-                self._current_batch = self._pool.get_results()
+                self._current_batch = self._pull_result()
                 self._batch_cursor = 0
             except EmptyResultError:
                 self.last_row_consumed = True
@@ -448,7 +472,7 @@ class Reader:
             raise RuntimeError('Trying to read a sample from a stopped reader')
         self._ensure_started()
         try:
-            batch = self._pool.get_results()
+            batch = self._pull_result()
         except EmptyResultError:
             self.last_row_consumed = True
             raise StopIteration from None
@@ -509,6 +533,15 @@ class Reader:
     @property
     def diagnostics(self):
         return self._pool.diagnostics
+
+    def pipeline_report(self, wall_time_s=None):
+        """Per-stage time breakdown + stall attribution for this process's
+        pipeline (:func:`petastorm_tpu.telemetry.pipeline_report`) —
+        worker-side stages (io/decode/filter/transform) are included for
+        every pool flavor because the process/service pools merge worker
+        metric deltas back over their result channels."""
+        from petastorm_tpu.telemetry import pipeline_report
+        return pipeline_report(wall_time_s=wall_time_s)
 
     # -- checkpointable iteration state --------------------------------------
 

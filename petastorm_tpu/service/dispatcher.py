@@ -34,6 +34,7 @@ import threading
 import time
 
 from petastorm_tpu.service import protocol as proto
+from petastorm_tpu.telemetry import merge_worker_delta, note_producer_wait
 
 logger = logging.getLogger(__name__)
 
@@ -107,6 +108,7 @@ class Dispatcher:
         self._completed_count = 0
         self._reventilated_count = 0
         self._workers_seen = 0
+        self._metrics_deltas_merged = 0
         self._fatal_error = None
         self._no_workers_since = None
 
@@ -153,6 +155,7 @@ class Dispatcher:
             'items_assigned': len(self._inflight),
             'items_pending': pending,
             'items_reventilated': self._reventilated_count,
+            'metrics_deltas_merged': self._metrics_deltas_merged,
         }
 
     # -- dispatcher thread ---------------------------------------------------
@@ -181,10 +184,35 @@ class Dispatcher:
         self._bound.set()
 
         last_sweep = time.monotonic()
+        last_tick = last_sweep
+        backlog_prev = False
         try:
             while not self._stop_event.is_set():
                 self._flush_backlog()
-                if sock.poll(_POLL_INTERVAL_MS):
+                # Time spent with completions backlogged behind a full
+                # consumer queue is the service-side back-pressure clock:
+                # the fleet is quiesced because the CONSUMER is slow —
+                # producer wait, consumer-bound evidence (the remote
+                # workers never block locally; their out channel is the
+                # dispatcher, so this is measured here). An interval
+                # counts only when the backlog existed at BOTH of its
+                # ends: charging the interval in which a backlog first
+                # appeared would bill message-handling time that preceded
+                # it as a stall.
+                tick = time.monotonic()
+                backlogged = bool(self._out_backlog)
+                if backlogged and backlog_prev:
+                    note_producer_wait(tick - last_tick)
+                backlog_prev = backlogged
+                last_tick = tick
+                # While completions are backlogged the consumer's next free
+                # queue slot is the event that matters, and ZMQ cannot wake
+                # us for it — poll short so drained slots refill within
+                # ~5ms instead of a full poll interval (otherwise every
+                # marker behind a full queue costs the consumer a phantom
+                # ~50ms starvation wait).
+                poll_ms = 5 if self._out_backlog else _POLL_INTERVAL_MS
+                if sock.poll(poll_ms):
                     # Drain everything queued before scheduling: completions
                     # free credit that the assignment pass below can use.
                     while True:
@@ -248,16 +276,46 @@ class Dispatcher:
             sock.send_multipart([identity, proto.MSG_HEARTBEAT_ACK])
         elif msg == proto.MSG_DONE:
             item_id = proto.unpack_item_id(frames[2])
-            self._complete(identity, item_id, ('result', frames[3:]), now)
+            # frames: [identity, DONE, item_id, metrics, result*]. The
+            # wire has no version marker, and externally-started worker
+            # servers may run a pre-telemetry build whose DONE is
+            # [identity, DONE, item_id, result*] — so the slot is claimed
+            # as metrics ONLY when it is empty (b'': "nothing changed")
+            # or passes load_metrics_delta's strict delta-shape check;
+            # otherwise it is treated as the first result frame. Dropping
+            # a result would be silent row loss; misreading one as a
+            # delta is made implausible by the strict shape.
+            payload = frames[3:]
+            if payload and (payload[0] == b''
+                            or self._merge_metrics(payload[0])):
+                payload = payload[1:]
+            self._complete(identity, item_id, ('result', payload), now)
         elif msg == proto.MSG_ERROR:
             item_id = proto.unpack_item_id(frames[2])
             exc = proto.load_exception(frames[3])
+            if len(frames) > 4:
+                self._merge_metrics(frames[4])
             self._complete(identity, item_id, ('error', exc), now)
         elif msg == proto.MSG_BYE:
             self._deregister(identity, 'said goodbye')
         else:
             logger.warning('Unknown service message type %r from %s',
                            msg, identity)
+
+    def _merge_metrics(self, frame):
+        """Fold one worker server's piggybacked telemetry delta into this
+        (client) process's registry — the dispatcher is where per-worker
+        deltas become the fleet-wide aggregate. Returns whether the frame
+        WAS a delta (the DONE path uses this to tell the metrics slot from
+        a result frame sent by a pre-telemetry worker build). Duplicate
+        completions double-merge in the worst case (telemetry is advisory;
+        item delivery, not metrics, carries the exactly-once guarantee)."""
+        delta = proto.load_metrics_delta(frame)
+        if delta is None:
+            return False
+        self._metrics_deltas_merged += 1
+        merge_worker_delta(delta)
+        return True
 
     def _complete(self, identity, item_id, outcome, now):
         worker = self._workers.get(identity)

@@ -5,13 +5,19 @@ messages are multipart frames; the first payload frame is the message type.
 The DEALER side sends ``[TYPE, ...]``; the ROUTER side sees
 ``[identity, TYPE, ...]`` and addresses replies with the same identity.
 
-    worker ──► dispatcher                 dispatcher ──► worker
-    REGISTER                              SPEC <job payload>
-    READY                                 WORK <item id> <item payload>
-    HEARTBEAT                             HEARTBEAT_ACK
-    DONE <item id> <result payload>*      STOP
-    ERROR <item id> <exc payload>
+    worker ──► dispatcher                      dispatcher ──► worker
+    REGISTER                                   SPEC <job payload>
+    READY                                      WORK <item id> <item payload>
+    HEARTBEAT                                  HEARTBEAT_ACK
+    DONE <item id> <metrics> <result>*         STOP
+    ERROR <item id> <exc payload> <metrics>
     BYE
+
+The ``<metrics>`` frame piggybacks the worker server's telemetry delta
+(:meth:`~petastorm_tpu.telemetry.registry.MetricsRegistry.collect_delta`)
+on each completion — an empty frame when nothing changed — so the
+dispatcher aggregates stage timings and stall clocks fleet-wide without a
+separate metrics channel (docs/telemetry.md).
 
 Payload encodings reuse the local pools' codecs: work items and the job spec
 ride dill (same framing the :class:`~petastorm_tpu.workers.process_pool
@@ -75,6 +81,24 @@ def dump_exception(exc):
 
 def load_exception(payload):
     return dill.loads(payload)
+
+
+def dump_metrics_delta():
+    """The calling process's registry increments since the previous call,
+    framed for the wire (b'' when nothing changed — telemetry must never
+    fail a completion, so errors degrade to the empty frame). One shared
+    framing with the process pool's markers
+    (:func:`petastorm_tpu.telemetry.registry.dump_delta_frame`)."""
+    from petastorm_tpu.telemetry.registry import dump_delta_frame
+    return dump_delta_frame()
+
+
+def load_metrics_delta(frame):
+    """Inverse of :func:`dump_metrics_delta`; None for empty, undecodable
+    or non-delta-shaped frames (a dropped delta loses gauge freshness,
+    nothing more)."""
+    from petastorm_tpu.telemetry.registry import load_delta_frame
+    return load_delta_frame(frame)
 
 
 def free_tcp_port(host='127.0.0.1'):

@@ -319,7 +319,8 @@ class ServicePool:
         else:
             diag.update({'workers_alive': 0, 'workers_registered': 0,
                          'workers_seen': 0, 'items_assigned': 0,
-                         'items_pending': 0, 'items_reventilated': 0})
+                         'items_pending': 0, 'items_reventilated': 0,
+                         'metrics_deltas_merged': 0})
         return diag
 
     @property

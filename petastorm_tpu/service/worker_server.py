@@ -110,13 +110,19 @@ def _run_job(sock, spec_payload, worker_id, heartbeat_interval_s,
             try:
                 args, kwargs = proto.load_work_item(payload)
                 worker.process(*args, **kwargs)
-                frames = ([proto.MSG_DONE, proto.pack_item_id(item_id)]
+                # metrics delta rides the DONE (io/decode/transform spans,
+                # cache counters accrued while processing this item); the
+                # dispatcher merges it into the client-side registry, so
+                # the whole fleet aggregates without a separate channel
+                frames = ([proto.MSG_DONE, proto.pack_item_id(item_id),
+                           proto.dump_metrics_delta()]
                           + [serializer.serialize(v) for v in buffer])
             except Exception as e:  # noqa: BLE001 - forwarded to consumer
                 logger.debug('Worker %d forwarding exception', worker_id,
                              exc_info=True)
                 frames = [proto.MSG_ERROR, proto.pack_item_id(item_id),
-                          proto.dump_exception(e)]
+                          proto.dump_exception(e),
+                          proto.dump_metrics_delta()]
             out_queue.put(frames)
 
     executor_thread = threading.Thread(target=executor, daemon=True)

@@ -1,0 +1,81 @@
+"""Telemetry: unified metrics registry, per-stage spans, stall attribution.
+
+The observability subsystem the pipeline layers share (SURVEY.md §5.5 names
+the reference's total absence of instrumentation; tf.data (Murray et al.,
+2021) and the tf.data service (Audibert et al., 2022) both make per-stage
+timing + producer/consumer stall attribution the prerequisite for
+autotuning). Dependency-free by design: stdlib only, cheap enough for
+per-row-group hot paths, safe under threads, and mergeable across the
+process/service pools (worker processes ship counter deltas back over the
+existing result channels — markers for the ZMQ process pool, DONE messages
+for the disaggregated service, aggregated fleet-wide at the dispatcher).
+
+Three layers:
+
+* :class:`MetricsRegistry` (:func:`get_registry` is the process-wide one) —
+  counters, gauges, fixed-bucket histograms, with ``collect_delta`` /
+  ``merge_delta`` for cross-process aggregation.
+* :func:`span` — per-stage timing context managers over the canonical
+  pipeline stages (:data:`STAGES`); compiled to shared no-ops when
+  ``PETASTORM_TPU_METRICS=0``.
+* :class:`StallAttributor` (:func:`get_attributor` is the process-wide one)
+  — classifies each sampling window as producer-bound / consumer-bound /
+  balanced from the two wait clocks (consumer blocked pulling vs producer
+  blocked pushing).
+
+Exporters: :func:`write_jsonl_snapshot` / :func:`read_jsonl_snapshots`
+(JSONL), :func:`prometheus_text` (Prometheus text format), and
+:func:`pipeline_report` / :func:`format_pipeline_report` (per-stage time
+breakdown + stall attribution). See docs/telemetry.md.
+"""
+
+from petastorm_tpu.telemetry.registry import (  # noqa: F401
+    Counter, Gauge, Histogram, MetricsRegistry, dump_delta_frame,
+    get_registry, load_delta_frame, merge_worker_delta, reset_registry,
+)
+from petastorm_tpu.telemetry.spans import (  # noqa: F401
+    STAGES, metrics_disabled, refresh_enabled, span,
+)
+from petastorm_tpu.telemetry.stall import (  # noqa: F401
+    BALANCED, CONSUMER_BOUND, PRODUCER_BOUND, StallAttributor,
+    get_attributor, reset_attributor,
+)
+from petastorm_tpu.telemetry.export import (  # noqa: F401
+    format_pipeline_report, pipeline_report, prometheus_text,
+    read_jsonl_snapshots, write_jsonl_snapshot,
+)
+
+#: registry counter names the wait clocks accumulate into (seconds)
+STALL_PRODUCER_WAIT = 'petastorm_tpu_stall_producer_wait_seconds_total'
+STALL_CONSUMER_WAIT = 'petastorm_tpu_stall_consumer_wait_seconds_total'
+
+#: waits shorter than this are scheduling noise, not stalls; callers skip
+#: noting them so fast balanced pipelines don't accumulate phantom waits
+STALL_NOTE_FLOOR_S = 0.001
+
+
+def note_producer_wait(seconds):
+    """Producer blocked pushing results toward the consumer (back-pressure:
+    the CONSUMER is the slow side). Feeds both the process-wide registry
+    and the process-wide stall attributor."""
+    if seconds <= 0.0 or metrics_disabled():
+        return
+    get_registry().counter(STALL_PRODUCER_WAIT).inc(seconds)
+    get_attributor().note_producer_wait(seconds)
+
+
+def note_consumer_wait(seconds):
+    """Consumer blocked waiting for data (starvation: the PRODUCER is the
+    slow side). Feeds both the process-wide registry and the process-wide
+    stall attributor."""
+    if seconds <= 0.0 or metrics_disabled():
+        return
+    get_registry().counter(STALL_CONSUMER_WAIT).inc(seconds)
+    get_attributor().note_consumer_wait(seconds)
+
+
+def reset_for_tests():
+    """Fresh process-wide registry + attributor (test isolation only)."""
+    reset_registry()
+    reset_attributor()
+    refresh_enabled()

@@ -1,0 +1,118 @@
+"""Per-stage timing spans over the canonical pipeline stages.
+
+``with span('decode'): ...`` accumulates, per stage, into the process-wide
+registry: ``petastorm_tpu_stage_seconds_total{stage=...}`` (counter),
+``petastorm_tpu_stage_calls_total{stage=...}`` (counter) and
+``petastorm_tpu_stage_duration_seconds{stage=...}`` (histogram). Worker-side
+spans (io/decode/filter/transform) record into the WORKER process's
+registry and ride the pool's delta channel back to the consumer.
+
+``PETASTORM_TPU_METRICS=0`` (or ``false``/``off``) compiles every span to a
+shared no-op singleton — no clock reads, no dict lookups, no metric
+updates — so the hot path pays one cached boolean check per span site
+(docs/env_knobs.md; enforced by
+``tests/test_telemetry.py::test_disabled_spans_are_noops``).
+"""
+
+import os
+import time
+
+from petastorm_tpu.telemetry.registry import get_registry, on_registry_reset
+
+#: canonical pipeline stages, ventilator → device (docs/telemetry.md):
+#: ``ventilate`` hand item to pool · ``io`` parquet row-group read ·
+#: ``decode`` codec decode · ``filter`` predicate/row-mask eval ·
+#: ``transform`` TransformSpec · ``queue_wait`` consumer blocked pulling ·
+#: ``collate`` re-batch/shuffle-buffer/pad · ``h2d`` host→device staging
+STAGES = ('ventilate', 'io', 'decode', 'filter', 'transform', 'queue_wait',
+          'collate', 'h2d')
+
+STAGE_SECONDS = 'petastorm_tpu_stage_seconds_total'
+STAGE_CALLS = 'petastorm_tpu_stage_calls_total'
+STAGE_DURATION = 'petastorm_tpu_stage_duration_seconds'
+
+_DISABLED_VALUES = ('0', 'false', 'off', 'no')
+
+# resolved once (refresh_enabled() re-reads, for tests and long-lived
+# processes that flip the knob); None = not yet resolved
+_disabled = None
+
+
+def metrics_disabled():
+    """True when ``PETASTORM_TPU_METRICS`` disables telemetry."""
+    global _disabled
+    if _disabled is None:
+        raw = os.environ.get('PETASTORM_TPU_METRICS', '').strip().lower()
+        _disabled = raw in _DISABLED_VALUES
+    return _disabled
+
+
+def refresh_enabled():
+    """Re-read ``PETASTORM_TPU_METRICS`` (next span sees the new value)."""
+    global _disabled
+    _disabled = None
+    _stage_cache.clear()
+
+
+class _NoopSpan:
+    """Shared do-nothing context manager for disabled telemetry."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        return False
+
+
+_NOOP_SPAN = _NoopSpan()
+
+# stage -> (seconds counter, calls counter, duration histogram); caches the
+# metric-object lookups so a span's enter/exit is clock reads + three adds.
+# Invalidated on registry reset (hook below): cached objects of a replaced
+# registry would otherwise keep absorbing spans invisibly.
+_stage_cache = {}
+on_registry_reset(_stage_cache.clear)
+
+
+def _stage_metrics(stage):
+    metrics = _stage_cache.get(stage)
+    if metrics is None:
+        registry = get_registry()
+        metrics = (registry.counter(STAGE_SECONDS, stage=stage),
+                   registry.counter(STAGE_CALLS, stage=stage),
+                   registry.histogram(STAGE_DURATION, stage=stage))
+        _stage_cache[stage] = metrics
+    return metrics
+
+
+class _Span:
+    __slots__ = ('_metrics', '_t0')
+
+    def __init__(self, metrics):
+        self._metrics = metrics
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        elapsed = time.perf_counter() - self._t0
+        seconds, calls, duration = self._metrics
+        seconds.inc(elapsed)
+        calls.inc()
+        duration.observe(elapsed)
+        return False
+
+
+def span(stage):
+    """Context manager timing one ``stage`` occurrence.
+
+    Stage names outside :data:`STAGES` are allowed (library extensions,
+    tests) but the canonical names are what :func:`~petastorm_tpu.telemetry
+    .pipeline_report` groups by. Returns the shared no-op singleton when
+    telemetry is disabled."""
+    if metrics_disabled():
+        return _NOOP_SPAN
+    return _Span(_stage_metrics(stage))

@@ -9,6 +9,17 @@ synchronous dummy pool for debugging/profiling.
 """
 
 
+#: gauge names EVERY pool flavor (thread/process/dummy/service) must expose
+#: through ``diagnostics``, so dashboards and autotune advice read the same
+#: keys wherever decode runs; enforced by
+#: ``tests/test_telemetry_pools.py::test_pool_gauge_name_parity``. Pools may
+#: add flavor-specific extras on top, never rename these.
+SHARED_POOL_GAUGES = frozenset([
+    'items_ventilated', 'items_processed', 'items_inflight',
+    'workers_alive', 'output_queue_size',
+])
+
+
 class EmptyResultError(Exception):
     """Raised by ``get_results`` when all ventilated work is done
     (reference: ``workers_pool/__init__.py:16``)."""

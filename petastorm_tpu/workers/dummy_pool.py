@@ -17,6 +17,8 @@ class DummyPool:
         self._ventilator = None
         self._work_items = deque()
         self._results = deque()
+        self._ventilated_items = 0
+        self._processed_items = 0
 
     @property
     def workers_count(self):
@@ -33,6 +35,7 @@ class DummyPool:
             ventilator.start()
 
     def ventilate(self, *args, **kwargs):
+        self._ventilated_items += 1
         self._work_items.append((args, kwargs))
 
     def get_results(self, timeout=None):
@@ -54,9 +57,11 @@ class DummyPool:
             try:
                 self._worker.process(*args, **kwargs)
             except Exception as e:  # noqa: BLE001 - surfaced to the consumer
+                self._processed_items += 1
                 if self._ventilator is not None:
                     self._ventilator.processed_item()
                 raise e
+            self._processed_items += 1
             if self._ventilator is not None:
                 self._ventilator.processed_item()
 
@@ -74,9 +79,12 @@ class DummyPool:
     def diagnostics(self):
         return {'pending_work_items': len(self._work_items),
                 'pending_results': len(self._results),
-                # shared gauge names (work runs lazily on the caller's
+                # SHARED_POOL_GAUGES (work runs lazily on the caller's
                 # thread, so "in flight" is exactly the undrained backlog)
+                'items_ventilated': self._ventilated_items,
+                'items_processed': self._processed_items,
                 'items_inflight': len(self._work_items),
+                'output_queue_size': len(self._results),
                 'workers_alive': 1 if self._worker is not None else 0}
 
     @property

@@ -36,6 +36,10 @@ import time
 import dill
 
 from petastorm_tpu.serializers import PickleSerializer
+from petastorm_tpu.telemetry import (
+    STALL_NOTE_FLOOR_S, dump_delta_frame, load_delta_frame,
+    merge_worker_delta, note_producer_wait,
+)
 from petastorm_tpu.workers import (
     EmptyResultError, TimeoutWaitingForResultError, WorkerTerminationRequested,
 )
@@ -186,6 +190,11 @@ class ProcessPool:
                 self._processed_items += 1
                 if self._ventilator is not None:
                     self._ventilator.processed_item()
+                # markers piggyback the worker's metric delta (io/decode/
+                # transform spans, cache counters, producer-wait clock):
+                # fold it into THIS process's registry + stall attributor
+                if len(frames) > 1:
+                    merge_worker_delta(load_delta_frame(frames[1]))
                 continue
             if kind == _MSG_ERROR:
                 self._error = dill.loads(frames[1])
@@ -262,6 +271,10 @@ class ProcessPool:
             'items_processed': processed,
             'items_inflight': max(0, ventilated - processed),
             'workers_alive': sum(1 for p in self._processes if p.poll() is None),
+            # SHARED_POOL_GAUGES parity: results buffer in ZMQ (per-socket
+            # HWM), not a host-side queue this process can measure — 0 is
+            # the honest depth of the (nonexistent) consumer-side queue
+            'output_queue_size': 0,
         }
 
 
@@ -295,13 +308,23 @@ def _worker_bootstrap(worker_id, main_pid, work_ep, control_ep, results_ep,
     def send_or_stop(frames):
         """Stop-aware send (mirrors ThreadPool._publish): a worker parked on
         a full results channel must still hear the stop broadcast, or every
-        mid-stream shutdown would end in SIGTERM with no clean shutdown()."""
-        while True:
-            if results.poll(_POLL_INTERVAL_MS, zmq.POLLOUT):
-                results.send_multipart(frames)
-                return
-            if control.poll(0) and control.recv() == _CTRL_STOP:
-                raise WorkerTerminationRequested()
+        mid-stream shutdown would end in SIGTERM with no clean shutdown().
+
+        Time blocked against the channel's HWM is back-pressure from a
+        slow consumer; it lands in this worker's registry (producer-wait
+        counter) and reaches the consumer with the next marker's delta."""
+        start = time.monotonic()
+        try:
+            while True:
+                if results.poll(_POLL_INTERVAL_MS, zmq.POLLOUT):
+                    results.send_multipart(frames)
+                    return
+                if control.poll(0) and control.recv() == _CTRL_STOP:
+                    raise WorkerTerminationRequested()
+        finally:
+            blocked = time.monotonic() - start
+            if blocked > STALL_NOTE_FLOOR_S:
+                note_producer_wait(blocked)
 
     def publish(value):
         send_or_stop([_MSG_RESULT, serializer.serialize(value)])
@@ -323,7 +346,9 @@ def _worker_bootstrap(worker_id, main_pid, work_ep, control_ep, results_ep,
                 args, kwargs = dill.loads(work.recv())
                 try:
                     worker.process(*args, **kwargs)
-                    send_or_stop([_MSG_MARKER, b''])
+                    # marker piggybacks this worker's metric delta (one
+                    # shared framing with the service's DONE piggyback)
+                    send_or_stop([_MSG_MARKER, dump_delta_frame()])
                 except WorkerTerminationRequested:
                     break
                 except Exception as e:  # noqa: BLE001 - forwarded to consumer
@@ -334,7 +359,7 @@ def _worker_bootstrap(worker_id, main_pid, work_ep, control_ep, results_ep,
                             RuntimeError('%s: %s' % (type(e).__name__, e)))
                     try:
                         send_or_stop([_MSG_ERROR, err_payload])
-                        send_or_stop([_MSG_MARKER, b''])
+                        send_or_stop([_MSG_MARKER, dump_delta_frame()])
                     except WorkerTerminationRequested:
                         break
     finally:

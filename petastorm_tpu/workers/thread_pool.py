@@ -12,6 +12,7 @@ import time
 from cProfile import Profile
 from pstats import Stats
 
+from petastorm_tpu.telemetry import STALL_NOTE_FLOOR_S, note_producer_wait
 from petastorm_tpu.workers import (
     EmptyResultError, TimeoutWaitingForResultError, VentilatedItemProcessedMessage,
 )
@@ -167,14 +168,24 @@ class ThreadPool:
 
     def _publish(self, data):
         """Stop-aware put: never deadlocks a worker against a full results
-        queue during shutdown (reference: ``thread_pool.py:200-214``)."""
-        while not self._stop_event.is_set():
-            try:
-                self._results_queue.put(data, timeout=_POLL_INTERVAL_S)
-                return
-            except queue.Full:
-                continue
-        raise _WorkerExit()
+        queue during shutdown (reference: ``thread_pool.py:200-214``).
+
+        Time blocked against a full queue is back-pressure from a slow
+        consumer — it feeds stall attribution as producer wait
+        (= consumer-bound evidence)."""
+        start = time.monotonic()
+        try:
+            while not self._stop_event.is_set():
+                try:
+                    self._results_queue.put(data, timeout=_POLL_INTERVAL_S)
+                    return
+                except queue.Full:
+                    continue
+            raise _WorkerExit()
+        finally:
+            blocked = time.monotonic() - start
+            if blocked > STALL_NOTE_FLOOR_S:
+                note_producer_wait(blocked)
 
     def _worker_loop(self, worker):
         profiler = Profile() if self._profiling_enabled else None

@@ -13,6 +13,8 @@ from abc import ABCMeta, abstractmethod
 
 import numpy as np
 
+from petastorm_tpu.telemetry import span
+
 logger = logging.getLogger(__name__)
 
 _VENTILATION_INTERVAL_S = 0.01
@@ -213,10 +215,15 @@ class ConcurrentVentilator(Ventilator):
                     # precede the increment and be lost to the >=0 clamp.
                     self._in_flight += 1
                     item_index = order[self._cursor]
-                if self._pass_epoch:
-                    self._ventilate_fn(epoch=self._epoch, **self._items[item_index])
-                else:
-                    self._ventilate_fn(**self._items[item_index])
+                # 'ventilate' stage = time HANDING items to the pool
+                # (serialization, dispatcher submit); the bounded wait
+                # above is back-pressure by design, not stage work
+                with span('ventilate'):
+                    if self._pass_epoch:
+                        self._ventilate_fn(epoch=self._epoch,
+                                           **self._items[item_index])
+                    else:
+                        self._ventilate_fn(**self._items[item_index])
                 # The cursor advances only after the item was handed to the
                 # pool, so a state_dict() snapshot can never skip an item that
                 # was not ventilated (at-least-once resume semantics).

@@ -33,3 +33,15 @@ class MultiplyingWorker(WorkerBase):
 
     def process(self, value):
         self.publish_func(value * self.args['factor'])
+
+
+class SpanningSleepyWorker(WorkerBase):
+    """Sleeps under a telemetry 'decode' span, then publishes its input —
+    the probe for worker-side metric deltas crossing pool result channels
+    (process markers / service DONE messages)."""
+
+    def process(self, value, sleep_s=0.02):
+        from petastorm_tpu.telemetry import span
+        with span('decode'):
+            time.sleep(sleep_s)
+        self.publish_func(value)

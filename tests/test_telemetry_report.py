@@ -1,0 +1,185 @@
+"""pipeline_report acceptance: the dummy-reader benchmark attributes its
+wall time to named stages, the CLI emits JSONL metrics, and the disk cache
+counts hits/misses/evictions/bytes with sound size accounting."""
+
+import json
+import os
+import pickle
+
+import pytest
+
+from petastorm_tpu import telemetry as T
+from petastorm_tpu.cache import (
+    CACHE_BYTES_EVICTED, CACHE_BYTES_WRITTEN, CACHE_EVICTIONS, CACHE_HITS,
+    CACHE_MISSES, LocalDiskCache,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    T.reset_for_tests()
+    yield
+    T.reset_for_tests()
+
+
+# -- dummy-reader benchmark: the ≥95% wall-attribution gate ------------------
+
+
+def test_dummy_benchmark_attributes_wall_time():
+    """tier-1 smoke (ISSUE acceptance + CI satellite): one measure window
+    of the dummy-reader benchmark; pipeline_report must attribute ≥95% of
+    the measured wall to named stages, and the per-stage shares must sum
+    to ~1.0 of the wall.
+
+    One retry: a scheduler preemption landing in the handful of unclocked
+    instructions between the wall clock and the span (a single-core CI box
+    running the rest of the suite) can eat >5% of a small window; two
+    consecutive such hits on independent windows would be a real
+    attribution bug, not noise."""
+    from petastorm_tpu.benchmark.throughput import reader_throughput
+    report = None
+    for _ in range(2):
+        result = reader_throughput(None, reader_type='dummy',
+                                   warmup_cycles=100, measure_cycles=50000,
+                                   read_method='python', pool_type='dummy')
+        report = result.pipeline
+        assert report is not None
+        assert report['wall_time_s'] == pytest.approx(result.elapsed_s)
+        if report['attributed_fraction'] >= 0.95:
+            break
+    assert report['attributed_fraction'] >= 0.95, report
+    share_sum = sum(s['share'] for s in report['stages'].values())
+    assert 0.95 <= share_sum <= 1.05, report['stages']
+    assert set(report['stages']) <= set(T.STAGES)
+    # the rendering names every attributed stage
+    text = T.format_pipeline_report(report)
+    for stage in report['stages']:
+        assert stage in text
+
+
+def test_dummy_batch_benchmark_has_report_too():
+    from petastorm_tpu.benchmark.throughput import reader_throughput
+    result = reader_throughput(None, reader_type='dummy',
+                               warmup_cycles=1000, measure_cycles=2000000,
+                               read_method='batch', pool_type='dummy')
+    report = result.pipeline
+    assert report['attributed_fraction'] >= 0.9, report
+    assert 'queue_wait' in report['stages']
+
+
+def test_cli_metrics_out_writes_snapshot(tmp_path, capsys):
+    """--metrics-out appends one parseable JSONL line carrying the full
+    registry snapshot AND the measure window's pipeline report."""
+    from petastorm_tpu.benchmark.cli import main
+    out = str(tmp_path / 'metrics.jsonl')
+    rc = main(['--reader', 'dummy', '-w', '100', '-m', '5000',
+               '--pool', 'dummy', '--metrics-out', out])
+    assert rc == 0
+    (snap,) = T.read_jsonl_snapshots(out)
+    assert snap['samples'] == 5000
+    assert snap['pipeline_report']['attributed_fraction'] >= 0.9
+    assert any(k.startswith('petastorm_tpu_stage_seconds_total')
+               for k in snap['counters'])
+    # stdout still carries the human rendering
+    assert 'pipeline stages' in capsys.readouterr().out
+
+
+# -- LocalDiskCache telemetry + eviction-size accounting ---------------------
+
+
+def _fill(value):
+    return lambda: value
+
+
+def test_cache_counts_hits_misses_and_bytes(tmp_path):
+    cache = LocalDiskCache(str(tmp_path / 'c'), size_limit_bytes=1 << 20)
+    reg = T.get_registry()
+    assert cache.get('k1', _fill('v1')) == 'v1'   # miss + store
+    assert cache.get('k1', _fill('XX')) == 'v1'   # hit
+    assert cache.get('k2', _fill('v2')) == 'v2'   # miss
+    assert reg.counter_value(CACHE_HITS) == 1
+    assert reg.counter_value(CACHE_MISSES) == 2
+    assert reg.counter_value(CACHE_BYTES_WRITTEN) > 0
+    assert reg.counter_value(CACHE_EVICTIONS) == 0
+    # pipeline_report surfaces the cache section once traffic exists
+    report = T.pipeline_report()
+    assert report['cache']['hits'] == 1
+    assert report['cache']['misses'] == 2
+    assert report['cache']['hit_rate'] == pytest.approx(1 / 3, abs=1e-3)
+
+
+def test_cache_eviction_counts_and_actual_sizes(tmp_path):
+    # tiny limit: every new entry pushes the total over and evicts LRU
+    cache = LocalDiskCache(str(tmp_path / 'c'), size_limit_bytes=400)
+    payload = 'x' * 120  # ~130 pickled bytes per entry
+    for i in range(8):
+        cache.get('key-%d' % i, _fill(payload + str(i)))
+    reg = T.get_registry()
+    assert reg.counter_value(CACHE_EVICTIONS) >= 1
+    assert reg.counter_value(CACHE_BYTES_EVICTED) > 0
+    # running total must equal the actual on-disk footprint (the fix: size
+    # measured at eviction time, and overwrites subtract the old bytes)
+    actual = cache._scan_total()
+    assert cache._total == actual
+    assert actual <= 400 + 200  # limit + at most one entry of slack
+
+
+def test_cache_overwrite_does_not_double_count(tmp_path):
+    """Re-filling an existing entry (corrupt file) must replace its bytes
+    in the running total, not add them again — the drift that used to
+    cause premature evictions. Corruption keeps the file size so the
+    invariant is exact: running total == on-disk total."""
+    cache = LocalDiskCache(str(tmp_path / 'c'), size_limit_bytes=1 << 20)
+    cache.get('k', _fill('A' * 100))
+    entry = cache._entry_path('k')
+    size = os.stat(entry).st_size
+    with open(entry, 'wb') as f:
+        f.write(b'z' * size)  # unpicklable, same size → next get re-fills
+    for _ in range(3):  # repeated refills must not inflate the total
+        with open(entry, 'wb') as f:
+            f.write(b'z' * size)
+        assert cache.get('k', _fill('A' * 100)) == 'A' * 100
+    assert cache._total == cache._scan_total() == size
+    with open(entry, 'rb') as f:
+        assert pickle.load(f) == 'A' * 100
+
+
+def test_cache_eviction_uses_size_at_eviction_time(tmp_path):
+    """An entry re-written (larger) after insert must be accounted at its
+    CURRENT size when evicted — the bytes-evicted clock and the running
+    total both reflect eviction-time reality, not the insert-time size."""
+    cache = LocalDiskCache(str(tmp_path / 'c'), size_limit_bytes=6000)
+    cache.get('victim', _fill('v'))  # ~20 bytes at insert
+    victim = cache._entry_path('victim')
+    # grow the file behind the cache's back (another process re-wrote it;
+    # atomic-rename sharing makes that a supported scenario)
+    with open(victim, 'wb') as f:
+        pickle.dump('W' * 5000, f)
+    os.utime(victim, (1, 1))  # oldest access → first eviction candidate
+    # this store pushes the RUNNING total past the limit → eviction pass
+    cache.get('big', _fill('y' * 8000))
+    reg = T.get_registry()
+    assert reg.counter_value(CACHE_EVICTIONS) >= 1
+    # evicted bytes reflect the GROWN victim (~5KB), not its ~20-byte
+    # insert-time size
+    assert reg.counter_value(CACHE_BYTES_EVICTED) >= 5000
+    assert cache._total == cache._scan_total()
+
+
+def test_cache_section_absent_without_traffic():
+    assert 'cache' not in T.pipeline_report()
+
+
+def test_jsonl_roundtrip_through_cli_snapshot(tmp_path):
+    """A snapshot written by the exporter parses back to the exact
+    registry state even after cache + span traffic."""
+    cache = LocalDiskCache(str(tmp_path / 'c'), size_limit_bytes=1 << 20)
+    cache.get('k', _fill(json.dumps({'a': 1})))
+    with T.span('io'):
+        pass
+    path = str(tmp_path / 'm.jsonl')
+    T.write_jsonl_snapshot(path)
+    (snap,) = T.read_jsonl_snapshots(path)
+    live = T.get_registry().snapshot()
+    assert snap['counters'] == live['counters']
+    assert snap['histograms'] == live['histograms']
