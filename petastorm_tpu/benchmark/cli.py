@@ -61,6 +61,14 @@ def build_parser():
                              'report) after the run — the machine-readable '
                              'twin of the printed report '
                              '(docs/telemetry.md)')
+    parser.add_argument('--trace-out', default=None, metavar='PATH',
+                        help='enable per-item tracing for the run '
+                             '(PETASTORM_TPU_TRACE=1) and write the '
+                             'Perfetto-viewable Chrome trace-event JSON '
+                             'for the measure window here, mirroring '
+                             '--metrics-out; also prints the stall '
+                             'verdict and the top-3 slowest row-groups '
+                             '(docs/telemetry.md)')
     parser.add_argument('-v', '--verbose', action='store_true')
     return parser
 
@@ -77,11 +85,46 @@ def _write_metrics(path, result):
     write_jsonl_snapshot(path, extra=extra)
 
 
+def _write_trace(path, result):
+    """Dump the run's flight recorder as a Chrome trace and print the
+    timeline-level summary: the stall verdict plus the top-3 slowest
+    row-groups (summed worker-side attempt time per trace)."""
+    from petastorm_tpu.telemetry import dump_trace, slowest_items
+    count = dump_trace(path)
+    print('trace: %d event(s) -> %s (open in ui.perfetto.dev)'
+          % (count, path))
+    pipeline = getattr(result, 'pipeline', None)
+    if pipeline is not None:
+        print('stall verdict: %s' % pipeline['stall']['verdict'])
+    slowest = slowest_items(n=3)
+    if slowest:
+        print('slowest row-groups (worker-side time):')
+        for trace_id, seconds, args in slowest:
+            where = ['%s=%s' % (k, args[k])
+                     for k in ('item', 'epoch', 'shard', 'worker')
+                     if k in args]
+            print('  %-28s %8.3fs  %s'
+                  % (trace_id, seconds, ' '.join(where)))
+
+
 def main(argv=None):
     parser = build_parser()
     args = parser.parse_args(argv)
     if args.verbose:
         logging.basicConfig(level=logging.DEBUG)
+    if args.trace_out:
+        if args.spawn_new_process:
+            parser.error('--trace-out needs the measurement in THIS '
+                         'process (the flight recorder is per-process); '
+                         'drop --spawn-new-process')
+        if args.write:
+            parser.error('--trace-out applies to read measurements only, '
+                         'not --write')
+        # the knob must be live before any reader/ventilator exists
+        import os
+        os.environ['PETASTORM_TPU_TRACE'] = '1'
+        from petastorm_tpu import telemetry
+        telemetry.refresh()
     if args.write:
         if args.dataset_url is None:
             parser.error('dataset_url is required with --write')
@@ -111,6 +154,8 @@ def main(argv=None):
     print(result)
     if args.metrics_out:
         _write_metrics(args.metrics_out, result)
+    if args.trace_out:
+        _write_trace(args.trace_out, result)
     return 0
 
 

@@ -50,10 +50,18 @@ def _measure_window(fn):
     both the per-stage shares and the stall verdict cover exactly the
     measured interval — warmup/spin-up waits (reader startup blocking the
     first pulls) would otherwise misattribute a balanced steady state as
-    producer-bound. Returns ``(samples, elapsed, report)``."""
-    from petastorm_tpu.telemetry import get_attributor
+    producer-bound. With tracing on, the flight recorder resets here too:
+    the exported trace (``--trace-out``) and its slowest-row-group
+    ranking must cover the measure window, not warmup's cold-cache I/O
+    (items straddling the boundary keep only their post-boundary
+    events). Returns ``(samples, elapsed, report)``."""
+    from petastorm_tpu.telemetry import (
+        get_attributor, reset_recorder, trace_enabled,
+    )
     baseline = get_registry().snapshot()
     get_attributor().reset()
+    if trace_enabled():
+        reset_recorder()
     start = time.monotonic()
     samples = fn()
     elapsed = time.monotonic() - start

@@ -28,7 +28,7 @@ import numpy as np
 
 from petastorm_tpu.telemetry import (
     STALL_NOTE_FLOOR_S, StallAttributor, note_consumer_wait,
-    note_producer_wait, span,
+    note_producer_wait, span, tracing,
 )
 
 logger = logging.getLogger(__name__)
@@ -235,6 +235,11 @@ class JaxLoader:
         self._delivered_by_epoch = {}   # epoch -> {item_index, ...}
         self._next_pull_id = 0
         self._uses_provenance = hasattr(reader, 'next_batch_info')
+        # trace context of the most recent reader pull (staging thread
+        # only): batches mix rows across pulls, so staging-side trace
+        # events (collate/h2d) attribute to the pull being folded in —
+        # the honest approximation for a batching stage
+        self._last_pull_ctx = None
         # staging gauges (see diagnostics): who is waiting on whom?
         self._consumer_wait_s = 0.0   # consumer blocked on get → input-bound
         self._stage_blocked_s = 0.0   # producer blocked on put → compute-bound
@@ -537,6 +542,10 @@ class JaxLoader:
                 columns, item_index, epoch = self._reader.next_batch_info()
             except StopIteration:
                 return
+            if tracing.trace_enabled():
+                self._last_pull_ctx = tracing.ctx_for(
+                    item_index, epoch, getattr(self._reader, 'cur_shard',
+                                               None))
             n = len(next(iter(columns.values()))) if columns else 0
             with self._prov_lock:
                 pull_id = self._next_pull_id
@@ -552,22 +561,26 @@ class JaxLoader:
                 return
             buf = self._make_buffer()
             for columns in self._pull_batches():
-                with span('collate'):
-                    # densify BEFORE the buffer: a variable field arrives
-                    # as a dense (n, ...) array from a uniform row-group
-                    # but as an object array from a ragged one, and the
-                    # buffers cannot mix the two forms (nor two dense
-                    # widths); after this, every chunk has ONE static
-                    # shape and the shuffle buffer preallocates correctly
-                    if self._pad_ragged:
-                        columns = self._densify_ragged(columns)
-                    buf.add_many(columns)
-                while buf.can_retrieve:
+                # staging-side trace events (collate/h2d spans below)
+                # attach to the pull just folded in; no-op when untraced
+                with tracing.activate(self._last_pull_ctx, track='stager'):
                     with span('collate'):
-                        batch = buf.retrieve()
-                    self._emit(batch)
-                    if self._stop_event.is_set():
-                        return
+                        # densify BEFORE the buffer: a variable field
+                        # arrives as a dense (n, ...) array from a uniform
+                        # row-group but as an object array from a ragged
+                        # one, and the buffers cannot mix the two forms
+                        # (nor two dense widths); after this, every chunk
+                        # has ONE static shape and the shuffle buffer
+                        # preallocates correctly
+                        if self._pad_ragged:
+                            columns = self._densify_ragged(columns)
+                        buf.add_many(columns)
+                    while buf.can_retrieve:
+                        with span('collate'):
+                            batch = buf.retrieve()
+                        self._emit(batch)
+                        if self._stop_event.is_set():
+                            return
                 if self._stop_event.is_set():
                     return
             buf.finish()
@@ -601,22 +614,23 @@ class JaxLoader:
         pad-to-bucket."""
         buffers = {}
         for columns in self._pull_batches():
-            with span('collate'):
-                if self._pad_ragged:
-                    columns = self._densify_ragged(columns)
-                split = list(self._split_by_bucket(columns))
-            for bound, subcols in split:
-                buf = buffers.get(bound)
-                if buf is None:
-                    buf = buffers[bound] = self._make_buffer()
+            with tracing.activate(self._last_pull_ctx, track='stager'):
                 with span('collate'):
-                    buf.add_many(subcols)
-                while buf.can_retrieve:
+                    if self._pad_ragged:
+                        columns = self._densify_ragged(columns)
+                    split = list(self._split_by_bucket(columns))
+                for bound, subcols in split:
+                    buf = buffers.get(bound)
+                    if buf is None:
+                        buf = buffers[bound] = self._make_buffer()
                     with span('collate'):
-                        batch = buf.retrieve()
-                    self._emit(batch)
-                    if self._stop_event.is_set():
-                        return
+                        buf.add_many(subcols)
+                    while buf.can_retrieve:
+                        with span('collate'):
+                            batch = buf.retrieve()
+                        self._emit(batch)
+                        if self._stop_event.is_set():
+                            return
             if self._stop_event.is_set():
                 return
         for buf in buffers.values():
@@ -935,6 +949,14 @@ class JaxLoader:
         delta channels."""
         from petastorm_tpu.telemetry import pipeline_report
         return pipeline_report(wall_time_s=wall_time_s)
+
+    def dump_trace(self, path):
+        """Export the per-item trace (ventilate → worker stages →
+        queue_wait → collate/h2d, across every pool flavor) as Chrome
+        trace-event JSON; needs ``PETASTORM_TPU_TRACE=1`` during the run
+        (docs/telemetry.md). Returns the number of events written."""
+        from petastorm_tpu.telemetry import dump_trace
+        return dump_trace(path)
 
     def autotune_report(self):
         """Bottleneck attribution + concrete tuning advice, tf.data-style
@@ -1300,6 +1322,11 @@ class InMemoryCachedLoader:
         # the full JaxLoader merge (pool + staging gauges), so the
         # tpu_guide's consumer_wait_s/backpressure advice applies here too
         return self._loader.diagnostics
+
+    def dump_trace(self, path):
+        """See :meth:`JaxLoader.dump_trace` (replay epochs add no events —
+        they never touch the reader)."""
+        return self._loader.dump_trace(path)
 
     def state_dict(self):
         raise RuntimeError(

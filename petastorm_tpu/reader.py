@@ -19,7 +19,7 @@ import time
 import warnings
 
 from petastorm_tpu.arrow_worker import RowGroupWorker
-from petastorm_tpu.telemetry import note_consumer_wait, span
+from petastorm_tpu.telemetry import note_consumer_wait, span, tracing
 from petastorm_tpu.cache import LocalDiskCache, NullCache
 from petastorm_tpu.errors import MetadataError, NoDataAvailableError
 from petastorm_tpu.etl.dataset_metadata import (
@@ -300,7 +300,7 @@ class Reader:
             max_ventilation_queue_size=lambda: (
                 self._pool.workers_count + _VENTILATE_EXTRA_ROWGROUPS),
             randomize_item_order=shuffle_row_groups, random_seed=seed,
-            pass_epoch=True)
+            pass_epoch=True, trace_shard=self.cur_shard)
 
         # (5) start workers; ventilation begins lazily on first read so that
         # load_state_dict can reposition the cursor first.
@@ -404,15 +404,35 @@ class Reader:
     def _pull_result(self):
         """One pool result under the ``queue_wait`` stage span; blocked
         time above the noise floor feeds the stall attributor as consumer
-        wait (= producer-bound evidence)."""
+        wait (= producer-bound evidence). With tracing on, the wait is
+        also stamped onto the ARRIVED item's trace (the context is
+        re-derived from the result's item_index/epoch — sampling is
+        deterministic, so no wire change on the result path) and the
+        producer-bound auto-dump trigger is polled."""
         with span('queue_wait'):
             t0 = time.monotonic()
+            result = None
             try:
-                return self._pool.get_results()
+                result = self._pool.get_results()
+                return result
             finally:
                 waited = time.monotonic() - t0
                 if waited > _PULL_NOTE_FLOOR_S:
                     note_consumer_wait(waited)
+                if tracing.trace_enabled():
+                    self._note_trace_pull(result, waited)
+                    tracing.maybe_autodump()
+
+    def _note_trace_pull(self, result, waited):
+        item_index = getattr(result, 'item_index', None)
+        epoch = getattr(result, 'epoch', None)
+        if item_index is None and isinstance(result, dict):
+            item_index = result.get('item_index')
+            epoch = result.get('epoch')
+        ctx = tracing.ctx_for(item_index, epoch, self.cur_shard)
+        if ctx is not None:
+            tracing.record_complete('queue_wait', time.time() - waited,
+                                    waited, ctx, track='consumer')
 
     def __next__(self):
         if self._stopped:
@@ -542,6 +562,15 @@ class Reader:
         metric deltas back over their result channels."""
         from petastorm_tpu.telemetry import pipeline_report
         return pipeline_report(wall_time_s=wall_time_s)
+
+    def dump_trace(self, path):
+        """Export the flight recorder's per-item trace as Chrome
+        trace-event JSON (Perfetto-viewable; needs ``PETASTORM_TPU_TRACE=1``
+        during the read — docs/telemetry.md). Worker-side events from
+        every pool flavor are already merged here via the pools' delta
+        channels. Returns the number of events written."""
+        from petastorm_tpu.telemetry import dump_trace
+        return dump_trace(path)
 
     # -- checkpointable iteration state --------------------------------------
 

@@ -34,12 +34,26 @@ import threading
 import time
 
 from petastorm_tpu.service import protocol as proto
-from petastorm_tpu.telemetry import merge_worker_delta, note_producer_wait
+from petastorm_tpu.telemetry import (
+    get_registry, merge_worker_delta, metrics_disabled, note_producer_wait,
+    tracing,
+)
 
 logger = logging.getLogger(__name__)
 
 _POLL_INTERVAL_MS = 50
 _STOP_BROADCASTS = 3
+
+# Fleet-health metric names (docs/telemetry.md): the dispatcher runs in
+# the CONSUMER process, so these land straight in its process-wide
+# registry and surface through pipeline_report()'s `service` section —
+# re-ventilation/dedupe activity visible without reading dispatcher logs.
+SERVICE_REVENTILATED = 'petastorm_tpu_service_reventilated_total'
+SERVICE_DUPLICATE_DONE = 'petastorm_tpu_service_duplicate_done_total'
+SERVICE_WORKERS_ALIVE = 'petastorm_tpu_service_workers_alive'
+SERVICE_WORKERS_REGISTERED = 'petastorm_tpu_service_workers_registered'
+SERVICE_ITEMS_PENDING = 'petastorm_tpu_service_items_pending'
+SERVICE_ITEMS_ASSIGNED = 'petastorm_tpu_service_items_assigned'
 
 
 class _WorkerState:
@@ -50,6 +64,19 @@ class _WorkerState:
         self.last_heartbeat = now
         self.ready = False
         self.inflight = set()
+
+
+class _TraceEntry:
+    """Lifecycle of one traced item at the dispatcher: how many times it
+    was dispatched, and — once delivered while still dedup-risky — when,
+    so the sweep can age the retained entry out."""
+
+    __slots__ = ('ctx', 'attempts', 'completed_at')
+
+    def __init__(self, ctx):
+        self.ctx = ctx
+        self.attempts = 0
+        self.completed_at = None
 
 
 class Dispatcher:
@@ -107,20 +134,36 @@ class Dispatcher:
         self._out_backlog = collections.deque()
         self._completed_count = 0
         self._reventilated_count = 0
+        self._duplicate_done_count = 0
         self._workers_seen = 0
         self._metrics_deltas_merged = 0
         self._fatal_error = None
         self._no_workers_since = None
+        # item_id -> _TraceEntry for traced items: the
+        # work payload is opaque dill here, so the ServicePool registers
+        # the context at submit time and the dispatcher stamps lifecycle
+        # instants (dispatch/reventilate/done/duplicate_done) — which is
+        # exactly what makes the exactly-once machinery OBSERVABLE: a
+        # re-ventilated item's timeline shows every dispatch attempt and
+        # its single deduped completion. Entries drop at completion; risky
+        # ones are retained briefly for dedup marking and aged out by the
+        # sweep, so the map stays bounded by in-flight work, never by
+        # stream length or failure churn.
+        self._trace_ctx = {}
 
     # -- thread-safe surface (called from pool / ventilator threads) ---------
 
-    def submit(self, payload):
-        """Enqueue one dill-framed work item; returns its item id."""
+    def submit(self, payload, trace_ctx=None):
+        """Enqueue one dill-framed work item; returns its item id.
+        ``trace_ctx`` (when the item is traced) keys the dispatcher's
+        lifecycle instants to the trace minted at ventilation."""
         with self._lock:
             item_id = self._next_item_id
             self._next_item_id += 1
             self._pending.append((item_id, payload))
             self._pending_ids.add(item_id)
+            if trace_ctx is not None:
+                self._trace_ctx[item_id] = _TraceEntry(trace_ctx)
             return item_id
 
     def wait_bound(self, timeout):
@@ -155,8 +198,27 @@ class Dispatcher:
             'items_assigned': len(self._inflight),
             'items_pending': pending,
             'items_reventilated': self._reventilated_count,
+            'items_duplicate_done': self._duplicate_done_count,
             'metrics_deltas_merged': self._metrics_deltas_merged,
         }
+
+    def _update_fleet_gauges(self):
+        """Mirror fleet health into the process-wide registry so
+        pipeline_report()'s `service` section (and the Prometheus/JSONL
+        exporters) see it without holding a pool reference."""
+        if metrics_disabled():
+            return
+        now = time.monotonic()
+        workers = list(self._workers.values())
+        live = sum(1 for w in workers
+                   if now - w.last_heartbeat <= self._liveness_timeout_s)
+        registry = get_registry()
+        registry.gauge(SERVICE_WORKERS_ALIVE).set(live)
+        registry.gauge(SERVICE_WORKERS_REGISTERED).set(len(workers))
+        with self._lock:
+            pending = len(self._pending)
+        registry.gauge(SERVICE_ITEMS_PENDING).set(pending)
+        registry.gauge(SERVICE_ITEMS_ASSIGNED).set(len(self._inflight))
 
     # -- dispatcher thread ---------------------------------------------------
 
@@ -226,6 +288,7 @@ class Dispatcher:
                 if now - last_sweep >= self._heartbeat_interval_s:
                     last_sweep = now
                     self._sweep(now)
+                    self._update_fleet_gauges()
         except Exception as e:  # noqa: BLE001 - fatal for the whole pool
             logger.exception('Dispatcher loop died')
             self._fatal_error = e
@@ -256,6 +319,7 @@ class Dispatcher:
                 self._workers[identity].last_heartbeat = now
             sock.send_multipart([identity, proto.MSG_SPEC,
                                  self._job_spec_payload])
+            self._update_fleet_gauges()
         elif msg == proto.MSG_READY:
             worker = self._workers.get(identity)
             if worker is not None:
@@ -327,6 +391,16 @@ class Dispatcher:
             # first DONE already delivered this item's rows.
             logger.debug('Dropping duplicate completion of item %d from %s',
                          item_id, identity)
+            self._duplicate_done_count += 1
+            if not metrics_disabled():
+                get_registry().counter(SERVICE_DUPLICATE_DONE).inc()
+            # both completions have now been seen: the trace entry has
+            # served its purpose (the dedup drop is marked on the timeline)
+            entry = self._trace_ctx.pop(item_id, None)
+            if entry is not None:
+                tracing.record_instant(
+                    'duplicate_done', entry.ctx, 'dispatcher',
+                    worker=identity.decode('utf-8', 'replace'))
             return
         entry = self._inflight.pop(item_id, None)
         if entry is None:
@@ -347,6 +421,23 @@ class Dispatcher:
                 owner.inflight.discard(item_id)
         if item_id in self._risky_ids:
             self._done.add(item_id)
+            # a risky item keeps its trace entry so a RACED second DONE
+            # can be marked as deduped — but a SIGKILLed first owner never
+            # sends one, so stamp the completion time and let the sweep
+            # age the entry out (the ghost race window is a few liveness
+            # timeouts at most); without this the map would grow with
+            # failure churn for the life of the process
+            entry = self._trace_ctx.get(item_id)
+            if entry is not None and entry.completed_at is None:
+                entry.completed_at = now
+        else:
+            entry = self._trace_ctx.pop(item_id, None)
+        if entry is not None:
+            # the item's ONE delivered completion
+            tracing.record_instant(
+                'done', entry.ctx, 'dispatcher',
+                worker=identity.decode('utf-8', 'replace'),
+                attempts=entry.attempts, outcome=outcome[0])
         self._completed_count += 1
         kind, payload = outcome
         if kind == 'result':
@@ -393,6 +484,13 @@ class Dispatcher:
                                      proto.pack_item_id(item_id), payload])
                 self._inflight[item_id] = (worker.identity, payload)
                 worker.inflight.add(item_id)
+                entry = self._trace_ctx.get(item_id)
+                if entry is not None:
+                    entry.attempts += 1
+                    tracing.record_instant(
+                        'dispatch', entry.ctx, 'dispatcher',
+                        worker=worker.identity.decode('utf-8', 'replace'),
+                        attempt=entry.attempts)
 
     def _sweep(self, now):
         for identity, worker in list(self._workers.items()):
@@ -400,6 +498,15 @@ class Dispatcher:
                 self._deregister(
                     identity, 'heartbeat lapsed (%.1fs > %.1fs)'
                     % (now - worker.last_heartbeat, self._liveness_timeout_s))
+        # age out trace entries retained past completion for dedup marking
+        # (see _complete): a ghost DONE races within ZMQ buffering of one
+        # lapse, so several liveness timeouts is a generous window
+        retention_s = 10.0 * self._liveness_timeout_s
+        stale = [item_id for item_id, entry in list(self._trace_ctx.items())
+                 if entry.completed_at is not None
+                 and now - entry.completed_at > retention_s]
+        for item_id in stale:
+            self._trace_ctx.pop(item_id, None)
         with self._lock:
             outstanding = bool(self._pending) or bool(self._inflight)
         if outstanding and not self._workers:
@@ -431,6 +538,15 @@ class Dispatcher:
             # copy); only such items need completion dedup.
             self._risky_ids.add(item_id)
             reventilated += 1
+            trace_entry = self._trace_ctx.get(item_id)
+            if trace_entry is not None:
+                tracing.record_instant(
+                    'reventilate', trace_entry.ctx, 'dispatcher',
+                    worker=identity.decode('utf-8', 'replace'),
+                    reason=reason)
         self._reventilated_count += reventilated
+        if reventilated and not metrics_disabled():
+            get_registry().counter(SERVICE_REVENTILATED).inc(reventilated)
+        self._update_fleet_gauges()
         logger.warning('Worker %s deregistered (%s); re-ventilated %d '
                        'in-flight item(s)', identity, reason, reventilated)

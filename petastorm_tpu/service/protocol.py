@@ -17,7 +17,12 @@ The ``<metrics>`` frame piggybacks the worker server's telemetry delta
 (:meth:`~petastorm_tpu.telemetry.registry.MetricsRegistry.collect_delta`)
 on each completion — an empty frame when nothing changed — so the
 dispatcher aggregates stage timings and stall clocks fleet-wide without a
-separate metrics channel (docs/telemetry.md).
+separate metrics channel (docs/telemetry.md). With per-item tracing on
+(``PETASTORM_TPU_TRACE=1``) the same frame also carries the server's
+flight-recorder batch (``trace_events``): a traced item's context rides
+in the WORK payload's kwargs, its worker-side events ride back here, and
+the dispatcher lands them in the consumer-side recorder — one export
+then shows the whole distributed timeline.
 
 Payload encodings reuse the local pools' codecs: work items and the job spec
 ride dill (same framing the :class:`~petastorm_tpu.workers.process_pool

@@ -36,6 +36,7 @@ import time
 from petastorm_tpu.serializers import PickleSerializer
 from petastorm_tpu.service import protocol as proto
 from petastorm_tpu.service.dispatcher import Dispatcher
+from petastorm_tpu.telemetry import tracing
 from petastorm_tpu.workers import (
     EmptyResultError, TimeoutWaitingForResultError,
 )
@@ -203,7 +204,11 @@ class ServicePool:
     def ventilate(self, *args, **kwargs):
         with self._counter_lock:
             self._ventilated_items += 1
-        self._dispatcher.submit(proto.dump_work_item(args, kwargs))
+        # a traced item's context rides INSIDE the opaque work payload to
+        # the worker server; the dispatcher additionally needs it BY item
+        # id to stamp its lifecycle instants (dispatch/reventilate/done)
+        self._dispatcher.submit(proto.dump_work_item(args, kwargs),
+                                trace_ctx=kwargs.get(tracing.TRACE_CTX_KEY))
 
     def _deliver(self, entry):
         """Dispatcher-thread side of the results queue: NON-BLOCKING put.
@@ -320,6 +325,7 @@ class ServicePool:
             diag.update({'workers_alive': 0, 'workers_registered': 0,
                          'workers_seen': 0, 'items_assigned': 0,
                          'items_pending': 0, 'items_reventilated': 0,
+                         'items_duplicate_done': 0,
                          'metrics_deltas_merged': 0})
         return diag
 

@@ -37,6 +37,7 @@ import time
 import uuid
 
 from petastorm_tpu.service import protocol as proto
+from petastorm_tpu.telemetry import tracing
 
 logger = logging.getLogger(__name__)
 
@@ -109,7 +110,13 @@ def _run_job(sock, spec_payload, worker_id, heartbeat_interval_s,
             del buffer[:]
             try:
                 args, kwargs = proto.load_work_item(payload)
-                worker.process(*args, **kwargs)
+                # traced items carry their context inside the WORK frame's
+                # kwargs; activate it so this server's stage spans + the
+                # attempt event (worker id + pid track) join the item's
+                # timeline — they ship back inside the DONE's delta frame
+                ctx = kwargs.pop(tracing.TRACE_CTX_KEY, None)
+                with tracing.attempt(ctx, 'service-%d' % worker_id):
+                    worker.process(*args, **kwargs)
                 # metrics delta rides the DONE (io/decode/transform spans,
                 # cache counters accrued while processing this item); the
                 # dispatcher merges it into the client-side registry, so

@@ -27,6 +27,16 @@ Exporters: :func:`write_jsonl_snapshot` / :func:`read_jsonl_snapshots`
 (JSONL), :func:`prometheus_text` (Prometheus text format), and
 :func:`pipeline_report` / :func:`format_pipeline_report` (per-stage time
 breakdown + stall attribution). See docs/telemetry.md.
+
+A fourth layer rides on the first three: per-item distributed tracing
+(:mod:`~petastorm_tpu.telemetry.tracing` +
+:mod:`~petastorm_tpu.telemetry.recorder`) — ``PETASTORM_TPU_TRACE=1``
+mints a trace context per ventilated row-group, worker-side events ride
+the same delta channels the metrics use, and the per-process flight
+recorder exports a Perfetto-viewable Chrome trace
+(:func:`dump_trace`; ``Reader.dump_trace`` / ``JaxLoader.dump_trace`` /
+``benchmark --trace-out``). Off by default with the spans' no-op
+discipline. See the tracing section of docs/telemetry.md.
 """
 
 from petastorm_tpu.telemetry.registry import (  # noqa: F401
@@ -43,6 +53,14 @@ from petastorm_tpu.telemetry.stall import (  # noqa: F401
 from petastorm_tpu.telemetry.export import (  # noqa: F401
     format_pipeline_report, pipeline_report, prometheus_text,
     read_jsonl_snapshots, write_jsonl_snapshot,
+)
+from petastorm_tpu.telemetry.recorder import (  # noqa: F401
+    FlightRecorder, export_chrome_trace, get_recorder, reset_recorder,
+    slowest_items,
+)
+from petastorm_tpu.telemetry import tracing  # noqa: F401
+from petastorm_tpu.telemetry.tracing import (  # noqa: F401
+    TRACE_CTX_KEY, TraceContext, dump_trace, refresh_trace, trace_enabled,
 )
 
 #: registry counter names the wait clocks accumulate into (seconds)
@@ -74,8 +92,20 @@ def note_consumer_wait(seconds):
     get_attributor().note_consumer_wait(seconds)
 
 
+def refresh():
+    """Re-read EVERY telemetry knob — metrics enable, trace enable,
+    sampling stride, autodump state — so tests and long-lived processes
+    flip all of them through one entry point (the per-module
+    ``refresh_enabled``/``refresh_trace`` remain as the two halves)."""
+    refresh_enabled()
+    refresh_trace()
+
+
 def reset_for_tests():
-    """Fresh process-wide registry + attributor (test isolation only)."""
+    """Fresh process-wide registry + attributor + flight recorder and
+    re-read knobs (test isolation only)."""
     reset_registry()
     reset_attributor()
+    reset_recorder()
+    tracing._reset_for_tests()
     refresh_enabled()

@@ -202,6 +202,9 @@ def pipeline_report(registry=None, wall_time_s=None, baseline=None,
     cache = _cache_section(registry)
     if cache is not None:
         report['cache'] = cache
+    service = _service_section(registry)
+    if service is not None:
+        report['service'] = service
     return report
 
 
@@ -233,6 +236,32 @@ def _cache_section(registry):
     }
 
 
+def _service_section(registry):
+    """Disaggregated-fleet health, from the gauges/counters the service
+    dispatcher mirrors into the registry — present only when a service
+    pool ran in this process (a worker ever registered), so local-pool
+    reports stay unchanged. Re-ventilation/dedupe make the exactly-once
+    machinery's activity visible without reading dispatcher logs."""
+    from petastorm_tpu.service.dispatcher import (
+        SERVICE_DUPLICATE_DONE, SERVICE_ITEMS_ASSIGNED,
+        SERVICE_ITEMS_PENDING, SERVICE_REVENTILATED, SERVICE_WORKERS_ALIVE,
+        SERVICE_WORKERS_REGISTERED,
+    )
+    gauges = registry.gauges_with_prefix('petastorm_tpu_service_')
+    if not gauges:
+        return None
+    return {
+        'workers_alive': int(registry.gauge_value(SERVICE_WORKERS_ALIVE)),
+        'workers_registered': int(
+            registry.gauge_value(SERVICE_WORKERS_REGISTERED)),
+        'items_pending': int(registry.gauge_value(SERVICE_ITEMS_PENDING)),
+        'items_assigned': int(registry.gauge_value(SERVICE_ITEMS_ASSIGNED)),
+        'reventilated': int(registry.counter_value(SERVICE_REVENTILATED)),
+        'duplicate_done': int(
+            registry.counter_value(SERVICE_DUPLICATE_DONE)),
+    }
+
+
 def format_pipeline_report(report):
     """Human-readable rendering of :func:`pipeline_report` (one stage per
     line, canonical pipeline order first, then any extra stages)."""
@@ -261,4 +290,12 @@ def format_pipeline_report(report):
                      % (c['hits'], c['misses'], 100 * c['hit_rate'],
                         c['evictions'], c['bytes_written'],
                         c['bytes_evicted'], c['size_bytes']))
+    if 'service' in report:
+        s = report['service']
+        lines.append('service fleet: %d alive / %d registered worker(s), '
+                     '%d pending / %d assigned item(s), %d re-ventilated, '
+                     '%d duplicate completion(s) dropped'
+                     % (s['workers_alive'], s['workers_registered'],
+                        s['items_pending'], s['items_assigned'],
+                        s['reventilated'], s['duplicate_done']))
     return '\n'.join(lines)

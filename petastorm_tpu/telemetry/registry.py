@@ -320,10 +320,21 @@ def dump_delta_frame():
     dill-framed for a pool's result channel (b'' when nothing changed).
     Telemetry must never fail a completion: errors degrade to b''. The one
     owner of delta framing — the process pool's markers and the service's
-    DONE messages both call it."""
+    DONE messages both call it.
+
+    Per-item trace events piggyback here too: when the flight recorder
+    holds events (worker-side stage/attempt events of traced items), the
+    frame carries them under ``trace_events`` and the recorder is drained
+    — the trace layer reuses the metrics' channel instead of adding one."""
     import dill
     try:
         delta = get_registry().collect_delta()
+        from petastorm_tpu.telemetry.recorder import get_recorder
+        recorder = get_recorder()
+        if len(recorder):
+            delta = delta or {'counters': {}, 'gauges': {},
+                              'histograms': {}}
+            delta['trace_events'] = recorder.drain()
         return dill.dumps(delta) if delta else b''
     except Exception:  # noqa: BLE001 - telemetry is advisory
         return b''
@@ -334,8 +345,9 @@ def load_delta_frame(frame):
     or non-delta-shaped frames (a dropped delta loses some gauge
     freshness, nothing more — it must never take a data channel down).
 
-    The shape check is strict — EXACTLY the three delta keys, all dicts,
-    at least one non-empty — because the service dispatcher uses it to
+    The shape check is strict — EXACTLY the three delta keys (plus an
+    optional ``trace_events`` LIST), the three all dicts, at least one of
+    the fields non-empty — because the service dispatcher uses it to
     tell a metrics frame from a result frame sent by a pre-telemetry
     worker build (the wire has no version marker); a permissive check
     would let arbitrary pickled results masquerade as deltas and vanish."""
@@ -346,10 +358,14 @@ def load_delta_frame(frame):
         delta = dill.loads(frame)
     except Exception:  # noqa: BLE001 - telemetry is advisory
         return None
-    if not isinstance(delta, dict) or set(delta) != {'counters', 'gauges',
-                                                     'histograms'}:
+    if not isinstance(delta, dict):
         return None
-    if not all(isinstance(v, dict) for v in delta.values()):
+    base_keys = {'counters', 'gauges', 'histograms'}
+    if set(delta) not in (base_keys, base_keys | {'trace_events'}):
+        return None
+    if not all(isinstance(delta[k], dict) for k in base_keys):
+        return None
+    if not isinstance(delta.get('trace_events', []), list):
         return None
     if not any(delta.values()):
         return None
@@ -374,6 +390,13 @@ def merge_worker_delta(delta):
 
 def _merge_worker_delta(delta):
     get_registry().merge_delta(delta)
+    events = delta.get('trace_events')
+    if events:
+        # a remote worker's flight-recorder batch: fold it into THIS
+        # process's recorder, where the whole distributed timeline
+        # accumulates for export (dump_trace / --trace-out)
+        from petastorm_tpu.telemetry.recorder import get_recorder
+        get_recorder().add_many(e for e in events if isinstance(e, dict))
     counters = delta.get('counters', {})
     # import here: registry must stay importable before the package's
     # __init__ finishes binding the sibling modules

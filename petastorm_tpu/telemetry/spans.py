@@ -68,6 +68,18 @@ class _NoopSpan:
 
 _NOOP_SPAN = _NoopSpan()
 
+# Trace hook: None until per-item tracing activates a context in this
+# process (tracing.py installs it lazily), after which every span exit also
+# offers its (stage, elapsed) to the flight recorder. A module-global None
+# check is the entire cost when tracing is off — the span hot path keeps
+# its PR 3 shape (enforced by tests/test_tracing.py's overhead guard).
+_trace_hook = None
+
+
+def set_trace_hook(hook):
+    global _trace_hook
+    _trace_hook = hook
+
 # stage -> (seconds counter, calls counter, duration histogram); caches the
 # metric-object lookups so a span's enter/exit is clock reads + three adds.
 # Invalidated on registry reset (hook below): cached objects of a replaced
@@ -88,9 +100,10 @@ def _stage_metrics(stage):
 
 
 class _Span:
-    __slots__ = ('_metrics', '_t0')
+    __slots__ = ('_stage', '_metrics', '_t0')
 
-    def __init__(self, metrics):
+    def __init__(self, stage, metrics):
+        self._stage = stage
         self._metrics = metrics
 
     def __enter__(self):
@@ -103,6 +116,8 @@ class _Span:
         seconds.inc(elapsed)
         calls.inc()
         duration.observe(elapsed)
+        if _trace_hook is not None:
+            _trace_hook(self._stage, elapsed)
         return False
 
 
@@ -115,4 +130,4 @@ def span(stage):
     telemetry is disabled."""
     if metrics_disabled():
         return _NOOP_SPAN
-    return _Span(_stage_metrics(stage))
+    return _Span(stage, _stage_metrics(stage))

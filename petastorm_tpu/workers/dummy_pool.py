@@ -8,6 +8,7 @@ debuggers see the full pipeline.
 import time
 from collections import deque
 
+from petastorm_tpu.telemetry import tracing
 from petastorm_tpu.workers import EmptyResultError, VentilatedItemProcessedMessage
 
 
@@ -54,8 +55,10 @@ class DummyPool:
                 time.sleep(0.001)
                 continue
             args, kwargs = self._work_items.popleft()
+            ctx = kwargs.pop(tracing.TRACE_CTX_KEY, None)
             try:
-                self._worker.process(*args, **kwargs)
+                with tracing.attempt(ctx, 'dummy-0'):
+                    self._worker.process(*args, **kwargs)
             except Exception as e:  # noqa: BLE001 - surfaced to the consumer
                 self._processed_items += 1
                 if self._ventilator is not None:

@@ -38,7 +38,7 @@ import dill
 from petastorm_tpu.serializers import PickleSerializer
 from petastorm_tpu.telemetry import (
     STALL_NOTE_FLOOR_S, dump_delta_frame, load_delta_frame,
-    merge_worker_delta, note_producer_wait,
+    merge_worker_delta, note_producer_wait, tracing,
 )
 from petastorm_tpu.workers import (
     EmptyResultError, TimeoutWaitingForResultError, WorkerTerminationRequested,
@@ -344,8 +344,14 @@ def _worker_bootstrap(worker_id, main_pid, work_ep, control_ep, results_ep,
                     break
             if work in events:
                 args, kwargs = dill.loads(work.recv())
+                # traced items carry their context over the work channel;
+                # activate it here so this PROCESS's stage spans + attempt
+                # event land on the item's timeline (they ship back with
+                # the marker's delta frame below)
+                ctx = kwargs.pop(tracing.TRACE_CTX_KEY, None)
                 try:
-                    worker.process(*args, **kwargs)
+                    with tracing.attempt(ctx, 'process-%d' % worker_id):
+                        worker.process(*args, **kwargs)
                     # marker piggybacks this worker's metric delta (one
                     # shared framing with the service's DONE piggyback)
                     send_or_stop([_MSG_MARKER, dump_delta_frame()])

@@ -12,7 +12,9 @@ import time
 from cProfile import Profile
 from pstats import Stats
 
-from petastorm_tpu.telemetry import STALL_NOTE_FLOOR_S, note_producer_wait
+from petastorm_tpu.telemetry import (
+    STALL_NOTE_FLOOR_S, note_producer_wait, tracing,
+)
 from petastorm_tpu.workers import (
     EmptyResultError, TimeoutWaitingForResultError, VentilatedItemProcessedMessage,
 )
@@ -198,10 +200,16 @@ class ThreadPool:
                     args, kwargs = self._work_queue.get(timeout=_POLL_INTERVAL_S)
                 except queue.Empty:
                     continue
+                # traced items carry their context as a reserved kwarg;
+                # strip it and make it the thread's active trace so the
+                # worker's stage spans land on the item's timeline
+                ctx = kwargs.pop(tracing.TRACE_CTX_KEY, None)
                 try:
                     if profiler:
                         profiler.enable()
-                    worker.process(*args, **kwargs)
+                    with tracing.attempt(ctx, 'thread-%d'
+                                         % worker.worker_id):
+                        worker.process(*args, **kwargs)
                     if profiler:
                         profiler.disable()
                     self._publish(VentilatedItemProcessedMessage())

@@ -13,7 +13,7 @@ from abc import ABCMeta, abstractmethod
 
 import numpy as np
 
-from petastorm_tpu.telemetry import span
+from petastorm_tpu.telemetry import span, tracing
 
 logger = logging.getLogger(__name__)
 
@@ -62,15 +62,23 @@ class ConcurrentVentilator(Ventilator):
     :param random_seed: seed for the per-epoch permutations. Epoch ``e`` uses
         ``seed + e`` so every shard/host can reproduce the order
         arithmetically without communication.
+    :param trace_shard: shard id recorded in minted trace contexts (the
+        Reader passes its resolved ``cur_shard``). The ventilator is where
+        per-item tracing BEGINS: each sampled item gets a
+        :class:`~petastorm_tpu.telemetry.tracing.TraceContext` injected as
+        the reserved ``_trace_ctx`` kwarg, which every pool flavor strips
+        (and activates) before ``worker.process`` — so worker-side events
+        anywhere in the fleet share the trace id minted here.
     """
 
     def __init__(self, ventilate_fn, items_to_ventilate, iterations=1,
                  max_ventilation_queue_size=None, randomize_item_order=False,
-                 random_seed=0, pass_epoch=False):
+                 random_seed=0, pass_epoch=False, trace_shard=None):
         super().__init__(ventilate_fn)
         if iterations is not None and iterations <= 0:
             raise ValueError('iterations must be positive or None, got %r' % iterations)
         self._pass_epoch = pass_epoch
+        self._trace_shard = trace_shard
         self._items = list(items_to_ventilate)
         self._initial_iterations = iterations
         self._iterations_remaining = iterations
@@ -218,12 +226,19 @@ class ConcurrentVentilator(Ventilator):
                 # 'ventilate' stage = time HANDING items to the pool
                 # (serialization, dispatcher submit); the bounded wait
                 # above is back-pressure by design, not stage work
-                with span('ventilate'):
-                    if self._pass_epoch:
-                        self._ventilate_fn(epoch=self._epoch,
-                                           **self._items[item_index])
-                    else:
-                        self._ventilate_fn(**self._items[item_index])
+                item = self._items[item_index]
+                ctx = tracing.mint(item.get('item_index', item_index),
+                                   epoch=self._epoch,
+                                   shard=self._trace_shard)
+                if ctx is not None:
+                    item = dict(item)
+                    item[tracing.TRACE_CTX_KEY] = ctx
+                with tracing.activate(ctx, track='ventilator'):
+                    with span('ventilate'):
+                        if self._pass_epoch:
+                            self._ventilate_fn(epoch=self._epoch, **item)
+                        else:
+                            self._ventilate_fn(**item)
                 # The cursor advances only after the item was handed to the
                 # pool, so a state_dict() snapshot can never skip an item that
                 # was not ventilated (at-least-once resume semantics).

@@ -45,3 +45,17 @@ class SpanningSleepyWorker(WorkerBase):
         with span('decode'):
             time.sleep(sleep_s)
         self.publish_func(value)
+
+
+class TracingProbeWorker(WorkerBase):
+    """Publishes ``(item_index, trace_id seen worker-side)`` plus a tiny
+    'decode' span — the probe asserting a trace context minted at the
+    ventilator arrives ACTIVATED inside any pool flavor's worker and that
+    the stage span lands on its timeline."""
+
+    def process(self, item_index=None, sleep_s=0.002):
+        from petastorm_tpu.telemetry import span
+        from petastorm_tpu.telemetry.tracing import current_trace_id
+        with span('decode'):
+            time.sleep(sleep_s)
+        self.publish_func((item_index, current_trace_id()))

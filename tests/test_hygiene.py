@@ -5,6 +5,7 @@ invariants locally enforced)."""
 import ast
 import glob
 import os
+import re
 import tokenize
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -43,6 +44,74 @@ def test_no_tabs_no_overlong_lines():
             if len(line) > MAX_LINE:
                 offenders.append('%s:%d: %d chars' % (path, lineno, len(line)))
     assert not offenders, '\n'.join(offenders)
+
+
+def _package_sources():
+    for path in SOURCES:
+        rel = os.path.relpath(path, REPO)
+        if rel.startswith('petastorm_tpu'):
+            yield rel, _read(path)
+
+
+def _call_name(node):
+    if isinstance(node.func, ast.Name):
+        return node.func.id
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    return None
+
+
+def test_span_and_trace_stage_names_are_canonical():
+    """Every literal stage/event name recorded by the package — span(...)
+    and the tracing record_* calls — must be in telemetry.STAGES or
+    tracing.EVENT_NAMES (or the explicit whitelist below): a typo'd stage
+    would silently fall out of pipeline_report's canonical grouping and
+    out of the timeline's known tracks."""
+    from petastorm_tpu.telemetry import STAGES
+    from petastorm_tpu.telemetry.tracing import EVENT_NAMES
+    whitelist = set()  # intentionally empty today; add with a comment why
+    allowed = set(STAGES) | set(EVENT_NAMES) | whitelist
+    recording_calls = ('span', 'record_complete', 'record_instant')
+    offenders = []
+    for rel, source in _package_sources():
+        for node in ast.walk(ast.parse(source, filename=rel)):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            if _call_name(node) not in recording_calls:
+                continue
+            first = node.args[0]
+            if isinstance(first, ast.Constant) and \
+                    isinstance(first.value, str) and \
+                    first.value not in allowed:
+                offenders.append('%s:%d: %r' % (rel, node.lineno,
+                                                first.value))
+    assert not offenders, \
+        'unknown stage/event names (add to STAGES/EVENT_NAMES or ' \
+        'whitelist): %s' % offenders
+
+
+def test_exported_metric_names_are_documented():
+    """Every registry metric name the package exports (string literals of
+    the ``petastorm_tpu_*`` namespace) must appear in docs/telemetry.md's
+    metric reference — dashboards are built from the docs, and an
+    undocumented series is invisible operational surface."""
+    name_re = re.compile(r'petastorm_tpu_[a-z0-9_]*[a-z0-9]')
+    with open(os.path.join(REPO, 'docs', 'telemetry.md')) as f:
+        # extract WHOLE documented names with the same lexer — substring
+        # containment would let an undocumented 'petastorm_tpu_cache_hits'
+        # hide inside the documented '..._cache_hits_total'
+        documented = set(name_re.findall(f.read()))
+    names = set()
+    for rel, source in _package_sources():
+        for node in ast.walk(ast.parse(source, filename=rel)):
+            if isinstance(node, ast.Constant) and \
+                    isinstance(node.value, str) and \
+                    name_re.fullmatch(node.value):
+                names.add(node.value)
+    assert len(names) >= 10, 'metric-literal scan went blind: %s' % names
+    missing = sorted(names - documented)
+    assert not missing, \
+        'metric names missing from docs/telemetry.md: %s' % missing
 
 
 def test_no_print_in_library_code():

@@ -1,0 +1,241 @@
+"""Trace-context propagation across the pool flavors — the ISSUE's
+acceptance path: the SAME trace id observed worker-side (thread AND
+process AND service pools) and consumer-side, dispatcher lifecycle
+instants for re-ventilated items, and the end-to-end export of a
+``make_jax_loader`` run over the service pool with a worker SIGKILLed
+mid-epoch.
+
+Service tests spawn real localhost worker-server subprocesses and are
+marked ``service`` like tests/test_service.py (tier-1, tight internal
+timeouts)."""
+
+import collections
+import json
+import os
+import signal
+import time
+
+import pytest
+
+from petastorm_tpu import telemetry as T
+from petastorm_tpu.telemetry import tracing
+from petastorm_tpu.workers import EmptyResultError
+from petastorm_tpu.workers.thread_pool import ThreadPool
+from petastorm_tpu.workers.ventilator import ConcurrentVentilator
+from tests.stub_workers import TracingProbeWorker
+
+_RESULT_TIMEOUT_S = 60
+
+# same tight-but-safe timing as tests/test_service.py's kill tests
+_FAST = dict(heartbeat_interval_s=0.15, liveness_timeout_s=0.75,
+             connect_timeout_s=60, no_workers_timeout_s=20)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    T.reset_for_tests()
+    yield
+    T.reset_for_tests()
+
+
+@pytest.fixture
+def traced(monkeypatch):
+    monkeypatch.setenv('PETASTORM_TPU_TRACE', '1')
+    T.refresh()
+    yield
+
+
+def _drain(pool):
+    out = []
+    while True:
+        try:
+            out.append(pool.get_results(timeout=_RESULT_TIMEOUT_S))
+        except EmptyResultError:
+            return out
+
+
+def _roundtrip_through(pool, items=6):
+    """Ventilate ``items`` probe items; return {item_index: worker-side
+    trace id} as published by the workers."""
+    ventilator = ConcurrentVentilator(
+        pool.ventilate, [{'item_index': i} for i in range(items)],
+        iterations=1)
+    pool.start(TracingProbeWorker, ventilator=ventilator)
+    try:
+        results = dict(_drain(pool))
+        assert sorted(results) == list(range(items))
+        return results
+    finally:
+        pool.stop()
+        pool.join()
+
+
+def _assert_worker_ids_match_minted(results):
+    for item_index, worker_side_id in results.items():
+        minted = tracing.ctx_for(item_index, epoch=0)
+        assert minted is not None
+        assert worker_side_id == minted.trace_id, \
+            'item %d: worker saw %r, consumer minted %r' \
+            % (item_index, worker_side_id, minted.trace_id)
+
+
+def test_thread_pool_roundtrip(traced):
+    results = _roundtrip_through(ThreadPool(2, results_queue_size=10))
+    _assert_worker_ids_match_minted(results)
+    events = T.get_recorder().snapshot()
+    names = collections.Counter(e['name'] for e in events)
+    assert names['ventilate'] == 6
+    assert names['attempt'] == 6
+    assert names['decode'] == 6
+    # worker tracks carry the thread-worker label
+    assert any(str(e['tid']).startswith('thread-') for e in events)
+
+
+def test_thread_pool_sampling_strides(traced, monkeypatch):
+    monkeypatch.setenv('PETASTORM_TPU_TRACE_SAMPLE', '1/2')
+    T.refresh()
+    results = _roundtrip_through(ThreadPool(2, results_queue_size=10))
+    assert {i for i, tid in results.items() if tid is not None} == {0, 2, 4}
+    events = T.get_recorder().snapshot()
+    assert collections.Counter(e['name'] for e in events)['attempt'] == 3
+
+
+def test_untraced_roundtrip_records_nothing():
+    results = _roundtrip_through(ThreadPool(2, results_queue_size=10))
+    assert set(results.values()) == {None}
+    assert len(T.get_recorder()) == 0
+
+
+def test_process_pool_roundtrip(traced):
+    """Worker-side events cross the ZMQ marker channel: the trace id
+    minted here must be ACTIVE inside the spawned decode process, and its
+    events must land back in this process's recorder."""
+    from petastorm_tpu.workers.process_pool import ProcessPool
+    results = _roundtrip_through(ProcessPool(1, results_queue_size=10))
+    _assert_worker_ids_match_minted(results)
+    events = T.get_recorder().snapshot()
+    names = collections.Counter(e['name'] for e in events)
+    assert names['attempt'] == 6 and names['decode'] == 6
+    worker_pids = {e['pid'] for e in events if e['name'] == 'attempt'}
+    assert worker_pids and os.getpid() not in worker_pids, \
+        'attempt events must carry the decode PROCESS pid'
+
+
+@pytest.mark.service
+def test_service_pool_roundtrip(traced):
+    """The full tcp:// path: context rides the WORK frame, events ride
+    the DONE's delta frame, and the dispatcher stamps dispatch/done
+    instants keyed by the same trace id."""
+    from petastorm_tpu.service import ServicePool
+    pool = ServicePool(spawn_local_workers=1, heartbeat_interval_s=0.2,
+                       connect_timeout_s=60)
+    results = _roundtrip_through(pool)
+    _assert_worker_ids_match_minted(results)
+    events = T.get_recorder().snapshot()
+    names = collections.Counter(e['name'] for e in events)
+    assert names['attempt'] == 6 and names['decode'] == 6
+    assert names['dispatch'] == 6 and names['done'] == 6
+    done_ids = {e['args']['trace_id'] for e in events
+                if e['name'] == 'done'}
+    attempt_ids = {e['args']['trace_id'] for e in events
+                   if e['name'] == 'attempt'}
+    assert done_ids == attempt_ids
+
+
+def _slow_batch_identity(df):
+    # per-row-group brake so a killed worker server reliably owns
+    # in-flight row-groups when the SIGKILL lands
+    time.sleep(0.05)
+    return df
+
+
+@pytest.fixture
+def many_rowgroup_scalar_dataset(tmp_path):
+    from tests.test_common import create_test_scalar_dataset
+    url = 'file://' + str(tmp_path / 'dataset')
+    create_test_scalar_dataset(url, num_rows=100, num_files=10)
+    return url
+
+
+@pytest.mark.service
+def test_jax_loader_service_trace_with_worker_kill(
+        traced, many_rowgroup_scalar_dataset, tmp_path):
+    """ISSUE acceptance, end to end: a make_jax_loader run over the
+    service pool with one worker SIGKILLed mid-epoch exports a valid
+    Chrome trace where (a) per-worker tracks are present, (b) the
+    re-ventilated item shows BOTH dispatch attempts and exactly ONE
+    completion, and (c) consumer-side queue_wait events share the trace
+    ids minted at ventilation."""
+    from petastorm_tpu.jax import make_jax_loader
+    from petastorm_tpu.service import ServicePool
+    from petastorm_tpu.transform import TransformSpec
+
+    pool = ServicePool(spawn_local_workers=2, **_FAST)
+    loader = make_jax_loader(
+        many_rowgroup_scalar_dataset, batch_size=10, num_epochs=1,
+        fields=['^id$', '^float64$'], shuffle_row_groups=False,
+        last_batch='short', reader_pool_type=pool,
+        transform_spec=TransformSpec(_slow_batch_identity))
+    rows = 0
+    try:
+        first = True
+        for batch in loader:
+            rows += int(next(iter(batch.values())).shape[0])
+            if first:
+                os.kill(pool._local_procs[0].pid, signal.SIGKILL)
+                first = False
+        path = str(tmp_path / 'service_kill.trace.json')
+        assert loader.dump_trace(path) > 0
+    finally:
+        loader.stop()
+    assert rows == 100, 'exactly-once delivery must survive the kill'
+
+    with open(path) as f:
+        doc = json.load(f)
+    events = doc['traceEvents']
+    data = [e for e in events if e['ph'] != 'M']
+    # (schema) every event well-formed
+    for e in data:
+        assert isinstance(e['name'], str) and e['ph'] in ('X', 'i')
+        assert isinstance(e['pid'], int) and isinstance(e['tid'], int)
+        assert isinstance(e['ts'], (int, float))
+        assert 'trace_id' in e['args']
+    # (tracks) per-worker tracks present: worker-server attempt tracks
+    # plus the consumer-side dispatcher/consumer/ventilator tracks
+    track_names = {m['args']['name'] for m in events if m['ph'] == 'M'}
+    assert any(name.startswith('service-') for name in track_names), \
+        track_names
+    assert {'dispatcher', 'consumer', 'ventilator'} <= track_names
+    # dispatch instants name ≥2 distinct worker servers (both attempts of
+    # a re-ventilated item land on different identities)
+    dispatch_workers = {e['args']['worker'] for e in data
+                        if e['name'] == 'dispatch'}
+    assert len(dispatch_workers) >= 2, dispatch_workers
+
+    def ids(name):
+        return [e['args']['trace_id'] for e in data if e['name'] == name]
+
+    # (re-ventilation) the killed worker's in-flight items were lapsed
+    # back and re-dispatched: both attempts on the timeline, one 'done'
+    reventilated = set(ids('reventilate'))
+    assert reventilated, 'SIGKILL mid-epoch must re-ventilate something'
+    dispatch_counts = collections.Counter(ids('dispatch'))
+    done_counts = collections.Counter(ids('done'))
+    for trace_id in reventilated:
+        assert dispatch_counts[trace_id] >= 2, \
+            'both attempts must be on the timeline (%s)' % trace_id
+        assert done_counts[trace_id] == 1, \
+            'exactly one completion per item (%s)' % trace_id
+    # every delivered item completed exactly once
+    assert done_counts and set(done_counts.values()) == {1}
+    # (consumer side) queue_wait events share ids minted at ventilation
+    queue_wait_ids = set(ids('queue_wait'))
+    ventilate_ids = set(ids('ventilate'))
+    assert queue_wait_ids and queue_wait_ids <= ventilate_ids
+    # fleet health satellite: the re-ventilation surfaced as first-class
+    # metrics + the pipeline_report service section
+    assert T.get_registry().counter_value(
+        'petastorm_tpu_service_reventilated_total') >= 1
+    report = T.pipeline_report()
+    assert report['service']['reventilated'] >= 1
+    assert 'service fleet:' in T.format_pipeline_report(report)
