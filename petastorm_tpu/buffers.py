@@ -198,7 +198,11 @@ class BatchedNoopShufflingBuffer(BatchedShufflingBufferBase):
         self._chunks.append(columns)
         self._size += n
 
-    def retrieve(self):
+    def retrieve_parts(self):
+        """One batch as a LIST of column-dict parts (views/whole chunks,
+        no concatenation): consumers that copy into a preallocated
+        destination — the JAX staging arena — skip the intermediate
+        concatenated batch allocation entirely."""
         if not self.can_retrieve:
             raise RuntimeError('retrieve called but can_retrieve is False')
         want = min(self.batch_size, self._size)
@@ -215,6 +219,10 @@ class BatchedNoopShufflingBuffer(BatchedShufflingBufferBase):
                 self._chunks[0] = {k: v[take:] for k, v in chunk.items()}
             got += take
         self._size -= want
+        return parts
+
+    def retrieve(self):
+        parts = self.retrieve_parts()
         if len(parts) == 1:
             return parts[0]
         return {k: _concat([p[k] for p in parts]) for k in parts[0]}

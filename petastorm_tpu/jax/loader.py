@@ -26,6 +26,7 @@ import time
 
 import numpy as np
 
+from petastorm_tpu.jax import staging
 from petastorm_tpu.telemetry import (
     STALL_NOTE_FLOOR_S, StallAttributor, note_consumer_wait,
     note_producer_wait, span, tracing,
@@ -37,7 +38,8 @@ _SENTINEL_END = object()
 _NO_ITEM = object()
 
 #: name of the validity-mask column added under ``last_batch='pad'``
-MASK_FIELD = 'valid_mask'
+#: (one canonical definition, shared with the staging engine)
+MASK_FIELD = staging.MASK_FIELD
 #: suffix of the true-size companion column added per ``pad_ragged`` field
 LEN_SUFFIX = '_len'
 # hidden per-row provenance column riding through the staging buffers; maps
@@ -210,6 +212,7 @@ class JaxLoader:
         self._min_after_retrieve = min_after_retrieve
         self._extra_capacity = extra_capacity
         self._sharding = self._resolve_sharding(mesh, data_axes, batch_size)
+        self._stager = None   # per-pass staging arena (stage thread only)
         self._stage_thread = None
         self._out_queue = None
         self._stop_event = threading.Event()
@@ -374,6 +377,14 @@ class JaxLoader:
         # fresh event per pass: a predecessor thread in teardown may still
         # set the previous pass's event after this point
         self._produce_done = threading.Event()
+        # fresh arena per pass, created BEFORE the thread starts so
+        # diagnostics never observe a started pass without its stager: a
+        # replay must not inherit slots whose in-flight transfers
+        # belonged to the previous pass, and knob changes (after
+        # staging.refresh_staging()) take effect here
+        self._stager = staging.make_stager(
+            self._batch_size, self._dtypes, self._last_batch,
+            self._put_to_device)
         self._out_queue = queue.Queue(maxsize=self._prefetch)
         self._stage_thread = threading.Thread(target=self._stage_loop,
                                               daemon=True)
@@ -576,23 +587,25 @@ class JaxLoader:
                             columns = self._densify_ragged(columns)
                         buf.add_many(columns)
                     while buf.can_retrieve:
-                        with span('collate'):
-                            batch = buf.retrieve()
-                        self._emit(batch)
+                        self._retrieve_and_emit(buf)
                         if self._stop_event.is_set():
                             return
                 if self._stop_event.is_set():
                     return
             buf.finish()
             while buf.can_retrieve:
-                with span('collate'):
-                    batch = buf.retrieve()
-                self._emit(batch)
+                self._retrieve_and_emit(buf)
                 if self._stop_event.is_set():
                     return
         except Exception as e:  # noqa: BLE001 - surfaced to consumer
             self._stage_error = e
         finally:
+            if self._stager is not None:
+                # drop slot slabs + their in-flight device refs: a ring
+                # kept across the epoch gap would pin host and device
+                # memory the post-epoch consumer (eval, checkpointing)
+                # may need
+                self._stager.release()
             # set happens-before put: a sentinel can only be OBSERVED in
             # the queue after _produce_done is visible, which is what lets
             # __iter__'s probe distinguish "real mid-pass batches" from "a
@@ -626,9 +639,7 @@ class JaxLoader:
                     with span('collate'):
                         buf.add_many(subcols)
                     while buf.can_retrieve:
-                        with span('collate'):
-                            batch = buf.retrieve()
-                        self._emit(batch)
+                        self._retrieve_and_emit(buf)
                         if self._stop_event.is_set():
                             return
             if self._stop_event.is_set():
@@ -636,9 +647,7 @@ class JaxLoader:
         for buf in buffers.values():
             buf.finish()
             while buf.can_retrieve:
-                with span('collate'):
-                    batch = buf.retrieve()
-                self._emit(batch)
+                self._retrieve_and_emit(buf)
                 if self._stop_event.is_set():
                     return
 
@@ -731,28 +740,66 @@ class JaxLoader:
             subcols[len_name] = lens[rows]
             yield bound, subcols
 
+    def _retrieve_and_emit(self, buf):
+        """Pull one batch from ``buf`` and emit it. With the staging arena
+        on and a buffer that can hand out parts (the noop re-batcher),
+        the batch travels as a LIST of chunk views — the arena fills its
+        slot from the parts directly, skipping the concatenated
+        intermediate the plain ``retrieve()`` would allocate."""
+        with span('collate'):
+            if self._stager is not None and hasattr(buf, 'retrieve_parts'):
+                batch = buf.retrieve_parts()
+            else:
+                batch = buf.retrieve()
+        self._emit(batch)
+
     def _emit(self, host_batch):
         with span('collate'):
-            host_batch = dict(host_batch)
-            pull_col = host_batch.pop(_PULL_FIELD, None)
-            n = len(next(iter(host_batch.values())))
-            if n < self._batch_size:
-                if self._last_batch == 'drop':
-                    # dropped rows: their pulls stay incomplete (sound)
-                    return
-                if self._last_batch == 'pad':
-                    host_batch = self._pad(host_batch, n)
-                # 'short': ship as-is
-            elif self._last_batch == 'pad':
-                host_batch[MASK_FIELD] = np.ones(n, dtype=bool)
+            if isinstance(host_batch, list):
+                # parts form (arena path only; see _retrieve_and_emit)
+                parts = [dict(p) for p in host_batch]
+                pulls = [p.pop(_PULL_FIELD, None) for p in parts]
+                if pulls[0] is None:
+                    pull_col = None
+                elif len(pulls) == 1:
+                    pull_col = pulls[0]   # common aligned case: no copy
+                else:
+                    pull_col = np.concatenate(pulls)
+                n = sum(len(next(iter(p.values()))) for p in parts)
+                host_batch = parts
+            else:
+                host_batch = dict(host_batch)
+                pull_col = host_batch.pop(_PULL_FIELD, None)
+                n = len(next(iter(host_batch.values())))
+            if n < self._batch_size and self._last_batch == 'drop':
+                # dropped rows: their pulls stay incomplete (sound)
+                return
             if pull_col is None:
                 pull_counts = None
             else:
                 ids, counts = np.unique(np.asarray(pull_col),
                                         return_counts=True)
                 pull_counts = dict(zip(ids.tolist(), counts.tolist()))
-        with span('h2d'):
-            device_batch = self._to_device(host_batch)
+            stager = self._stager
+            if stager is None:
+                # PETASTORM_TPU_STAGING=0: the pre-arena copy path (pad
+                # allocates, _to_device casts) — the reference behavior
+                # the arena's round-trip tests compare against
+                if n < self._batch_size:
+                    if self._last_batch == 'pad':
+                        host_batch = self._pad(host_batch, n)
+                    # 'short': ship as-is
+                elif self._last_batch == 'pad':
+                    host_batch[MASK_FIELD] = np.ones(n, dtype=bool)
+        if stager is None:
+            with span('h2d'):
+                device_batch = self._to_device(host_batch)
+        else:
+            # arena path: cast/pad/mask write into a preallocated slot and
+            # the transfer is dispatched async (stage_fill/h2d_dispatch/
+            # h2d_ready spans) — the consumer of batch N computes while
+            # batch N+1's transfer is in flight
+            device_batch = stager.stage(host_batch, n)
         # provenance rides the queue as a sidecar: rows count as delivered
         # only when the consumer actually receives this item in __next__
         self._put_blocking((device_batch, pull_counts))
@@ -838,32 +885,33 @@ class JaxLoader:
         return out
 
     def _to_device(self, host_batch):
-        import jax
+        """Pre-arena staging: validate + cast (allocating) + dispatch."""
         staged = {}
         for name, arr in host_batch.items():
             arr = np.asarray(arr)
-            if arr.dtype == object:
-                # shared classified diagnosis (ragged vs string vs null);
-                # the ragged message names pad_ragged/bucket_boundaries
-                from petastorm_tpu.ragged import reject_object_column
-                reject_object_column(name, arr)
-            if arr.dtype.kind in 'US':
-                # fixed-width numpy strings are not object dtype but are
-                # just as undevicable — same diagnosis, not jax's raw
-                # 'not a valid JAX array type'
-                from petastorm_tpu.ragged import STRING_MESSAGE
-                raise TypeError(STRING_MESSAGE % name)
+            # shared classified diagnosis (ragged vs string vs null); the
+            # ragged message names pad_ragged/bucket_boundaries, and
+            # fixed-width numpy strings get the same treatment instead of
+            # jax's raw 'not a valid JAX array type'
+            staging._check_deviceable(name, arr)
             want = self._dtypes.get(name)
             if want is not None:
                 arr = arr.astype(want)
             staged[name] = arr
+        return self._put_to_device(staged)
+
+    def _put_to_device(self, host_batch):
+        """Dispatch one host batch to the device(s) — the transfer leg the
+        staging arena and the legacy path share (validation and dtype
+        casting already happened upstream)."""
+        import jax
         if self._sharding is not None:
             return {name: jax.make_array_from_process_local_data(
                         self._sharding, arr)
-                    for name, arr in staged.items()}
+                    for name, arr in host_batch.items()}
         # one device_put of the whole pytree: a single dispatch covering
         # every field's transfer, instead of one runtime round trip each
-        return jax.device_put(staged)
+        return jax.device_put(host_batch)
 
     def _put_blocking(self, item):
         start = time.monotonic()
@@ -931,6 +979,7 @@ class JaxLoader:
         ``stage_backpressure_s`` means the training step is (keep prefetch
         small, the input side is not the problem)."""
         diag = dict(self._reader.diagnostics)
+        stager = self._stager
         diag.update({
             'stage_queue_depth': (self._out_queue.qsize()
                                   if self._out_queue is not None else 0),
@@ -939,6 +988,17 @@ class JaxLoader:
             'consumer_wait_s': round(self._consumer_wait_s, 3),
             'stage_backpressure_s': round(self._stage_blocked_s, 3),
             'pulls_in_flight': len(self._pull_info),
+            # staging arena (docs/telemetry.md "Host→device staging"):
+            # slot slabs only grow at startup / on a new bucket shape —
+            # steady growth here means the arena is not being reused.
+            # Between passes the stager of the FINISHED pass is not the
+            # truth about the knob, so report the knob itself then.
+            'staging_enabled': (stager is not None
+                                if self._stage_thread is not None
+                                and self._stage_thread.is_alive()
+                                else staging.staging_enabled()),
+            'staging_slots_allocated': (stager.slabs_allocated
+                                        if stager is not None else 0),
         })
         return diag
 

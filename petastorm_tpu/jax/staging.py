@@ -1,0 +1,413 @@
+"""Zero-copy staging arena + double-buffered async host→device transfer.
+
+The final hop of the JAX path used to be copy-heavy and single-buffered:
+``_pad``/``_to_device`` allocated fresh host arrays per batch
+(``np.asarray``/``astype``/``np.concatenate`` all copy — up to twice per
+batch when a spanning batch also casts) and the staging thread had no
+dispatch/recycle discipline, so the host buffers feeding a transfer could
+not be prepared ahead. This module replaces that path with a
+:class:`StagingEngine` that picks the cheapest correct strategy per batch
+and per backend:
+
+* **Direct dispatch** — a batch that needs NO assembly (one chunk view, no
+  dtype cast, no tail pad) skips every copy: the source views go straight
+  to ``put_fn`` (``jax.device_put`` or the sharded
+  ``make_array_from_process_local_data`` build), which transfers
+  asynchronously. The source chunk is never written again, so this is safe
+  on every backend. Under ``last_batch='pad'`` a full batch rides with one
+  shared immutable all-true ``valid_mask``.
+* **Arena ring** (accelerator backends) — per batch signature (field
+  shapes + target dtypes; one signature per bucket under
+  ``bucket_boundaries``), a ring of ``PETASTORM_TPU_STAGING_SLOTS``
+  (default 2, ≥2 so the filler never races the in-flight transfer)
+  preallocated host slots at ``(batch_size, *shape)`` in the TARGET dtype.
+  Collate/pad/cast write INTO the slot (``np.copyto`` with
+  cast-during-copy — one pass, no intermediate ``astype`` array), the
+  transfer dispatches asynchronously, and the slot is recycled only after
+  the transfer *previously dispatched from that slot* reports complete
+  (``block_until_ready`` on the PREVIOUS handoff, never the current one).
+  Steady state performs **zero per-batch host-buffer allocations**
+  (``tests/test_staging.py`` holds this with tracemalloc), so the staging
+  thread stops paying allocator+page-fault costs per batch while transfers
+  overlap consumption.
+* **Fresh assembly** (host-backed backends, e.g. XLA:CPU) — reusing slots
+  is counterproductive there: the runtime zero-copies suitably-aligned
+  host arrays into device handles (measured on this jaxlib: a 64-byte-
+  aligned array aliases; a fresh large numpy allocation is page-aligned),
+  so a reused slot would either alias (corrupting a held batch on
+  recycle) or force a second real copy. Instead each batch assembles into
+  fresh buffers — the ONE copy legacy paid — and the dispatch aliases
+  them for free; the buffers are never touched again, making aliasing
+  harmless by construction.
+
+The engine starts on the ring path and switches to fresh assembly as soon
+as the first dispatch reveals a host-backed (``'cpu'`` platform) target;
+unknown array types conservatively count as host-backed (fresh assembly is
+the always-correct strategy).
+
+Knobs (docs/env_knobs.md): ``PETASTORM_TPU_STAGING=0`` disables the engine
+(the loader falls back to the pre-arena copy path — also the reference
+behavior for exact-value comparison tests); ``PETASTORM_TPU_STAGING_SLOTS``
+sizes the ring. Both are read once and cached; :func:`refresh_staging`
+re-reads (the established knob discipline).
+
+Telemetry (docs/telemetry.md): per-batch ``stage_fill`` /
+``h2d_dispatch`` / ``h2d_ready`` spans (which double as trace events when
+``PETASTORM_TPU_TRACE=1``, so Perfetto dumps show fill/transfer/consume
+overlap) and the ``petastorm_tpu_h2d_bytes_total`` counter;
+``pipeline_report`` derives ``h2d_overlap_share`` from the three stages.
+"""
+
+import logging
+import os
+
+import numpy as np
+
+from petastorm_tpu.telemetry import (
+    get_registry, metrics_disabled, register_refresh, span,
+)
+from petastorm_tpu.telemetry.spans import DISABLED_VALUES
+
+logger = logging.getLogger(__name__)
+
+#: registry counter: bytes handed to the device transfer path
+H2D_BYTES = 'petastorm_tpu_h2d_bytes_total'
+
+#: name of the validity-mask column added under ``last_batch='pad'`` — the
+#: canonical definition (jax.loader re-exports it)
+MASK_FIELD = 'valid_mask'
+
+_MIN_SLOTS = 2
+
+# knob caches (refresh_staging() re-reads); None = not yet resolved
+_enabled = None
+_slots = None
+
+
+def staging_enabled():
+    """True unless ``PETASTORM_TPU_STAGING`` disables the arena."""
+    global _enabled
+    if _enabled is None:
+        raw = os.environ.get('PETASTORM_TPU_STAGING', '').strip().lower()
+        _enabled = raw not in DISABLED_VALUES
+    return _enabled
+
+
+def staging_slots():
+    """Ring size from ``PETASTORM_TPU_STAGING_SLOTS`` (default and floor:
+    2 — one slot filling while the other's transfer is in flight)."""
+    global _slots
+    if _slots is None:
+        raw = os.environ.get('PETASTORM_TPU_STAGING_SLOTS', '').strip()
+        slots = _MIN_SLOTS
+        if raw:
+            try:
+                slots = max(_MIN_SLOTS, int(raw))
+            except ValueError:
+                logger.warning('Unparseable PETASTORM_TPU_STAGING_SLOTS=%r; '
+                               'using %d', raw, _MIN_SLOTS)
+        _slots = slots
+    return _slots
+
+
+def refresh_staging():
+    """Re-read both staging knobs (tests, long-lived processes flipping
+    the env); the next :func:`make_stager` call sees the new values.
+    Also runs as part of ``petastorm_tpu.telemetry.refresh()``, the
+    process's one re-read-every-knob entry point."""
+    global _enabled, _slots
+    _enabled = None
+    _slots = None
+
+
+register_refresh(refresh_staging)
+
+
+def make_stager(batch_size, dtypes, last_batch, put_fn):
+    """A :class:`StagingEngine` for one staging pass, or None when
+    ``PETASTORM_TPU_STAGING=0`` tells the loader to use its pre-arena
+    copy path."""
+    if not staging_enabled():
+        return None
+    return StagingEngine(batch_size, dtypes, last_batch, put_fn,
+                         num_slots=staging_slots())
+
+
+def _is_host_backed(leaf):
+    """True when the dispatched array lives in host memory ('cpu'
+    platform) — where ``device_put`` can alias the source buffer and
+    fresh assembly beats slot reuse. Unknown array types count as
+    host-backed (fresh assembly is the always-correct strategy)."""
+    devices = getattr(leaf, 'devices', None)
+    if devices is None:
+        return True
+    try:
+        return all(getattr(d, 'platform', 'cpu') == 'cpu'
+                   for d in devices())
+    except Exception:  # noqa: BLE001 - duck-typed runtimes
+        return True
+
+
+def _check_deviceable(name, arr):
+    """The shared undevicable-column diagnosis (object → classified ragged
+    message, fixed-width strings → string message)."""
+    if arr.dtype == object:
+        from petastorm_tpu.ragged import reject_object_column
+        reject_object_column(name, arr)
+    if arr.dtype.kind in 'US':
+        from petastorm_tpu.ragged import STRING_MESSAGE
+        raise TypeError(STRING_MESSAGE % name)
+
+
+class _Slot:
+    """One ring slot: preallocated per-field host buffers plus the device
+    arrays of the transfer most recently dispatched from it."""
+
+    __slots__ = ('buffers', 'in_flight')
+
+    def __init__(self, buffers):
+        self.buffers = buffers      # {field: ndarray(batch_size, *shape)}
+        self.in_flight = None       # leaves of the last dispatch
+
+    def await_retired(self):
+        """Block until the transfer previously dispatched from this slot
+        completes — only then may the buffers be overwritten (an in-flight
+        ``device_put`` may still be reading them)."""
+        leaves = self.in_flight
+        if leaves is not None:
+            for leaf in leaves:
+                leaf.block_until_ready()
+            self.in_flight = None
+
+
+class _Ring:
+    """Round-robin ring of slots for one batch signature."""
+
+    __slots__ = ('slots', 'cursor')
+
+    def __init__(self, slots):
+        self.slots = slots
+        self.cursor = 0
+
+    def next_slot(self):
+        slot = self.slots[self.cursor]
+        self.cursor = (self.cursor + 1) % len(self.slots)
+        return slot
+
+
+class StagingEngine:
+    """Per-pass staging engine for :class:`JaxLoader`.
+
+    Single-threaded by contract: only the loader's staging thread calls
+    :meth:`stage`. ``put_fn(host_pytree) -> device_pytree`` is the
+    loader's dispatch (plain ``device_put`` or the sharded build).
+    """
+
+    def __init__(self, batch_size, dtypes, last_batch, put_fn, num_slots=2):
+        self._batch_size = batch_size
+        self._dtypes = dict(dtypes or {})
+        self._last_batch = last_batch
+        self._put_fn = put_fn
+        self._num_slots = max(_MIN_SLOTS, num_slots)
+        self._rings = {}            # signature -> _Ring (ring mode only)
+        # None until the first dispatch reveals the backend; True routes
+        # every assembled batch to fresh buffers (see module docstring)
+        self._host_backed = None
+        # shared immutable all-true mask for full batches on the direct
+        # path; allocated once on first use
+        self._full_mask = None
+        # test/diagnostic hooks: in ring mode, slot-slab allocations are
+        # startup-only (steady growth = the arena is not being reused)
+        self.slabs_allocated = 0
+        self.batches_staged = 0
+
+    # -- arena ---------------------------------------------------------------
+
+    def _target_dtype(self, name, arr):
+        want = self._dtypes.get(name)
+        return np.dtype(want) if want is not None else arr.dtype
+
+    def _resolve_dtypes(self, parts):
+        """Per-field assembly dtype: the ``dtypes=`` policy wins;
+        otherwise mixed-dtype parts PROMOTE exactly like the
+        ``np.concatenate`` the pre-arena path performed (an int32 chunk
+        followed by an int64 one must yield int64, never a wrapping
+        downcast into the first chunk's dtype)."""
+        resolved = {}
+        for name, arr in parts[0].items():
+            want = self._dtypes.get(name)
+            if want is not None:
+                resolved[name] = np.dtype(want)
+                continue
+            dtype = arr.dtype
+            if any(p[name].dtype != dtype for p in parts[1:]):
+                dtype = np.result_type(*[p[name].dtype for p in parts])
+            resolved[name] = dtype
+        return resolved
+
+    def _signature(self, columns, dtype_map, with_mask):
+        # leading (batch) dim excluded: a short tail reuses the full-size
+        # slots through [:n] views instead of allocating a one-off ring
+        return (with_mask,) + tuple(
+            (name, arr.shape[1:], dtype_map[name].str)
+            for name, arr in sorted(columns.items()))
+
+    def _new_buffers(self, columns, dtype_map, with_mask):
+        buffers = {
+            name: np.empty((self._batch_size,) + arr.shape[1:],
+                           dtype_map[name])
+            for name, arr in columns.items()}
+        if with_mask:
+            buffers[MASK_FIELD] = np.empty(self._batch_size, bool)
+        return buffers
+
+    def _ring_for(self, columns, dtype_map, with_mask):
+        sig = self._signature(columns, dtype_map, with_mask)
+        ring = self._rings.get(sig)
+        if ring is None:
+            slots = [_Slot(self._new_buffers(columns, dtype_map, with_mask))
+                     for _ in range(self._num_slots)]
+            self.slabs_allocated += len(slots)
+            ring = self._rings[sig] = _Ring(slots)
+        return ring
+
+    # -- staging -------------------------------------------------------------
+
+    def stage(self, columns, n_valid):
+        """Assemble + dispatch one batch; ``columns`` is one column dict
+        or a LIST of column-dict parts (chunk views from the noop
+        re-batcher, copied in sequentially so the concatenated
+        intermediate never exists). Returns the device batch WITHOUT
+        waiting for the transfer to complete."""
+        parts = columns if isinstance(columns, list) else [columns]
+        parts = [{name: np.asarray(arr) for name, arr in p.items()}
+                 for p in parts]
+        for p in parts:
+            for name, arr in p.items():
+                _check_deviceable(name, arr)
+        with_mask = self._last_batch == 'pad'
+        full = n_valid >= self._batch_size
+        if (len(parts) == 1 and (full or not with_mask)
+                and all(self._target_dtype(name, arr) == arr.dtype
+                        for name, arr in parts[0].items())):
+            # one ready chunk view, no cast, no pad: dispatch the source
+            # directly — it is never written again, so no copy is needed
+            # on any backend; the transfer is still async
+            return self._stage_direct(parts[0], with_mask)
+        dtype_map = self._resolve_dtypes(parts)
+        if self._host_backed:
+            return self._stage_fresh(parts, dtype_map, n_valid, with_mask)
+        return self._stage_ring(parts, dtype_map, n_valid, with_mask)
+
+    def _stage_direct(self, cols, with_mask):
+        """Zero-copy dispatch of a ready single-chunk batch (plus the
+        shared immutable all-true mask under ``last_batch='pad'``)."""
+        if with_mask:
+            if self._full_mask is None:
+                self._full_mask = np.ones(self._batch_size, bool)
+            cols = dict(cols)
+            cols[MASK_FIELD] = self._full_mask
+        with span('h2d_dispatch'):
+            device_batch = self._put_fn(cols)
+        self._account(cols.values())
+        self._learn_backend(device_batch)
+        return device_batch
+
+    def _stage_fresh(self, parts, dtype_map, n, with_mask):
+        """Host-backed backends: assemble into FRESH buffers (the one
+        copy the legacy path also paid) and let the runtime zero-copy
+        them into the device handle — never reused, so aliasing is
+        harmless by construction."""
+        with span('stage_fill'):
+            buffers = self._new_buffers(parts[0], dtype_map, with_mask)
+            views = self._fill(buffers, parts, n, with_mask)
+        with span('h2d_dispatch'):
+            device_batch = self._put_fn(views)
+        self._account(views.values())
+        return device_batch
+
+    def _stage_ring(self, parts, dtype_map, n, with_mask):
+        """Accelerator backends: fill a recycled arena slot (no per-batch
+        host allocation) and dispatch the async transfer."""
+        ring = self._ring_for(parts[0], dtype_map, with_mask)
+        slot = ring.next_slot()
+        with span('h2d_ready'):
+            # gate recycling on the slot's PREVIOUS handoff — with ≥2
+            # slots this is never the batch just returned to the consumer
+            slot.await_retired()
+        with span('stage_fill'):
+            views = self._fill(slot.buffers, parts, n, with_mask)
+        with span('h2d_dispatch'):
+            device_batch = self._put_fn(views)
+        self._account(views.values())
+        if self._learn_backend(device_batch):
+            # first dispatch revealed a host-backed target: the runtime
+            # may have aliased this slot into the returned arrays, so the
+            # ring (including this slot) is abandoned, never recycled —
+            # every later batch takes the fresh-assembly path
+            self._rings = {}
+        else:
+            slot.in_flight = list(device_batch.values())
+        return device_batch
+
+    def _fill(self, buffers, parts, n, with_mask):
+        """Cast/pad/mask-assemble ``parts`` into ``buffers``; returns the
+        dict to dispatch (``[:n]`` views for a maskless short tail)."""
+        full = n >= self._batch_size
+        for name in parts[0]:
+            dst = buffers[name]
+            offset = 0
+            for p in parts:
+                arr = p[name]
+                m = len(arr)
+                if arr.shape[1:] != dst.shape[1:]:
+                    # explicit, BEFORE the copy: np.copyto would happily
+                    # BROADCAST a narrower chunk into the slot — silent
+                    # corruption where the legacy np.concatenate raised
+                    raise ValueError(
+                        'staging: field %r chunk of shape %s does not '
+                        'fit the batch slot of shape %s; variable-shape '
+                        'fields need pad_ragged= or bucket_boundaries='
+                        % (name, arr.shape, dst.shape))
+                # cast-during-copy: the single copy this path performs
+                # (same 'unsafe' semantics as .astype())
+                np.copyto(dst[offset:offset + m], arr, casting='unsafe')
+                offset += m
+            if with_mask and not full:
+                dst[n:] = 0
+        if with_mask:
+            mask = buffers[MASK_FIELD]
+            mask[:n] = True
+            mask[n:] = False
+        if full or with_mask:
+            return buffers
+        return {name: buf[:n] for name, buf in buffers.items()}
+
+    def release(self):
+        """Pass end: drop the slot slabs and the in-flight device-array
+        references they hold — otherwise up to ``num_slots`` device
+        batches per signature (plus every host slab) stay pinned between
+        epochs. The engine object itself survives for the diagnostics
+        counters."""
+        self._rings = {}
+        self._full_mask = None
+
+    def _account(self, arrays):
+        self.batches_staged += 1
+        if not metrics_disabled():
+            get_registry().counter(H2D_BYTES).inc(
+                sum(arr.nbytes for arr in arrays))
+
+    def _learn_backend(self, device_batch):
+        """Resolve ``_host_backed`` from the first dispatched batch;
+        returns True exactly once, when a host-backed target is first
+        detected (the ring-mode caller must then retire its slots)."""
+        if self._host_backed is None:
+            self._host_backed = _is_host_backed(
+                next(iter(device_batch.values())))
+            if self._host_backed:
+                logger.debug('staging: host-backed target; using fresh '
+                             'assembly (zero-copy dispatch) over slot '
+                             'reuse')
+                return True
+        return False

@@ -2,11 +2,23 @@
 
 Parity with ``petastorm/reader_impl/pickle_serializer.py`` and
 ``arrow_table_serializer.py``: a serializer turns a worker result into bytes
-for the ZMQ hop and back. :class:`PickleSerializer` (protocol 5, out-of-band
-buffers capable) is the default — :class:`~petastorm_tpu.arrow_worker.ColumnBatch`
-payloads are dicts of numpy arrays, which pickle ships with a single memcpy.
+for the ZMQ hop and back. :class:`PickleSerializer` (protocol 5) is the
+default — :class:`~petastorm_tpu.arrow_worker.ColumnBatch` payloads are
+dicts of numpy arrays, which the **multipart frame API** ships with their
+ndarray payloads as pickle-5 *out-of-band buffers*, one ZMQ frame each:
+the pickle stream carries only metadata, serialization is a single memcpy
+per array into its frame, and receive-side deserialization is **zero-copy**
+(the reconstructed arrays view the received frames directly —
+``pickle.loads(..., buffers=frames)``; with ``recv_multipart(copy=False)``
+nothing is copied between the wire and the consumer's numpy arrays).
 :class:`ArrowTableSerializer` streams a ``pyarrow.Table`` as a RecordBatch
 stream for consumers that stay in Arrow.
+
+The single-payload ``serialize``/``deserialize`` pair remains the
+one-frame contract for channels that cannot carry multipart payloads (the
+service protocol's framed messages); ``serialize_frames`` /
+``deserialize_frames`` default to delegating to it, so custom serializers
+keep working unchanged on the multipart process-pool channel.
 """
 
 import pickle
@@ -24,6 +36,21 @@ class SerializerBase(metaclass=ABCMeta):
     def deserialize(self, payload):
         """bytes-like → value."""
 
+    def serialize_frames(self, value):
+        """value → non-empty list of bytes-likes, each shipped as its own
+        ZMQ frame. Default: one frame via :meth:`serialize`."""
+        return [self.serialize(value)]
+
+    def deserialize_frames(self, frames):
+        """Inverse of :meth:`serialize_frames`; ``frames`` may be
+        memoryviews over receive buffers (zero-copy receive)."""
+        if len(frames) != 1:
+            raise ValueError(
+                '%s expects a single payload frame; got %d (was the result '
+                'produced by a different serializer?)'
+                % (type(self).__name__, len(frames)))
+        return self.deserialize(frames[0])
+
 
 class PickleSerializer(SerializerBase):
     """Default payload codec (reference: ``pickle_serializer.py:17-23``)."""
@@ -33,6 +60,22 @@ class PickleSerializer(SerializerBase):
 
     def deserialize(self, payload):
         return pickle.loads(payload)
+
+    def serialize_frames(self, value):
+        """Pickle-5 out-of-band: frame 0 is the pickle stream (metadata +
+        small objects), every buffer-exporting payload (ndarrays, Arrow
+        buffers) follows as its own raw frame — no copy into the stream."""
+        buffers = []
+        head = pickle.dumps(value, protocol=5,
+                            buffer_callback=buffers.append)
+        return [head] + [b.raw() for b in buffers]
+
+    def deserialize_frames(self, frames):
+        """Zero-copy reconstruction: out-of-band arrays are rebuilt as
+        views over ``frames[1:]`` (read-only when the receive buffers
+        are). Decode paths never mutate result columns in place, so
+        read-only views are safe batch payloads."""
+        return pickle.loads(frames[0], buffers=frames[1:])
 
 
 class ArrowTableSerializer(SerializerBase):

@@ -92,13 +92,28 @@ def note_consumer_wait(seconds):
     get_attributor().note_consumer_wait(seconds)
 
 
+#: extra knob-refreshers registered by other subsystems (the jax staging
+#: arena) so ``refresh()`` stays the ONE entry point that re-reads every
+#: cached PETASTORM_TPU_* knob in the process
+_extra_refreshers = []
+
+
+def register_refresh(fn):
+    """Hook a subsystem's knob-refresh function into :func:`refresh`."""
+    if fn not in _extra_refreshers:
+        _extra_refreshers.append(fn)
+
+
 def refresh():
-    """Re-read EVERY telemetry knob — metrics enable, trace enable,
-    sampling stride, autodump state — so tests and long-lived processes
-    flip all of them through one entry point (the per-module
-    ``refresh_enabled``/``refresh_trace`` remain as the two halves)."""
+    """Re-read EVERY cached knob — metrics enable, trace enable, sampling
+    stride, autodump state, plus any registered subsystem knobs (the jax
+    staging arena's) — so tests and long-lived processes flip all of them
+    through one entry point (the per-module ``refresh_enabled``/
+    ``refresh_trace``/``refresh_staging`` remain as the halves)."""
     refresh_enabled()
     refresh_trace()
+    for fn in list(_extra_refreshers):
+        fn()
 
 
 def reset_for_tests():

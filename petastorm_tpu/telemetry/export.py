@@ -199,6 +199,9 @@ def pipeline_report(registry=None, wall_time_s=None, baseline=None,
             'windows': attributor.windows()[-20:],
         },
     }
+    overlap = _h2d_overlap_share(stages)
+    if overlap is not None:
+        report['h2d_overlap_share'] = overlap
     cache = _cache_section(registry)
     if cache is not None:
         report['cache'] = cache
@@ -206,6 +209,21 @@ def pipeline_report(registry=None, wall_time_s=None, baseline=None,
     if service is not None:
         report['service'] = service
     return report
+
+
+def _h2d_overlap_share(stages):
+    """Share of staging-engine time NOT spent blocked on an in-flight
+    transfer (``h2d_ready``): 1.0 means every dispatched transfer landed
+    while the consumer computed / the next slot filled — fully overlapped;
+    low values mean the link itself is the wall. Present only when the
+    arena ran (the stages exist)."""
+    fill = stages.get('stage_fill', {}).get('seconds', 0.0)
+    dispatch = stages.get('h2d_dispatch', {}).get('seconds', 0.0)
+    ready = stages.get('h2d_ready', {}).get('seconds', 0.0)
+    total = fill + dispatch + ready
+    if not total:
+        return None
+    return round(1.0 - ready / total, 4)
 
 
 def _cache_section(registry):
@@ -278,6 +296,10 @@ def format_pipeline_report(report):
         lines.append('  attributed %5.1f%% of %.3fs wall'
                      % (100 * (report['attributed_fraction'] or 0.0),
                         report['wall_time_s']))
+    if report.get('h2d_overlap_share') is not None:
+        lines.append('  h2d overlap %5.1f%% (share of staging-engine time '
+                     'not blocked on an in-flight transfer)'
+                     % (100 * report['h2d_overlap_share']))
     stall = report['stall']
     lines.append('stall attribution: %s (producer_wait %.3fs, '
                  'consumer_wait %.3fs over %d window(s))'

@@ -23,15 +23,22 @@ from petastorm_tpu.telemetry.registry import get_registry, on_registry_reset
 #: ``ventilate`` hand item to pool · ``io`` parquet row-group read ·
 #: ``decode`` codec decode · ``filter`` predicate/row-mask eval ·
 #: ``transform`` TransformSpec · ``queue_wait`` consumer blocked pulling ·
-#: ``collate`` re-batch/shuffle-buffer/pad · ``h2d`` host→device staging
+#: ``collate`` re-batch/shuffle-buffer/densify · ``h2d`` host→device
+#: staging (pre-arena path) · ``h2d_ready`` staging arena blocked until a
+#: slot's previous transfer completed · ``stage_fill`` cast/pad/mask copy
+#: into the arena slot · ``h2d_dispatch`` async transfer dispatch
 STAGES = ('ventilate', 'io', 'decode', 'filter', 'transform', 'queue_wait',
-          'collate', 'h2d')
+          'collate', 'h2d', 'h2d_ready', 'stage_fill', 'h2d_dispatch')
 
 STAGE_SECONDS = 'petastorm_tpu_stage_seconds_total'
 STAGE_CALLS = 'petastorm_tpu_stage_calls_total'
 STAGE_DURATION = 'petastorm_tpu_stage_duration_seconds'
 
-_DISABLED_VALUES = ('0', 'false', 'off', 'no')
+#: the one knob-truthiness rule for "disable" env values — shared by every
+#: PETASTORM_TPU_* kill switch (metrics here, the jax staging arena, ...)
+#: so the accepted spellings cannot drift between knobs
+DISABLED_VALUES = ('0', 'false', 'off', 'no')
+_DISABLED_VALUES = DISABLED_VALUES
 
 # resolved once (refresh_enabled() re-reads, for tests and long-lived
 # processes that flip the knob); None = not yet resolved

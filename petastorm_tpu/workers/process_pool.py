@@ -15,9 +15,12 @@ Re-design of ``petastorm/workers_pool/process_pool.py`` (protocol diagram at
 * Workers are spawned (never forked) via
   :func:`~petastorm_tpu.workers.exec_in_new_process.exec_in_new_process` and
   pinned to ``JAX_PLATFORMS=cpu`` so they can never grab the trainer's TPU.
-* Results ride a pluggable :mod:`~petastorm_tpu.serializers` codec
-  (pickle-5 default); back-pressure = ZMQ high-water marks sized from
-  ``results_queue_size``.
+* Results ride a pluggable :mod:`~petastorm_tpu.serializers` codec via its
+  multipart frame API — the default :class:`PickleSerializer` ships every
+  ndarray payload as its own pickle-5 out-of-band ZMQ frame, and the
+  consumer receives with ``copy=False`` so deserialization is zero-copy
+  (arrays view the wire buffers); back-pressure = ZMQ high-water marks
+  sized from ``results_queue_size``.
 * Same failure model as the reference: worker exceptions are serialized onto
   the results channel and re-raised in the consumer; an orphan-monitor thread
   in each worker exits when the main process dies (``process_pool.py:320-327``);
@@ -184,8 +187,12 @@ class ProcessPool:
                         'Pool worker process(es) died unexpectedly: %s'
                         % self._dead_workers())
                 continue
-            frames = self._results_socket.recv_multipart()
-            kind = frames[0]
+            # copy=False: frames stay in ZMQ's receive buffers, exposed as
+            # zero-copy memoryviews — what lets the pickle-5 out-of-band
+            # result path rebuild ndarrays as views over the wire buffers
+            # (no host copy between the socket and the consumer's arrays)
+            frames = self._results_socket.recv_multipart(copy=False)
+            kind = frames[0].bytes
             if kind == _MSG_MARKER:
                 self._processed_items += 1
                 if self._ventilator is not None:
@@ -194,15 +201,16 @@ class ProcessPool:
                 # transform spans, cache counters, producer-wait clock):
                 # fold it into THIS process's registry + stall attributor
                 if len(frames) > 1:
-                    merge_worker_delta(load_delta_frame(frames[1]))
+                    merge_worker_delta(load_delta_frame(frames[1].bytes))
                 continue
             if kind == _MSG_ERROR:
-                self._error = dill.loads(frames[1])
+                self._error = dill.loads(frames[1].bytes)
                 self.stop()
                 self.join()
                 raise self._error
             if kind == _MSG_RESULT:
-                return self._serializer.deserialize(frames[1])
+                return self._serializer.deserialize_frames(
+                    [f.buffer for f in frames[1:]])
             if kind in (_MSG_READY, _MSG_EXIT):
                 continue
             logger.warning('Unknown pool message type %r', kind)
@@ -327,7 +335,10 @@ def _worker_bootstrap(worker_id, main_pid, work_ep, control_ep, results_ep,
                 note_producer_wait(blocked)
 
     def publish(value):
-        send_or_stop([_MSG_RESULT, serializer.serialize(value)])
+        # multipart result: frame 0 the pickle-5 stream, every ndarray
+        # payload its own out-of-band frame (serializers.py) — ZMQ sends
+        # straight from the exported buffers, one memcpy per array
+        send_or_stop([_MSG_RESULT] + list(serializer.serialize_frames(value)))
 
     worker = worker_class(worker_id, publish, worker_args)
     worker.initialize()

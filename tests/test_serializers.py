@@ -58,3 +58,64 @@ def test_arrow_roundtrip_preserves_chunking_content():
     table = pa.table({'x': chunked})
     out = s.deserialize(s.serialize(table))
     assert out.column('x').to_pylist() == [1, 2, 3, 4, 5]
+
+
+# -- pickle-5 out-of-band multipart frames (the process-pool wire) -----------
+
+
+def test_pickle_frames_ship_ndarrays_out_of_band():
+    s = PickleSerializer()
+    batch = ColumnBatch({'a': np.arange(1000),
+                         'b': np.ones((50, 64), np.float32)}, 1000)
+    frames = s.serialize_frames(batch)
+    # frame 0 = pickle stream (metadata only), one raw frame per ndarray
+    assert len(frames) == 3
+    payload_bytes = {f.nbytes for f in map(memoryview, frames[1:])}
+    assert payload_bytes == {batch.columns['a'].nbytes,
+                             batch.columns['b'].nbytes}
+    assert len(frames[0]) < 1000  # arrays did NOT land in the stream
+    out = s.deserialize_frames(frames)
+    np.testing.assert_array_equal(out.columns['a'], batch.columns['a'])
+    np.testing.assert_array_equal(out.columns['b'], batch.columns['b'])
+
+
+def test_pickle_frames_receive_side_is_zero_copy():
+    """Deserializing from received buffers must reconstruct arrays as
+    VIEWS over those buffers (what recv_multipart(copy=False) + pickle-5
+    out-of-band buys): no host copy between the wire and the consumer."""
+    s = PickleSerializer()
+    batch = ColumnBatch({'big': np.random.RandomState(0)
+                                  .rand(100, 32).astype(np.float32)}, 100)
+    # simulate the wire: frames arrive as distinct (read-only) buffers
+    wire = [bytes(memoryview(f)) for f in s.serialize_frames(batch)]
+    received = [memoryview(f) for f in wire]
+    out = s.deserialize_frames(received)
+    np.testing.assert_array_equal(out.columns['big'], batch.columns['big'])
+    assert any(np.shares_memory(out.columns['big'],
+                                np.frombuffer(f, np.uint8))
+               for f in wire[1:]), 'deserialized array copied off the wire'
+
+
+def test_pickle_frames_roundtrip_mixed_and_object_columns():
+    # object (ragged) columns cannot go out-of-band; they ride the stream
+    # while the dense columns still split out — both must round-trip
+    s = PickleSerializer()
+    ragged = np.empty(2, dtype=object)
+    ragged[0] = np.arange(4)
+    ragged[1] = None
+    batch = ColumnBatch({'r': ragged, 'd': np.arange(64.0)}, 2)
+    out = s.deserialize_frames(s.serialize_frames(batch))
+    np.testing.assert_array_equal(out.columns['r'][0], np.arange(4))
+    assert out.columns['r'][1] is None
+    np.testing.assert_array_equal(out.columns['d'], np.arange(64.0))
+
+
+def test_default_frames_api_wraps_single_payload():
+    s = ArrowTableSerializer()
+    table = pa.table({'x': pa.array([1, 2, 3], pa.int64())})
+    frames = s.serialize_frames(table)
+    assert len(frames) == 1
+    assert s.deserialize_frames(frames).equals(table)
+    import pytest
+    with pytest.raises(ValueError, match='single payload frame'):
+        s.deserialize_frames([b'x', b'y'])
