@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: test test-fast lint typecheck bench dryrun docker clean
+.PHONY: test test-fast analyze lint typecheck bench dryrun docker clean
 
 # full suite (~10 min: includes the compile-heavy model/attention tests)
 test:
@@ -13,7 +13,12 @@ test:
 test-fast:
 	$(PYTHON) -m pytest tests/ -q -m "not slow"
 
-lint:
+# pipecheck: AST-level contract & concurrency analyzer (docs/development.md);
+# stdlib-only, so it runs on the bare TPU image where flake8/mypy don't
+analyze:
+	$(PYTHON) -m petastorm_tpu.analysis petastorm_tpu
+
+lint: analyze
 	$(PYTHON) -m flake8 petastorm_tpu tests examples
 
 typecheck:

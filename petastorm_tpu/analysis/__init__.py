@@ -1,0 +1,56 @@
+"""pipecheck: AST-level contract & concurrency analyzer for the pipeline.
+
+The last several PRs grew a concurrency-heavy surface — thread/process/
+service pools, a ZMQ dispatcher, a slot-ring staging engine — whose
+correctness rests on contracts no runtime test sees on every path:
+canonical stage/metric/event names, ``PETASTORM_TPU_*`` knobs going
+through one registry, threads that are daemonized or joined, no blocking
+calls under a lock, pickle-safe payloads across process boundaries. This
+package verifies those contracts statically, on every commit::
+
+    python -m petastorm_tpu.analysis petastorm_tpu   # CI gate: exit 0
+    make analyze                                     # same, via make
+
+Library API::
+
+    from petastorm_tpu.analysis import analyze_paths, analyze_source
+    findings = analyze_paths(['petastorm_tpu'])      # [] on a clean tree
+
+Five composable passes (six rules) — see
+:data:`~petastorm_tpu.analysis.core.RULE_DESCRIPTIONS` and the rule
+reference table in docs/development.md. Findings are structured
+``(path, line, rule, message)``; a ``# pipecheck: disable=<rule>``
+comment on the offending line suppresses a finding (use sparingly, with
+a justification comment). The canonical name sets live in
+:mod:`~petastorm_tpu.analysis.contracts`, imported by the telemetry
+subsystem at runtime and by this checker statically — one source of
+truth, enforced from both sides.
+
+Stdlib-only by design: the analyzer must run on a bare TPU image (no
+flake8/mypy there), inside ``tests/test_analysis.py`` in tier-1, and in
+CI, all from the same code.
+"""
+
+from petastorm_tpu.analysis import contracts  # noqa: F401
+
+#: public API, resolved lazily (PEP 562): telemetry imports
+#: ``analysis.contracts`` on every production import path (knob registry,
+#: stage/event sets), and that must load ONLY the contracts data — never
+#: drag the whole ast/tokenize analyzer into reader/worker/service
+#: processes that will never run it.
+_CORE_API = ('ALL_RULES', 'PASSES', 'RULE_DESCRIPTIONS', 'analyze_paths',
+             'analyze_source', 'iter_python_files', 'run_passes')
+_FINDINGS_API = ('Finding', 'SourceModule')
+
+__all__ = ('contracts',) + _CORE_API + _FINDINGS_API
+
+
+def __getattr__(name):
+    if name in _CORE_API:
+        from petastorm_tpu.analysis import core
+        return getattr(core, name)
+    if name in _FINDINGS_API:
+        from petastorm_tpu.analysis import findings
+        return getattr(findings, name)
+    raise AttributeError('module %r has no attribute %r'
+                         % (__name__, name))

@@ -1,0 +1,70 @@
+"""CLI: ``python -m petastorm_tpu.analysis [paths...]``.
+
+Exit status 0 when no findings, 1 when findings, 2 on usage errors —
+the CI gate shape (``make analyze``). ``--json`` emits one finding per
+line for tooling; ``--select`` narrows to specific rules;
+``--list-rules`` prints the rule reference.
+"""
+
+import argparse
+import json
+import sys
+
+from petastorm_tpu.analysis.core import (
+    ALL_RULES, RULE_DESCRIPTIONS, analyze_paths,
+)
+
+
+def build_parser():
+    parser = argparse.ArgumentParser(
+        prog='python -m petastorm_tpu.analysis',
+        description='pipecheck: AST-level contract & concurrency analyzer')
+    parser.add_argument('paths', nargs='*', default=['petastorm_tpu'],
+                        help='files or directories to analyze '
+                             '(default: petastorm_tpu)')
+    parser.add_argument('--select', default=None, metavar='RULE[,RULE...]',
+                        help='run only these rules (see --list-rules)')
+    parser.add_argument('--json', action='store_true',
+                        help='one JSON finding per line instead of text')
+    parser.add_argument('--no-docs-check', action='store_true',
+                        help='skip the project-level knob-docs coverage '
+                             'check')
+    parser.add_argument('--list-rules', action='store_true',
+                        help='print the rule reference and exit')
+    return parser
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for rule in ALL_RULES:
+            print('%-20s %s' % (rule, RULE_DESCRIPTIONS.get(rule, '')))
+        return 0
+    select = None
+    if args.select:
+        select = {r.strip() for r in args.select.split(',') if r.strip()}
+        unknown = select - set(ALL_RULES)
+        if unknown:
+            print('unknown rule(s): %s (try --list-rules)'
+                  % ', '.join(sorted(unknown)), file=sys.stderr)
+            return 2
+    try:
+        findings = analyze_paths(args.paths, select=select,
+                                 check_docs=not args.no_docs_check)
+    except FileNotFoundError as e:
+        # a gate that scanned nothing must not read as a clean pass
+        print('error: %s' % e, file=sys.stderr)
+        return 2
+    for finding in findings:
+        if args.json:
+            print(json.dumps(finding.as_dict(), sort_keys=True))
+        else:
+            print(finding)
+    if findings:
+        print('%d finding(s)' % len(findings), file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

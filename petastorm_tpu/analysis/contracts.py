@@ -1,0 +1,99 @@
+"""Canonical name sets: the pipeline's naming contracts in ONE place.
+
+Every name contract the concurrency-heavy surface relies on lives here —
+imported at runtime by the telemetry subsystem (stage spans, trace events,
+the env-knob registry) AND statically by the :mod:`petastorm_tpu.analysis`
+checker plus ``tests/test_hygiene.py``. Before this module existed the
+same literals were duplicated between ``telemetry/spans.py``,
+``telemetry/tracing.py`` and the hygiene test, where they could (and did)
+drift silently; now a typo'd stage name, an unregistered knob or an
+undocumented metric is a static-analysis finding, not a runtime mystery.
+
+Dependency-free and import-light by design: this module imports nothing,
+so the checker can read the contracts without dragging in numpy/pyarrow,
+and ``telemetry`` can import it without cycles (nothing here imports
+telemetry back).
+"""
+
+#: canonical pipeline stages, ventilator → device (docs/telemetry.md):
+#: ``ventilate`` hand item to pool · ``io`` parquet row-group read ·
+#: ``decode`` codec decode · ``filter`` predicate/row-mask eval ·
+#: ``transform`` TransformSpec · ``queue_wait`` consumer blocked pulling ·
+#: ``collate`` re-batch/shuffle-buffer/densify · ``h2d`` host→device
+#: staging (pre-arena path) · ``h2d_ready`` staging arena blocked until a
+#: slot's previous transfer completed · ``stage_fill`` cast/pad/mask copy
+#: into the arena slot · ``h2d_dispatch`` async transfer dispatch
+STAGES = ('ventilate', 'io', 'decode', 'filter', 'transform', 'queue_wait',
+          'collate', 'h2d', 'h2d_ready', 'stage_fill', 'h2d_dispatch')
+
+#: every trace-event name the package records outside the canonical stage
+#: spans (docs/telemetry.md, tracing section)
+EVENT_NAMES = frozenset([
+    'attempt',          # one worker-side processing of one item (X event)
+    'ventilate',        # recorded via the ventilator's stage span
+    'dispatch',         # dispatcher assigned the item to a worker (instant)
+    'reventilate',      # heartbeat lapse sent the item back to pending
+    'done',             # the item's single delivered completion
+    'duplicate_done',   # a raced second completion, deduped (dropped)
+])
+
+#: every metric series name the package exports — the registry namespace
+#: (``petastorm_tpu_*``). Dashboards are built from docs/telemetry.md's
+#: metric reference; the hygiene test holds that table and this set equal,
+#: and the canonical-name analysis pass holds every
+#: ``registry.counter/gauge/histogram`` call in the package to this set.
+METRIC_NAMES = frozenset([
+    # stage spans (telemetry/spans.py)
+    'petastorm_tpu_stage_seconds_total',
+    'petastorm_tpu_stage_calls_total',
+    'petastorm_tpu_stage_duration_seconds',
+    # stall wait clocks (telemetry/__init__.py)
+    'petastorm_tpu_stall_producer_wait_seconds_total',
+    'petastorm_tpu_stall_consumer_wait_seconds_total',
+    # staging arena (jax/staging.py)
+    'petastorm_tpu_h2d_bytes_total',
+    # row-group cache (cache.py)
+    'petastorm_tpu_cache_hits_total',
+    'petastorm_tpu_cache_misses_total',
+    'petastorm_tpu_cache_evictions_total',
+    'petastorm_tpu_cache_bytes_written_total',
+    'petastorm_tpu_cache_bytes_evicted_total',
+    'petastorm_tpu_cache_size_bytes',
+    # disaggregated-service fleet health (service/dispatcher.py)
+    'petastorm_tpu_service_reventilated_total',
+    'petastorm_tpu_service_duplicate_done_total',
+    'petastorm_tpu_service_workers_alive',
+    'petastorm_tpu_service_workers_registered',
+    'petastorm_tpu_service_items_pending',
+    'petastorm_tpu_service_items_assigned',
+])
+
+#: prefix of every operator-facing environment knob
+KNOB_PREFIX = 'PETASTORM_TPU_'
+
+#: every registered environment knob. A ``PETASTORM_TPU_*`` read anywhere
+#: but ``telemetry/knobs.py`` — or of a name missing here, or of a name
+#: without a row in docs/env_knobs.md — is an ``env-knob`` analysis
+#: finding; :mod:`petastorm_tpu.telemetry.knobs` additionally enforces the
+#: set at runtime (reading an unregistered knob raises).
+KNOWN_KNOBS = frozenset([
+    'PETASTORM_TPU_NATIVE',
+    'PETASTORM_TPU_JPEG_FANCY',
+    'PETASTORM_TPU_JPEG_DCT',            # parsed by native/jpeg_batch.c
+    'PETASTORM_TPU_IMAGE_DECODER_THREADS',
+    'PETASTORM_TPU_SERVICE_DISPATCHER',
+    'PETASTORM_TPU_SERVICE_WORKERS',
+    'PETASTORM_TPU_METRICS',
+    'PETASTORM_TPU_METRICS_WINDOW_S',
+    'PETASTORM_TPU_TRACE',
+    'PETASTORM_TPU_TRACE_SAMPLE',
+    'PETASTORM_TPU_TRACE_DUMP',
+    'PETASTORM_TPU_TRACE_AUTODUMP_WINDOWS',
+    'PETASTORM_TPU_STAGING',
+    'PETASTORM_TPU_STAGING_SLOTS',
+])
+
+#: the one knob-truthiness rule for "disable"/"enable" env spellings —
+#: shared by every PETASTORM_TPU_* switch so spellings cannot drift
+DISABLED_VALUES = ('0', 'false', 'off', 'no')
+ENABLED_VALUES = ('1', 'true', 'on', 'yes')

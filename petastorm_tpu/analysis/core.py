@@ -1,0 +1,149 @@
+"""pipecheck runner: load sources, run every pass, collect findings.
+
+Library API (``tests/test_analysis.py`` and the CLI both sit on it):
+
+* :func:`analyze_paths` — files/directories → sorted Finding list.
+* :func:`analyze_source` — one in-memory snippet (fixture tests).
+* :data:`ALL_RULES` / :data:`PASSES` — the registry.
+
+The project-level half of the ``env-knob`` rule (docs coverage) runs
+once per :func:`analyze_paths` call when a ``docs/env_knobs.md`` is
+discoverable above the first analyzed path.
+"""
+
+import os
+
+from petastorm_tpu.analysis import (
+    pass_env_knobs, pass_locks, pass_names, pass_payloads, pass_threads,
+)
+from petastorm_tpu.analysis.findings import SourceModule
+
+#: the composable passes, in report order
+PASSES = (pass_env_knobs, pass_names, pass_locks, pass_threads,
+          pass_payloads)
+
+#: every rule id a pass can emit (suppression tokens)
+ALL_RULES = tuple(rule for p in PASSES for rule in p.RULES)
+
+#: rule id -> one-line description (the CLI's --list-rules table and the
+#: docs/development.md reference are both rendered from this)
+RULE_DESCRIPTIONS = {
+    'env-knob':
+        'PETASTORM_TPU_* reads go through telemetry.knobs; knobs are '
+        'registered in contracts.KNOWN_KNOBS and documented in '
+        'docs/env_knobs.md',
+    'canonical-name':
+        'span()/trace-event/metric name literals are members of the '
+        'canonical sets in analysis/contracts.py',
+    'blocking-under-lock':
+        'no indefinitely-blocking call (queue get/put sans timeout, ZMQ '
+        'sans NOBLOCK, join()/wait() sans timeout, block_until_ready, '
+        'subprocess, sleep) while lexically holding a lock',
+    'lock-order':
+        'two locks never nest in opposite orders within one module',
+    'thread-lifecycle':
+        'every threading.Thread is daemon=True or join()ed from a '
+        'teardown path',
+    'pickle-payload':
+        'no lambdas / locally-defined functions or classes handed to '
+        'process-boundary calls (ventilate, dill/pickle dumps, '
+        'exec_in_new_process, send_pyobj)',
+}
+
+
+def iter_python_files(paths):
+    """Every ``.py`` file under the given files/directories, sorted,
+    deduplicated; ``__pycache__`` skipped."""
+    seen = set()
+    for path in paths:
+        if os.path.isdir(path):
+            for dirpath, dirnames, filenames in os.walk(path):
+                dirnames[:] = [d for d in sorted(dirnames)
+                               if d != '__pycache__']
+                for filename in sorted(filenames):
+                    if filename.endswith('.py'):
+                        full = os.path.join(dirpath, filename)
+                        if full not in seen:
+                            seen.add(full)
+                            yield full
+        elif path.endswith('.py'):
+            if path not in seen:
+                seen.add(path)
+                yield path
+
+
+def _find_docs(start):
+    """Walk up from ``start`` towards the filesystem root looking for
+    ``docs/env_knobs.md`` (the repo checkout shape); None when not found
+    (analyzing an installed copy: the per-file rules still run)."""
+    current = os.path.abspath(start if os.path.isdir(start)
+                              else os.path.dirname(start))
+    for _ in range(10):
+        candidate = os.path.join(current, 'docs', 'env_knobs.md')
+        if os.path.isfile(candidate):
+            return candidate
+        parent = os.path.dirname(current)
+        if parent == current:
+            break
+        current = parent
+    return None
+
+
+def run_passes(module, select=None):
+    """All (selected) passes over one :class:`SourceModule`."""
+    findings = []
+    for p in PASSES:
+        if select is not None and not (set(p.RULES) & select):
+            continue
+        found = p.run(module)
+        if select is not None:
+            found = [f for f in found if f.rule in select]
+        findings.extend(found)
+    return findings
+
+
+def analyze_source(source, path='<string>', select=None):
+    """Analyze one in-memory snippet (fixture tests drive rules here)."""
+    select = set(select) if select else None
+    module = SourceModule(path, source=source)
+    return sorted(run_passes(module, select), key=lambda f: f.sort_key())
+
+
+def analyze_paths(paths, select=None, root=None, check_docs=True):
+    """Analyze files/directories; returns sorted findings.
+
+    ``root`` anchors the relative paths in findings (default: cwd).
+    ``check_docs`` adds the project-level knob-docs coverage check when a
+    ``docs/env_knobs.md`` is discoverable.
+    """
+    select = set(select) if select else None
+    root = root or os.getcwd()
+    # A gate that silently scans nothing is worse than no gate: a wrong
+    # cwd or a renamed package must fail loudly, not exit 0.
+    for path in paths:
+        if not os.path.exists(path):
+            raise FileNotFoundError('analysis path does not exist: %r'
+                                    % (path,))
+    findings = []
+    any_path = None
+    for path in iter_python_files(paths):
+        any_path = any_path or path
+        try:
+            rel = os.path.relpath(path, root)
+        except ValueError:  # different drive (windows)
+            rel = path
+        module = SourceModule(path, relpath=rel)
+        findings.extend(run_passes(module, select))
+    if any_path is None:
+        raise FileNotFoundError('no Python files found under: %s'
+                                % ', '.join(map(repr, paths)))
+    if check_docs and any_path is not None \
+            and (select is None or 'env-knob' in select):
+        docs = _find_docs(any_path)
+        if docs is not None:
+            try:
+                rel = os.path.relpath(docs, root)
+            except ValueError:
+                rel = docs
+            findings.extend(pass_env_knobs.check_docs_coverage(docs, rel))
+    return sorted(findings, key=lambda f: f.sort_key())

@@ -1,0 +1,159 @@
+"""Finding records, parsed source modules, and suppression comments.
+
+The pieces every pass shares: a :class:`Finding` is one structured
+``(path, line, rule, message)`` result; a :class:`SourceModule` is one
+parsed file (source text, AST, and its ``# pipecheck: disable=...``
+comment map). Stdlib only — the analyzer must run on a bare TPU image.
+"""
+
+import ast
+import io
+import re
+import tokenize
+
+#: suppression comment syntax: ``# pipecheck: disable=rule[,rule...]``
+#: on any line the finding's node spans (``all`` silences every rule).
+_SUPPRESS_RE = re.compile(r'pipecheck:\s*disable=([A-Za-z0-9_,\- ]+)')
+
+
+class Finding:
+    """One structured analyzer result."""
+
+    __slots__ = ('path', 'line', 'rule', 'message')
+
+    def __init__(self, path, line, rule, message):
+        self.path = path
+        self.line = line
+        self.rule = rule
+        self.message = message
+
+    def __str__(self):
+        return '%s:%d: [%s] %s' % (self.path, self.line, self.rule,
+                                   self.message)
+
+    def __repr__(self):
+        return 'Finding(%r, %r, %r, %r)' % (self.path, self.line,
+                                            self.rule, self.message)
+
+    def as_dict(self):
+        return {'path': self.path, 'line': self.line, 'rule': self.rule,
+                'message': self.message}
+
+    def sort_key(self):
+        return (self.path, self.line, self.rule, self.message)
+
+
+def parse_suppressions(source):
+    """``{line: set(rule_ids)}`` of every ``pipecheck: disable=`` comment
+    (comments only — a disable token inside a string literal is inert)."""
+    out = {}
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            match = _SUPPRESS_RE.search(tok.string)
+            if match is None:
+                continue
+            rules = {r.strip() for r in match.group(1).split(',') if r.strip()}
+            out.setdefault(tok.start[0], set()).update(rules)
+    except tokenize.TokenError:
+        pass  # findings still apply; only suppressions are best-effort
+    return out
+
+
+class SourceModule:
+    """One parsed Python file handed to every pass."""
+
+    def __init__(self, path, source=None, relpath=None):
+        if source is None:
+            with tokenize.open(path) as f:  # honors coding declarations
+                source = f.read()
+        self.path = path
+        self.relpath = relpath or path
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        self.suppressions = parse_suppressions(source)
+
+    def suppressed(self, rule, node_or_line):
+        """True when a ``disable=`` comment for ``rule`` (or ``all``) sits
+        on any line the node spans."""
+        if isinstance(node_or_line, int):
+            lines = (node_or_line,)
+        else:
+            start = getattr(node_or_line, 'lineno', 0)
+            end = getattr(node_or_line, 'end_lineno', start) or start
+            lines = range(start, end + 1)
+        for line in lines:
+            rules = self.suppressions.get(line)
+            if rules and (rule in rules or 'all' in rules):
+                return True
+        return False
+
+    def finding(self, rule, node_or_line, message):
+        """A :class:`Finding` anchored at the node, or None when a
+        suppression comment covers it."""
+        if self.suppressed(rule, node_or_line):
+            return None
+        line = (node_or_line if isinstance(node_or_line, int)
+                else getattr(node_or_line, 'lineno', 0))
+        return Finding(self.relpath, line, rule, message)
+
+
+def call_name(node):
+    """Terminal callable name of a Call node ('get' for ``q.get(...)``,
+    'span' for ``span(...)``); None for exotic callees."""
+    func = node.func
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def dotted_text(expr):
+    """Source-ish dotted name of a Name/Attribute chain ('self._lock');
+    None for anything else (calls, subscripts)."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        base = dotted_text(expr.value)
+        return None if base is None else '%s.%s' % (base, expr.attr)
+    return None
+
+
+def literal_str(node):
+    """The str value of a Constant node, else None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def module_constants(tree):
+    """``{NAME: 'literal'}`` for module-level string-constant assigns —
+    how passes resolve ``registry.counter(SERVICE_REVENTILATED)`` back to
+    the literal the constant holds."""
+    consts = {}
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name):
+            value = literal_str(stmt.value)
+            if value is not None:
+                consts[stmt.targets[0].id] = value
+        elif isinstance(stmt, ast.AnnAssign) \
+                and isinstance(stmt.target, ast.Name):
+            value = literal_str(stmt.value) if stmt.value else None
+            if value is not None:
+                consts[stmt.target.id] = value
+    return consts
+
+
+def resolve_str(node, consts):
+    """Literal string of ``node``: a Constant directly, or a module-level
+    constant Name; None when not statically resolvable."""
+    value = literal_str(node)
+    if value is not None:
+        return value
+    if isinstance(node, ast.Name):
+        return consts.get(node.id)
+    return None

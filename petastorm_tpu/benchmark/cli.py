@@ -121,9 +121,8 @@ def main(argv=None):
             parser.error('--trace-out applies to read measurements only, '
                          'not --write')
         # the knob must be live before any reader/ventilator exists
-        import os
-        os.environ['PETASTORM_TPU_TRACE'] = '1'
         from petastorm_tpu import telemetry
+        telemetry.knobs.set_env('PETASTORM_TPU_TRACE', '1')
         telemetry.refresh()
     if args.write:
         if args.dataset_url is None:

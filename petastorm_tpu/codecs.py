@@ -31,6 +31,7 @@ from io import BytesIO
 import numpy as np
 import pyarrow as pa
 
+from petastorm_tpu.telemetry import knobs
 from petastorm_tpu.unischema import numpy_to_arrow_type
 
 logger = logging.getLogger(__name__)
@@ -91,7 +92,7 @@ def _jpeg_upsampling_mode(decode_fn, cells, image_shape):
     — both modes are faithful decodes of the same bytes.
     """
     global _JPEG_FANCY_MODE, _JPEG_FANCY_ATTEMPTS
-    if os.environ.get('PETASTORM_TPU_JPEG_FANCY'):
+    if knobs.raw('PETASTORM_TPU_JPEG_FANCY'):
         return -1
     if _JPEG_FANCY_MODE is not None:
         return _JPEG_FANCY_MODE
@@ -180,7 +181,7 @@ def _image_decode_pool():
             if _IMAGE_POOL is _IMAGE_POOL_DISABLED:
                 return None
             if _IMAGE_POOL is None:
-                raw = os.environ.get('PETASTORM_TPU_IMAGE_DECODER_THREADS')
+                raw = knobs.raw('PETASTORM_TPU_IMAGE_DECODER_THREADS')
                 try:
                     workers = (int(raw) if raw is not None
                                else min(4, os.cpu_count() or 1))

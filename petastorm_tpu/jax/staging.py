@@ -59,14 +59,12 @@ overlap) and the ``petastorm_tpu_h2d_bytes_total`` counter;
 """
 
 import logging
-import os
 
 import numpy as np
 
 from petastorm_tpu.telemetry import (
-    get_registry, metrics_disabled, register_refresh, span,
+    get_registry, knobs, metrics_disabled, register_refresh, span,
 )
-from petastorm_tpu.telemetry.spans import DISABLED_VALUES
 
 logger = logging.getLogger(__name__)
 
@@ -88,8 +86,7 @@ def staging_enabled():
     """True unless ``PETASTORM_TPU_STAGING`` disables the arena."""
     global _enabled
     if _enabled is None:
-        raw = os.environ.get('PETASTORM_TPU_STAGING', '').strip().lower()
-        _enabled = raw not in DISABLED_VALUES
+        _enabled = not knobs.is_disabled('PETASTORM_TPU_STAGING')
     return _enabled
 
 
@@ -98,15 +95,8 @@ def staging_slots():
     2 — one slot filling while the other's transfer is in flight)."""
     global _slots
     if _slots is None:
-        raw = os.environ.get('PETASTORM_TPU_STAGING_SLOTS', '').strip()
-        slots = _MIN_SLOTS
-        if raw:
-            try:
-                slots = max(_MIN_SLOTS, int(raw))
-            except ValueError:
-                logger.warning('Unparseable PETASTORM_TPU_STAGING_SLOTS=%r; '
-                               'using %d', raw, _MIN_SLOTS)
-        _slots = slots
+        _slots = knobs.get_int('PETASTORM_TPU_STAGING_SLOTS', _MIN_SLOTS,
+                               floor=_MIN_SLOTS)
     return _slots
 
 

@@ -167,13 +167,14 @@ def _make_pool(reader_pool_type, workers_count, results_queue_size):
         # fleet of workers_count servers is spawned (same shape as
         # 'process', but through the full network stack).
         from petastorm_tpu.service import ServicePool
-        endpoint = os.environ.get('PETASTORM_TPU_SERVICE_DISPATCHER')
+        from petastorm_tpu.telemetry import knobs
+        endpoint = knobs.get_str('PETASTORM_TPU_SERVICE_DISPATCHER') or None
         if endpoint:
             # workers_count deliberately does NOT feed expected_workers: it
             # sizes LOCAL decode parallelism, while the external fleet size
             # is the operator's (default: start as soon as one worker
             # registers; more join live — docs/env_knobs.md).
-            expected = os.environ.get('PETASTORM_TPU_SERVICE_WORKERS')
+            expected = knobs.get_str('PETASTORM_TPU_SERVICE_WORKERS') or None
             return ServicePool(endpoint=endpoint,
                                expected_workers=int(expected) if expected
                                else None,

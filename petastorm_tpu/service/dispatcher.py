@@ -396,14 +396,14 @@ class Dispatcher:
                 get_registry().counter(SERVICE_DUPLICATE_DONE).inc()
             # both completions have now been seen: the trace entry has
             # served its purpose (the dedup drop is marked on the timeline)
-            entry = self._trace_ctx.pop(item_id, None)
-            if entry is not None:
+            dup_entry = self._trace_ctx.pop(item_id, None)
+            if dup_entry is not None:
                 tracing.record_instant(
-                    'duplicate_done', entry.ctx, 'dispatcher',
+                    'duplicate_done', dup_entry.ctx, 'dispatcher',
                     worker=identity.decode('utf-8', 'replace'))
             return
-        entry = self._inflight.pop(item_id, None)
-        if entry is None:
+        assignment = self._inflight.pop(item_id, None)
+        if assignment is None:
             # Ghost completion: the item lapsed back onto the pending queue
             # but its original owner finished after all. Accept the result
             # and withdraw the pending copy so it is not run twice.
@@ -416,7 +416,7 @@ class Dispatcher:
                 self._pending = collections.deque(
                     (i, p) for i, p in self._pending if i != item_id)
         else:
-            owner = self._workers.get(entry[0])
+            owner = self._workers.get(assignment[0])
             if owner is not None:
                 owner.inflight.discard(item_id)
         if item_id in self._risky_ids:
@@ -427,17 +427,17 @@ class Dispatcher:
             # age the entry out (the ghost race window is a few liveness
             # timeouts at most); without this the map would grow with
             # failure churn for the life of the process
-            entry = self._trace_ctx.get(item_id)
-            if entry is not None and entry.completed_at is None:
-                entry.completed_at = now
+            trace_entry = self._trace_ctx.get(item_id)
+            if trace_entry is not None and trace_entry.completed_at is None:
+                trace_entry.completed_at = now
         else:
-            entry = self._trace_ctx.pop(item_id, None)
-        if entry is not None:
+            trace_entry = self._trace_ctx.pop(item_id, None)
+        if trace_entry is not None:
             # the item's ONE delivered completion
             tracing.record_instant(
-                'done', entry.ctx, 'dispatcher',
+                'done', trace_entry.ctx, 'dispatcher',
                 worker=identity.decode('utf-8', 'replace'),
-                attempts=entry.attempts, outcome=outcome[0])
+                attempts=trace_entry.attempts, outcome=outcome[0])
         self._completed_count += 1
         kind, payload = outcome
         if kind == 'result':

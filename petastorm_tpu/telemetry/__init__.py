@@ -39,6 +39,7 @@ recorder exports a Perfetto-viewable Chrome trace
 discipline. See the tracing section of docs/telemetry.md.
 """
 
+from petastorm_tpu.telemetry import knobs  # noqa: F401
 from petastorm_tpu.telemetry.registry import (  # noqa: F401
     Counter, Gauge, Histogram, MetricsRegistry, dump_delta_frame,
     get_registry, load_delta_frame, merge_worker_delta, reset_registry,

@@ -1,0 +1,103 @@
+"""Central registry of ``PETASTORM_TPU_*`` environment knobs.
+
+The package's ONE place that touches ``os.environ`` for its own knobs.
+Every knob name must be a member of
+:data:`petastorm_tpu.analysis.contracts.KNOWN_KNOBS` (reading an
+unregistered name raises — a typo'd knob fails loudly instead of
+silently reading the default forever) and must carry a row in
+docs/env_knobs.md. Both contracts are enforced statically by the
+``env-knob`` pass of :mod:`petastorm_tpu.analysis`: a raw
+``os.environ`` read of the namespace anywhere else in the package is a
+finding, so call-site parsing can never drift from the registry again.
+
+Call sites keep their own caching discipline (resolve once, re-read via
+``petastorm_tpu.telemetry.refresh()``); this module is deliberately
+cache-free so a refresh sees the live environment.
+"""
+
+import logging
+import os
+
+from petastorm_tpu.analysis.contracts import (  # noqa: F401 - re-exported
+    DISABLED_VALUES, ENABLED_VALUES, KNOB_PREFIX, KNOWN_KNOBS,
+)
+
+logger = logging.getLogger(__name__)
+
+
+def _check(name):
+    if name not in KNOWN_KNOBS:
+        raise ValueError(
+            'Unregistered environment knob %r: add it to '
+            'petastorm_tpu/analysis/contracts.py KNOWN_KNOBS and document '
+            'it in docs/env_knobs.md' % (name,))
+
+
+def raw(name, default=None):
+    """The registry's one ``os.environ`` read: the raw string value of a
+    REGISTERED knob (``default`` when unset)."""
+    _check(name)
+    return os.environ.get(name, default)
+
+
+def get_str(name, default=''):
+    """Stripped string value of a registered knob."""
+    value = raw(name, default)
+    return value.strip() if isinstance(value, str) else value
+
+
+def is_disabled(name):
+    """True when the knob carries a disable spelling
+    (:data:`DISABLED_VALUES`); unset/empty is NOT disabled — the pattern
+    of every on-by-default kill switch (metrics, staging, native)."""
+    return get_str(name).lower() in DISABLED_VALUES
+
+
+def is_enabled(name):
+    """True when the knob carries an enable spelling
+    (:data:`ENABLED_VALUES`); unset/empty is NOT enabled — the pattern of
+    every off-by-default opt-in (tracing)."""
+    return get_str(name).lower() in ENABLED_VALUES
+
+
+def get_int(name, default, floor=None):
+    """Integer value of a registered knob; unparseable values log a
+    warning and fall back to ``default``; ``floor`` clamps from below."""
+    text = get_str(name)
+    value = default
+    if text:
+        try:
+            value = int(text)
+        except ValueError:
+            logger.warning('Unparseable %s=%r; using %r', name, text,
+                           default)
+            value = default
+    if floor is not None and value is not None:
+        value = max(floor, value)
+    return value
+
+
+def get_float(name, default, floor=None):
+    """Float value of a registered knob; same fallback rules as
+    :func:`get_int`."""
+    text = get_str(name)
+    value = default
+    if text:
+        try:
+            value = float(text)
+        except ValueError:
+            logger.warning('Unparseable %s=%r; using %r', name, text,
+                           default)
+            value = default
+    if floor is not None and value is not None:
+        value = max(floor, value)
+    return value
+
+
+def set_env(name, value):
+    """Write a registered knob into this process's environment (the
+    benchmark CLI arming ``PETASTORM_TPU_TRACE`` before any reader
+    exists). Callers still need ``telemetry.refresh()`` for already-cached
+    call sites to notice."""
+    _check(name)
+    os.environ[name] = value

@@ -14,31 +14,16 @@ updates — so the hot path pays one cached boolean check per span site
 ``tests/test_telemetry.py::test_disabled_spans_are_noops``).
 """
 
-import os
 import time
 
+from petastorm_tpu.analysis.contracts import STAGES  # noqa: F401 - canonical
+from petastorm_tpu.telemetry import knobs
+from petastorm_tpu.telemetry.knobs import DISABLED_VALUES  # noqa: F401
 from petastorm_tpu.telemetry.registry import get_registry, on_registry_reset
-
-#: canonical pipeline stages, ventilator → device (docs/telemetry.md):
-#: ``ventilate`` hand item to pool · ``io`` parquet row-group read ·
-#: ``decode`` codec decode · ``filter`` predicate/row-mask eval ·
-#: ``transform`` TransformSpec · ``queue_wait`` consumer blocked pulling ·
-#: ``collate`` re-batch/shuffle-buffer/densify · ``h2d`` host→device
-#: staging (pre-arena path) · ``h2d_ready`` staging arena blocked until a
-#: slot's previous transfer completed · ``stage_fill`` cast/pad/mask copy
-#: into the arena slot · ``h2d_dispatch`` async transfer dispatch
-STAGES = ('ventilate', 'io', 'decode', 'filter', 'transform', 'queue_wait',
-          'collate', 'h2d', 'h2d_ready', 'stage_fill', 'h2d_dispatch')
 
 STAGE_SECONDS = 'petastorm_tpu_stage_seconds_total'
 STAGE_CALLS = 'petastorm_tpu_stage_calls_total'
 STAGE_DURATION = 'petastorm_tpu_stage_duration_seconds'
-
-#: the one knob-truthiness rule for "disable" env values — shared by every
-#: PETASTORM_TPU_* kill switch (metrics here, the jax staging arena, ...)
-#: so the accepted spellings cannot drift between knobs
-DISABLED_VALUES = ('0', 'false', 'off', 'no')
-_DISABLED_VALUES = DISABLED_VALUES
 
 # resolved once (refresh_enabled() re-reads, for tests and long-lived
 # processes that flip the knob); None = not yet resolved
@@ -49,8 +34,7 @@ def metrics_disabled():
     """True when ``PETASTORM_TPU_METRICS`` disables telemetry."""
     global _disabled
     if _disabled is None:
-        raw = os.environ.get('PETASTORM_TPU_METRICS', '').strip().lower()
-        _disabled = raw in _DISABLED_VALUES
+        _disabled = knobs.is_disabled('PETASTORM_TPU_METRICS')
     return _disabled
 
 

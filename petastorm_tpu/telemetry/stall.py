@@ -20,9 +20,10 @@ wait increments here).
 """
 
 import collections
-import os
 import threading
 import time
+
+from petastorm_tpu.telemetry import knobs
 
 PRODUCER_BOUND = 'producer-bound'
 CONSUMER_BOUND = 'consumer-bound'
@@ -38,15 +39,9 @@ _DEFAULT_WINDOW_S = 0.5
 
 
 def default_window_s():
-    raw = os.environ.get('PETASTORM_TPU_METRICS_WINDOW_S', '').strip()
-    if raw:
-        try:
-            value = float(raw)
-            if value > 0:
-                return value
-        except ValueError:
-            pass
-    return _DEFAULT_WINDOW_S
+    value = knobs.get_float('PETASTORM_TPU_METRICS_WINDOW_S',
+                            _DEFAULT_WINDOW_S)
+    return value if value > 0 else _DEFAULT_WINDOW_S
 
 
 def classify_window(producer_wait_s, consumer_wait_s, window_s):

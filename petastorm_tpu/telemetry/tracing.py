@@ -36,7 +36,8 @@ import threading
 import time
 import uuid
 
-from petastorm_tpu.telemetry import spans
+from petastorm_tpu.analysis.contracts import EVENT_NAMES  # noqa: F401
+from petastorm_tpu.telemetry import knobs, spans
 from petastorm_tpu.telemetry.recorder import export_chrome_trace, get_recorder
 
 logger = logging.getLogger(__name__)
@@ -44,20 +45,6 @@ logger = logging.getLogger(__name__)
 #: reserved kwarg the ventilator injects into sampled work items and every
 #: pool flavor strips (and activates) before calling ``worker.process``
 TRACE_CTX_KEY = '_trace_ctx'
-
-#: every trace-event name this package records outside the canonical stage
-#: spans — the hygiene test (tests/test_hygiene.py) holds recorded names to
-#: ``STAGES | EVENT_NAMES``
-EVENT_NAMES = frozenset([
-    'attempt',          # one worker-side processing of one item (X event)
-    'ventilate',        # recorded via the ventilator's stage span
-    'dispatch',         # dispatcher assigned the item to a worker (instant)
-    'reventilate',      # heartbeat lapse sent the item back to pending
-    'done',             # the item's single delivered completion
-    'duplicate_done',   # a raced second completion, deduped (dropped)
-])
-
-_ENABLED_VALUES = ('1', 'true', 'on', 'yes')
 
 TraceContext = collections.namedtuple(
     'TraceContext', ('trace_id', 'item_seq', 'epoch', 'shard'))
@@ -76,8 +63,7 @@ def trace_enabled():
     """True when ``PETASTORM_TPU_TRACE`` turns per-item tracing on."""
     global _enabled
     if _enabled is None:
-        raw = os.environ.get('PETASTORM_TPU_TRACE', '').strip().lower()
-        _enabled = raw in _ENABLED_VALUES
+        _enabled = knobs.is_enabled('PETASTORM_TPU_TRACE')
         if _enabled:
             _install_dump_hooks()
     return _enabled
@@ -88,7 +74,7 @@ def sample_stride():
     too): every N-th ventilated item is traced. Default 1 (every item)."""
     global _stride
     if _stride is None:
-        raw = os.environ.get('PETASTORM_TPU_TRACE_SAMPLE', '').strip()
+        raw = knobs.get_str('PETASTORM_TPU_TRACE_SAMPLE')
         stride = 1
         if raw:
             try:
@@ -321,7 +307,7 @@ def dump_trace(path):
 
 
 def _dump_path():
-    return os.environ.get('PETASTORM_TPU_TRACE_DUMP', '').strip() or None
+    return knobs.get_str('PETASTORM_TPU_TRACE_DUMP') or None
 
 
 _atexit_installed = False
@@ -372,11 +358,7 @@ _install_dump_hooks()
 
 
 def autodump_windows():
-    raw = os.environ.get('PETASTORM_TPU_TRACE_AUTODUMP_WINDOWS', '').strip()
-    try:
-        return max(1, int(raw)) if raw else 6
-    except ValueError:
-        return 6
+    return knobs.get_int('PETASTORM_TPU_TRACE_AUTODUMP_WINDOWS', 6, floor=1)
 
 
 def maybe_autodump():

@@ -7,6 +7,7 @@ epochs), this ventilator is **checkpointable**: :meth:`state_dict` /
 resume mid-epoch — a capability the reference lacks (SURVEY.md §5.4).
 """
 
+import inspect
 import logging
 import threading
 from abc import ABCMeta, abstractmethod
@@ -16,6 +17,24 @@ import numpy as np
 from petastorm_tpu.telemetry import span, tracing
 
 logger = logging.getLogger(__name__)
+
+
+def _accepts_trace_ctx(fn):
+    """True when ``fn(**item)`` tolerates the injected ``_trace_ctx``
+    kwarg (a ``**kwargs`` or an explicit parameter). The pools' ``ventilate``
+    methods do; a bare user callable may not — tracing is advisory, so
+    for those the context is simply not carried rather than crashing the
+    ventilation thread with a TypeError."""
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return False
+    for param in sig.parameters.values():
+        if param.kind is inspect.Parameter.VAR_KEYWORD:
+            return True
+        if param.name == tracing.TRACE_CTX_KEY:
+            return True
+    return False
 
 _VENTILATION_INTERVAL_S = 0.01
 
@@ -79,6 +98,7 @@ class ConcurrentVentilator(Ventilator):
             raise ValueError('iterations must be positive or None, got %r' % iterations)
         self._pass_epoch = pass_epoch
         self._trace_shard = trace_shard
+        self._carries_trace_ctx = _accepts_trace_ctx(ventilate_fn)
         self._items = list(items_to_ventilate)
         self._initial_iterations = iterations
         self._iterations_remaining = iterations
@@ -98,6 +118,7 @@ class ConcurrentVentilator(Ventilator):
         self._cv = threading.Condition()
         self._stop_requested = False
         self._completed = False
+        self._error = None
         self._thread = None
 
     # -- lifecycle ----------------------------------------------------------
@@ -124,6 +145,14 @@ class ConcurrentVentilator(Ventilator):
     def completed(self):
         return self._completed
 
+    @property
+    def error(self):
+        """The exception that killed ventilation, or None. A dead
+        ventilator reads as completed (no more items will ever arrive) so
+        consumers drain and stop instead of waiting forever; callers that
+        must distinguish truncation from success check here."""
+        return self._error
+
     def stop(self):
         with self._cv:
             self._stop_requested = True
@@ -146,6 +175,7 @@ class ConcurrentVentilator(Ventilator):
             raise RuntimeError('Cannot reset a ventilator before it completed')
         self._thread = None
         self._completed = False
+        self._error = None
         self._stop_requested = False
         self._cursor = 0
         self._epoch = 0
@@ -200,6 +230,24 @@ class ConcurrentVentilator(Ventilator):
         return list(rng.permutation(len(self._items)))
 
     def _run(self):
+        # A ventilation-thread death must never read as "still running":
+        # before this guard, an exception here (e.g. a ventilate_fn
+        # rejecting the injected _trace_ctx kwarg) died silently with
+        # ``completed()`` stuck False, wedging every consumer that polls
+        # it — the exact silent-deadlock class pipecheck exists to stop.
+        # Found while testing the analyzer; regression:
+        # tests/test_workers_pool.py::test_ventilator_error_completes.
+        try:
+            self._run_inner()
+        except Exception as e:  # noqa: BLE001 - surfaced via .error
+            logger.exception('Ventilator thread died; marking ventilation '
+                             'complete so consumers do not wait forever')
+            with self._cv:
+                self._error = e
+                self._completed = True
+                self._cv.notify_all()
+
+    def _run_inner(self):
         while True:
             with self._cv:
                 if self._stop_requested:
@@ -230,7 +278,7 @@ class ConcurrentVentilator(Ventilator):
                 ctx = tracing.mint(item.get('item_index', item_index),
                                    epoch=self._epoch,
                                    shard=self._trace_shard)
-                if ctx is not None:
+                if ctx is not None and self._carries_trace_ctx:
                     item = dict(item)
                     item[tracing.TRACE_CTX_KEY] = ctx
                 with tracing.activate(ctx, track='ventilator'):

@@ -1,0 +1,36 @@
+"""Fixture: indefinitely-blocking calls lexically inside lock bodies."""
+
+import queue
+import subprocess
+import threading
+import time
+
+
+class Pipeline:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._queue = queue.Queue()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def drain(self, sock, handle):
+        with self._lock:
+            item = self._queue.get()            # finding: get() sans timeout
+            self._queue.put(item)               # finding: put() sans timeout
+            frames = sock.recv_multipart()      # finding: ZMQ sans NOBLOCK
+            self._thread.join()                 # finding: join() sans timeout
+            handle.block_until_ready()          # finding
+            subprocess.run(['true'])            # finding
+            time.sleep(1.0)                     # finding
+
+    def drain_politely(self, sock):
+        with self._lock:
+            item = self._queue.get(timeout=0.05)      # clean: bounded
+            self._queue.put(item, timeout=0.05)       # clean: bounded
+            self._thread.join(0.1)                    # clean: bounded
+        self._queue.get()                             # clean: no lock held
+
+    def acquire_style(self):
+        self._lock.acquire()
+        self._queue.get()                       # finding: between acquire/release
+        self._lock.release()
+        self._queue.get()                       # clean: released

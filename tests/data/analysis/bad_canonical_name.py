@@ -1,0 +1,17 @@
+"""Fixture: off-contract stage/event/metric names."""
+
+from petastorm_tpu.telemetry import get_registry, span
+from petastorm_tpu.telemetry.tracing import record_instant
+
+# resolved through a module-level constant, like the real call sites
+_TYPO_METRIC = 'petastorm_tpu_reventilated_totl'
+
+
+def record(ctx):
+    with span('decod'):          # finding: typo'd stage
+        pass
+    with span('decode'):         # clean: canonical stage
+        pass
+    record_instant('reventilated', ctx, 'dispatcher')   # finding: not an event
+    get_registry().counter(_TYPO_METRIC).inc()          # finding: via constant
+    get_registry().counter('petastorm_tpu_cache_hits_total').inc()  # clean

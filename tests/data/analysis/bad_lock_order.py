@@ -1,0 +1,18 @@
+"""Fixture: two locks nested in opposite orders in one module."""
+
+import threading
+
+_STATE_LOCK = threading.Lock()
+_IO_LOCK = threading.Lock()
+
+
+def writer():
+    with _STATE_LOCK:
+        with _IO_LOCK:       # order: state -> io
+            pass
+
+
+def reader():
+    with _IO_LOCK:
+        with _STATE_LOCK:    # finding: io -> state inverts writer()'s order
+            pass

@@ -1,0 +1,17 @@
+"""Fixture: closure-y payloads handed to process boundaries."""
+
+import dill
+
+
+def enqueue_all(pool, rows):
+    def local_transform(row):        # nested def: pickles by value, if at all
+        return row * 2
+
+    pool.ventilate(local_transform, rows)       # finding: local function
+    pool.ventilate(lambda r: r + 1, rows)       # finding: lambda
+    _payload = dill.dumps((local_transform, rows))   # finding: local function
+    pool.ventilate(process_row, rows)           # clean: module-level
+
+
+def process_row(row):
+    return row

@@ -1,0 +1,38 @@
+"""Fixture: non-daemon threads nobody ever joins."""
+
+import threading
+
+
+class LeakyPool:
+    def start(self):
+        # finding: no daemon=True and the class never join()s it
+        self._thread = threading.Thread(target=self._run)
+        self._thread.start()
+
+    def _run(self):
+        pass
+
+
+class CleanPool:
+    def start(self):
+        # clean: joined from stop()
+        self._thread = threading.Thread(target=self._run)
+        self._thread.start()
+
+    def stop(self):
+        self._thread.join(timeout=5.0)
+
+    def _run(self):
+        pass
+
+
+def fire_and_forget():
+    # finding: unbound, undaemonized, unjoined
+    threading.Thread(target=print).start()
+
+
+def scoped_worker():
+    # clean: daemonized after construction
+    worker = threading.Thread(target=print)
+    worker.daemon = True
+    worker.start()

@@ -1,0 +1,211 @@
+"""pipecheck gate + self-tests.
+
+Two halves: (1) the baseline-zero gate — every analyzer pass over the
+whole ``petastorm_tpu`` package yields no findings, so a contract
+regression (raw env read, typo'd stage, blocking call under a lock,
+leaky thread, closure payload) fails tier-1 at commit time; (2) rule
+self-tests — the known-bad fixtures under ``tests/data/analysis/``
+prove each rule actually fires, so the gate can never rot into a
+scanner that silently matches nothing.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from petastorm_tpu.analysis import (
+    ALL_RULES, RULE_DESCRIPTIONS, analyze_paths, analyze_source, contracts,
+)
+from petastorm_tpu.analysis.core import iter_python_files
+from petastorm_tpu.analysis.pass_env_knobs import check_docs_coverage
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.join(REPO, 'petastorm_tpu')
+FIXTURES = os.path.join(REPO, 'tests', 'data', 'analysis')
+
+
+def _fixture_findings(name, rule=None):
+    path = os.path.join(FIXTURES, name)
+    findings = analyze_paths([path], root=REPO, check_docs=False)
+    if rule is not None:
+        findings = [f for f in findings if f.rule == rule]
+    return findings
+
+
+# -- the gate -----------------------------------------------------------------
+
+
+def test_package_is_finding_free():
+    """The whole package passes every pass — the CI gate in test form."""
+    findings = analyze_paths([PACKAGE], root=REPO)
+    assert not findings, 'pipecheck findings on the tree:\n%s' \
+        % '\n'.join(str(f) for f in findings)
+
+
+def test_gate_scans_the_real_tree():
+    """Guard against a silently-empty scan (wrong path, glob rot)."""
+    files = list(iter_python_files([PACKAGE]))
+    assert len(files) > 50
+    assert any(f.endswith('dispatcher.py') for f in files)
+
+
+def test_registered_knobs_are_documented():
+    findings = check_docs_coverage(os.path.join(REPO, 'docs',
+                                                'env_knobs.md'))
+    assert not findings, '\n'.join(str(f) for f in findings)
+
+
+def test_every_rule_has_a_description():
+    assert set(ALL_RULES) == set(RULE_DESCRIPTIONS)
+    assert len(ALL_RULES) == 6
+
+
+# -- rule self-tests over the fixtures ---------------------------------------
+
+
+def test_env_knob_rule_fires():
+    findings = _fixture_findings('bad_env_knob.py', 'env-knob')
+    lines = [f.line for f in findings]
+    assert lines == [8, 11, 14, 17, 20], findings
+    assert 'unregistered knob' in findings[-1].message
+
+
+def test_canonical_name_rule_fires():
+    findings = _fixture_findings('bad_canonical_name.py', 'canonical-name')
+    assert [f.line for f in findings] == [11, 15, 16], findings
+    # the metric finding resolved through a module-level constant
+    assert 'petastorm_tpu_reventilated_totl' in findings[2].message
+
+
+def test_blocking_under_lock_rule_fires():
+    findings = _fixture_findings('bad_blocking_under_lock.py',
+                                 'blocking-under-lock')
+    lines = [f.line for f in findings]
+    # 7 hazards in drain(), 1 in acquire_style(); the bounded/unlocked
+    # calls in drain_politely() and after release() stay clean
+    assert lines == [17, 18, 19, 20, 21, 22, 23, 34], findings
+
+
+def test_lock_order_rule_fires():
+    findings = _fixture_findings('bad_lock_order.py', 'lock-order')
+    assert len(findings) == 1, findings
+    assert '_IO_LOCK' in findings[0].message
+    assert '_STATE_LOCK' in findings[0].message
+
+
+def test_thread_lifecycle_rule_fires():
+    findings = _fixture_findings('bad_thread_lifecycle.py',
+                                 'thread-lifecycle')
+    assert [f.line for f in findings] == [9, 31], findings
+
+
+def test_pickle_payload_rule_fires():
+    findings = _fixture_findings('bad_pickle_payload.py', 'pickle-payload')
+    assert [f.line for f in findings] == [10, 11, 12], findings
+
+
+def test_suppression_comment_silences_findings():
+    assert _fixture_findings('suppressed.py') == []
+
+
+def test_suppression_is_rule_specific():
+    findings = analyze_source(
+        "import queue\nimport threading\n_lock = threading.Lock()\n"
+        "q = queue.Queue()\n"
+        "def f():\n"
+        "    with _lock:\n"
+        "        q.get()  # pipecheck: disable=lock-order\n")
+    assert [f.rule for f in findings] == ['blocking-under-lock']
+
+
+# -- library/CLI surface ------------------------------------------------------
+
+
+def test_analyze_source_on_clean_snippet():
+    assert analyze_source('x = 1\n') == []
+
+
+def test_select_narrows_rules():
+    source = ("import os\nimport threading\n"
+              "_RAW = os.environ.get('PETASTORM_TPU_STAGING')\n"
+              "t = threading.Thread(target=print)\nt.start()\n")
+    only_env = analyze_source(source, select=['env-knob'])
+    assert [f.rule for f in only_env] == ['env-knob']
+    both = analyze_source(source)
+    assert {f.rule for f in both} == {'env-knob', 'thread-lifecycle'}
+
+
+def test_findings_are_structured():
+    findings = _fixture_findings('bad_lock_order.py')
+    record = findings[0].as_dict()
+    assert set(record) == {'path', 'line', 'rule', 'message'}
+    assert str(findings[0]).startswith(record['path'])
+
+
+def test_missing_path_raises_not_clean():
+    """A scan of nothing must never read as a clean pass (a wrong cwd or
+    a renamed package would otherwise turn the CI gate silently green)."""
+    with pytest.raises(FileNotFoundError):
+        analyze_paths(['no_such_dir_xyz'])
+
+
+def test_empty_scan_raises(tmp_path):
+    with pytest.raises(FileNotFoundError, match='no Python files'):
+        analyze_paths([str(tmp_path)])
+
+
+def test_contracts_import_is_light():
+    """telemetry's production import path (analysis.contracts via the
+    knob registry) must not drag the ast/tokenize analyzer into every
+    reader/worker process."""
+    proc = subprocess.run(
+        [sys.executable, '-c',
+         'import sys; import petastorm_tpu.telemetry.knobs; '
+         'bad = [m for m in sys.modules if "analysis.core" in m or '
+         '"analysis.pass_" in m or "analysis.findings" in m]; '
+         'assert not bad, bad; print("light")'],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert 'light' in proc.stdout
+
+
+@pytest.mark.parametrize('args,expected_rc', [
+    (['petastorm_tpu'], 0),
+    (['tests/data/analysis/bad_lock_order.py', '--no-docs-check'], 1),
+    (['--list-rules'], 0),
+    (['petastorm_tpu', '--select', 'no-such-rule'], 2),
+    (['no_such_dir_xyz'], 2),
+])
+def test_cli_exit_codes(args, expected_rc):
+    proc = subprocess.run([sys.executable, '-m', 'petastorm_tpu.analysis']
+                          + args, cwd=REPO, capture_output=True, text=True,
+                          timeout=120)
+    assert proc.returncode == expected_rc, (proc.stdout, proc.stderr)
+
+
+def test_cli_json_output():
+    proc = subprocess.run(
+        [sys.executable, '-m', 'petastorm_tpu.analysis',
+         'tests/data/analysis/bad_lock_order.py', '--json',
+         '--no-docs-check'],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+    import json
+    records = [json.loads(line) for line in proc.stdout.splitlines()]
+    assert records and records[0]['rule'] == 'lock-order'
+
+
+# -- contracts stay in sync with the runtime ---------------------------------
+
+
+def test_contracts_are_the_runtime_sets():
+    """telemetry imports the SAME objects the checker verifies against —
+    the drift this PR exists to make impossible."""
+    from petastorm_tpu import telemetry
+    from petastorm_tpu.telemetry import tracing
+    assert telemetry.STAGES is contracts.STAGES
+    assert tracing.EVENT_NAMES is contracts.EVENT_NAMES
+    from petastorm_tpu.telemetry.knobs import KNOWN_KNOBS
+    assert KNOWN_KNOBS is contracts.KNOWN_KNOBS

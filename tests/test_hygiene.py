@@ -63,12 +63,15 @@ def _call_name(node):
 
 def test_span_and_trace_stage_names_are_canonical():
     """Every literal stage/event name recorded by the package — span(...)
-    and the tracing record_* calls — must be in telemetry.STAGES or
-    tracing.EVENT_NAMES (or the explicit whitelist below): a typo'd stage
-    would silently fall out of pipeline_report's canonical grouping and
-    out of the timeline's known tracks."""
-    from petastorm_tpu.telemetry import STAGES
-    from petastorm_tpu.telemetry.tracing import EVENT_NAMES
+    and the tracing record_* calls — must be in the canonical sets of
+    analysis/contracts.py (the ONE source of truth telemetry imports at
+    runtime and the pipecheck analyzer verifies statically; or the
+    explicit whitelist below): a typo'd stage would silently fall out of
+    pipeline_report's canonical grouping and out of the timeline's known
+    tracks. The canonical-name analysis pass enforces the same contract
+    with constant resolution; this test stays as the dumb independent
+    check that would catch the analyzer itself regressing."""
+    from petastorm_tpu.analysis.contracts import EVENT_NAMES, STAGES
     whitelist = set()  # intentionally empty today; add with a comment why
     allowed = set(STAGES) | set(EVENT_NAMES) | whitelist
     recording_calls = ('span', 'record_complete', 'record_instant')
@@ -91,10 +94,13 @@ def test_span_and_trace_stage_names_are_canonical():
 
 
 def test_exported_metric_names_are_documented():
-    """Every registry metric name the package exports (string literals of
-    the ``petastorm_tpu_*`` namespace) must appear in docs/telemetry.md's
+    """Metric-name chain of custody, hubbed on analysis/contracts.py:
+    every ``petastorm_tpu_*`` literal in the package is a member of
+    contracts.METRIC_NAMES (no off-contract series can exist in source),
+    and every member of METRIC_NAMES has a row in docs/telemetry.md's
     metric reference — dashboards are built from the docs, and an
     undocumented series is invisible operational surface."""
+    from petastorm_tpu.analysis.contracts import METRIC_NAMES
     name_re = re.compile(r'petastorm_tpu_[a-z0-9_]*[a-z0-9]')
     with open(os.path.join(REPO, 'docs', 'telemetry.md')) as f:
         # extract WHOLE documented names with the same lexer — substring
@@ -109,15 +115,21 @@ def test_exported_metric_names_are_documented():
                     name_re.fullmatch(node.value):
                 names.add(node.value)
     assert len(names) >= 10, 'metric-literal scan went blind: %s' % names
-    missing = sorted(names - documented)
-    assert not missing, \
-        'metric names missing from docs/telemetry.md: %s' % missing
+    off_contract = sorted(names - METRIC_NAMES)
+    assert not off_contract, \
+        'metric literals missing from contracts.METRIC_NAMES: %s' \
+        % off_contract
+    undocumented = sorted(METRIC_NAMES - documented)
+    assert not undocumented, \
+        'canonical metric names missing from docs/telemetry.md: %s' \
+        % undocumented
 
 
 def test_no_print_in_library_code():
     """Library modules log; only CLIs/examples/tools/benchmarks print."""
     allowed = ('tools', 'benchmark', 'etl%smetadata_util' % os.sep,
-               'etl%spetastorm_generate_metadata' % os.sep, 'test_util')
+               'etl%spetastorm_generate_metadata' % os.sep, 'test_util',
+               'analysis%s__main__' % os.sep)  # the pipecheck CLI reports
     offenders = []
     for path in SOURCES:
         rel = os.path.relpath(path, REPO)

@@ -147,6 +147,67 @@ def test_ventilator_randomizes_order_per_epoch():
     assert epoch1 != epoch2  # reshuffled between epochs
 
 
+def test_ventilator_error_completes_instead_of_wedging():
+    """A ventilation-thread death must read as 'completed with .error',
+    never as still-running: before the fix, the exception died silently
+    with completed() stuck False and every consumer polling it hung
+    forever (found via the pipecheck work: a leaked PETASTORM_TPU_TRACE=1
+    made _trace_ctx injection TypeError a bare-lambda ventilate_fn)."""
+    def explode(value):
+        raise RuntimeError('boom on %r' % (value,))
+
+    vent = ConcurrentVentilator(explode, [{'value': 1}], iterations=1)
+    vent.start()
+    deadline = time.monotonic() + 10
+    while not vent.completed():
+        assert time.monotonic() < deadline, 'ventilator wedged'
+        time.sleep(0.01)
+    assert isinstance(vent.error, RuntimeError)
+    vent.stop()
+
+
+def test_ventilator_tracing_skips_kwarg_blind_callables(monkeypatch):
+    """With tracing on, _trace_ctx is injected only into ventilate_fns
+    that can accept it (the pools' **kwargs signatures); a bare user
+    callable still receives exactly its own kwargs — tracing is advisory
+    and must never break ventilation."""
+    from petastorm_tpu import telemetry
+    monkeypatch.setenv('PETASTORM_TPU_TRACE', '1')
+    telemetry.refresh()
+    try:
+        received = []
+        vent = ConcurrentVentilator(lambda value: received.append(value),
+                                    [{'value': i} for i in range(10)],
+                                    iterations=1)
+        vent.start()
+        deadline = time.monotonic() + 10
+        while not vent.completed():
+            assert time.monotonic() < deadline, 'ventilator wedged'
+            time.sleep(0.01)
+            for _ in range(len(received)):
+                vent.processed_item()
+        assert vent.error is None
+        assert sorted(received) == list(range(10))
+
+        # a **kwargs ventilate_fn DOES carry the context (the pool shape)
+        carried = []
+        vent2 = ConcurrentVentilator(lambda **kw: carried.append(kw),
+                                     [{'value': i} for i in range(4)],
+                                     iterations=1)
+        vent2.start()
+        deadline = time.monotonic() + 10
+        while not vent2.completed():
+            assert time.monotonic() < deadline, 'ventilator wedged'
+            time.sleep(0.01)
+            for _ in range(len(carried)):
+                vent2.processed_item()
+        from petastorm_tpu.telemetry.tracing import TRACE_CTX_KEY
+        assert all(TRACE_CTX_KEY in kw for kw in carried)
+    finally:
+        monkeypatch.delenv('PETASTORM_TPU_TRACE', raising=False)
+        telemetry.refresh()
+
+
 def test_ventilator_deterministic_given_seed():
     def collect(seed):
         got = []
