@@ -105,6 +105,8 @@ _START = time.monotonic()
 _HEADLINE_MAX_CHARS = 1500
 _HEADLINE_EXTRA_KEYS = (
     'vs_tfdata',
+    'hello_world_warm_epoch_rows_per_sec',
+    'cache_hit_share',
     'lm_train_mfu',
     'lm_train_input_bound_util',
     'lm_train_tuned_mfu',
@@ -1447,6 +1449,46 @@ def main():
         batch_rate, _ = _measure_batch(hello_url, warm, meas)
         extra['hello_world_batch_rows_per_sec'] = round(batch_rate, 1)
 
+    def sec_decoded_cache():
+        # Decode-once serve-many (the 71% io+decode share in BENCH_r05):
+        # epoch 1 fills the materialized decoded-row-group cache (Arrow
+        # IPC, cache_type='decoded'), epoch 2 must serve from it — the
+        # warm/cold ratio and hit share are the record. Full sweeps, no
+        # warmup: a warmup pass would pre-fill the cache and erase the
+        # cold number.
+        from petastorm_tpu.reader import make_batch_reader
+        from petastorm_tpu.telemetry import get_registry, pipeline_report
+        cache_dir = os.path.join(tmp, 'decoded_cache')
+
+        def one_pass(epochs):
+            # rate over the iteration only (construction is identical on
+            # both sides and would otherwise drown the 1k-row sweep)
+            with make_batch_reader(hello_url, reader_pool_type='thread',
+                                   num_epochs=epochs,
+                                   shuffle_row_groups=False,
+                                   cache_type='decoded',
+                                   cache_location=cache_dir,
+                                   cache_size_limit=2 * 10 ** 9) as reader:
+                seen = 0
+                start = time.monotonic()
+                for batch in reader:
+                    seen += len(batch.id)
+                return seen / (time.monotonic() - start)
+
+        # the cold pass is exactly ONE epoch: its epoch 2 would already
+        # be warm; the warm pass sweeps more to amortize scheduling noise
+        cold_rate = one_pass(1)
+        mid = get_registry().snapshot()
+        warm_rate = one_pass(1 if SMOKE else 3)
+        report = pipeline_report(baseline=mid)
+        extra['hello_world_cold_epoch_rows_per_sec'] = round(cold_rate, 1)
+        extra['hello_world_warm_epoch_rows_per_sec'] = round(warm_rate, 1)
+        extra['decoded_cache_warm_speedup'] = round(warm_rate / cold_rate, 3)
+        cache = report.get('decoded_cache') or {}
+        if cache:
+            extra['cache_hit_share'] = cache['hit_rate']
+            extra['decoded_cache_warm_verdict'] = cache['verdict']
+
     def sec_lm_tokens():
         _build_c4_like(c4_url)
         extra['lm_packed_tokens_per_sec'] = round(_measure_lm_tokens(c4_url),
@@ -1660,6 +1702,7 @@ def main():
         # ratio) follows, then the H2D story, decode, pp smoke.
         section('hello_row', 10, sec_hello_row)
         section('hello_batch', 5, sec_hello_batch)
+        section('decoded_cache', 10, sec_decoded_cache)
         section('lm_tokens', 10, sec_lm_tokens)
         section('imagenet', 20, sec_imagenet)
         section('probe', 20, lambda: _probe_tpu(extra))

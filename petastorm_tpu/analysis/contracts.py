@@ -22,9 +22,13 @@ telemetry back).
 #: ``collate`` re-batch/shuffle-buffer/densify · ``h2d`` host→device
 #: staging (pre-arena path) · ``h2d_ready`` staging arena blocked until a
 #: slot's previous transfer completed · ``stage_fill`` cast/pad/mask copy
-#: into the arena slot · ``h2d_dispatch`` async transfer dispatch
+#: into the arena slot · ``h2d_dispatch`` async transfer dispatch ·
+#: ``cache_hit_read`` decoded-row-group cache hit served (mmap + column
+#: reconstruct) · ``cache_fill`` decoded batch serialized to Arrow IPC +
+#: atomically published into the cache
 STAGES = ('ventilate', 'io', 'decode', 'filter', 'transform', 'queue_wait',
-          'collate', 'h2d', 'h2d_ready', 'stage_fill', 'h2d_dispatch')
+          'collate', 'h2d', 'h2d_ready', 'stage_fill', 'h2d_dispatch',
+          'cache_hit_read', 'cache_fill')
 
 #: every trace-event name the package records outside the canonical stage
 #: spans (docs/telemetry.md, tracing section)
@@ -59,6 +63,16 @@ METRIC_NAMES = frozenset([
     'petastorm_tpu_cache_bytes_written_total',
     'petastorm_tpu_cache_bytes_evicted_total',
     'petastorm_tpu_cache_size_bytes',
+    # materialized decoded-row-group cache (materialized_cache.py)
+    'petastorm_tpu_decoded_cache_hits_total',
+    'petastorm_tpu_decoded_cache_misses_total',
+    'petastorm_tpu_decoded_cache_mem_hits_total',
+    'petastorm_tpu_decoded_cache_evictions_total',
+    'petastorm_tpu_decoded_cache_bytes_written_total',
+    'petastorm_tpu_decoded_cache_bytes_read_total',
+    'petastorm_tpu_decoded_cache_mmap_reads_total',
+    'petastorm_tpu_decoded_cache_copy_reads_total',
+    'petastorm_tpu_decoded_cache_size_bytes',
     # disaggregated-service fleet health (service/dispatcher.py)
     'petastorm_tpu_service_reventilated_total',
     'petastorm_tpu_service_duplicate_done_total',
@@ -91,6 +105,10 @@ KNOWN_KNOBS = frozenset([
     'PETASTORM_TPU_TRACE_AUTODUMP_WINDOWS',
     'PETASTORM_TPU_STAGING',
     'PETASTORM_TPU_STAGING_SLOTS',
+    'PETASTORM_TPU_DECODED_CACHE',
+    'PETASTORM_TPU_DECODED_CACHE_DIR',
+    'PETASTORM_TPU_DECODED_CACHE_MEM_MB',
+    'PETASTORM_TPU_DECODED_CACHE_DISK_MB',
 ])
 
 #: the one knob-truthiness rule for "disable"/"enable" env spellings —

@@ -20,6 +20,9 @@ import pyarrow.parquet as pq
 
 from petastorm_tpu.cache import NullCache
 from petastorm_tpu.codecs import CompressedImageCodec, decode_batch_with_nulls
+from petastorm_tpu.materialized_cache import (
+    MaterializedRowGroupCache, dataset_file_fingerprint, decode_fingerprint,
+)
 from petastorm_tpu.telemetry import span
 from petastorm_tpu.workers.worker_base import WorkerBase
 
@@ -127,6 +130,10 @@ class RowGroupWorker(WorkerBase):
         self._ngram = args.get('ngram')
         self._row_groups = args['row_groups']
         self._parquet_files = {}
+        # decoded-cache key identity, resolved lazily (per process, per
+        # parquet file) — see _decoded_fingerprint
+        self._decode_fp = None
+        self._file_fps = {}
 
     # -- worker contract ----------------------------------------------------
 
@@ -135,14 +142,29 @@ class RowGroupWorker(WorkerBase):
         from petastorm_tpu.filters import FiltersPredicate
         piece = self._row_groups[piece_index]
         # Cache only content with a stable identity: arbitrary predicates
-        # and TransformSpec callables have none (their output is baked into
-        # the cached batch), so those readers load fresh every time.
+        # have none, and for the raw pickle cache neither do TransformSpec
+        # callables. The MATERIALIZED cache fingerprints the transform
+        # (code + closure + schema edits) and the codec/schema view into
+        # its key, so it caches the post-transform batch — the whole
+        # point of the decoded tier.
+        decoded = isinstance(self._cache, MaterializedRowGroupCache)
+        # TransformSpec(cacheable=False) marks a STOCHASTIC transform
+        # (random augmentation): caching its output would silently replay
+        # epoch 1's randomness forever, so those readers always decode.
+        # Under the implicit fleet-knob upgrade the bar is higher still:
+        # only transforms that DECLARED cacheable=True participate — the
+        # operator's knob must not freeze an unmarked transform whose
+        # determinism nobody ever vouched for.
+        transform_ok = self._transform_spec is None or (
+            decoded and self._spec_cacheable())
         if self._cache is not None and not isinstance(self._cache, NullCache) \
-                and self._transform_spec is None \
+                and transform_ok \
                 and (worker_predicate is None
                      or isinstance(worker_predicate, FiltersPredicate)):
             cache_key = self._cache_key(piece, worker_predicate,
                                         shuffle_row_drop_partition)
+            if decoded:
+                cache_key += ':d%s' % self._decoded_fingerprint(piece)
             batch = self._cache.get(
                 cache_key,
                 lambda: self._load_rowgroup(piece, worker_predicate,
@@ -199,6 +221,34 @@ class RowGroupWorker(WorkerBase):
                                         self._dataset_info.relpath(piece.path),
                                         piece.row_group, drop_partition,
                                         columns_hash, filter_part)
+
+    def _spec_cacheable(self):
+        """May the decoded cache store this TransformSpec's output?
+        ``cacheable``: False → never; True → always; None (undeclared) →
+        only when the reader explicitly asked for the decoded cache, not
+        when the fleet knob upgraded it behind the job's back."""
+        cacheable = getattr(self._transform_spec, 'cacheable', None)
+        if cacheable is not None:
+            return cacheable
+        return not getattr(self._cache, 'implicit_upgrade', False)
+
+    def _decoded_fingerprint(self, piece):
+        """Decode-identity suffix of a materialized-cache key: what was
+        decoded (schema view + codecs), what transformed it, and the
+        parquet file's bytes identity — any change must miss (serving a
+        stale decoded batch is silent corruption). Both halves are
+        cached: the decode fingerprint once per worker, the file
+        fingerprint once per parquet file."""
+        if self._decode_fp is None:
+            self._decode_fp = decode_fingerprint(self._loaded_schema,
+                                                 self._transform_spec,
+                                                 self._ngram)
+        file_fp = self._file_fps.get(piece.path)
+        if file_fp is None:
+            file_fp = dataset_file_fingerprint(self._dataset_info,
+                                               piece.path)
+            self._file_fps[piece.path] = file_fp
+        return '%s:%s' % (self._decode_fp, file_fp)
 
     def _parquet_file(self, path):
         if path not in self._parquet_files:

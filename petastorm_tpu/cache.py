@@ -9,10 +9,186 @@ import hashlib
 import logging
 import os
 import pickle
+import re
+import socket
 import threading
+import time
 from abc import ABCMeta, abstractmethod
 
 logger = logging.getLogger(__name__)
+
+#: every disk tier writes entries as ``<entry><_TMP_MARKER><host>-<pid>``
+#: and publishes them with an atomic ``os.replace``
+_TMP_MARKER = '.tmp.'
+
+#: pid liveness can only be checked on the writer's own host; a FOREIGN
+#: host's tmp file is purged only once it is old enough that its writer
+#: has certainly crashed or finished (a write takes seconds, not an hour)
+_FOREIGN_TMP_TTL_S = 3600.0
+
+_HOST = re.sub(r'[^A-Za-z0-9]', '', socket.gethostname())[:32] or 'host'
+
+
+def is_tmp_entry(name):
+    """True for an in-flight (or orphaned) writer's tmp file."""
+    return _TMP_MARKER in name
+
+
+def tmp_entry_path(entry):
+    """The tmp name a writer publishes ``entry`` through. Carries host AND
+    pid: pid liveness is only checkable on the writer's own host, so a
+    cache directory on shared storage (the multi-host service-fleet
+    shape) must be able to tell a local writer from a remote one."""
+    return '%s%s%s-%d' % (entry, _TMP_MARKER, _HOST, os.getpid())
+
+
+def _pid_alive(pid):
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except OSError:
+        return True  # e.g. EPERM: the pid exists, just isn't ours
+    return True
+
+
+def _tmp_status(full, name, now):
+    """``None`` for a real entry, ``'live'`` for an in-flight writer's
+    tmp file, ``'stale'`` for a dead writer's orphan. This host's tmp
+    files are judged by pid liveness; a foreign host's (shared-storage
+    fleet directory) only by age past :data:`_FOREIGN_TMP_TTL_S` —
+    ``os.kill`` on another host's pid would misread a LIVE remote writer
+    as dead and delete the file out from under its rename."""
+    i = name.rfind(_TMP_MARKER)
+    if i < 0:
+        return None
+    suffix = name[i + len(_TMP_MARKER):]
+    host, _, pid_text = suffix.rpartition('-')
+    if not pid_text.isdigit():
+        return 'live'  # not our naming: excluded from scans, never purged
+    if host in ('', _HOST):
+        # this host (or a legacy pid-only suffix): liveness check
+        return 'live' if _pid_alive(int(pid_text)) else 'stale'
+    try:
+        age = now - os.stat(full).st_mtime
+    except OSError:
+        return 'live'
+    return 'stale' if age >= _FOREIGN_TMP_TTL_S else 'live'
+
+
+def purge_stale_tmp_files(path):
+    """Delete tmp files whose writer is dead (see :func:`_tmp_status`).
+
+    A writer killed between its tmp write and the ``os.replace`` leaks an
+    orphan that would otherwise inflate every size scan forever and — if
+    the eviction walk saw it — could be "evicted" out from under a LIVE
+    writer's in-flight rename. Returns the number removed."""
+    removed = 0
+    now = time.time()
+    for root, _, files in os.walk(path):
+        for name in files:
+            full = os.path.join(root, name)
+            if _tmp_status(full, name, now) == 'stale':
+                try:
+                    os.remove(full)
+                    removed += 1
+                except OSError:
+                    pass
+    return removed
+
+
+def attach_scan(path):
+    """Cache-init walk: purge stale tmp files AND total the surviving
+    entries in ONE pass — a fleet directory can hold tens of thousands of
+    entries on network storage, and two back-to-back walks would double
+    an already slow startup stat storm. Returns the entry byte total."""
+    total = 0
+    now = time.time()
+    for root, _, files in os.walk(path):
+        for name in files:
+            full = os.path.join(root, name)
+            status = _tmp_status(full, name, now)
+            if status is None:  # a real entry: count it
+                try:
+                    total += os.stat(full).st_size
+                except OSError:
+                    pass
+            elif status == 'stale':
+                try:
+                    os.remove(full)
+                except OSError:
+                    pass
+    return total
+
+
+def publish_entry(entry, write_func):
+    """Atomic cache-entry publish, shared by both disk tiers: write the
+    payload to the entry's tmp name via ``write_func(tmp_path)``, then
+    ``os.replace`` it into place — concurrent readers see the old bytes
+    or the new, never a partial file. Returns ``(size, replaced)``:
+    the new entry's size and the size of any entry it overwrote (the
+    caller's running-total accounting needs the difference; forgetting
+    the overwrite would inflate the total until the next full rescan)."""
+    os.makedirs(os.path.dirname(entry), exist_ok=True)
+    tmp = tmp_entry_path(entry)
+    write_func(tmp)
+    size = os.stat(tmp).st_size
+    try:
+        replaced = os.stat(entry).st_size
+    except OSError:
+        replaced = 0
+    os.replace(tmp, entry)
+    return size, replaced
+
+
+def scan_dir_entries(path):
+    """``([(atime, size, path), ...], total_bytes)`` over a cache
+    directory, skipping in-flight tmp files (they aren't entries and must
+    never be size-accounted or evicted). Shared by both disk tiers."""
+    entries, total = [], 0
+    for root, _, files in os.walk(path):
+        for name in files:
+            if is_tmp_entry(name):
+                continue
+            p = os.path.join(root, name)
+            try:
+                st = os.stat(p)
+            except OSError:
+                continue
+            entries.append((st.st_atime, st.st_size, p))
+            total += st.st_size
+    return entries, total
+
+
+def evict_lru(path, size_limit):
+    """Walk ``path`` and LRU-delete entries (oldest atime first) until it
+    fits ``size_limit``. Returns ``(total_after, evictions,
+    bytes_evicted)``. Deliberately lock-free: callers must NOT hold their
+    cache lock across this filesystem walk (it would serialize every
+    concurrent hit behind I/O); concurrent evictors/re-writers are
+    tolerated — sizes are re-measured at eviction time and races surface
+    as the OSError passes."""
+    entries, total = scan_dir_entries(path)
+    evictions = bytes_evicted = 0
+    if total > size_limit:
+        entries.sort()  # oldest access first
+        for _, _, p in entries:
+            try:
+                # Size measured at EVICTION time, not scan time: another
+                # process may have re-written the entry since (atomic
+                # rename), and accounting the stale size would drift the
+                # running total.
+                size = os.stat(p).st_size
+                os.remove(p)
+                total -= size
+                evictions += 1
+                bytes_evicted += size
+            except OSError:
+                pass
+            if total <= size_limit:
+                break
+    return total, evictions, bytes_evicted
+
 
 # telemetry counter names (read back by telemetry.pipeline_report's cache
 # section); a worker process's increments ride the pool delta channel
@@ -58,19 +234,13 @@ class LocalDiskCache(CacheBase):
         self._cleanup_on_exit = cleanup
         self._lock = threading.Lock()
         os.makedirs(path, exist_ok=True)
-        # Running byte total avoids walking the whole tree on every store;
-        # the full walk happens only at init and when the cap is crossed.
-        self._total = self._scan_total()
+        # One walk purges dead writers' tmp files AND totals the entries.
+        # The running byte total avoids re-walking the tree on every
+        # store; full walks happen only here and when the cap is crossed.
+        self._total = attach_scan(path)
 
     def _scan_total(self):
-        total = 0
-        for root, _, files in os.walk(self._path):
-            for name in files:
-                try:
-                    total += os.stat(os.path.join(root, name)).st_size
-                except OSError:
-                    pass
-        return total
+        return scan_dir_entries(self._path)[1]
 
     def __getstate__(self):
         # Locks don't cross the process-pool spawn boundary; each process
@@ -110,25 +280,32 @@ class LocalDiskCache(CacheBase):
             os.utime(entry)  # LRU touch
             self._registry().counter(CACHE_HITS).inc()
             return value
-        except (OSError, pickle.UnpicklingError, EOFError):
-            pass
+        except OSError:
+            pass  # plain miss: no entry yet
+        except (pickle.UnpicklingError, ValueError, EOFError,
+                AttributeError):
+            # Corrupt entry (UnpicklingError and its subclasses, numpy's
+            # truncated-read ValueError, a short file's EOFError, a
+            # missing-attribute unpickle): delete it NOW so every other
+            # process stops re-reading the bad bytes until our re-fill
+            # below lands — and keep the running total honest.
+            logger.warning('LocalDiskCache entry for %r corrupt; deleting',
+                           key, exc_info=True)
+            try:
+                size = os.stat(entry).st_size
+                os.remove(entry)
+                with self._lock:
+                    self._total -= size
+            except OSError:
+                pass
         self._registry().counter(CACHE_MISSES).inc()
         value = fill_cache_func()
         try:
-            os.makedirs(os.path.dirname(entry), exist_ok=True)
-            tmp = entry + '.tmp.%d' % os.getpid()
-            with open(tmp, 'wb') as f:
-                pickle.dump(value, f, protocol=pickle.HIGHEST_PROTOCOL)
-            size = os.stat(tmp).st_size
-            # An overwrite (re-fill after a truncated/corrupt entry)
-            # replaces the old bytes; forgetting to subtract them would
-            # inflate the running total until the next full rescan and
-            # trigger premature evictions.
-            try:
-                replaced = os.stat(entry).st_size
-            except OSError:
-                replaced = 0
-            os.replace(tmp, entry)
+            def write(tmp):
+                with open(tmp, 'wb') as f:
+                    pickle.dump(value, f,
+                                protocol=pickle.HIGHEST_PROTOCOL)
+            size, replaced = publish_entry(entry, write)
             self._registry().counter(CACHE_BYTES_WRITTEN).inc(size)
             with self._lock:
                 self._total += size - replaced
@@ -141,40 +318,21 @@ class LocalDiskCache(CacheBase):
         return value
 
     def _maybe_evict(self):
-        evictions = 0
-        bytes_evicted = 0
+        # the walk runs OUTSIDE the lock (an eviction pass over a large
+        # tier must not serialize every concurrent get behind disk I/O);
+        # only the running-total update is guarded
         with self._lock:
-            entries = []
-            total = 0
-            for root, _, files in os.walk(self._path):
-                for name in files:
-                    p = os.path.join(root, name)
-                    try:
-                        st = os.stat(p)
-                    except OSError:
-                        continue
-                    entries.append((st.st_atime, p))
-                    total += st.st_size
-            if total <= self._size_limit:
-                self._total = total
-            else:
-                entries.sort()  # oldest access first
-                for _, p in entries:
-                    try:
-                        # Size measured at EVICTION time, not insert/scan
-                        # time: another process may have re-written the
-                        # entry since (atomic rename), and accounting the
-                        # stale size would drift the running total.
-                        size = os.stat(p).st_size
-                        os.remove(p)
-                        total -= size
-                        evictions += 1
-                        bytes_evicted += size
-                    except OSError:
-                        pass
-                    if total <= self._size_limit:
-                        break
-                self._total = total
+            before = self._total
+        total, evictions, bytes_evicted = evict_lru(self._path,
+                                                    self._size_limit)
+        with self._lock:
+            # merge, don't assign: entries published DURING the walk
+            # bumped _total concurrently, and a plain overwrite would
+            # lose them (cap overrun with no eviction trigger). Keeping
+            # their delta can at worst double-count a publish the walk
+            # also saw — an overestimate that only triggers an extra
+            # self-correcting walk, never a silent overrun.
+            self._total = total + (self._total - before)
         if evictions:
             registry = self._registry()
             registry.counter(CACHE_EVICTIONS).inc(evictions)

@@ -254,6 +254,11 @@ class JaxLoader:
         self._attributor = StallAttributor()
         self._awaiting_first_delivery = True
         self._batches_delivered = 0
+        # registry snapshot taken at each pass's first delivery: scopes
+        # autotune's decoded-cache verdict to the CURRENT pass (lifetime
+        # counters would blend epoch 1's full decode cost into every
+        # later epoch's hit rate and misread healthy warm passes)
+        self._pass_baseline = None
 
     # -- sharding ------------------------------------------------------------
 
@@ -448,6 +453,8 @@ class JaxLoader:
                 # attribution covers steady state of the current pass only
                 self._attributor.reset()
                 self._awaiting_first_delivery = False
+                from petastorm_tpu.telemetry import get_registry
+                self._pass_baseline = get_registry().snapshot()
             return batch
 
     def _record_delivery(self, pull_counts):
@@ -1063,6 +1070,9 @@ class JaxLoader:
             report['input_stall_fraction'] = 0.0
             report['advice'] = ['not enough iteration observed yet; '
                                 'consume more batches before tuning']
+            # the cache section is observational, not verdict-derived —
+            # a short pass still shows whether the decoded tier served
+            self._add_decoded_cache_advice(report)
             return report
         frac = consumer / total
         report['input_stall_fraction'] = round(frac, 3)
@@ -1111,7 +1121,43 @@ class JaxLoader:
             report['bottleneck'] = 'balanced'
             report['advice'] = ['producer and consumer are balanced; '
                                 'tune the model step first']
+        self._add_decoded_cache_advice(report)
         return report
+
+    def _add_decoded_cache_advice(self, report):
+        """Cache-aware autotune: when the materialized decoded-row-group
+        cache is live, the right advice changes — a cache-bound pass
+        makes io/decode tuning pointless, and a warm-epoch pass that is
+        NOT cache-bound points at fingerprint churn or an undersized
+        tier (docs/troubleshoot.md has the runbook). The section comes
+        from the full pipeline_report so the verdict sees the stage
+        timings — a bare hit-rate verdict could claim 'cache-bound'
+        while the misses' decode time dominates the wall, directly
+        contradicting the attributor's 'add decode workers' advice —
+        and is baselined at this pass's first delivery so epoch 1's
+        fill cost never dilutes a healthy warm pass's hit rate."""
+        from petastorm_tpu.telemetry import pipeline_report
+        section = pipeline_report(
+            baseline=self._pass_baseline).get('decoded_cache')
+        if section is None:
+            return
+        report['decoded_cache'] = section
+        advice = report.setdefault('advice', [])
+        if section['verdict'] == 'cache-bound':
+            advice.append(
+                'the decoded row-group cache serves this pass (%.0f%% '
+                'hits): epoch 2+ is cache-bound as designed, so decode '
+                'workers/io tuning will not help — look at collate/H2D '
+                'and the model step' % (100 * section['hit_rate']))
+        elif section['hit_rate'] < 0.5 and report.get('bottleneck') == \
+                'input':
+            advice.append(
+                'a decoded cache is configured but only %.0f%% of reads '
+                'hit: if this is epoch 2+, check for cache-key churn '
+                '(unstable TransformSpec closure, rewritten dataset '
+                'files) or an undersized tier evicting the working set '
+                "(docs/troubleshoot.md, 'epoch 2 is not cache-bound')"
+                % (100 * section['hit_rate']))
 
     def state_dict(self):
         """Row-group-granular, at-least-once checkpoint of the DATA

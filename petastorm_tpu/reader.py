@@ -65,6 +65,15 @@ def make_reader(dataset_url, schema_fields=None, reader_pool_type='thread',
         an OR-list of such AND-lists). Row-groups that provably cannot match
         (hive partition values + parquet min/max statistics) are skipped
         without any I/O; surviving rows are filtered exactly on the workers.
+    :param cache_type: ``'null'`` (default; upgraded to ``'decoded'``
+        when ``PETASTORM_TPU_DECODED_CACHE=1``), ``'local-disk'`` (raw
+        pickled reads, pre-transform), or ``'decoded'`` — the
+        materialized decoded-row-group cache: finished post-transform
+        column batches in Arrow IPC files, zero-copy mmap'd back on hit,
+        shared across processes/jobs via one directory
+        (``cache_location`` or ``PETASTORM_TPU_DECODED_CACHE_DIR``);
+        ``cache_size_limit`` bounds the disk tier
+        (default ``PETASTORM_TPU_DECODED_CACHE_DISK_MB``).
     :param filesystem: an already-constructed fsspec filesystem (e.g. a
         pre-authenticated gcsfs/s3fs instance) used instead of resolving
         one from the URL scheme; mutually exclusive with
@@ -89,7 +98,8 @@ def make_reader(dataset_url, schema_fields=None, reader_pool_type='thread',
                   num_epochs=num_epochs, cur_shard=cur_shard,
                   shard_count=shard_count, seed=seed,
                   cache=_make_cache(cache_type, cache_location, cache_size_limit,
-                                    cache_row_size_estimate),
+                                    cache_row_size_estimate,
+                                    predicate=predicate),
                   transform_spec=transform_spec, ngram=ngram, filters=filters,
                   batched_output=False)
 
@@ -120,19 +130,56 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None,
                   num_epochs=num_epochs, cur_shard=cur_shard,
                   shard_count=shard_count, seed=seed,
                   cache=_make_cache(cache_type, cache_location, cache_size_limit,
-                                    cache_row_size_estimate),
+                                    cache_row_size_estimate,
+                                    predicate=predicate),
                   transform_spec=transform_spec, ngram=None, filters=filters,
                   batched_output=True)
 
 
-def _make_cache(cache_type, location, size_limit, row_size_estimate):
+def _make_cache(cache_type, location, size_limit, row_size_estimate,
+                predicate=None):
+    from petastorm_tpu.telemetry import knobs
     if cache_type in (None, 'null', 'none'):
-        return NullCache()
+        # operators can arm the decoded tier fleet-wide without touching
+        # reader call sites: PETASTORM_TPU_DECODED_CACHE=1 upgrades the
+        # default no-cache readers to the materialized cache. Readers
+        # with an arbitrary predicate stay uncached (a predicate has no
+        # stable identity to key on): the knob must never turn a
+        # previously-working job into Reader's cache+predicate
+        # RuntimeError — that check is for EXPLICIT cache requests.
+        if knobs.is_enabled('PETASTORM_TPU_DECODED_CACHE') \
+                and predicate is None:
+            cache_type = 'decoded'
+            implicit = True
+        else:
+            return NullCache()
+    else:
+        implicit = False
     if cache_type == 'local-disk':
         if not location or not size_limit:
             raise ValueError("cache_type='local-disk' requires cache_location "
                              'and cache_size_limit')
         return LocalDiskCache(location, size_limit, row_size_estimate)
+    if cache_type == 'decoded':
+        # Materialized decoded-row-group cache (docs/telemetry.md):
+        # decode-once-serve-many Arrow IPC tier, zero-copy mmap on hit.
+        # Everything defaults from knobs so the fleet can share one
+        # directory with no per-reader configuration.
+        from petastorm_tpu.materialized_cache import (
+            MaterializedRowGroupCache, default_cache_dir,
+        )
+        location = (location
+                    or knobs.get_str('PETASTORM_TPU_DECODED_CACHE_DIR')
+                    or default_cache_dir())
+        disk_limit = size_limit or knobs.get_int(
+            'PETASTORM_TPU_DECODED_CACHE_DISK_MB', 8192, floor=1) * 2 ** 20
+        mem_limit = knobs.get_int(
+            'PETASTORM_TPU_DECODED_CACHE_MEM_MB', 256, floor=0) * 2 ** 20
+        # implicit (knob-upgraded) caches are conservative about
+        # TransformSpecs whose determinism was never declared — see
+        # MaterializedRowGroupCache.implicit_upgrade
+        return MaterializedRowGroupCache(location, disk_limit, mem_limit,
+                                         implicit_upgrade=implicit)
     raise ValueError('Unknown cache_type %r' % cache_type)
 
 

@@ -37,7 +37,7 @@ import time
 import uuid
 
 from petastorm_tpu.service import protocol as proto
-from petastorm_tpu.telemetry import tracing
+from petastorm_tpu.telemetry import knobs, tracing
 
 logger = logging.getLogger(__name__)
 
@@ -87,11 +87,35 @@ def _register(sock, parent_pid, register_timeout_s):
         backoff_s = min(backoff_s * 2, _REGISTER_BACKOFF_MAX_S)
 
 
+def _reroot_decoded_cache(worker_args):
+    """Point a job's materialized decoded-row-group cache at THIS host's
+    shared directory (``--cache-dir`` / ``PETASTORM_TPU_DECODED_CACHE_DIR``).
+
+    The cache object travels inside the job spec with whatever directory
+    the *client* configured — meaningless on a remote decode host. With
+    the override set, every job a standing worker-server fleet serves
+    lands on one local tier, so N jobs over one dataset decode each
+    row-group once per HOST, not once per job (the tf.data-service
+    "decode once, serve many" shape). Without it, the spec's directory is
+    kept (localhost fleets share the client's directory naturally)."""
+    cache_dir = knobs.get_str('PETASTORM_TPU_DECODED_CACHE_DIR')
+    if not cache_dir or not isinstance(worker_args, dict):
+        return
+    from petastorm_tpu.materialized_cache import MaterializedRowGroupCache
+    cache = worker_args.get('cache')
+    if isinstance(cache, MaterializedRowGroupCache) \
+            and cache.path != cache_dir:
+        logger.info('Rerooting decoded cache %s -> %s', cache.path,
+                    cache_dir)
+        cache.reroot(cache_dir)
+
+
 def _run_job(sock, spec_payload, worker_id, heartbeat_interval_s,
              ack_timeout_s, parent_pid):
     """One job lifetime: build the worker, stream items until STOP or the
     dispatcher vanishes. Returns True if the server should serve again."""
     worker_class, worker_args, serializer = proto.load_job_spec(spec_payload)
+    _reroot_decoded_cache(worker_args)
 
     buffer = []
     worker = worker_class(worker_id, buffer.append, worker_args)
@@ -257,8 +281,17 @@ def main(argv=None):
     parser.add_argument('--register-timeout', type=float, default=None,
                         help='give up when no dispatcher answers within '
                              'this many seconds (default: retry forever)')
+    parser.add_argument('--cache-dir', default=None,
+                        help='host-local directory for the materialized '
+                             'decoded-row-group cache: every job this '
+                             'server (re-)registers for shares it, so N '
+                             'jobs over one dataset decode each row-group '
+                             'once per host (same as setting '
+                             'PETASTORM_TPU_DECODED_CACHE_DIR)')
     parser.add_argument('-v', '--verbose', action='store_true')
     args = parser.parse_args(argv)
+    if args.cache_dir:
+        knobs.set_env('PETASTORM_TPU_DECODED_CACHE_DIR', args.cache_dir)
     logging.basicConfig(
         level=logging.DEBUG if args.verbose else logging.INFO,
         format='%(asctime)s worker-server[%(process)d] %(message)s')

@@ -52,8 +52,9 @@ from petastorm_tpu.telemetry.stall import (  # noqa: F401
     get_attributor, reset_attributor,
 )
 from petastorm_tpu.telemetry.export import (  # noqa: F401
-    format_pipeline_report, pipeline_report, prometheus_text,
-    read_jsonl_snapshots, write_jsonl_snapshot,
+    classify_cache_phase, decoded_cache_section, format_pipeline_report,
+    pipeline_report, prometheus_text, read_jsonl_snapshots,
+    write_jsonl_snapshot,
 )
 from petastorm_tpu.telemetry.recorder import (  # noqa: F401
     FlightRecorder, export_chrome_trace, get_recorder, reset_recorder,

@@ -205,6 +205,10 @@ def pipeline_report(registry=None, wall_time_s=None, baseline=None,
     cache = _cache_section(registry)
     if cache is not None:
         report['cache'] = cache
+    decoded = decoded_cache_section(registry, baseline=baseline,
+                                    stages=stages)
+    if decoded is not None:
+        report['decoded_cache'] = decoded
     service = _service_section(registry)
     if service is not None:
         report['service'] = service
@@ -251,6 +255,73 @@ def _cache_section(registry):
             registry.gauges_with_prefix(CACHE_SIZE_BYTES).values(),
             default=0)),
         'hit_rate': round(hits / (hits + misses), 4),
+    }
+
+
+def classify_cache_phase(stages, hits, misses):
+    """'cache-bound' / 'decode-bound' / 'mixed' verdict for a pass over a
+    decoded-row-group cache — the "epoch 2+ should be cache-bound"
+    contract. Cache-bound means hits dominate (≥80%) AND the pass's
+    decode-side time (io+decode+transform) no longer dominates its
+    hit-serving time — i.e. the pipeline is reading materialized batches,
+    not re-paying the 71% io+decode share. ``stages`` is a
+    :func:`pipeline_report`-shaped per-stage dict (baseline-scoped when
+    the report was)."""
+    total = hits + misses
+    if total <= 0:
+        return None
+    hit_rate = hits / total
+
+    def _sec(stage):
+        return stages.get(stage, {}).get('seconds', 0.0)
+
+    decode_side = _sec('io') + _sec('decode') + _sec('transform')
+    hit_side = _sec('cache_hit_read')
+    if hit_rate >= 0.8 and (decode_side <= hit_side or decode_side < 0.05):
+        return 'cache-bound'
+    if hit_rate <= 0.2:
+        return 'decode-bound'
+    return 'mixed'
+
+
+def decoded_cache_section(registry=None, baseline=None, stages=None):
+    """Materialized decoded-row-group cache activity (None when the cache
+    never ran), with the :func:`classify_cache_phase` verdict. ``baseline``
+    (an earlier ``registry.snapshot()``) scopes the counters to one
+    measurement window — pass the snapshot taken between epochs to ask
+    "was THIS pass cache-bound?"."""
+    from petastorm_tpu.materialized_cache import (
+        DECODED_CACHE_BYTES_READ, DECODED_CACHE_BYTES_WRITTEN,
+        DECODED_CACHE_COPY_READS, DECODED_CACHE_EVICTIONS,
+        DECODED_CACHE_HITS, DECODED_CACHE_MEM_HITS, DECODED_CACHE_MISSES,
+        DECODED_CACHE_MMAP_READS, DECODED_CACHE_SIZE_BYTES,
+    )
+    registry = registry or get_registry()
+    base = (baseline or {}).get('counters', {})
+
+    def value(name):
+        return registry.counter_value(name) - base.get(name, 0)
+
+    hits = value(DECODED_CACHE_HITS)
+    misses = value(DECODED_CACHE_MISSES)
+    if not hits and not misses:
+        return None
+    return {
+        'hits': int(hits),
+        'misses': int(misses),
+        'mem_hits': int(value(DECODED_CACHE_MEM_HITS)),
+        'evictions': int(value(DECODED_CACHE_EVICTIONS)),
+        'bytes_written': int(value(DECODED_CACHE_BYTES_WRITTEN)),
+        'bytes_read': int(value(DECODED_CACHE_BYTES_READ)),
+        'mmap_reads': int(value(DECODED_CACHE_MMAP_READS)),
+        'copy_reads': int(value(DECODED_CACHE_COPY_READS)),
+        # per-process gauges over ONE shared directory: aggregate with
+        # max (freshest estimate), never sum — same rule as the raw cache
+        'size_bytes': int(max(
+            registry.gauges_with_prefix(DECODED_CACHE_SIZE_BYTES).values(),
+            default=0)),
+        'hit_rate': round(hits / (hits + misses), 4),
+        'verdict': classify_cache_phase(stages or {}, hits, misses),
     }
 
 
@@ -312,6 +383,17 @@ def format_pipeline_report(report):
                      % (c['hits'], c['misses'], 100 * c['hit_rate'],
                         c['evictions'], c['bytes_written'],
                         c['bytes_evicted'], c['size_bytes']))
+    if 'decoded_cache' in report:
+        d = report['decoded_cache']
+        lines.append('decoded cache: %s — %d hit / %d miss (%.1f%%, %d '
+                     'from memory tier), %d mmap / %d copy column read(s), '
+                     '%d B written, %d B read, %d eviction(s), %d B '
+                     'resident'
+                     % (d['verdict'] or 'idle', d['hits'], d['misses'],
+                        100 * d['hit_rate'], d['mem_hits'],
+                        d['mmap_reads'], d['copy_reads'],
+                        d['bytes_written'], d['bytes_read'],
+                        d['evictions'], d['size_bytes']))
     if 'service' in report:
         s = report['service']
         lines.append('service fleet: %d alive / %d registered worker(s), '

@@ -21,16 +21,30 @@ class TransformSpec:
     :param removed_fields: list of field names deleted by the transform.
     :param selected_fields: if not None, exactly these field names remain,
         in this order (mutually exclusive with removed_fields).
+    :param cacheable: whether the materialized decoded cache
+        (``cache_type='decoded'``) may cache this transform's output.
+        The cache keys a transform by its *code* — it cannot tell a
+        random crop from a deterministic resize, and caching a
+        STOCHASTIC transform would silently replay epoch 1's
+        augmentations forever. ``False``: never cache (the required
+        marking for random augmentation). ``True``: explicitly
+        deterministic — cacheable everywhere. ``None`` (default):
+        cacheable when the reader *explicitly* requested the decoded
+        cache, but NOT under the implicit fleet-wide
+        ``PETASTORM_TPU_DECODED_CACHE=1`` upgrade — an operator flipping
+        that knob must not silently freeze pre-existing jobs' transforms
+        whose determinism nobody ever declared.
     """
 
     def __init__(self, func=None, edit_fields=None, removed_fields=None,
-                 selected_fields=None):
+                 selected_fields=None, cacheable=None):
         if removed_fields and selected_fields:
             raise ValueError('removed_fields and selected_fields are mutually exclusive')
         self.func = func
         self.edit_fields = [self._as_field(f) for f in (edit_fields or [])]
         self.removed_fields = list(removed_fields or [])
         self.selected_fields = list(selected_fields) if selected_fields is not None else None
+        self.cacheable = cacheable
 
     @staticmethod
     def _as_field(f):

@@ -105,3 +105,77 @@ class TestLocalDiskCache:
         cache.get('k', lambda: 1)
         cache.cleanup()
         assert os.path.exists(path)
+
+    def test_corrupt_entry_is_deleted_before_refill(self, tmp_path):
+        """Other processes must stop re-reading a corrupt entry's bytes:
+        the reader that detects corruption deletes the file itself, not
+        just its own view of it."""
+        cache = LocalDiskCache(str(tmp_path / 'c'), 10 ** 6)
+        cache.get('k', lambda: 'good')
+        entry = cache._entry_path('k')
+        with open(entry, 'wb') as f:
+            f.write(b'not a pickle')
+        removed_during_fill = []
+
+        def fill():
+            removed_during_fill.append(not os.path.exists(entry))
+            return 'recomputed'
+
+        assert cache.get('k', fill) == 'recomputed'
+        assert removed_during_fill == [True]
+
+    def test_truncated_pickle_valueerror_recomputed(self, tmp_path):
+        import numpy as np
+        cache = LocalDiskCache(str(tmp_path / 'c'), 10 ** 6)
+        cache.get('k', lambda: np.arange(1000))
+        entry = cache._entry_path('k')
+        blob = open(entry, 'rb').read()
+        with open(entry, 'wb') as f:
+            f.write(blob[:len(blob) - 500])  # truncate inside the array
+        out = cache.get('k', lambda: 'refilled')
+        assert out == 'refilled'
+
+    def test_stale_tmp_files_purged_at_init(self, tmp_path):
+        path = str(tmp_path / 'c')
+        cache = LocalDiskCache(path, 10 ** 6)
+        cache.get('k', lambda: 'v')
+        shard = os.path.dirname(cache._entry_path('k'))
+        # a crashed writer's orphan (pid 2**22+9999 can't be running:
+        # default pid_max) and live-looking garbage from THIS process
+        dead = os.path.join(shard, 'orphan.pkl.tmp.%d' % (2 ** 22 + 9999))
+        live = os.path.join(shard, 'inflight.pkl.tmp.%d' % os.getpid())
+        for p in (dead, live):
+            with open(p, 'wb') as f:
+                f.write(b'x' * 4096)
+        fresh = LocalDiskCache(path, 10 ** 6)
+        assert not os.path.exists(dead)   # dead writer: purged
+        assert os.path.exists(live)       # live pid: left alone
+        # and the running total never counted tmp files
+        assert fresh._total == os.path.getsize(cache._entry_path('k'))
+
+    def test_foreign_host_tmp_files_need_age_not_pid(self, tmp_path):
+        """On shared storage (multi-host fleet dir) another host's pid
+        cannot be liveness-checked here: a FRESH foreign tmp must
+        survive the purge (its writer may be mid-rename on its own
+        host); only a stale one (writer long dead) is collected."""
+        path = str(tmp_path / 'c')
+        os.makedirs(os.path.join(path, '00'), exist_ok=True)
+        fresh = os.path.join(path, '00', 'e.pkl.tmp.otherhost-12345')
+        stale = os.path.join(path, '00', 'f.pkl.tmp.otherhost-12346')
+        for p in (fresh, stale):
+            with open(p, 'wb') as f:
+                f.write(b'x')
+        os.utime(stale, (1.0, 1.0))  # hours past the foreign TTL
+        LocalDiskCache(path, 10 ** 6)
+        assert os.path.exists(fresh)
+        assert not os.path.exists(stale)
+
+    def test_eviction_walk_skips_inflight_tmp_files(self, tmp_path):
+        cache = LocalDiskCache(str(tmp_path / 'c'), size_limit_bytes=20_000)
+        tmp_file = os.path.join(str(tmp_path / 'c'), '00',
+                                'big.pkl.tmp.%d' % os.getpid())
+        os.makedirs(os.path.dirname(tmp_file), exist_ok=True)
+        with open(tmp_file, 'wb') as f:
+            f.write(b'x' * 100_000)  # way over the cap, but in-flight
+        cache.get('k', lambda: b'y' * 30_000)  # triggers eviction
+        assert os.path.exists(tmp_file)  # never "evicted" under a writer

@@ -1,0 +1,586 @@
+"""Materialized decoded-row-group cache: Arrow IPC round-trip, zero-copy
+mmap hits, fingerprint invalidation, crash safety, end-to-end wiring."""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from petastorm_tpu import telemetry as T
+from petastorm_tpu.arrow_worker import ColumnBatch
+from petastorm_tpu.materialized_cache import (
+    MaterializedRowGroupCache, callable_fingerprint, decode_fingerprint,
+    ngram_fingerprint, read_entry, schema_fingerprint,
+    transform_fingerprint, write_entry,
+)
+from petastorm_tpu.transform import TransformSpec
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def fresh_telemetry():
+    T.reset_for_tests()
+    yield
+    T.reset_for_tests()
+
+
+def _sample_columns(n=4):
+    return {
+        'image': np.arange(n * 8 * 8 * 3, dtype=np.uint8).reshape(n, 8, 8, 3),
+        'ids': np.arange(n, dtype=np.int64),
+        'score': np.linspace(0, 1, n, dtype=np.float32),
+        'name': np.array(['row%d' % i for i in range(n)]),
+        'ragged': np.array([np.arange(i + 1) for i in range(n)],
+                           dtype=object),
+    }
+
+
+def _cache(tmp_path, mem_mb=0, disk_limit=10 ** 8):
+    return MaterializedRowGroupCache(str(tmp_path / 'dc'), disk_limit,
+                                     mem_limit_bytes=mem_mb * 2 ** 20)
+
+
+def _fill(columns, calls=None):
+    def fill():
+        if calls is not None:
+            calls.append(1)
+        return ColumnBatch(dict(columns), len(columns['ids']))
+    return fill
+
+
+class TestRoundTrip:
+    def test_decode_once_then_hit(self, tmp_path):
+        cache = _cache(tmp_path)
+        cols = _sample_columns()
+        calls = []
+        first = cache.get('k', _fill(cols, calls))
+        second = cache.get('k', _fill(cols, calls))
+        assert len(calls) == 1
+        assert first.length == second.length == 4
+        for name in cols:
+            if name == 'ragged':
+                for a, b in zip(cols['ragged'], second.columns['ragged']):
+                    np.testing.assert_array_equal(a, b)
+            else:
+                np.testing.assert_array_equal(second.columns[name],
+                                              cols[name])
+
+    def test_hit_is_mmap_backed_not_fresh_allocation(self, tmp_path):
+        """The acceptance gate's zero-copy claim: numeric/string columns
+        of a disk-tier hit alias the IPC file's memory map — their base
+        chain ends in a pyarrow Buffer and they own no data."""
+        cache = _cache(tmp_path)
+        cols = _sample_columns()
+        cache.get('k', _fill(cols))
+        batch = cache.get('k', _fill(cols))
+        for name in ('image', 'ids', 'score', 'name'):
+            col = batch.columns[name]
+            assert not col.flags['OWNDATA'], name
+            base = col
+            while getattr(base, 'base', None) is not None and \
+                    type(base).__module__.split('.')[0] != 'pyarrow':
+                base = base.base
+            assert type(base).__module__.split('.')[0] == 'pyarrow', \
+                '%s not backed by the IPC buffer: %r' % (name, type(base))
+        registry = T.get_registry()
+        assert registry.counter_value(
+            'petastorm_tpu_decoded_cache_mmap_reads_total') >= 4
+
+    def test_hit_records_no_decode_or_transform_spans(self, tmp_path):
+        cache = _cache(tmp_path)
+        cols = _sample_columns()
+        cache.get('k', _fill(cols))
+        registry = T.get_registry()
+        base = registry.counter_value('petastorm_tpu_stage_calls_total',
+                                      stage='cache_hit_read')
+        cache.get('k', _fill(cols))
+        assert registry.counter_value('petastorm_tpu_stage_calls_total',
+                                      stage='decode') == 0
+        assert registry.counter_value('petastorm_tpu_stage_calls_total',
+                                      stage='transform') == 0
+        assert registry.counter_value('petastorm_tpu_stage_calls_total',
+                                      stage='cache_hit_read') == base + 1
+
+    def test_empty_rowgroup_tombstone(self, tmp_path):
+        """A filter-emptied row-group (fill returns None) is cached as a
+        tombstone: the warm epoch skips the re-read too."""
+        cache = _cache(tmp_path)
+        calls = []
+        assert cache.get('k', lambda: calls.append(1)) is None
+        assert cache.get('k', lambda: calls.append(1)) is None
+        assert len(calls) == 1
+
+    def test_memory_tier_hit_touches_disk_lru(self, tmp_path):
+        """Eviction sorts by the disk entry's atime: a row-group served
+        from the memory tier is HOT and must not age toward eviction."""
+        cache = _cache(tmp_path, mem_mb=64)
+        cols = _sample_columns()
+        cache.get('k', _fill(cols))
+        entry = cache._entry_path('k')
+        os.utime(entry, (1.0, 1.0))  # pretend it is ancient
+        cache.get('k', _fill(cols))  # memory-tier hit
+        assert os.stat(entry).st_atime > 1.0
+
+    def test_memory_tier_serves_without_disk(self, tmp_path):
+        import shutil
+        cache = _cache(tmp_path, mem_mb=64)
+        cols = _sample_columns()
+        cache.get('k', _fill(cols))
+        shutil.rmtree(str(tmp_path / 'dc'))  # disk tier gone
+        batch = cache.get('k', _fill(cols))
+        np.testing.assert_array_equal(batch.columns['image'], cols['image'])
+        assert T.get_registry().counter_value(
+            'petastorm_tpu_decoded_cache_mem_hits_total') == 1
+
+    def test_disk_tier_lru_eviction_bounds_size(self, tmp_path):
+        cache = MaterializedRowGroupCache(str(tmp_path / 'dc'), 200_000,
+                                          mem_limit_bytes=0)
+        payload = {'x': np.zeros(50_000, dtype=np.uint8)}
+        for i in range(10):
+            cache.get('k%d' % i, _fill({'x': payload['x'],
+                                        'ids': np.arange(1)}))
+            time.sleep(0.01)  # distinct atimes for a deterministic LRU
+        total = sum(os.path.getsize(os.path.join(root, f))
+                    for root, _, files in os.walk(str(tmp_path / 'dc'))
+                    for f in files)
+        assert total <= 200_000
+        assert T.get_registry().counter_value(
+            'petastorm_tpu_decoded_cache_evictions_total') > 0
+
+    def test_corrupt_entry_deleted_and_refilled(self, tmp_path):
+        cache = _cache(tmp_path)
+        cols = _sample_columns()
+        cache.get('k', _fill(cols))
+        entry = cache._entry_path('k')
+        with open(entry, 'wb') as f:
+            f.write(b'not an arrow file')
+        batch = cache.get('k', _fill(cols))
+        np.testing.assert_array_equal(batch.columns['ids'], cols['ids'])
+        # refilled with a valid entry, readable again
+        assert read_entry(entry)[1] == 4
+
+    def test_truncated_entry_treated_as_miss(self, tmp_path):
+        cache = _cache(tmp_path)
+        cols = _sample_columns()
+        cache.get('k', _fill(cols))
+        entry = cache._entry_path('k')
+        blob = open(entry, 'rb').read()
+        with open(entry, 'wb') as f:
+            f.write(blob[:len(blob) // 2])
+        calls = []
+        batch = cache.get('k', _fill(cols, calls))
+        assert len(calls) == 1
+        np.testing.assert_array_equal(batch.columns['image'], cols['image'])
+
+    def test_pickles_across_process_boundary(self, tmp_path):
+        import pickle
+        cache = _cache(tmp_path, mem_mb=16)
+        cols = _sample_columns()
+        cache.get('k', _fill(cols))
+        clone = pickle.loads(pickle.dumps(cache))
+        calls = []
+        batch = clone.get('k', _fill(cols, calls))
+        assert not calls  # served from the shared disk tier
+        np.testing.assert_array_equal(batch.columns['ids'], cols['ids'])
+
+    def test_reroot_switches_directory(self, tmp_path):
+        cache = _cache(tmp_path, mem_mb=16)
+        cols = _sample_columns()
+        cache.get('k', _fill(cols))
+        cache.reroot(str(tmp_path / 'other'))
+        calls = []
+        cache.get('k', _fill(cols, calls))
+        assert len(calls) == 1  # fresh tier: the old dir's entry is gone
+        assert os.path.isdir(str(tmp_path / 'other'))
+
+
+# -- fingerprints: never serve stale decoded rows ---------------------------
+
+
+def _transform_a(df):
+    return df
+
+
+def _transform_b(df):
+    return df.head(1)
+
+
+def _closure_transform(k):
+    def inner(df):
+        return df.head(k)
+    return inner
+
+
+def _transform_with_inner_lambda(df):
+    return df.assign(id=df['id'].map(lambda x: x + 0))
+
+
+def sample_decode_fingerprint():
+    """Helper shared with the cross-process determinism test (the child
+    imports and prints it; both sides must agree). Deliberately includes
+    a NESTED lambda: its code object lands in co_consts, where a naive
+    repr-based digest would embed a per-process memory address and
+    silently defeat the shared cache."""
+    from tests.test_common import TestSchema
+    spec = TransformSpec(_transform_with_inner_lambda,
+                         removed_fields=['matrix_string'])
+    return decode_fingerprint(TestSchema, spec)
+
+
+class TestFingerprints:
+    def test_transform_code_change_misses(self):
+        assert transform_fingerprint(TransformSpec(_transform_a)) != \
+            transform_fingerprint(TransformSpec(_transform_b))
+
+    def test_transform_closure_change_misses(self):
+        assert callable_fingerprint(_closure_transform(2)) != \
+            callable_fingerprint(_closure_transform(3))
+
+    def test_transform_schema_edit_change_misses(self):
+        base = TransformSpec(_transform_a)
+        removed = TransformSpec(_transform_a, removed_fields=['x'])
+        selected = TransformSpec(_transform_a, selected_fields=['x'])
+        prints = {transform_fingerprint(s) for s in (base, removed,
+                                                     selected)}
+        assert len(prints) == 3
+
+    def test_identical_spec_same_fingerprint(self):
+        a = TransformSpec(_transform_a, removed_fields=['x'])
+        b = TransformSpec(_transform_a, removed_fields=['x'])
+        assert transform_fingerprint(a) == transform_fingerprint(b)
+
+    def test_none_transform_stable(self):
+        assert transform_fingerprint(None) == 'none'
+
+    def test_large_ndarray_closure_change_misses(self):
+        """numpy repr truncates big arrays with '…': a repr-based digest
+        would collide two different lookup tables and serve the OLD
+        transform's cached output — the digest must hash the bytes."""
+        base = np.arange(10_000, dtype=np.int64)
+        changed = base.copy()
+        changed[5_000] += 1
+
+        def closing(table):
+            def inner(df):
+                return table
+            return inner
+        assert callable_fingerprint(closing(base)) != \
+            callable_fingerprint(closing(changed))
+        assert callable_fingerprint(closing(base)) == \
+            callable_fingerprint(closing(base.copy()))
+
+    def test_nested_lambda_fingerprint_is_process_stable(self):
+        """repr() of a code object carries its memory address; the digest
+        must not (checked directly here, and across real processes by
+        test_identical_spec_across_processes_hits)."""
+        fp = callable_fingerprint(_transform_with_inner_lambda)
+        assert '0x' not in fp
+        assert fp == callable_fingerprint(_transform_with_inner_lambda)
+
+    def test_ngram_shape_change_misses(self):
+        from petastorm_tpu.ngram import NGram
+        from tests.test_common import TestSchema
+
+        def gram(length):
+            fields = {i: ['id', 'matrix'] for i in range(length)}
+            return NGram(fields, delta_threshold=10, timestamp_field='id')
+        assert ngram_fingerprint(gram(2)) != ngram_fingerprint(gram(3))
+        assert ngram_fingerprint(gram(2)) == ngram_fingerprint(gram(2))
+        assert ngram_fingerprint(None) == 'none'
+        assert decode_fingerprint(TestSchema, None, gram(2)) != \
+            decode_fingerprint(TestSchema, None, gram(3))
+
+    def test_codec_parameter_change_misses(self):
+        import pyarrow as pa
+        from petastorm_tpu.codecs import CompressedImageCodec, ScalarCodec
+        from petastorm_tpu.unischema import Unischema, UnischemaField
+
+        def schema(quality):
+            return Unischema('S', [
+                UnischemaField('id', np.int32, (), ScalarCodec(pa.int32()),
+                               False),
+                UnischemaField('image', np.uint8, (8, 8, 3),
+                               CompressedImageCodec('jpeg', quality=quality),
+                               False),
+            ])
+        assert schema_fingerprint(schema(80)) != schema_fingerprint(
+            schema(90))
+        assert schema_fingerprint(schema(80)) == schema_fingerprint(
+            schema(80))
+
+    def test_column_set_change_misses(self):
+        from tests.test_common import TestSchema
+        view = TestSchema.create_schema_view(['id', 'matrix'])
+        assert schema_fingerprint(TestSchema) != schema_fingerprint(view)
+
+    def test_identical_spec_across_processes_hits(self):
+        """The fleet contract: two processes importing the same transform
+        derive the SAME key (code-byte hashing is deterministic), so a
+        shared directory serves both."""
+        out = subprocess.run(
+            [sys.executable, '-c',
+             'from tests.test_materialized_cache import '
+             'sample_decode_fingerprint; print(sample_decode_fingerprint())'],
+            cwd=REPO, capture_output=True, text=True, timeout=120,
+            env=dict(os.environ, JAX_PLATFORMS='cpu'))
+        assert out.returncode == 0, out.stderr
+        assert out.stdout.strip() == sample_decode_fingerprint()
+
+
+# -- crash safety ------------------------------------------------------------
+
+_CRASH_WRITER = r'''
+import numpy as np, sys
+from petastorm_tpu.arrow_worker import ColumnBatch
+from petastorm_tpu.materialized_cache import MaterializedRowGroupCache
+cache = MaterializedRowGroupCache(sys.argv[1], 10**9, mem_limit_bytes=0)
+cols = {'x': np.arange(200_000, dtype=np.int64)}
+print('ready', flush=True)
+i = 0
+while True:
+    cache.get('key%d' % i, lambda: ColumnBatch(dict(cols), 1))
+    i += 1
+'''
+
+
+class TestCrashSafety:
+    def test_sigkill_mid_write_never_exposes_partial_entry(self, tmp_path):
+        """A writer SIGKILLed in a tight fill loop leaves at most tmp
+        files behind: every PUBLISHED entry must open and round-trip
+        (os.replace is the commit point), and a fresh cache purges the
+        orphan tmps at init."""
+        cache_dir = str(tmp_path / 'dc')
+        proc = subprocess.Popen(
+            [sys.executable, '-c', _CRASH_WRITER, cache_dir],
+            cwd=REPO, stdout=subprocess.PIPE, text=True,
+            env=dict(os.environ, JAX_PLATFORMS='cpu'))
+        try:
+            assert proc.stdout.readline().strip() == 'ready'
+            time.sleep(0.3)  # let a few dozen writes land
+        finally:
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=30)
+        published = [os.path.join(root, f)
+                     for root, _, files in os.walk(cache_dir)
+                     for f in files if '.tmp.' not in f]
+        assert published, 'writer never published an entry'
+        for path in published:
+            columns, length, _, _ = read_entry(path)  # raises on partial
+            assert length == 1
+            np.testing.assert_array_equal(columns['x'],
+                                          np.arange(200_000,
+                                                    dtype=np.int64))
+        # a fresh cache over the same dir purges dead writers' tmp files
+        MaterializedRowGroupCache(cache_dir, 10 ** 9)
+        leftovers = [f for root, _, files in os.walk(cache_dir)
+                     for f in files if '.tmp.' in f]
+        assert not leftovers, leftovers
+
+
+# -- end-to-end through make_reader -----------------------------------------
+
+
+class TestEndToEnd:
+    def _read_all(self, url, tmp_path, **extra):
+        from petastorm_tpu.reader import make_reader
+        kwargs = dict(reader_pool_type='thread', workers_count=2,
+                      shuffle_row_groups=False, cache_type='decoded',
+                      cache_location=str(tmp_path / 'dc'),
+                      cache_size_limit=10 ** 9)
+        kwargs.update(extra)
+        with make_reader(url, **kwargs) as reader:
+            return {row.id: row for row in reader}
+
+    def test_warm_pass_is_cache_bound_with_zero_decode(
+            self, synthetic_dataset, tmp_path):
+        """The acceptance gate: epoch 2 serves every row identically from
+        the cache, pipeline_report classifies the pass cache-bound, and
+        the hit path records zero decode/transform spans."""
+        rows1 = self._read_all(synthetic_dataset.url, tmp_path)
+        registry = T.get_registry()
+        assert registry.counter_value(
+            'petastorm_tpu_decoded_cache_misses_total') > 0
+        mid = registry.snapshot()
+        rows2 = self._read_all(synthetic_dataset.url, tmp_path)
+        assert set(rows1) == set(rows2) and len(rows1) == 100
+        for i in list(rows1)[:10]:
+            np.testing.assert_array_equal(rows1[i].matrix, rows2[i].matrix)
+            np.testing.assert_array_equal(rows1[i].image_png,
+                                          rows2[i].image_png)
+            assert rows1[i].decimal == rows2[i].decimal
+        report = T.pipeline_report(baseline=mid)
+        cache = report['decoded_cache']
+        assert cache['verdict'] == 'cache-bound'
+        assert cache['hit_rate'] == 1.0
+        assert cache['mmap_reads'] > 0
+
+        def warm_calls(stage):
+            key = 'petastorm_tpu_stage_calls_total{stage="%s"}' % stage
+            return registry.counter_value(
+                'petastorm_tpu_stage_calls_total',
+                stage=stage) - mid['counters'].get(key, 0)
+        assert warm_calls('decode') == 0
+        assert warm_calls('io') == 0
+        assert warm_calls('cache_hit_read') > 0
+
+    def test_transform_spec_output_is_cached(self, synthetic_dataset,
+                                             tmp_path):
+        """Unlike the raw pickle cache (which bypasses transform readers),
+        the decoded tier caches POST-transform batches."""
+        spec = TransformSpec(_transform_a, removed_fields=['matrix_string'])
+        rows1 = self._read_all(synthetic_dataset.url, tmp_path,
+                               transform_spec=spec)
+        mid = T.get_registry().snapshot()
+        rows2 = self._read_all(synthetic_dataset.url, tmp_path,
+                               transform_spec=spec)
+        assert len(rows1) == len(rows2) == 100
+        assert 'matrix_string' not in rows2[0]._fields
+        section = T.decoded_cache_section(baseline=mid)
+        assert section['hit_rate'] == 1.0
+
+    def test_uncacheable_transform_bypasses_decoded_cache(
+            self, synthetic_dataset, tmp_path):
+        """TransformSpec(cacheable=False) marks a stochastic transform:
+        caching it would replay epoch 1's randomness, so those readers
+        decode fresh every pass and never touch the decoded cache."""
+        spec = TransformSpec(_transform_a, cacheable=False)
+        self._read_all(synthetic_dataset.url, tmp_path,
+                       transform_spec=spec)
+        self._read_all(synthetic_dataset.url, tmp_path,
+                       transform_spec=spec)
+        registry = T.get_registry()
+        assert registry.counter_value(
+            'petastorm_tpu_decoded_cache_hits_total') == 0
+        assert registry.counter_value(
+            'petastorm_tpu_decoded_cache_misses_total') == 0
+
+    def test_changed_transform_never_serves_stale_rows(
+            self, synthetic_dataset, tmp_path):
+        self._read_all(synthetic_dataset.url, tmp_path,
+                       transform_spec=TransformSpec(_transform_a))
+        mid = T.get_registry().snapshot()
+        self._read_all(synthetic_dataset.url, tmp_path,
+                       transform_spec=TransformSpec(
+                           _transform_a, removed_fields=['matrix_string']))
+        section = T.decoded_cache_section(baseline=mid)
+        assert section['hits'] == 0  # every read missed: new fingerprint
+
+    def test_env_knob_never_breaks_predicate_readers(self,
+                                                     synthetic_dataset,
+                                                     tmp_path,
+                                                     monkeypatch):
+        """A fleet-wide PETASTORM_TPU_DECODED_CACHE=1 must not turn a
+        previously-working predicate reader into the cache+predicate
+        RuntimeError: arbitrary predicates simply stay uncached."""
+        from petastorm_tpu.predicates import in_lambda
+        from petastorm_tpu.reader import make_reader
+        monkeypatch.setenv('PETASTORM_TPU_DECODED_CACHE', '1')
+        monkeypatch.setenv('PETASTORM_TPU_DECODED_CACHE_DIR',
+                           str(tmp_path / 'fleet'))
+        with make_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                         predicate=in_lambda(['id'],
+                                             lambda v: v['id'] < 5),
+                         num_epochs=1) as reader:
+            rows = list(reader)
+        assert rows and all(r.id < 5 for r in rows)
+        assert T.get_registry().counter_value(
+            'petastorm_tpu_decoded_cache_misses_total') == 0
+
+    def test_env_knob_never_caches_undeclared_transforms(
+            self, synthetic_dataset, tmp_path, monkeypatch):
+        """The fleet knob must not freeze a transform whose determinism
+        nobody declared (it could be random augmentation): under the
+        IMPLICIT upgrade only TransformSpec(cacheable=True) participates;
+        an explicit cache_type='decoded' keeps the default-cacheable
+        behavior (the user configured the cache deliberately)."""
+        from petastorm_tpu.reader import make_reader
+        monkeypatch.setenv('PETASTORM_TPU_DECODED_CACHE', '1')
+        monkeypatch.setenv('PETASTORM_TPU_DECODED_CACHE_DIR',
+                           str(tmp_path / 'fleet'))
+        registry = T.get_registry()
+
+        def misses():
+            return registry.counter_value(
+                'petastorm_tpu_decoded_cache_misses_total')
+
+        undeclared = TransformSpec(_transform_a)
+        with make_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                         transform_spec=undeclared, num_epochs=1) as r:
+            next(r)
+        assert misses() == 0  # bypassed: determinism never declared
+        declared = TransformSpec(_transform_a, cacheable=True)
+        with make_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                         transform_spec=declared, num_epochs=1) as r:
+            next(r)
+        assert misses() > 0  # declared deterministic: cached
+
+    def test_env_knob_upgrades_default_readers(self, synthetic_dataset,
+                                               tmp_path, monkeypatch):
+        monkeypatch.setenv('PETASTORM_TPU_DECODED_CACHE', '1')
+        monkeypatch.setenv('PETASTORM_TPU_DECODED_CACHE_DIR',
+                           str(tmp_path / 'fleet'))
+        from petastorm_tpu.reader import make_reader
+        with make_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                         num_epochs=1) as reader:
+            next(reader)
+        assert T.get_registry().counter_value(
+            'petastorm_tpu_decoded_cache_misses_total') > 0
+        assert os.path.isdir(str(tmp_path / 'fleet'))
+
+
+class TestServiceReroot:
+    def test_worker_server_reroots_cache_to_host_dir(self, tmp_path,
+                                                     monkeypatch):
+        """A standing fleet's host-local override: the job spec arrives
+        with the CLIENT's directory; with the knob set the server re-roots
+        it so every job shares this host's tier."""
+        from petastorm_tpu.service.worker_server import \
+            _reroot_decoded_cache
+        cache = MaterializedRowGroupCache(str(tmp_path / 'client'), 10 ** 8)
+        host_dir = str(tmp_path / 'host')
+        monkeypatch.setenv('PETASTORM_TPU_DECODED_CACHE_DIR', host_dir)
+        _reroot_decoded_cache({'cache': cache})
+        assert cache.path == host_dir
+        assert os.path.isdir(host_dir)
+
+    def test_worker_server_keeps_spec_dir_without_knob(self, tmp_path,
+                                                       monkeypatch):
+        from petastorm_tpu.service.worker_server import \
+            _reroot_decoded_cache
+        monkeypatch.delenv('PETASTORM_TPU_DECODED_CACHE_DIR',
+                           raising=False)
+        cache = MaterializedRowGroupCache(str(tmp_path / 'client'), 10 ** 8)
+        _reroot_decoded_cache({'cache': cache})
+        assert cache.path == str(tmp_path / 'client')
+
+
+@pytest.mark.perf
+def test_warm_epoch_reads_at_least_as_fast_as_cold(synthetic_dataset,
+                                                   tmp_path):
+    """Perf guard (loose, order-of-magnitude — see pytest.ini): with the
+    decoded cache on, the warm epoch must not read slower than the cold
+    epoch that paid io+decode. The 0.8 factor absorbs shared-box noise;
+    a real regression (warm path re-decoding) shows up as ~cold/2."""
+    from petastorm_tpu.reader import make_batch_reader
+
+    def one_pass():
+        with make_batch_reader(synthetic_dataset.url,
+                               reader_pool_type='thread', workers_count=2,
+                               shuffle_row_groups=False,
+                               cache_type='decoded',
+                               cache_location=str(tmp_path / 'dc'),
+                               cache_size_limit=10 ** 9) as reader:
+            seen = 0
+            start = time.monotonic()
+            for batch in reader:
+                seen += len(batch.id)
+            return seen / (time.monotonic() - start)
+
+    cold = one_pass()
+    warm = max(one_pass() for _ in range(3))
+    assert warm >= 0.8 * cold, (cold, warm)
