@@ -13,10 +13,13 @@ test:
 test-fast:
 	$(PYTHON) -m pytest tests/ -q -m "not slow"
 
-# pipecheck: AST-level contract & concurrency analyzer (docs/development.md);
-# stdlib-only, so it runs on the bare TPU image where flake8/mypy don't
+# pipecheck: AST-level contract & concurrency analyzer (docs/development.md),
+# including the pipesan buffer-ownership and whole-program lock-order passes;
+# stdlib-only, so it runs on the bare TPU image where flake8/mypy don't.
+# Land a rule strict-on-new-code before its backlog hits zero:
+#   make analyze ANALYZE_ARGS="--baseline known.jsonl --fail-on-new"
 analyze:
-	$(PYTHON) -m petastorm_tpu.analysis petastorm_tpu
+	$(PYTHON) -m petastorm_tpu.analysis petastorm_tpu $(ANALYZE_ARGS)
 
 lint: analyze
 	$(PYTHON) -m flake8 petastorm_tpu tests examples

@@ -16,15 +16,22 @@ Library API::
     from petastorm_tpu.analysis import analyze_paths, analyze_source
     findings = analyze_paths(['petastorm_tpu'])      # [] on a clean tree
 
-Five composable passes (six rules) — see
+Six composable passes (eight rules) — see
 :data:`~petastorm_tpu.analysis.core.RULE_DESCRIPTIONS` and the rule
-reference table in docs/development.md. Findings are structured
-``(path, line, rule, message)``; a ``# pipecheck: disable=<rule>``
-comment on the offending line suppresses a finding (use sparingly, with
-a justification comment). The canonical name sets live in
+reference table in docs/development.md. Passes are per-module
+(``run(module)``), whole-program (``run_project(modules)`` — the pipesan
+``buffer-escape``/``buffer-write`` ownership pass and the call-graph half
+of ``lock-order``, both over :mod:`~petastorm_tpu.analysis.callgraph`),
+or both. Findings are structured ``(path, line, rule, message)``; a
+``# pipecheck: disable=<rule>`` comment on the offending line suppresses
+a finding (use sparingly, with a justification comment), and a
+``# pipesan: owns`` annotation records an intentional buffer-ownership
+transfer. The canonical name sets and borrow-source registries live in
 :mod:`~petastorm_tpu.analysis.contracts`, imported by the telemetry
-subsystem at runtime and by this checker statically — one source of
-truth, enforced from both sides.
+subsystem and the runtime sanitizer (:mod:`petastorm_tpu.sanitizer`) at
+runtime and by this checker statically — one source of truth, enforced
+from both sides. The CLI's ``--baseline``/``--fail-on-new`` let a new
+rule gate strictly on new code before its backlog hits zero.
 
 Stdlib-only by design: the analyzer must run on a bare TPU image (no
 flake8/mypy there), inside ``tests/test_analysis.py`` in tier-1, and in

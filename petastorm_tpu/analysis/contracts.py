@@ -80,6 +80,10 @@ METRIC_NAMES = frozenset([
     'petastorm_tpu_service_workers_registered',
     'petastorm_tpu_service_items_pending',
     'petastorm_tpu_service_items_assigned',
+    # pipesan runtime zero-copy sanitizer (sanitizer.py)
+    'petastorm_tpu_sanitizer_violations_total',
+    'petastorm_tpu_sanitizer_views_guarded_total',
+    'petastorm_tpu_sanitizer_canary_checks_total',
 ])
 
 #: prefix of every operator-facing environment knob
@@ -109,9 +113,55 @@ KNOWN_KNOBS = frozenset([
     'PETASTORM_TPU_DECODED_CACHE_DIR',
     'PETASTORM_TPU_DECODED_CACHE_MEM_MB',
     'PETASTORM_TPU_DECODED_CACHE_DISK_MB',
+    'PETASTORM_TPU_SANITIZE',
 ])
 
 #: the one knob-truthiness rule for "disable"/"enable" env spellings —
 #: shared by every PETASTORM_TPU_* switch so spellings cannot drift
 DISABLED_VALUES = ('0', 'false', 'off', 'no')
 ENABLED_VALUES = ('1', 'true', 'on', 'yes')
+
+# -- pipesan buffer-ownership contracts ---------------------------------------
+#
+# The zero-copy fast paths hand out BORROWED views: arrays whose memory is
+# owned by someone else with a shorter (or recycled) lifetime — ZMQ receive
+# buffers, the decoded-cache mmap, staging-arena slots. The
+# ``buffer-escape``/``buffer-write`` analysis pass
+# (:mod:`petastorm_tpu.analysis.pass_buffers`) taints values born from the
+# sources registered here and flags them escaping their owning scope or
+# being written through; the runtime sanitizer (``PETASTORM_TPU_SANITIZE=1``,
+# :mod:`petastorm_tpu.sanitizer`) guards the same three boundaries
+# dynamically. One registry, checked from both sides.
+
+#: terminal call names whose RESULT is a borrowed buffer view.
+#: ``frombuffer`` over a *call expression* (``np.frombuffer(bytes(...))``,
+#: ``np.frombuffer(x.encode())``) is exempt: the argument is a fresh
+#: anonymous temporary whose only reference becomes the array's ``.base``,
+#: so the view owns its memory by construction.
+BORROW_CALLS = frozenset([
+    'frombuffer',           # numpy view over someone else's buffer
+    'read_entry',           # decoded-cache columns alias the entry's mmap
+    '_binary_cell_views',   # cells alias the arrow column's data buffer
+])
+
+#: call names whose result is borrowed only when the given keyword is
+#: passed with the given value. ``astype`` over a *call expression*
+#: receiver is exempt for the same fresh-temporary reason as frombuffer.
+BORROW_CALL_KWARGS = {
+    'recv_multipart': ('copy', False),   # frames view ZMQ receive buffers
+    'astype': ('copy', False),           # may alias the source array
+}
+
+#: dotted expressions denoting staging-arena slot memory — recycled after
+#: the slot's next transfer retires, so any view over them is borrowed
+BORROW_ATTRS = frozenset([
+    'slot.buffers',
+])
+
+#: the ownership-transfer annotation: ``# pipesan: owns`` on (any line of)
+#: a flagged statement records that the transfer is intentional and the
+#: receiver owns (or knowingly borrows) the memory — always pair it with a
+#: justification comment. On a ``return`` it asserts the CALLER owns the
+#: result, so taint does NOT propagate — a function whose callers
+#: genuinely borrow its result belongs in :data:`BORROW_CALLS` instead.
+OWNS_ANNOTATION_RE = r'pipesan:\s*owns'

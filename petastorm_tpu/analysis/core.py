@@ -14,13 +14,17 @@ discoverable above the first analyzed path.
 import os
 
 from petastorm_tpu.analysis import (
-    pass_env_knobs, pass_locks, pass_names, pass_payloads, pass_threads,
+    callgraph, pass_buffers, pass_env_knobs, pass_locks, pass_names,
+    pass_payloads, pass_threads,
 )
 from petastorm_tpu.analysis.findings import SourceModule
 
-#: the composable passes, in report order
+#: the composable passes, in report order. A pass exposes ``run(module)``
+#: (per-module), ``run_project(modules)`` (whole-program, over every
+#: parsed module at once — the pipesan buffer-ownership pass and the
+#: whole-program half of lock-order), or both.
 PASSES = (pass_env_knobs, pass_names, pass_locks, pass_threads,
-          pass_payloads)
+          pass_payloads, pass_buffers)
 
 #: every rule id a pass can emit (suppression tokens)
 ALL_RULES = tuple(rule for p in PASSES for rule in p.RULES)
@@ -48,6 +52,16 @@ RULE_DESCRIPTIONS = {
         'no lambdas / locally-defined functions or classes handed to '
         'process-boundary calls (ventilate, dill/pickle dumps, '
         'exec_in_new_process, send_pyobj)',
+    'buffer-escape':
+        'a borrowed zero-copy view (np.frombuffer, recv_multipart('
+        'copy=False) frames, read_entry columns, staging slot views, '
+        'astype(copy=False)) must not escape its owning scope — object/'
+        'module state, queues, closures, returns — without a '
+        "'# pipesan: owns' transfer annotation",
+    'buffer-write':
+        'no in-place write through a borrowed zero-copy view '
+        '(view[...] =, +=, np.copyto(dst=view)): it corrupts the shared '
+        'backing memory (mmap, wire buffer, arena slot)',
 }
 
 
@@ -90,23 +104,51 @@ def _find_docs(start):
 
 
 def run_passes(module, select=None):
-    """All (selected) passes over one :class:`SourceModule`."""
+    """All (selected) per-module passes over one :class:`SourceModule`."""
     findings = []
     for p in PASSES:
         if select is not None and not (set(p.RULES) & select):
             continue
-        found = p.run(module)
+        run = getattr(p, 'run', None)
+        if run is None:
+            continue  # project-level-only pass (pass_buffers)
+        found = run(module)
         if select is not None:
             found = [f for f in found if f.rule in select]
         findings.extend(found)
     return findings
 
 
+def run_project_passes(modules, select=None):
+    """Whole-program passes over every parsed module at once. The passes
+    share one memoized call graph; it is dropped when the run ends so a
+    long-lived process does not pin the parse state."""
+    findings = []
+    try:
+        for p in PASSES:
+            project_rules = getattr(p, 'PROJECT_RULES', p.RULES)
+            if select is not None and not (set(project_rules) & select):
+                continue
+            run_project = getattr(p, 'run_project', None)
+            if run_project is None:
+                continue
+            found = run_project(modules)
+            if select is not None:
+                found = [f for f in found if f.rule in select]
+            findings.extend(found)
+    finally:
+        callgraph.clear_graph_cache()
+    return findings
+
+
 def analyze_source(source, path='<string>', select=None):
-    """Analyze one in-memory snippet (fixture tests drive rules here)."""
+    """Analyze one in-memory snippet (fixture tests drive rules here).
+    Whole-program passes run over the single module."""
     select = set(select) if select else None
     module = SourceModule(path, source=source)
-    return sorted(run_passes(module, select), key=lambda f: f.sort_key())
+    findings = run_passes(module, select) \
+        + run_project_passes([module], select)
+    return sorted(findings, key=lambda f: f.sort_key())
 
 
 def analyze_paths(paths, select=None, root=None, check_docs=True):
@@ -125,6 +167,7 @@ def analyze_paths(paths, select=None, root=None, check_docs=True):
             raise FileNotFoundError('analysis path does not exist: %r'
                                     % (path,))
     findings = []
+    modules = []
     any_path = None
     for path in iter_python_files(paths):
         any_path = any_path or path
@@ -133,10 +176,12 @@ def analyze_paths(paths, select=None, root=None, check_docs=True):
         except ValueError:  # different drive (windows)
             rel = path
         module = SourceModule(path, relpath=rel)
+        modules.append(module)
         findings.extend(run_passes(module, select))
     if any_path is None:
         raise FileNotFoundError('no Python files found under: %s'
                                 % ', '.join(map(repr, paths)))
+    findings.extend(run_project_passes(modules, select))
     if check_docs and any_path is not None \
             and (select is None or 'env-knob' in select):
         docs = _find_docs(any_path)

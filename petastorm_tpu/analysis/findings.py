@@ -11,9 +11,16 @@ import io
 import re
 import tokenize
 
+from petastorm_tpu.analysis.contracts import OWNS_ANNOTATION_RE
+
 #: suppression comment syntax: ``# pipecheck: disable=rule[,rule...]``
 #: on any line the finding's node spans (``all`` silences every rule).
 _SUPPRESS_RE = re.compile(r'pipecheck:\s*disable=([A-Za-z0-9_,\- ]+)')
+
+#: ownership-transfer annotation: ``# pipesan: owns`` (contracts.py is the
+#: one owner of the token spelling) — silences buffer-ownership findings
+#: on the lines it covers while recording an explicit, greppable transfer
+_OWNS_RE = re.compile(OWNS_ANNOTATION_RE)
 
 
 class Finding:
@@ -43,23 +50,48 @@ class Finding:
         return (self.path, self.line, self.rule, self.message)
 
 
-def parse_suppressions(source):
-    """``{line: set(rule_ids)}`` of every ``pipecheck: disable=`` comment
-    (comments only — a disable token inside a string literal is inert)."""
-    out = {}
+def _scan_comments(source):
+    """``[(lineno, text)]`` of every comment token — ONE tokenizer pass
+    per module, shared by the suppression and owns-annotation scans."""
+    out = []
     try:
         tokens = tokenize.generate_tokens(io.StringIO(source).readline)
         for tok in tokens:
-            if tok.type != tokenize.COMMENT:
-                continue
-            match = _SUPPRESS_RE.search(tok.string)
-            if match is None:
-                continue
-            rules = {r.strip() for r in match.group(1).split(',') if r.strip()}
-            out.setdefault(tok.start[0], set()).update(rules)
+            if tok.type == tokenize.COMMENT:
+                out.append((tok.start[0], tok.string))
     except tokenize.TokenError:
-        pass  # findings still apply; only suppressions are best-effort
+        pass
     return out
+
+
+def _suppressions_from(comments):
+    """``{line: set(rule_ids)}`` of every ``pipecheck: disable=`` comment
+    (comments only — a disable token inside a string literal is inert)."""
+    out = {}
+    for line, text in comments:
+        match = _SUPPRESS_RE.search(text)
+        if match is None:
+            continue
+        rules = {r.strip() for r in match.group(1).split(',') if r.strip()}
+        out.setdefault(line, set()).update(rules)
+    return out
+
+
+def _owns_from(comments):
+    """Line numbers carrying a ``# pipesan: owns`` ownership-transfer
+    annotation."""
+    return {line for line, text in comments if _OWNS_RE.search(text)}
+
+
+def parse_suppressions(source):
+    """Suppression map from raw source (one-off callers; SourceModule
+    shares a single :func:`_scan_comments` pass for both scans)."""
+    return _suppressions_from(_scan_comments(source))
+
+
+def parse_owns(source):
+    """Owns-annotation lines from raw source (one-off callers)."""
+    return _owns_from(_scan_comments(source))
 
 
 class SourceModule:
@@ -73,7 +105,9 @@ class SourceModule:
         self.relpath = relpath or path
         self.source = source
         self.tree = ast.parse(source, filename=path)
-        self.suppressions = parse_suppressions(source)
+        comments = _scan_comments(source)
+        self.suppressions = _suppressions_from(comments)
+        self.owns_lines = _owns_from(comments)
 
     def suppressed(self, rule, node_or_line):
         """True when a ``disable=`` comment for ``rule`` (or ``all``) sits
@@ -89,6 +123,19 @@ class SourceModule:
             if rules and (rule in rules or 'all' in rules):
                 return True
         return False
+
+    def owned(self, node_or_line):
+        """True when a ``# pipesan: owns`` annotation sits on any line the
+        node spans — or on the line directly above it (the justified
+        annotation-block style) — the buffer-ownership passes treat the
+        transfer as explicit and emit no finding."""
+        if isinstance(node_or_line, int):
+            lines = (node_or_line - 1, node_or_line)
+        else:
+            start = getattr(node_or_line, 'lineno', 0)
+            end = getattr(node_or_line, 'end_lineno', start) or start
+            lines = range(start - 1, end + 1)
+        return any(line in self.owns_lines for line in lines)
 
     def finding(self, rule, node_or_line, message):
         """A :class:`Finding` anchored at the node, or None when a

@@ -18,10 +18,19 @@ deadlock. Lock identity is the dotted source text (``self._lock``),
 which is the right granularity for the single-class modules this
 package keeps its locks in.
 
-Lexical analysis by design: a lock attribute passed across modules or
-aliased through locals is out of scope (and out of this codebase's
-idiom). Nested ``def``/``lambda`` bodies under a ``with`` are skipped —
-they execute later, not under the lock.
+The per-module half is lexical by design; :func:`run_project` promotes
+the same rule to **whole-program**: using the shared
+:mod:`~petastorm_tpu.analysis.callgraph`, a call made while a lock is
+held inherits every lock the (conservatively resolved) callee can
+eventually acquire — so ``with A: helper()`` where ``helper`` (in any
+module) takes ``B`` records the nesting ``A → B``, and an opposite-order
+chain anywhere in the project is an inversion finding even though no
+single module ever shows both orders. Lock names are globalized
+(``module.Class._lock``) so cross-module nestings compare equal; pairs
+the lexical per-module scan already reports are not re-reported.
+
+Nested ``def``/``lambda`` bodies under a ``with`` are skipped in the
+lexical scan — they execute later, not under the lock.
 """
 
 import ast
@@ -31,6 +40,9 @@ from petastorm_tpu.analysis.findings import call_name, dotted_text
 BLOCK_RULE = 'blocking-under-lock'
 ORDER_RULE = 'lock-order'
 RULES = (BLOCK_RULE, ORDER_RULE)
+#: the subset run_project can emit — ``--select blocking-under-lock``
+#: must not pay for call-graph construction it cannot benefit from
+PROJECT_RULES = (ORDER_RULE,)
 
 #: ZMQ socket operations that block without an explicit NOBLOCK/DONTWAIT
 _ZMQ_OPS = frozenset(['recv', 'recv_multipart', 'recv_pyobj', 'recv_string',
@@ -246,3 +258,68 @@ def run(module):
     scanner = _Scanner(module)
     scanner.scan_body(module.tree.body, ())
     return scanner.findings
+
+
+def run_project(modules):
+    """Whole-program ``lock-order``: inversion pairs only visible through
+    the call graph (cross-function / cross-module). Same-module pairs the
+    lexical :func:`run` scan reports are excluded here."""
+    from petastorm_tpu.analysis.callgraph import build_graph
+    graph = build_graph(modules)
+    eventually = graph.eventually_acquires()
+    # (outer, inner) -> (SourceModule, line, kind); first witness wins
+    pairs = {}
+    # per-module lexical pair sets: an inversion whose BOTH orders are
+    # lexical within one module is the per-module run() scan's report,
+    # regardless of which witness kind got recorded into `pairs` first
+    lexical_by_module = {}
+    for info in graph.functions.values():
+        for outer, inner, line in info.lexical_pairs:
+            pairs.setdefault((outer, inner), (info.module, line, 'lexical'))
+            lexical_by_module.setdefault(id(info.module), set()).add(
+                (outer, inner))
+        for call, line, held in info.calls:
+            if not held:
+                continue
+            target = graph.resolve(info.modname, info.class_name, call)
+            if target is None:
+                continue
+            for inner in eventually.get(target, ()):
+                for outer in held:
+                    if outer != inner:
+                        pairs.setdefault((outer, inner),
+                                         (info.module, line, 'call'))
+    findings = []
+    reported = set()
+    for (outer, inner), witness in sorted(pairs.items()):
+        inverse = pairs.get((inner, outer))
+        if inverse is None:
+            continue
+        key = frozenset((outer, inner))
+        if key in reported:
+            continue
+        reported.add(key)
+        if any((outer, inner) in s and (inner, outer) in s
+               for s in lexical_by_module.values()):
+            continue  # the per-module lexical scan owns this report
+        module, line, kind = witness
+        imodule, iline, ikind = inverse
+        if kind != 'call' and ikind == 'call':
+            # anchor at the witness only the call graph could see
+            module, line, kind = imodule, iline, ikind
+            imodule = witness[0]
+            outer, inner = inner, outer
+        # the inverse witness is named by PATH only: baselines match on
+        # (path, rule, message) ignoring line numbers, and embedding the
+        # witness's line here would resurrect baselined findings whenever
+        # an unrelated edit shifts it
+        how = ('through this call chain' if kind == 'call'
+               else 'in this nesting')
+        finding = module.finding(
+            ORDER_RULE, line,
+            'whole-program lock order: %s is taken before %s %s, but the '
+            'opposite order holds in %s — lock-inversion deadlock hazard'
+            % (outer, inner, how, imodule.relpath))
+        if finding is not None:
+            findings.append(finding)
+    return findings

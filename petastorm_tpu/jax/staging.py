@@ -56,12 +56,21 @@ Telemetry (docs/telemetry.md): per-batch ``stage_fill`` /
 ``PETASTORM_TPU_TRACE=1``, so Perfetto dumps show fill/transfer/consume
 overlap) and the ``petastorm_tpu_h2d_bytes_total`` counter;
 ``pipeline_report`` derives ``h2d_overlap_share`` from the three stages.
+
+Sanitizer (docs/troubleshoot.md): ``PETASTORM_TPU_SANITIZE=1`` arms the
+pipesan runtime guards on the ring path — slot slabs get poisoned red
+zones verified before every refill, and a weakref census of each
+dispatch's outbound views aborts a recycle (fresh buffers, escaped
+holder keeps the old memory) when a consumer held a view past the slot's
+documented lifetime. Resolved once at engine construction: the unarmed
+hot path pays nothing.
 """
 
 import logging
 
 import numpy as np
 
+from petastorm_tpu import sanitizer
 from petastorm_tpu.telemetry import (
     get_registry, knobs, metrics_disabled, register_refresh, span,
 )
@@ -153,11 +162,12 @@ class _Slot:
     """One ring slot: preallocated per-field host buffers plus the device
     arrays of the transfer most recently dispatched from it."""
 
-    __slots__ = ('buffers', 'in_flight')
+    __slots__ = ('buffers', 'in_flight', 'census')
 
-    def __init__(self, buffers):
+    def __init__(self, buffers, census=None):
         self.buffers = buffers      # {field: ndarray(batch_size, *shape)}
         self.in_flight = None       # leaves of the last dispatch
+        self.census = census        # sanitizer.ViewCensus when armed
 
     def await_retired(self):
         """Block until the transfer previously dispatched from this slot
@@ -210,6 +220,10 @@ class StagingEngine:
         # startup-only (steady growth = the arena is not being reused)
         self.slabs_allocated = 0
         self.batches_staged = 0
+        # pipesan: resolved ONCE here so the unarmed per-batch path costs
+        # a single attribute read, not a knob parse
+        self._sanitize = sanitizer.sanitize_enabled()
+        self.slabs_quarantined = 0
 
     # -- arena ---------------------------------------------------------------
 
@@ -242,20 +256,30 @@ class StagingEngine:
             (name, arr.shape[1:], dtype_map[name].str)
             for name, arr in sorted(columns.items()))
 
-    def _new_buffers(self, columns, dtype_map, with_mask):
+    def _new_buffers(self, columns, dtype_map, with_mask, guarded=None):
+        # armed sanitizer: slabs carry poisoned red zones on both sides
+        # of the visible array, verified before every refill. Only ring
+        # slots are worth guarding (``guarded=False`` on the fresh-
+        # assembly path): fresh buffers are never recycled, so their red
+        # zones would never be checked.
+        if guarded is None:
+            guarded = self._sanitize
+        alloc = sanitizer.allocate_guarded if guarded else np.empty
         buffers = {
-            name: np.empty((self._batch_size,) + arr.shape[1:],
-                           dtype_map[name])
+            name: alloc((self._batch_size,) + arr.shape[1:],
+                        dtype_map[name])
             for name, arr in columns.items()}
         if with_mask:
-            buffers[MASK_FIELD] = np.empty(self._batch_size, bool)
+            buffers[MASK_FIELD] = alloc((self._batch_size,), bool)
         return buffers
 
     def _ring_for(self, columns, dtype_map, with_mask):
         sig = self._signature(columns, dtype_map, with_mask)
         ring = self._rings.get(sig)
         if ring is None:
-            slots = [_Slot(self._new_buffers(columns, dtype_map, with_mask))
+            slots = [_Slot(self._new_buffers(columns, dtype_map, with_mask),
+                           census=(sanitizer.ViewCensus()
+                                   if self._sanitize else None))
                      for _ in range(self._num_slots)]
             self.slabs_allocated += len(slots)
             ring = self._rings[sig] = _Ring(slots)
@@ -309,7 +333,8 @@ class StagingEngine:
         them into the device handle — never reused, so aliasing is
         harmless by construction."""
         with span('stage_fill'):
-            buffers = self._new_buffers(parts[0], dtype_map, with_mask)
+            buffers = self._new_buffers(parts[0], dtype_map, with_mask,
+                                        guarded=False)
             views = self._fill(buffers, parts, n, with_mask)
         with span('h2d_dispatch'):
             device_batch = self._put_fn(views)
@@ -325,8 +350,15 @@ class StagingEngine:
             # gate recycling on the slot's PREVIOUS handoff — with ≥2
             # slots this is never the batch just returned to the consumer
             slot.await_retired()
+        if self._sanitize:
+            self._sanitize_recycle(slot, parts, dtype_map, with_mask)
         with span('stage_fill'):
             views = self._fill(slot.buffers, parts, n, with_mask)
+        if self._sanitize:
+            # hand out fresh VIEW OBJECTS over the slot memory (still
+            # zero-copy) so the census can tell a consumer-held view
+            # from the slot's own reference to its buffers
+            views = {name: v[:] for name, v in views.items()}
         with span('h2d_dispatch'):
             device_batch = self._put_fn(views)
         self._account(views.values())
@@ -338,7 +370,37 @@ class StagingEngine:
             self._rings = {}
         else:
             slot.in_flight = list(device_batch.values())
+            if self._sanitize:
+                # census the views just handed to the transfer: any that
+                # still resolve when THIS slot comes up for recycling
+                # were kept past the documented lifetime
+                slot.census.register(views.values())
         return device_batch
+
+    def _sanitize_recycle(self, slot, parts, dtype_map, with_mask):
+        """pipesan recycle gate (armed mode only): abort the recycle when
+        a previously-dispatched view is still alive (the escaped holder
+        keeps the old slab — quarantine, no corruption), and verify the
+        red zones before letting the fill overwrite the slab."""
+        escaped = slot.census.escaped() if slot.census is not None else 0
+        if escaped:
+            sanitizer.record_violation(
+                'staging-use-after-recycle',
+                '%d staged view(s) still alive when their slot came up '
+                'for recycling; recycle aborted — slot re-backed with '
+                'fresh buffers' % escaped)
+            slot.buffers = self._new_buffers(parts[0], dtype_map,
+                                             with_mask)
+            slot.census = sanitizer.ViewCensus()
+            self.slabs_quarantined += 1
+            return
+        for name, buf in slot.buffers.items():
+            if not sanitizer.check_canaries(buf):
+                sanitizer.record_violation(
+                    'staging-canary-trampled',
+                    'red zone around staging slot field %r overwritten '
+                    'while the slot was out — an escaped view wrote past '
+                    'its bounds' % name)
 
     def _fill(self, buffers, parts, n, with_mask):
         """Cast/pad/mask-assemble ``parts`` into ``buffers``; returns the

@@ -39,6 +39,7 @@ from collections import OrderedDict
 
 import numpy as np
 
+from petastorm_tpu import sanitizer
 from petastorm_tpu.cache import (
     CacheBase, attach_scan, evict_lru, publish_entry,
 )
@@ -272,6 +273,8 @@ def _column_payload(col):
                 {b'kind': b'raw', b'dtype': col.dtype.str.encode(),
                  b'shape': json.dumps(list(col.shape)).encode()})
     payload = pickle.dumps(col, protocol=pickle.HIGHEST_PROTOCOL)
+    # The view's .base holds the freshly pickled bytes (this frame's
+    # only reference), so the caller owns the memory.  # pipesan: owns
     return ('pickle', np.frombuffer(payload, dtype=np.uint8),
             {b'kind': b'pickle'})
 
@@ -304,11 +307,17 @@ def write_entry(path, columns, length):
 def read_entry(path):
     """``(columns, length, mmap_columns, copy_columns)`` from an entry.
 
-    Raw columns come back as read-only ``np.frombuffer`` views whose base
-    chain holds the IPC file's memory-map buffer (zero-copy; the mmap
-    stays alive exactly as long as any returned array). Pickle columns
-    are materialized (copied). Raises on a malformed/truncated file —
-    callers treat that as a miss and re-fill."""
+    EVERY returned column arrives ``writeable=False``: raw columns are
+    ``np.frombuffer`` views whose base chain holds the IPC file's
+    read-only memory-map buffer (zero-copy; the mmap stays alive exactly
+    as long as any returned array), and pickle-fallback columns — fresh
+    allocations that would otherwise come back writable — are explicitly
+    frozen, because the same array objects are shared through the memory
+    tier with every later hit: a consumer's in-place write must raise
+    (``ValueError: assignment destination is read-only``, see
+    docs/troubleshoot.md) instead of silently corrupting the shared
+    entry. Raises on a malformed/truncated file — callers treat that as
+    a miss and re-fill."""
     import pyarrow as pa
     source = pa.memory_map(path, 'r')
     reader = pa.ipc.open_file(source)
@@ -327,11 +336,15 @@ def read_entry(path):
             dtype = np.dtype(fmeta[b'dtype'].decode())
             shape = tuple(json.loads(fmeta[b'shape'].decode()))
             count = int(np.prod(shape, dtype=np.int64)) if shape else 1
+            # read-only by construction: the mmap buffer is immutable
             columns[field.name] = np.frombuffer(
                 cell.buffers()[2], dtype=dtype, count=count).reshape(shape)
             mmap_columns += 1
         else:
-            columns[field.name] = pickle.loads(cell[0].as_py())
+            col = pickle.loads(cell[0].as_py())
+            if isinstance(col, np.ndarray):
+                col.flags.writeable = False
+            columns[field.name] = col
             copy_columns += 1
     return columns, length, mmap_columns, copy_columns
 
@@ -443,6 +456,14 @@ class MaterializedRowGroupCache(CacheBase):
         nbytes = self._columns_nbytes(columns)
         if nbytes > self._mem_limit:
             return  # a single oversized batch would just thrash the tier
+        if sanitizer.sanitize_enabled():
+            # the tier SHARES these array objects with every later hit
+            # (and, on the fill path, with the batch just returned to the
+            # consumer) — armed mode freezes them so an in-place write
+            # raises at the write site instead of corrupting the entry.
+            # AFTER the oversized bail-out: a batch the tier never stores
+            # stays the consumer's own writable memory.
+            sanitizer.guard_payload(columns)
         with self._lock:
             old = self._mem.pop(key, None)
             if old is not None:

@@ -1,0 +1,254 @@
+"""pipesan runtime half: ASan-style guards for the zero-copy boundaries.
+
+The static ``buffer-escape``/``buffer-write`` pass
+(:mod:`petastorm_tpu.analysis.pass_buffers`) proves the *source* honors
+the borrow contracts registered in ``analysis/contracts.py``; this module
+catches what static analysis can't — a consumer (user transform, training
+loop, third-party callback) mutating or outliving a borrowed view at
+runtime. ``PETASTORM_TPU_SANITIZE=1`` (docs/env_knobs.md) arms three
+guards at the three zero-copy boundaries:
+
+* **Staging arena** (``jax/staging.py``): slot slabs are allocated with
+  poisoned *red zones* (canary bytes) before and after the visible
+  array — verified before every refill and re-poisoned on recycle, so a
+  wild write through an escaped view is detected at the next cycle
+  instead of silently corrupting a neighbor batch. A **weakref census**
+  of the views handed to the dispatch records every consumer that still
+  holds one when the slot comes up for recycling; the recycle is
+  *aborted* (the slot gets fresh buffers, the escaped holder keeps the
+  old memory — quarantine, like ASan's) and the escape is reported.
+* **Decoded cache** (``materialized_cache.py``): memory-tier columns are
+  forced ``writeable=False`` before they are shared, so an in-place
+  consumer write raises ``ValueError: assignment destination is
+  read-only`` at the write site instead of corrupting every later hit
+  (disk-tier mmap columns are born read-only regardless of the knob).
+* **ZMQ results channel** (``workers/process_pool.py`` +
+  ``serializers.py``): receive frames are exposed as read-only
+  memoryviews and the reconstructed out-of-band arrays are forced
+  ``writeable=False`` — a consumer mutating a wire-buffer view raises
+  instead of scribbling on ZMQ's receive buffers.
+
+Violations the guards detect directly (canary trample, use-after-recycle)
+are recorded in a bounded in-process ring, surfaced as the ``pipesan``
+section of ``pipeline_report()`` and counted in the
+``petastorm_tpu_sanitizer_*`` metrics; violations the guards *convert*
+into exceptions (read-only writes) fail loudly in the consumer's own
+stack, which is the point. Off (the default) every guard is a cheap
+cached-boolean check resolved at engine/serializer construction — the
+hot path pays nothing (the ``perf``-marked guard in
+``tests/test_sanitizer.py`` holds this).
+"""
+
+import logging
+import threading
+import time
+import weakref
+
+import numpy as np
+
+from petastorm_tpu.telemetry import (
+    get_registry, knobs, metrics_disabled, register_refresh,
+)
+
+logger = logging.getLogger(__name__)
+
+#: registry counters (docs/telemetry.md metric reference)
+SANITIZER_VIOLATIONS = 'petastorm_tpu_sanitizer_violations_total'
+SANITIZER_VIEWS_GUARDED = 'petastorm_tpu_sanitizer_views_guarded_total'
+SANITIZER_CANARY_CHECKS = 'petastorm_tpu_sanitizer_canary_checks_total'
+
+#: red-zone size on each side of a guarded slab. 64 bytes keeps any
+#: numpy dtype's alignment intact for the visible region and is wide
+#: enough that an off-by-one-row write cannot jump the zone.
+CANARY_BYTES = 64
+
+#: the poison pattern (0xA5 = alternating bits, unlikely fill value)
+CANARY_BYTE = 0xA5
+
+#: violation-ring bound: keeps the newest entries (oldest drop off), so
+#: the ``recent`` slice of ``pipeline_report()['pipesan']`` stays recent
+#: in a long soak; the per-kind counters carry the full totals
+_RING_LIMIT = 50
+
+# cached knob (refresh_sanitizer/telemetry.refresh re-reads)
+_enabled = None
+
+_lock = threading.Lock()
+_violations = []
+
+
+def sanitize_enabled():
+    """True when ``PETASTORM_TPU_SANITIZE`` carries an enable spelling
+    (off by default — the guards cost real per-batch work)."""
+    global _enabled
+    if _enabled is None:
+        _enabled = knobs.is_enabled('PETASTORM_TPU_SANITIZE')
+    return _enabled
+
+
+def refresh_sanitizer():
+    """Re-read the knob (tests, long-lived processes); engines resolve it
+    at construction, so the next reader/loader pass sees the new value."""
+    global _enabled
+    _enabled = None
+
+
+register_refresh(refresh_sanitizer)
+
+
+def record_violation(kind, detail):
+    """One sanitizer finding: counted per ``kind``, kept in the bounded
+    ring for ``pipeline_report()['pipesan']``, and logged — never raised
+    (a false positive must not kill a training job; the guards that CAN
+    be precise raise in the consumer's stack instead)."""
+    with _lock:
+        _violations.append({'kind': kind, 'detail': detail,
+                            'ts': time.time()})
+        if len(_violations) > _RING_LIMIT:
+            del _violations[:len(_violations) - _RING_LIMIT]
+    if not metrics_disabled():
+        get_registry().counter(SANITIZER_VIOLATIONS, kind=kind).inc()
+    logger.warning('pipesan violation [%s]: %s', kind, detail)
+
+
+def violations():
+    """Snapshot of the recorded violations (oldest first)."""
+    with _lock:
+        return [dict(v) for v in _violations]
+
+
+def reset_for_tests():
+    """Clear the violation ring and the cached knob (test isolation; the
+    metric counters live in the registry and reset with it)."""
+    global _enabled
+    with _lock:
+        del _violations[:]
+    _enabled = None
+
+
+# -- read-only view guards ----------------------------------------------------
+
+
+def guard_readonly(arr):
+    """Force ``writeable=False`` on an ndarray; returns 1 when the flag
+    was flipped (0 for non-arrays, already-read-only views, and the rare
+    base that refuses)."""
+    if isinstance(arr, np.ndarray) and arr.flags.writeable:
+        try:
+            arr.flags.writeable = False
+            return 1
+        except ValueError:
+            return 0
+    return 0
+
+
+def guard_payload(value):
+    """Force every reachable top-level ndarray in a result payload
+    read-only: plain arrays, dicts/lists/tuples of them, and
+    ``ColumnBatch``-shaped objects (a ``columns`` dict attribute).
+    Returns the number of arrays guarded (also counted in the
+    ``views_guarded`` metric)."""
+    guarded = _guard_value(value, depth=0)
+    if guarded and not metrics_disabled():
+        get_registry().counter(SANITIZER_VIEWS_GUARDED).inc(guarded)
+    return guarded
+
+
+def _guard_value(value, depth):
+    if depth > 3:  # payloads are shallow; never chase arbitrary graphs
+        return 0
+    if isinstance(value, np.ndarray):
+        return guard_readonly(value)
+    guarded = 0
+    if isinstance(value, dict):
+        for v in value.values():
+            guarded += _guard_value(v, depth + 1)
+        return guarded
+    if isinstance(value, (list, tuple)):
+        for v in value:
+            guarded += _guard_value(v, depth + 1)
+        return guarded
+    columns = getattr(value, 'columns', None)
+    if isinstance(columns, dict):
+        return _guard_value(columns, depth + 1)
+    return guarded
+
+
+# -- red-zone (canary) slabs --------------------------------------------------
+
+
+def allocate_guarded(shape, dtype):
+    """An ``np.empty(shape, dtype)`` equivalent whose memory sits between
+    two poisoned red zones inside one flat uint8 slab. The visible array
+    is a view into the slab's middle; :func:`check_canaries` walks the
+    ``.base`` chain back to the slab to verify the zones."""
+    dtype = np.dtype(dtype)
+    nbytes = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+    slab = np.empty(nbytes + 2 * CANARY_BYTES, np.uint8)
+    _poison(slab, nbytes)
+    view = slab[CANARY_BYTES:CANARY_BYTES + nbytes].view(dtype)
+    return view.reshape(shape)
+
+
+def _poison(slab, nbytes):
+    slab[:CANARY_BYTES] = CANARY_BYTE
+    slab[CANARY_BYTES + nbytes:] = CANARY_BYTE
+
+
+def _slab_of(arr):
+    """The root uint8 slab of a guarded array (None when the array was
+    not built by :func:`allocate_guarded`)."""
+    root = arr
+    while isinstance(getattr(root, 'base', None), np.ndarray):
+        root = root.base
+    if isinstance(root, np.ndarray) and root.dtype == np.uint8 \
+            and root.ndim == 1 and root.nbytes >= 2 * CANARY_BYTES:
+        return root
+    return None
+
+
+def check_canaries(arr, repoison=True):
+    """True when both red zones around a guarded array still carry the
+    poison pattern; trampled zones are re-poisoned (so the NEXT trample
+    is caught too) when ``repoison``. Counted per check."""
+    slab = _slab_of(arr)
+    if slab is None:
+        return True  # not a guarded slab (plain np.empty): nothing to say
+    if not metrics_disabled():
+        get_registry().counter(SANITIZER_CANARY_CHECKS).inc()
+    nbytes = slab.nbytes - 2 * CANARY_BYTES
+    intact = bool((slab[:CANARY_BYTES] == CANARY_BYTE).all()
+                  and (slab[CANARY_BYTES + nbytes:] == CANARY_BYTE).all())
+    if not intact and repoison:
+        _poison(slab, nbytes)
+    return intact
+
+
+# -- escaped-view census ------------------------------------------------------
+
+
+class ViewCensus:
+    """Weakrefs of the views an arena slot handed out on its last
+    dispatch. At recycle time, any ref still resolving means a consumer
+    kept the view past the slot's documented lifetime — the classic
+    use-after-recycle. Single-threaded like the staging engine itself."""
+
+    __slots__ = ('_refs',)
+
+    def __init__(self):
+        self._refs = []
+
+    def register(self, arrays):
+        """Record this dispatch's outbound views (replaces the previous
+        dispatch's refs — those were checked at the recycle gate)."""
+        refs = []
+        for arr in arrays:
+            try:
+                refs.append(weakref.ref(arr))
+            except TypeError:  # non-weakref-able stand-in (tests, scalars)
+                pass
+        self._refs = refs
+
+    def escaped(self):
+        """How many of the registered views are still alive."""
+        return sum(1 for ref in self._refs if ref() is not None)

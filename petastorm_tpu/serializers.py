@@ -26,6 +26,8 @@ from abc import ABCMeta, abstractmethod
 
 import pyarrow as pa
 
+from petastorm_tpu import sanitizer
+
 
 class SerializerBase(metaclass=ABCMeta):
     @abstractmethod
@@ -74,8 +76,14 @@ class PickleSerializer(SerializerBase):
         """Zero-copy reconstruction: out-of-band arrays are rebuilt as
         views over ``frames[1:]`` (read-only when the receive buffers
         are). Decode paths never mutate result columns in place, so
-        read-only views are safe batch payloads."""
-        return pickle.loads(frames[0], buffers=frames[1:])
+        read-only views are safe batch payloads. Under
+        ``PETASTORM_TPU_SANITIZE=1`` the reconstructed arrays are forced
+        ``writeable=False`` regardless of the buffers' mutability, so a
+        consumer scribbling on a wire buffer raises at the write site."""
+        value = pickle.loads(frames[0], buffers=frames[1:])
+        if sanitizer.sanitize_enabled():
+            sanitizer.guard_payload(value)
+        return value
 
 
 class ArrowTableSerializer(SerializerBase):

@@ -126,14 +126,23 @@ def _series(key, suffix, **extra_labels):
 # -- pipeline report ---------------------------------------------------------
 
 
+def _label_of(key, label):
+    """Value of one label in a ``name{label="x",...}`` snapshot key, or
+    None when the key does not carry it. Anchored on the preceding
+    ``{``/``,`` so ``srckind`` never matches a lookup for ``kind``."""
+    for marker in ('{%s="' % label, ',%s="' % label):
+        i = key.find(marker)
+        if i < 0:
+            continue
+        start = i + len(marker)
+        j = key.find('"', start)
+        return key[start:j] if j > 0 else None
+    return None
+
+
 def _stage_of(key):
     """Stage label value of a ``...{stage="x"}`` key, or None."""
-    marker = 'stage="'
-    i = key.find(marker)
-    if i < 0:
-        return None
-    j = key.find('"', i + len(marker))
-    return key[i + len(marker):j] if j > 0 else None
+    return _label_of(key, 'stage')
 
 
 def pipeline_report(registry=None, wall_time_s=None, baseline=None,
@@ -212,6 +221,9 @@ def pipeline_report(registry=None, wall_time_s=None, baseline=None,
     service = _service_section(registry)
     if service is not None:
         report['service'] = service
+    pipesan = _sanitizer_section(registry)
+    if pipesan is not None:
+        report['pipesan'] = pipesan
     return report
 
 
@@ -351,6 +363,35 @@ def _service_section(registry):
     }
 
 
+def _sanitizer_section(registry):
+    """pipesan runtime-sanitizer findings — present when the sanitizer is
+    armed (``PETASTORM_TPU_SANITIZE=1``) or violations were recorded, so
+    unarmed reports stay unchanged. ``recent`` carries the last few
+    structured violations from the in-process ring (kind, detail, ts);
+    the counters aggregate across the pool delta channels like every
+    other metric."""
+    from petastorm_tpu import sanitizer
+    by_kind = {}
+    for key, value in registry.counters_with_prefix(
+            sanitizer.SANITIZER_VIOLATIONS).items():
+        kind = _label_of(key, 'kind') or 'unknown'
+        by_kind[kind] = by_kind.get(kind, 0) + int(value)
+    total = sum(by_kind.values())
+    enabled = sanitizer.sanitize_enabled()
+    if not enabled and not total:
+        return None
+    return {
+        'enabled': enabled,
+        'violations': total,
+        'by_kind': by_kind,
+        'views_guarded': int(registry.counter_value(
+            sanitizer.SANITIZER_VIEWS_GUARDED)),
+        'canary_checks': int(registry.counter_value(
+            sanitizer.SANITIZER_CANARY_CHECKS)),
+        'recent': sanitizer.violations()[-5:],
+    }
+
+
 def format_pipeline_report(report):
     """Human-readable rendering of :func:`pipeline_report` (one stage per
     line, canonical pipeline order first, then any extra stages)."""
@@ -402,4 +443,14 @@ def format_pipeline_report(report):
                      % (s['workers_alive'], s['workers_registered'],
                         s['items_pending'], s['items_assigned'],
                         s['reventilated'], s['duplicate_done']))
+    if 'pipesan' in report:
+        p = report['pipesan']
+        kinds = ', '.join('%s: %d' % (k, v)
+                          for k, v in sorted(p['by_kind'].items()))
+        lines.append('pipesan: %s — %d violation(s)%s, %d view(s) forced '
+                     'read-only, %d canary check(s)'
+                     % ('armed' if p['enabled'] else 'off',
+                        p['violations'],
+                        (' (%s)' % kinds) if kinds else '',
+                        p['views_guarded'], p['canary_checks']))
     return '\n'.join(lines)

@@ -38,6 +38,7 @@ import time
 
 import dill
 
+from petastorm_tpu import sanitizer
 from petastorm_tpu.serializers import PickleSerializer
 from petastorm_tpu.telemetry import (
     STALL_NOTE_FLOOR_S, dump_delta_frame, load_delta_frame,
@@ -209,8 +210,14 @@ class ProcessPool:
                 self.join()
                 raise self._error
             if kind == _MSG_RESULT:
-                return self._serializer.deserialize_frames(
-                    [f.buffer for f in frames[1:]])
+                payload = [f.buffer for f in frames[1:]]
+                if sanitizer.sanitize_enabled():
+                    # read-only memoryviews over the receive buffers:
+                    # arrays pickle-5 rebuilds over them come out
+                    # writeable=False, so a consumer's in-place write
+                    # raises instead of corrupting ZMQ's buffers
+                    payload = [b.toreadonly() for b in payload]
+                return self._serializer.deserialize_frames(payload)
             if kind in (_MSG_READY, _MSG_EXIT):
                 continue
             logger.warning('Unknown pool message type %r', kind)

@@ -1,0 +1,59 @@
+"""Fixture: borrowed zero-copy views escaping / written through."""
+
+import queue
+
+import numpy as np
+
+
+class Holder:
+    def keep(self, frames):
+        view = np.frombuffer(frames[0], dtype=np.uint8)
+        self.stash = view                     # escape: object state (11)
+
+    def enqueue(self, sock, q):
+        frames = sock.recv_multipart(copy=False)
+        q.put(frames)                         # escape: queue (15)
+
+
+def capture(buf):
+    view = np.frombuffer(buf, dtype=np.uint8)
+    return lambda: view.sum()                 # escape: closure (20)
+
+
+def give_back(buf):
+    view = np.frombuffer(buf, dtype=np.uint8)
+    return view                               # escape: returned (25)
+
+
+def scribble(buf):
+    view = np.frombuffer(buf, dtype=np.uint8)
+    view[0] = 1                               # write-through (30)
+    view += 1                                 # write-through (31)
+    np.copyto(view, 0)                        # write-through (32)
+
+
+def cast_alias(arr, dtype):
+    return arr.astype(dtype, copy=False)      # escape: alias returned (36)
+
+
+def indirect(buf):
+    view = give_back(buf)                     # give_back() returns borrowed
+    return view                               # escape: whole-program (41)
+
+
+def owned_fresh_temporary(payload):
+    # frombuffer over a call expression: the fresh bytes become the
+    # array's .base — owned by construction, no finding
+    return np.frombuffer(bytes(payload), dtype=np.uint8)
+
+
+def annotated_transfer(buf):
+    view = np.frombuffer(buf, dtype=np.uint8)
+    # Documented handoff: fixture for the annotation.  # pipesan: owns
+    return view
+
+
+def killed_taint_is_clean(buf):
+    view = np.frombuffer(buf, dtype=np.uint8)
+    view = np.array(view, copy=True)          # reassignment kills taint
+    return view

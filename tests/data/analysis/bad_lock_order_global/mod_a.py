@@ -1,0 +1,22 @@
+"""Fixture (whole-program lock-order): module A holds its lock and calls
+into module B, whose call chain acquires B's lock — order A → B. Module B
+runs the opposite chain. Neither module shows both orders lexically, so
+only the call-graph pass can see the inversion. Never imported: the
+circular import between the two fixture modules is parsed, not executed.
+"""
+
+import threading
+
+from mod_b import drain
+
+_A_LOCK = threading.Lock()
+
+
+def path_one():
+    with _A_LOCK:
+        drain()          # drain() acquires mod_b._FLUSH_LOCK: A then B
+
+
+def grab():
+    with _A_LOCK:
+        pass

@@ -1,0 +1,21 @@
+"""Fixture (whole-program lock-order): the inverse half of mod_a."""
+
+import threading
+
+from mod_a import grab
+
+_FLUSH_LOCK = threading.Lock()
+
+
+def flush_buffers():
+    pass
+
+
+def drain():
+    with _FLUSH_LOCK:
+        flush_buffers()
+
+
+def reverse_path():
+    with _FLUSH_LOCK:
+        grab()           # grab() acquires mod_a._A_LOCK: B then A — inversion

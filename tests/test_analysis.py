@@ -59,7 +59,7 @@ def test_registered_knobs_are_documented():
 
 def test_every_rule_has_a_description():
     assert set(ALL_RULES) == set(RULE_DESCRIPTIONS)
-    assert len(ALL_RULES) == 6
+    assert len(ALL_RULES) == 8
 
 
 # -- rule self-tests over the fixtures ---------------------------------------
@@ -104,6 +104,149 @@ def test_thread_lifecycle_rule_fires():
 def test_pickle_payload_rule_fires():
     findings = _fixture_findings('bad_pickle_payload.py', 'pickle-payload')
     assert [f.line for f in findings] == [10, 11, 12], findings
+
+
+def test_buffer_escape_rule_fires():
+    findings = _fixture_findings('bad_buffer_escape.py', 'buffer-escape')
+    # object state, queue, closure, return, astype alias, whole-program
+    # propagation through give_back(); the owned/annotated/killed-taint
+    # functions at the fixture's tail stay clean
+    assert [f.line for f in findings] == [11, 15, 20, 25, 36, 41], findings
+    assert 'give_back()' in findings[-1].message
+
+
+def test_buffer_write_rule_fires():
+    findings = _fixture_findings('bad_buffer_escape.py', 'buffer-write')
+    assert [f.line for f in findings] == [30, 31, 32], findings
+    assert 'copyto' in findings[2].message
+
+
+def test_owns_annotation_silences_buffer_findings():
+    findings = analyze_source(
+        "import numpy as np\n"
+        "def f(buf):\n"
+        "    view = np.frombuffer(buf, dtype=np.uint8)\n"
+        "    return view  # pipesan: owns\n")
+    assert findings == []
+
+
+def test_fresh_temporary_views_are_owned_by_construction():
+    # frombuffer over a call expression: the anonymous temporary's only
+    # reference becomes the array's .base — owned, not borrowed
+    assert analyze_source(
+        "import numpy as np\n"
+        "def f(payload):\n"
+        "    return np.frombuffer(bytes(payload), dtype=np.uint8)\n") == []
+
+
+def test_comprehensions_respect_laundering_and_unpack_is_elementwise():
+    """[v.copy() for v in views] (the documented fix) and a literal
+    tuple unpack assigning a fresh copy next to a tainted value are both
+    clean; a comprehension carrying the raw views still taints."""
+    assert analyze_source(
+        "import numpy as np\n"
+        "def f(frames):\n"
+        "    views = [np.frombuffer(b) for b in frames]\n"
+        "    return [v.copy() for v in views]\n"
+        "def g(frames):\n"
+        "    views = [np.frombuffer(b) for b in frames]\n"
+        "    return [len(v) for v in views]\n"
+        "def h(buf):\n"
+        "    view = np.frombuffer(buf, dtype=np.uint8)\n"
+        "    size, owned = view.nbytes, view.copy()\n"
+        "    return owned\n"
+        "def k(buf):\n"
+        "    view = np.frombuffer(buf, dtype=np.uint8)\n"
+        "    return view.shape[0] * view.itemsize\n") == []
+    tainted = analyze_source(
+        "import numpy as np\n"
+        "def f(frames):\n"
+        "    return [np.frombuffer(b) for b in frames]\n")
+    assert [f.rule for f in tainted] == ['buffer-escape']
+
+
+def test_recv_frames_list_mutation_is_not_a_buffer_write():
+    """recv_multipart returns a caller-owned LIST; replacing/extending
+    its elements mutates the list, not the borrowed frame memory."""
+    assert analyze_source(
+        "def f(sock, header):\n"
+        "    frames = sock.recv_multipart(copy=False)\n"
+        "    frames[0] = header\n"
+        "    frames += [b'trailer']\n"
+        "    return len(frames)\n") == []
+
+
+def test_owning_methods_launder_taint():
+    """view.copy() (and reductions/materializations) OWN their result —
+    the canonical fix for an escape finding must itself be clean."""
+    assert analyze_source(
+        "import numpy as np\n"
+        "def f(buf):\n"
+        "    view = np.frombuffer(buf, dtype=np.uint8)\n"
+        "    return view.copy()\n"
+        "def g(buf):\n"
+        "    view = np.frombuffer(buf, dtype=np.uint8)\n"
+        "    return view.sum()\n") == []
+
+
+def test_whole_program_lock_order_rule_fires():
+    findings = _fixture_findings('bad_lock_order_global', 'lock-order')
+    assert len(findings) == 1, findings
+    assert 'whole-program' in findings[0].message
+    assert '_A_LOCK' in findings[0].message
+    assert '_FLUSH_LOCK' in findings[0].message
+
+
+def test_whole_program_lock_order_resolves_imported_locks(tmp_path):
+    """A lock IMPORTED from another module must globalize to its defining
+    module, or the two sides of a cross-module inversion never compare
+    equal (regression: false negative)."""
+    (tmp_path / 'liba.py').write_text(
+        "import threading\n"
+        "from libb import FLUSH_LOCK\n"
+        "A_LOCK = threading.Lock()\n"
+        "def one():\n"
+        "    with A_LOCK:\n"
+        "        with FLUSH_LOCK:\n"
+        "            pass\n")
+    (tmp_path / 'libb.py').write_text(
+        "import threading\n"
+        "from liba import A_LOCK\n"
+        "FLUSH_LOCK = threading.Lock()\n"
+        "def two():\n"
+        "    with FLUSH_LOCK:\n"
+        "        with A_LOCK:\n"
+        "            pass\n")
+    findings = analyze_paths([str(tmp_path)], check_docs=False)
+    locks = [f for f in findings if f.rule == 'lock-order']
+    assert len(locks) == 1, findings
+    assert 'A_LOCK' in locks[0].message
+    assert 'FLUSH_LOCK' in locks[0].message
+
+
+def test_whole_program_pass_defers_same_module_inversions():
+    """An inversion whose both orders are lexical within one module is
+    the per-module scan's report — run_project must not double-report it
+    even when a call-graph witness for one order is recorded first."""
+    findings = analyze_source(
+        "import threading\n"
+        "a_lock = threading.Lock()\n"
+        "b_lock = threading.Lock()\n"
+        "def helper():\n"
+        "    with b_lock:\n"
+        "        pass\n"
+        "def f1():\n"
+        "    with a_lock:\n"
+        "        helper()\n"
+        "def f2():\n"
+        "    with a_lock:\n"
+        "        with b_lock:\n"
+        "            pass\n"
+        "def f3():\n"
+        "    with b_lock:\n"
+        "        with a_lock:\n"
+        "            pass\n", select=['lock-order'])
+    assert len(findings) == 1, findings
 
 
 def test_suppression_comment_silences_findings():
@@ -183,6 +326,56 @@ def test_cli_exit_codes(args, expected_rc):
                           + args, cwd=REPO, capture_output=True, text=True,
                           timeout=120)
     assert proc.returncode == expected_rc, (proc.stdout, proc.stderr)
+
+
+def _run_cli(args, **kw):
+    return subprocess.run([sys.executable, '-m', 'petastorm_tpu.analysis']
+                          + args, cwd=REPO, capture_output=True, text=True,
+                          timeout=120, **kw)
+
+
+def test_cli_baseline_filters_known_findings(tmp_path):
+    """--baseline lets a rule land strict-on-new-code: a --json dump of
+    the current findings turns the same scan green."""
+    fixture = 'tests/data/analysis/bad_buffer_escape.py'
+    dump = _run_cli([fixture, '--json', '--no-docs-check'])
+    assert dump.returncode == 1
+    baseline = tmp_path / 'baseline.jsonl'
+    baseline.write_text(dump.stdout)
+    clean = _run_cli([fixture, '--baseline', str(baseline),
+                      '--fail-on-new', '--no-docs-check'])
+    assert clean.returncode == 0, (clean.stdout, clean.stderr)
+    assert 'suppressed' in clean.stderr
+
+
+def test_cli_baseline_still_fails_on_new_findings(tmp_path):
+    dump = _run_cli(['tests/data/analysis/bad_lock_order.py', '--json',
+                     '--no-docs-check'])
+    baseline = tmp_path / 'baseline.jsonl'
+    baseline.write_text(dump.stdout)
+    mixed = _run_cli(['tests/data/analysis/bad_lock_order.py',
+                      'tests/data/analysis/bad_buffer_escape.py',
+                      '--baseline', str(baseline), '--no-docs-check'])
+    assert mixed.returncode == 1
+    # only the NEW findings survive the filter
+    assert 'bad_lock_order.py' not in mixed.stdout
+    assert 'bad_buffer_escape.py' in mixed.stdout
+
+
+def test_cli_fail_on_new_requires_a_baseline():
+    proc = _run_cli(['petastorm_tpu', '--fail-on-new'])
+    assert proc.returncode == 2
+    assert '--baseline' in proc.stderr
+
+
+def test_cli_unusable_baseline_is_an_error(tmp_path):
+    """A corrupt baseline must not silently waive every finding."""
+    bogus = tmp_path / 'bogus.jsonl'
+    bogus.write_text('not json\n')
+    proc = _run_cli(['tests/data/analysis/bad_lock_order.py',
+                     '--baseline', str(bogus), '--no-docs-check'])
+    assert proc.returncode == 2
+    assert 'unusable baseline' in proc.stderr
 
 
 def test_cli_json_output():

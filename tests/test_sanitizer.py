@@ -1,0 +1,390 @@
+"""pipesan runtime sanitizer (petastorm_tpu/sanitizer.py).
+
+The dynamic half of the ISSUE's tentpole: ``PETASTORM_TPU_SANITIZE=1``
+arms guards at the three zero-copy boundaries. Covered here: the seeded
+use-after-recycle fixture (a deliberately-escaped staging-slot view trips
+the weakref census and the recycle is aborted, not corrupted), red-zone
+canary tramples, the decoded-cache read path arriving ``writeable=False``
+on BOTH the mmap and pickle-fallback branches, pickle-5 wire views forced
+read-only, the ``pipesan`` section of ``pipeline_report()``, knob
+discipline through ``telemetry.refresh()``, and the ``perf``-marked
+overhead guard (armed stays within a bounded factor; unarmed does zero
+guard work)."""
+
+import contextlib
+import os
+import time
+
+import numpy as np
+import pytest
+
+from petastorm_tpu import sanitizer
+from petastorm_tpu import telemetry as T
+from petastorm_tpu.arrow_worker import ColumnBatch
+from petastorm_tpu.jax import staging
+from petastorm_tpu.materialized_cache import (
+    MaterializedRowGroupCache, read_entry, write_entry,
+)
+from petastorm_tpu.serializers import PickleSerializer
+
+
+@contextlib.contextmanager
+def _sanitize_env(value):
+    saved = os.environ.get('PETASTORM_TPU_SANITIZE')
+    if value is None:
+        os.environ.pop('PETASTORM_TPU_SANITIZE', None)
+    else:
+        os.environ['PETASTORM_TPU_SANITIZE'] = value
+    sanitizer.refresh_sanitizer()
+    try:
+        yield
+    finally:
+        if saved is None:
+            os.environ.pop('PETASTORM_TPU_SANITIZE', None)
+        else:
+            os.environ['PETASTORM_TPU_SANITIZE'] = saved
+        sanitizer.refresh_sanitizer()
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    T.reset_for_tests()
+    sanitizer.reset_for_tests()
+    yield
+    T.reset_for_tests()
+    sanitizer.reset_for_tests()
+
+
+class _AcceleratorLeaf:
+    """Device-array stand-in that copies on construction and claims a
+    non-host platform, pinning the staging engine's ring mode on the CPU
+    test host (same idiom as tests/test_staging.py)."""
+
+    def __init__(self, arr):
+        self.value = np.array(arr, copy=True)
+
+    def devices(self):
+        class _Dev:
+            platform = 'tpu'
+        return (_Dev(),)
+
+    def block_until_ready(self):
+        return self
+
+
+def _slab_root(arr):
+    root = arr
+    while isinstance(getattr(root, 'base', None), np.ndarray):
+        root = root.base
+    return root
+
+
+# -- knob discipline ----------------------------------------------------------
+
+
+def test_knob_off_by_default_and_covered_by_telemetry_refresh():
+    assert not sanitizer.sanitize_enabled()
+    os.environ['PETASTORM_TPU_SANITIZE'] = '1'
+    try:
+        # telemetry.refresh() is the documented one-stop knob re-read
+        T.refresh()
+        assert sanitizer.sanitize_enabled()
+    finally:
+        os.environ.pop('PETASTORM_TPU_SANITIZE', None)
+        T.refresh()
+    assert not sanitizer.sanitize_enabled()
+
+
+# -- guarded slabs + census units --------------------------------------------
+
+
+def test_allocate_guarded_round_trips_and_verifies_canaries():
+    arr = sanitizer.allocate_guarded((4, 8), np.float32)
+    assert arr.shape == (4, 8) and arr.dtype == np.float32
+    arr[:] = np.arange(32, dtype=np.float32).reshape(4, 8)
+    assert sanitizer.check_canaries(arr)
+    np.testing.assert_array_equal(
+        arr, np.arange(32, dtype=np.float32).reshape(4, 8))
+    root = _slab_root(arr)
+    assert root.dtype == np.uint8 and root.ndim == 1
+    root[0] = 0                      # trample the front red zone
+    assert not sanitizer.check_canaries(arr)
+    # trampled zones are re-poisoned so the NEXT trample is caught too
+    assert sanitizer.check_canaries(arr)
+
+
+def test_plain_arrays_are_not_guarded_slabs():
+    # np.empty allocations (unarmed engines) carry nothing to verify
+    assert sanitizer.check_canaries(np.empty((4, 8), np.float32))
+
+
+def test_view_census_counts_live_views():
+    census = sanitizer.ViewCensus()
+    a = np.arange(8)
+    b = np.arange(8)
+    census.register([a, b])
+    assert census.escaped() == 2
+    del a
+    assert census.escaped() == 1
+    view = b[:4]
+    census.register([view])          # new dispatch replaces the old refs
+    assert census.escaped() == 1
+    del view
+    assert census.escaped() == 0
+
+
+# -- the seeded use-after-recycle fixture -------------------------------------
+
+
+def test_escaped_staging_view_trips_the_census_and_quarantines():
+    """The acceptance-gate fixture: a consumer deliberately keeps a
+    dispatched host view; when its slot comes up for recycling the
+    weakref census catches it, the recycle is ABORTED (fresh buffers for
+    the slot, the escaped holder keeps the old memory — no corruption),
+    and the violation is recorded + counted."""
+    leaked = {}
+
+    def put(tree):
+        if not leaked:
+            leaked.update(tree)      # the deliberate escape
+        return {k: _AcceleratorLeaf(v) for k, v in tree.items()}
+
+    with _sanitize_env('1'):
+        eng = staging.StagingEngine(8, {'v': np.float32}, 'drop', put,
+                                    num_slots=2)
+        rng = np.random.RandomState(0)
+        sources, held = [], []
+        for i in range(6):
+            cols = {'v': rng.rand(8, 4) + i}        # f64 → f32: ring path
+            sources.append(cols['v'].astype(np.float32))
+            held.append(eng.stage(cols, 8))
+        assert eng._host_backed is False
+    # batch 0's slot came up for recycling at batch 2 with the view alive
+    assert eng.slabs_quarantined == 1
+    kinds = [v['kind'] for v in sanitizer.violations()]
+    assert kinds == ['staging-use-after-recycle']
+    assert T.get_registry().counter_value(
+        sanitizer.SANITIZER_VIOLATIONS,
+        kind='staging-use-after-recycle') == 1
+    # quarantine preserved the escaped holder's data: the old slab was
+    # never refilled, and every delivered batch still carries its values
+    np.testing.assert_array_equal(leaked['v'], sources[0])
+    for src, batch in zip(sources, held):
+        np.testing.assert_array_equal(batch['v'].value, src)
+
+
+def test_canary_trample_detected_on_recycle():
+    captured = {}
+
+    def put(tree):
+        if not captured:
+            captured.update(tree)
+        return {k: _AcceleratorLeaf(v) for k, v in tree.items()}
+
+    with _sanitize_env('1'):
+        eng = staging.StagingEngine(8, {'v': np.float32}, 'drop', put,
+                                    num_slots=2)
+        rng = np.random.RandomState(1)
+        eng.stage({'v': rng.rand(8, 4)}, 8)
+        root = _slab_root(captured['v'])
+        assert root.dtype == np.uint8   # the guarded slab is reachable
+        root[-1] = 0                    # wild write past the array bounds
+        captured.clear()                # drop the ref: census stays clean
+        eng.stage({'v': rng.rand(8, 4)}, 8)
+        eng.stage({'v': rng.rand(8, 4)}, 8)   # slot 0 recycles: verify
+    kinds = [v['kind'] for v in sanitizer.violations()]
+    assert kinds == ['staging-canary-trampled']
+    assert eng.slabs_quarantined == 0   # trample ≠ escape: slab reused
+    assert T.get_registry().counter_value(
+        sanitizer.SANITIZER_CANARY_CHECKS) > 0
+
+
+def test_unarmed_engine_does_no_guard_work():
+    """The ``=0`` half of the overhead claim, structurally: an unarmed
+    engine allocates plain slabs, runs zero canary checks, keeps no
+    census, and records nothing."""
+    eng = staging.StagingEngine(8, {'v': np.float32}, 'drop',
+                                lambda tree: {k: _AcceleratorLeaf(v)
+                                              for k, v in tree.items()},
+                                num_slots=2)
+    rng = np.random.RandomState(2)
+    for _ in range(5):
+        eng.stage({'v': rng.rand(8, 4)}, 8)
+    assert eng._sanitize is False
+    for ring in eng._rings.values():
+        for slot in ring.slots:
+            assert slot.census is None
+            assert _slab_root(slot.buffers['v']).dtype == np.float32
+    assert T.get_registry().counter_value(
+        sanitizer.SANITIZER_CANARY_CHECKS) == 0
+    assert sanitizer.violations() == []
+
+
+# -- decoded-cache boundary ---------------------------------------------------
+
+
+def test_cached_columns_arrive_read_only_on_both_branches(tmp_path):
+    """Satellite regression: EVERY column from ``read_entry`` is
+    ``writeable=False`` — the mmap-backed raw branch AND the
+    pickle-fallback branch (object/ragged columns) — knob-independent,
+    because the entry is shared across processes either way."""
+    path = str(tmp_path / 'entry.arrow')
+    cols = {
+        'ids': np.arange(6, dtype=np.int64),
+        'ragged': np.array([np.arange(i + 1) for i in range(6)],
+                           dtype=object),
+    }
+    write_entry(path, cols, 6)
+    got, length, mmaped, copied = read_entry(path)
+    assert length == 6 and mmaped >= 1 and copied >= 1
+    assert not got['ids'].flags.writeable
+    assert not got['ragged'].flags.writeable
+    with pytest.raises(ValueError, match='read-only'):
+        got['ids'][0] = 9
+    with pytest.raises(ValueError, match='read-only'):
+        got['ragged'][0] = None
+
+
+def test_mem_tier_freezes_shared_columns_under_sanitize(tmp_path):
+    """Armed mode: the memory tier shares its array objects with the
+    batch just returned to the consumer — they are frozen at ``_mem_put``
+    so an in-place consumer write raises at the write site."""
+    with _sanitize_env('1'):
+        cache = MaterializedRowGroupCache(str(tmp_path / 'dc'), 10 ** 8,
+                                          mem_limit_bytes=8 * 2 ** 20)
+        arr = np.arange(8, dtype=np.float32)
+        batch = cache.get('k', lambda: ColumnBatch({'v': arr}, 8))
+        assert not batch.columns['v'].flags.writeable
+        with pytest.raises(ValueError, match='read-only'):
+            batch.columns['v'][0] = 1.0
+        assert T.get_registry().counter_value(
+            sanitizer.SANITIZER_VIEWS_GUARDED) >= 1
+
+
+def test_oversized_batch_never_stored_stays_writable_armed(tmp_path):
+    """A batch the memory tier bails out on (nbytes > mem limit) is
+    never shared — the consumer keeps its own writable memory even
+    under SANITIZE=1."""
+    with _sanitize_env('1'):
+        cache = MaterializedRowGroupCache(str(tmp_path / 'dc'), 10 ** 8,
+                                          mem_limit_bytes=1024)
+        arr = np.zeros(4096, dtype=np.float32)     # 16 KB > 1 KB cap
+        batch = cache.get('k', lambda: ColumnBatch({'v': arr}, 4096))
+        assert batch.columns['v'].flags.writeable
+
+
+def test_mem_tier_fill_batch_stays_writable_unarmed(tmp_path):
+    cache = MaterializedRowGroupCache(str(tmp_path / 'dc'), 10 ** 8,
+                                      mem_limit_bytes=8 * 2 ** 20)
+    arr = np.arange(8, dtype=np.float32)
+    batch = cache.get('k', lambda: ColumnBatch({'v': arr}, 8))
+    assert batch.columns['v'].flags.writeable
+
+
+# -- ZMQ wire boundary --------------------------------------------------------
+
+
+def test_pickle5_wire_views_forced_read_only_under_sanitize():
+    """Out-of-band arrays rebuilt over MUTABLE receive buffers come back
+    writable by default; armed mode forces ``writeable=False`` so a
+    consumer scribbling on a wire buffer raises."""
+    serializer = PickleSerializer()
+    value = {'v': np.arange(16, dtype=np.int32)}
+    frames = [bytes(f) for f in serializer.serialize_frames(value)]
+    plain = serializer.deserialize_frames(
+        [bytearray(f) for f in frames])
+    assert plain['v'].flags.writeable      # the unarmed contract
+    with _sanitize_env('1'):
+        guarded = serializer.deserialize_frames(
+            [bytearray(f) for f in frames])
+        assert not guarded['v'].flags.writeable
+        with pytest.raises(ValueError, match='read-only'):
+            guarded['v'][0] = 1
+        np.testing.assert_array_equal(guarded['v'], value['v'])
+        assert T.get_registry().counter_value(
+            sanitizer.SANITIZER_VIEWS_GUARDED) >= 1
+
+
+def test_guard_payload_walks_batch_shapes():
+    inner = np.arange(4)
+    batch = ColumnBatch({'a': inner}, 4)
+    with _sanitize_env('1'):
+        assert sanitizer.guard_payload([batch, {'b': np.arange(2)}]) == 2
+    assert not inner.flags.writeable
+
+
+# -- report surface -----------------------------------------------------------
+
+
+def test_pipeline_report_grows_a_pipesan_section_when_armed():
+    with _sanitize_env('1'):
+        sanitizer.record_violation('staging-canary-trampled', 'seeded')
+        report = T.pipeline_report()
+        section = report['pipesan']
+        assert section['enabled'] is True
+        assert section['violations'] == 1
+        assert section['by_kind'] == {'staging-canary-trampled': 1}
+        assert section['recent'][-1]['detail'] == 'seeded'
+        assert 'pipesan' in T.format_pipeline_report(report)
+
+
+def test_pipeline_report_omits_pipesan_when_unarmed_and_clean():
+    assert 'pipesan' not in T.pipeline_report()
+
+
+def test_report_label_parsing_is_anchored():
+    """`by_kind` binning must not let a label that merely ENDS in 'kind'
+    (e.g. a future srckind=) satisfy the kind= lookup."""
+    from petastorm_tpu.telemetry.export import _label_of
+    assert _label_of('m{kind="a"}', 'kind') == 'a'
+    assert _label_of('m{srckind="a"}', 'kind') is None
+    assert _label_of('m{a="x",kind="b"}', 'kind') == 'b'
+    assert _label_of('m', 'kind') is None
+
+
+def test_violation_ring_is_bounded_and_keeps_the_newest():
+    total = sanitizer._RING_LIMIT + 10
+    for i in range(total):
+        sanitizer.record_violation('staging-canary-trampled', 'v%d' % i)
+    kept = sanitizer.violations()
+    assert len(kept) == sanitizer._RING_LIMIT
+    # oldest dropped off: the 'recent' report slice stays recent
+    assert kept[-1]['detail'] == 'v%d' % (total - 1)
+    assert kept[0]['detail'] == 'v10'
+
+
+# -- perf marker: overhead guard ---------------------------------------------
+
+
+def _staged_rows_per_sec(env_value):
+    with _sanitize_env(env_value):
+        eng = staging.StagingEngine(
+            64, {'v': np.float32}, 'drop',
+            lambda tree: {k: _AcceleratorLeaf(v)
+                          for k, v in tree.items()},
+            num_slots=2)
+        rng = np.random.RandomState(0)
+        cols = {'v': rng.rand(64, 64)}            # f64 → f32: ring path
+        for _ in range(5):
+            eng.stage(dict(cols), 64)
+        n = 200
+        start = time.monotonic()
+        for _ in range(n):
+            eng.stage(dict(cols), 64)
+        return n * 64 / (time.monotonic() - start)
+
+
+@pytest.mark.perf
+def test_sanitizer_overhead_stays_within_a_bounded_factor():
+    """Tier-1-safe budget, deliberately loose for shared-box noise: the
+    armed staging path must hold ≥ 0.25x the unarmed throughput (canary
+    verification + weakref census are O(fields), not O(bytes)). The
+    unarmed side costing NOTHING is held structurally by
+    test_unarmed_engine_does_no_guard_work."""
+    for _ in range(2):
+        off = _staged_rows_per_sec(None)
+        on = _staged_rows_per_sec('1')
+        if on >= 0.25 * off:
+            return
+    pytest.fail('sanitize on: %.0f rows/s vs off: %.0f rows/s '
+                '(budget: >= 0.25x)' % (on, off))
