@@ -113,6 +113,10 @@ _HEADLINE_EXTRA_KEYS = (
     'lm_decode_decode_tokens_per_sec',
     'lm_decode_gqa_decode_speedup',
     'native_decode_speedup',
+    # the granularity ladder's other rungs (fused_decode_batched_* etc.)
+    # stay in the full cumulative dict; only the MODE is headline-worthy
+    # (it says whether the imagenet numbers rode the fused path at all)
+    'fused_decode_mode',
     'imagenet_batch_rows_per_sec',
     'imagenet_jax_rows_per_sec',
     'jax_framework_share',
@@ -462,6 +466,10 @@ with make_jax_loader(url, batch_size=batch_size, fields=fields,
     # staging arena is disabled
     overlap_share = pipeline_report(
         baseline=stage_baseline).get('h2d_overlap_share')
+    # where image decode ran for this pass (fused-into-slot / -slab /
+    # batched) — makes BENCH_r0x rounds attributable when the fusion
+    # silently falls back (docs/troubleshoot.md)
+    fused_mode = loader.diagnostics.get('fused_decode_mode')
 
 # Raw H2D calibration: device_put the SAME host batch shapes in a tight
 # loop — the link's achievable bandwidth with zero pipeline around it.
@@ -508,6 +516,8 @@ result = {"rows_per_sec": seen / elapsed,
           "h2d_efficiency": loader_mb / raw_mb}
 if overlap_share is not None:
     result["h2d_overlap_share"] = overlap_share
+if fused_mode is not None:
+    result["fused_decode_mode"] = fused_mode
 
 # Bytes accounting for the uint8-staging design (VERDICT r3 #3): image
 # pipelines stage uint8 over the link and cast/normalize ON DEVICE
@@ -1542,6 +1552,76 @@ def main():
             extra['native_decode_speedup'] = round(
                 img_state['rate'] / py_rate, 3)
 
+    def sec_fused_decode():
+        """ISSUE 9's decode-granularity ladder at the 224² north-star
+        shape, on the SAME jpeg bytes the imagenet sections read: python
+        per-cell (cv2, the oracle), native per-cell (one C call per
+        image — the old dispatch granularity whose win capped at ~1.15×),
+        and native batched (ONE C call for the whole column, cells fanned
+        across the internal pthread pool). The fused-into-slot rung is
+        loader-level and lives in the imagenet_jax section — its
+        ``fused_decode_mode`` key says whether fusion engaged there."""
+        import glob
+        import statistics
+
+        import pyarrow.parquet as pq
+
+        from petastorm_tpu.codecs import (
+            CompressedImageCodec, image_decoder_threads,
+        )
+        from petastorm_tpu.native import get_jpeg_module, native_disabled
+        from petastorm_tpu.unischema import UnischemaField
+
+        root = imagenet_url[len('file://'):]
+        cells = []
+        for path in sorted(glob.glob(root + '/*.parquet')):
+            cells.extend(
+                bytes(c) for c in pq.read_table(path, columns=['image'])
+                .column('image').to_pylist())
+        cells = cells[:64 if SMOKE else 256]
+        n = len(cells)
+        codec = CompressedImageCodec('jpeg', quality=90)
+        field = UnischemaField('image', np.uint8, IMAGENET_SHAPE, codec,
+                               False)
+        out = np.empty((n,) + IMAGENET_SHAPE, np.uint8)
+
+        def rate(fn, reps=1 if SMOKE else 3):
+            fn()  # warm (page-in, mode calibration, pool spin-up)
+            samples = []
+            for _ in range(reps):
+                start = time.monotonic()
+                fn()
+                samples.append(time.monotonic() - start)
+            return n / statistics.median(samples)
+
+        py_rate = rate(lambda: [codec.decode(field, c) for c in cells])
+        extra['fused_decode_per_image_rows_per_sec'] = round(py_rate, 1)
+        if native_disabled() or get_jpeg_module() is None:
+            extra['fused_decode_native'] = 'unavailable'
+            return
+        decode_fn = get_jpeg_module().decode_jpeg_batch
+        # BOTH native rungs decode with the SAME chroma-upsampling mode —
+        # the one the codec's calibration picked (what decode_batch below
+        # uses) — so the batched-vs-per-cell ratio measures the batching
+        # win alone, never a mode delta
+        from petastorm_tpu.codecs import _jpeg_upsampling_mode
+        mode = _jpeg_upsampling_mode(decode_fn, cells, IMAGENET_SHAPE)
+        extra['fused_decode_jpeg_mode'] = mode
+
+        def native_per_cell():
+            for i in range(n):
+                decode_fn(cells[i:i + 1], out[i:i + 1], mode, 1)
+
+        cell_rate = rate(native_per_cell)
+        batched_rate = rate(
+            lambda: codec.decode_batch(field, cells, out=out))
+        extra['fused_decode_native_per_cell_rows_per_sec'] = \
+            round(cell_rate, 1)
+        extra['fused_decode_batched_rows_per_sec'] = round(batched_rate, 1)
+        extra['fused_decode_batched_vs_per_cell'] = \
+            round(batched_rate / cell_rate, 3)
+        extra['fused_decode_native_threads'] = image_decoder_threads()
+
     def sec_tfdata():
         # North star (BASELINE.json): ratio vs a tf.data+TFRecord pipeline
         # decoding the SAME jpeg bytes on the same machine. Target >= 0.9.
@@ -1572,6 +1652,11 @@ def main():
     def sec_jax_imagenet():
         jax_metrics('imagenet_jax', imagenet_url, IMAGENET_JAX_BATCH,
                     IMAGENET_ROWS // 2, IMAGENET_ROWS * 3, ['^image$'])
+        # headline-named copy: the BENCH_r0x record must say whether the
+        # imagenet H2D number rode the fused decode path or a fallback
+        if 'imagenet_jax_fused_decode_mode' in extra:
+            extra['fused_decode_mode'] = \
+                extra['imagenet_jax_fused_decode_mode']
         # Attribution marker: when even a RAW device_put tight loop cannot
         # reach 1 GB/s, the H2D ceiling is the link (a degraded tunnel),
         # not the staging layer — h2d_efficiency (loader/raw) close to or
@@ -1709,6 +1794,7 @@ def main():
         section('lm_train', 60, sec_lm_train)
         section('tfdata', 30, sec_tfdata)
         section('imagenet_python_decode', 10, sec_imagenet_python_decode)
+        section('fused_decode', 15, sec_fused_decode)
         section('jax_imagenet', 30, sec_jax_imagenet)
         # proven captures (decode/GQA) run before the round-5 sections
         # (vit/tuned/breakdown) — a new section's worst-case compile must

@@ -25,10 +25,12 @@ telemetry back).
 #: into the arena slot · ``h2d_dispatch`` async transfer dispatch ·
 #: ``cache_hit_read`` decoded-row-group cache hit served (mmap + column
 #: reconstruct) · ``cache_fill`` decoded batch serialized to Arrow IPC +
-#: atomically published into the cache
+#: atomically published into the cache · ``decode_fused`` deferred image
+#: cells decoded by the staging arena straight into the destination
+#: buffer (slot ring or fresh assembly; petastorm_tpu/fused.py)
 STAGES = ('ventilate', 'io', 'decode', 'filter', 'transform', 'queue_wait',
           'collate', 'h2d', 'h2d_ready', 'stage_fill', 'h2d_dispatch',
-          'cache_hit_read', 'cache_fill')
+          'cache_hit_read', 'cache_fill', 'decode_fused')
 
 #: every trace-event name the package records outside the canonical stage
 #: spans (docs/telemetry.md, tracing section)
@@ -84,6 +86,10 @@ METRIC_NAMES = frozenset([
     'petastorm_tpu_sanitizer_violations_total',
     'petastorm_tpu_sanitizer_views_guarded_total',
     'petastorm_tpu_sanitizer_canary_checks_total',
+    # fused batch-native decode (fused.py / jax/staging.py)
+    'petastorm_tpu_fused_decode_rows_total',
+    'petastorm_tpu_fused_decode_bytes_total',
+    'petastorm_tpu_fused_decode_fallbacks_total',
 ])
 
 #: prefix of every operator-facing environment knob
@@ -152,10 +158,14 @@ BORROW_CALL_KWARGS = {
     'astype': ('copy', False),           # may alias the source array
 }
 
-#: dotted expressions denoting staging-arena slot memory — recycled after
-#: the slot's next transfer retires, so any view over them is borrowed
+#: dotted expressions denoting borrowed buffer collections — staging-arena
+#: slot memory (recycled after the slot's next transfer retires) and a
+#: deferred image column's encoded cell views (zero-copy over the arrow
+#: data buffer; valid only while the column object — which carries the
+#: owning arrow column — is alive). Any view over them is borrowed.
 BORROW_ATTRS = frozenset([
     'slot.buffers',
+    'column.cells',
 ])
 
 #: the ownership-transfer annotation: ``# pipesan: owns`` on (any line of)

@@ -14,17 +14,31 @@ publish a :class:`ColumnBatch`.
 """
 
 import hashlib
+import logging
 
 import numpy as np
 import pyarrow.parquet as pq
 
 from petastorm_tpu.cache import NullCache
 from petastorm_tpu.codecs import CompressedImageCodec, decode_batch_with_nulls
+from petastorm_tpu.fused import (
+    EncodedImageColumn, alloc_column_slab, count_fallback,
+)
+
+#: the worker-side deferral gate, re-derived per worker but COUNTED once
+#: per Reader: deferral is sound only when the worker adds nothing after
+#: decode (a TransformSpec/NGram needs pixels there; a cache must store
+#: finished batches, not deferred stubs)
+def defer_config_ok(transform_spec, ngram, cache):
+    return (transform_spec is None and ngram is None
+            and (cache is None or isinstance(cache, NullCache)))
 from petastorm_tpu.materialized_cache import (
     MaterializedRowGroupCache, dataset_file_fingerprint, decode_fingerprint,
 )
 from petastorm_tpu.telemetry import span
 from petastorm_tpu.workers.worker_base import WorkerBase
+
+logger = logging.getLogger(__name__)
 
 _ALL_ROWS = slice(None)
 
@@ -129,6 +143,15 @@ class RowGroupWorker(WorkerBase):
         self._cache = args.get('cache')
         self._ngram = args.get('ngram')
         self._row_groups = args['row_groups']
+        # Fused-decode deferral (petastorm_tpu/fused.py): the consumer
+        # (JaxLoader) asked for encoded image cells instead of decoded
+        # pixels so the staging arena can decode straight into its slot
+        # buffers. The config decline is COUNTED once at Reader
+        # construction, not here — N pool workers re-deriving the same
+        # gate must not inflate the fallbacks counter by the worker count.
+        self._defer_decode = (bool(args.get('defer_image_decode'))
+                              and defer_config_ok(self._transform_spec,
+                                                  self._ngram, self._cache))
         self._parquet_files = {}
         # decoded-cache key identity, resolved lazily (per process, per
         # parquet file) — see _decoded_fingerprint
@@ -292,7 +315,8 @@ class RowGroupWorker(WorkerBase):
                 arrow_col = table.column(name)
                 selected = (arrow_col if select_all
                             else arrow_col.take(row_indices))
-                columns[name] = self._decode_column(name, selected)
+                columns[name] = self._decode_column(name, selected,
+                                                    allow_defer=True)
         for name in partition_keys:
             field = self._stored_schema.fields.get(name)
             value = self._typed_partition_value(field, piece.partition_values[name])
@@ -373,7 +397,7 @@ class RowGroupWorker(WorkerBase):
             selected = np.concatenate([selected, borrow])
         return selected
 
-    def _decode_column(self, name, arrow_col):
+    def _decode_column(self, name, arrow_col, allow_defer=False):
         """Arrow column → decoded numpy values (vectorized where possible).
 
         Collation semantics follow ``arrow_reader_worker.py:38-80``: scalars
@@ -381,6 +405,17 @@ class RowGroupWorker(WorkerBase):
         through the codec's batched decode; outputs with uniform shapes are
         stacked into ``(n,) + shape`` ndarrays, ragged outputs stay object
         arrays.
+
+        Row-group-granularity image dispatch: fixed-shape, null-free image
+        columns decode in ONE vectorized call per row-group into a
+        page-aligned column slab (``decode_batch(out=)``, the fused-decode
+        destination API) — and, when the consumer deferred decode
+        (``allow_defer`` + the reader's ``defer_image_decode``), skip
+        decoding here entirely and publish an
+        :class:`~petastorm_tpu.fused.EncodedImageColumn` for the staging
+        arena to decode straight into its slot buffers. Predicate-column
+        decode (``allow_defer=False``) always yields pixels — predicates
+        compare values.
         """
         field = self._loaded_schema.fields.get(name) or self._stored_schema.fields.get(name)
         if field is not None and field.codec is not None:
@@ -389,10 +424,37 @@ class RowGroupWorker(WorkerBase):
                 # arrow data buffer instead of a per-cell bytes copy
                 cells = _binary_cell_views(arrow_col)
                 if cells is not None:
-                    return self._stack(decode_batch_with_nulls(field, cells))
+                    return self._image_column(field, cells, arrow_col,
+                                              allow_defer)
             return self._stack(decode_batch_with_nulls(
                 field, arrow_col.to_pylist()))
         return self._collate_plain(field, arrow_col, arrow_col.to_pylist())
+
+    def _image_column(self, field, cells, arrow_col, allow_defer):
+        """One image column of one row-group: defer (fused), decode dense
+        into a page-aligned slab, or fall back to the per-cell path."""
+        shape = field.shape
+        dense_ok = (shape and not any(d is None for d in shape)
+                    and not any(c is None for c in cells))
+        if dense_ok:
+            try:
+                dtype = np.dtype(field.numpy_dtype)
+            except TypeError:
+                dense_ok = False
+        if self._defer_decode and allow_defer:
+            if dense_ok and dtype.kind in 'iuf':
+                return EncodedImageColumn(field, cells, owner=arrow_col)
+            count_fallback('column-shape')
+        if dense_ok:
+            try:
+                return decode_batch_with_nulls(
+                    field, cells,
+                    out=alloc_column_slab((len(cells),) + tuple(shape),
+                                          dtype))
+            except Exception:  # noqa: BLE001 - slab path is an accelerator
+                logger.debug('Dense slab image decode failed; falling back '
+                             'to the per-cell path', exc_info=True)
+        return self._stack(decode_batch_with_nulls(field, cells))
 
     def _collate_plain(self, field, arrow_col, values):
         """Codec-less columns (plain parquet / make_batch_reader path)."""

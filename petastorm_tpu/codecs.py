@@ -40,6 +40,11 @@ _IMAGE_POOL = None
 _IMAGE_POOL_DISABLED = object()
 _IMAGE_POOL_LOCK = threading.Lock()
 
+# decode_fn -> bool: does this native build accept the trailing `threads`
+# argument? Probed once per function with a zero-length call (only a stale
+# .so predating the argument can raise TypeError there).
+_NATIVE_THREADS_SUPPORT = {}
+
 # Calibrated jpeg chroma-upsampling mode (1 fancy / 0 merged), or None until
 # the first sizeable batch decides it; see _jpeg_upsampling_mode.
 _JPEG_FANCY_MODE = None
@@ -165,13 +170,53 @@ def _jpeg_upsampling_mode(decode_fn, cells, image_shape):
         return _JPEG_FANCY_MODE
 
 
+def _native_supports_threads(decode_fn, out, prefix_args):
+    """True when this native build's batch decoder accepts the trailing
+    ``threads`` argument (probed once per function with a zero-length
+    call; a stale ``.so`` predating the argument raises TypeError and is
+    routed to the Python-side chunking fallback)."""
+    ok = _NATIVE_THREADS_SUPPORT.get(decode_fn)
+    if ok is None:
+        try:
+            decode_fn([], out[:0], *(tuple(prefix_args) + (1,)))
+            ok = True
+        except TypeError:
+            ok = False
+        _NATIVE_THREADS_SUPPORT[decode_fn] = ok
+    return ok
+
+
+def image_decoder_threads():
+    """Decode-parallelism width from ``PETASTORM_TPU_IMAGE_DECODER_THREADS``
+    (0/1 = serial; default min(4, cpu_count)) — the ONE owner of the
+    parse. The SAME number sizes whichever pool actually runs a given
+    batch: the native batch decoders' internal C-level pthread pool (one
+    native call per row-group column, GIL released) when the C extensions
+    are live, or the Python-side cv2 executor
+    (:func:`_image_decode_pool`) on the fallback path. The two pools
+    never stack on ONE batch (no threads × threads within a decode);
+    concurrent reader workers each get their own width, so process-wide
+    decode threads scale as workers × knob — sizing guidance in
+    docs/env_knobs.md."""
+    raw = knobs.raw('PETASTORM_TPU_IMAGE_DECODER_THREADS')
+    if raw is None:
+        return min(4, os.cpu_count() or 1)
+    try:
+        return max(0, int(raw))
+    except ValueError:
+        logger.warning(
+            'PETASTORM_TPU_IMAGE_DECODER_THREADS=%r is not an '
+            'integer; threaded image decode disabled', raw)
+        return 0
+
+
 def _image_decode_pool():
-    """Shared small thread pool for batched image decode, or None.
+    """Shared small thread pool for batched cv2 image decode, or None.
 
     cv2 releases the GIL, so a few threads give real parallelism on top of
-    the reader's own worker parallelism without oversubscribing. Size comes
-    from ``PETASTORM_TPU_IMAGE_DECODER_THREADS`` (0 disables; default
-    min(4, cpu_count)).
+    the reader's own worker parallelism without oversubscribing. Sized by
+    :func:`image_decoder_threads`; only the cv2 fallback path uses it —
+    the native decoders parallelize inside the C call instead.
     """
     global _IMAGE_POOL
     if _IMAGE_POOL is _IMAGE_POOL_DISABLED:
@@ -181,15 +226,7 @@ def _image_decode_pool():
             if _IMAGE_POOL is _IMAGE_POOL_DISABLED:
                 return None
             if _IMAGE_POOL is None:
-                raw = knobs.raw('PETASTORM_TPU_IMAGE_DECODER_THREADS')
-                try:
-                    workers = (int(raw) if raw is not None
-                               else min(4, os.cpu_count() or 1))
-                except ValueError:
-                    logger.warning(
-                        'PETASTORM_TPU_IMAGE_DECODER_THREADS=%r is not an '
-                        'integer; threaded image decode disabled', raw)
-                    workers = 0
+                workers = image_decoder_threads()
                 if workers <= 1:
                     _IMAGE_POOL = _IMAGE_POOL_DISABLED
                     return None
@@ -210,9 +247,23 @@ class DataframeColumnCodec(metaclass=ABCMeta):
     def decode(self, unischema_field, encoded):
         """Decode a single stored cell back into its numpy form."""
 
-    def decode_batch(self, unischema_field, encoded_iterable):
-        """Decode many cells; default is a python loop, codecs may vectorize."""
-        return [self.decode(unischema_field, v) for v in encoded_iterable]
+    def decode_batch(self, unischema_field, encoded_iterable, out=None):
+        """Decode many cells; default is a python loop, codecs may vectorize.
+
+        ``out=`` (the fused-decode destination API, docs/telemetry.md):
+        a preallocated ``(n,) + shape`` array the decoded rows land in —
+        page-aligned column slabs from the row-group worker, or staging-
+        arena slot views from the JAX loader's fused fill. When given it
+        is filled IN PLACE and returned; a cell whose decoded shape/dtype
+        cannot land in its row raises instead of silently degrading to a
+        list (callers gate ``out=`` on fixed-shape fields).
+        """
+        values = [self.decode(unischema_field, v) for v in encoded_iterable]
+        if out is None:
+            return values
+        for i, value in enumerate(values):
+            _assign_row(out, i, value, unischema_field)
+        return out
 
     @abstractmethod
     def arrow_type(self, unischema_field):
@@ -227,7 +278,41 @@ class DataframeColumnCodec(metaclass=ABCMeta):
         return {'type': type(self).__name__}
 
 
-def decode_batch_with_nulls(unischema_field, values):
+def _check_out_destination(unischema_field, out, n):
+    """The ONE validation of a ``decode_batch(out=)`` destination: the
+    field must be fixed-shape and ``out`` must be exactly ``(n,) + shape``
+    in the field's dtype (both vectorizing codecs share this — the fused
+    contract must not fork between them)."""
+    shape = unischema_field.shape
+    if not shape or any(d is None for d in shape):
+        raise ValueError(
+            'decode_batch(out=) requires a fixed-shape field; %r '
+            'has shape %r' % (unischema_field.name, shape))
+    expected = (n,) + tuple(shape)
+    dtype = np.dtype(unischema_field.numpy_dtype)
+    if out.shape != expected or out.dtype != dtype:
+        raise ValueError(
+            'decode_batch(out=): destination %s %s does not match '
+            'the declared %s %s' % (out.shape, out.dtype, expected, dtype))
+
+
+def _assign_row(out, i, value, unischema_field):
+    """One decoded cell into row ``i`` of a caller-owned destination.
+    The shape must match EXACTLY — plain ``out[i] = value`` would happily
+    numpy-BROADCAST a smaller cell across the row (silent replicated
+    data, where the no-``out`` path preserved the true shape and
+    surfaced the raggedness downstream); dtype follows numpy assignment
+    casting, same as the codecs' own ``astype`` to the declared dtype."""
+    value = np.asarray(value)
+    if value.shape != out.shape[1:]:
+        raise ValueError(
+            'decode_batch(out=): field %r cell decoded to shape %s, not '
+            'the declared %s' % (unischema_field.name, value.shape,
+                                 out.shape[1:]))
+    out[i] = value
+
+
+def decode_batch_with_nulls(unischema_field, values, out=None):
     """Batch-decode a column whose cells may be None (nullable fields): null
     cells bypass the codec and stay None, non-null cells go through the
     codec's vectorized ``decode_batch``. Positions are preserved.
@@ -235,16 +320,43 @@ def decode_batch_with_nulls(unischema_field, values):
     Returns either a list (one entry per cell, None preserved) or — on the
     all-non-null fast path — whatever the codec's ``decode_batch`` returned,
     which may be a contiguous ``(n,)+shape`` ndarray.
+
+    ``out=`` (fused-decode destination): rows decode straight into the
+    caller's preallocated ``(n,) + shape`` slab — contiguous runs of
+    non-null cells each go through ONE vectorized ``decode_batch(out=)``
+    call on the matching slab slice, and null positions are explicitly
+    ZERO-FILLED (never left as uninitialized or previous-slot bytes —
+    the slab may be a recycled staging-arena slot whose stale pixels
+    would otherwise leak into "null" rows). Returns ``out``.
     """
+    if out is not None:
+        codec = unischema_field.codec
+        n = len(values)
+        i = 0
+        while i < n:
+            if values[i] is None:
+                j = i
+                while j < n and values[j] is None:
+                    j += 1
+                out[i:j] = 0
+                i = j
+            else:
+                j = i
+                while j < n and values[j] is not None:
+                    j += 1
+                codec.decode_batch(unischema_field, values[i:j],
+                                   out=out[i:j])
+                i = j
+        return out
     non_null_idx = [i for i, v in enumerate(values) if v is not None]
     if len(non_null_idx) == len(values):
         return unischema_field.codec.decode_batch(unischema_field, values)
     decoded = unischema_field.codec.decode_batch(
         unischema_field, [values[i] for i in non_null_idx])
-    out = [None] * len(values)
+    result = [None] * len(values)
     for slot, i in enumerate(non_null_idx):
-        out[i] = decoded[slot]
-    return out
+        result[i] = decoded[slot]
+    return result
 
 
 class CompressedImageCodec(DataframeColumnCodec):
@@ -402,7 +514,7 @@ class CompressedImageCodec(DataframeColumnCodec):
         else:
             dst[...] = image
 
-    def decode_batch(self, unischema_field, encoded_iterable):
+    def decode_batch(self, unischema_field, encoded_iterable, out=None):
         """Batched decode with a threaded cv2 fan-out for fixed-shape fields.
 
         cv2.imdecode releases the GIL, so decoding cells on a small shared
@@ -413,6 +525,13 @@ class CompressedImageCodec(DataframeColumnCodec):
         (bad bytes, shape mismatch) fall back to the sequential per-cell
         path, which preserves reference semantics exactly.
 
+        ``out=`` selects the fused-decode destination contract: the rows
+        decode straight into the caller's buffer (a page-aligned column
+        slab or a staging-arena slot view), it must be ``(n,) + shape`` in
+        the field's dtype, and decode surprises RAISE instead of falling
+        back to a list — the caller owns the buffer's lifecycle and a
+        silent shape change would corrupt it.
+
         SURVEY §7.3 calls jpeg/png decode throughput the place the
         north-star input rate is won or lost; this is the corresponding
         hot-loop (reference equivalent: ``petastorm/codecs.py:102-130``,
@@ -422,29 +541,40 @@ class CompressedImageCodec(DataframeColumnCodec):
             else list(encoded_iterable)
         shape = unischema_field.shape
         n = len(cells)
+        if out is not None:
+            _check_out_destination(unischema_field, out, n)
+            self._decode_dense(unischema_field, cells, out)
+            return out
         if n >= 4 and shape and not any(d is None for d in shape):
             try:
-                out = np.empty((n,) + tuple(shape),
-                               dtype=unischema_field.numpy_dtype)
-                pool = _image_decode_pool()
-                if self._native_image_batch(unischema_field, cells, out,
-                                            pool):
-                    return out
-                if pool is None:
-                    for i in range(n):
-                        self._decode_into(unischema_field, cells[i], out[i])
-                else:
-                    list(pool.map(
-                        lambda i: self._decode_into(unischema_field,
-                                                    cells[i], out[i]),
-                        range(n)))
-                return out
+                dense = np.empty((n,) + tuple(shape),
+                                 dtype=unischema_field.numpy_dtype)
+                self._decode_dense(unischema_field, cells, dense)
+                return dense
             except Exception:  # noqa: BLE001 - dense path is an accelerator
                 logger.debug('Dense batched image decode failed; falling back '
                              'to the per-cell path', exc_info=True)
         return [self.decode(unischema_field, v) for v in cells]
 
-    def _native_image_batch(self, unischema_field, cells, out, pool):
+    def _decode_dense(self, unischema_field, cells, out):
+        """Decode every cell into its row of ``out``; raises on any decode
+        surprise (the no-``out`` caller catches and falls back). The
+        Python-side cv2 executor is consulted only AFTER the native path
+        declined — on the native one-call path it is never even created
+        (the one-pool contract of docs/env_knobs.md)."""
+        if self._native_image_batch(unischema_field, cells, out):
+            return
+        pool = _image_decode_pool()
+        if pool is None:
+            for i in range(len(cells)):
+                self._decode_into(unischema_field, cells[i], out[i])
+        else:
+            list(pool.map(
+                lambda i: self._decode_into(unischema_field,
+                                            cells[i], out[i]),
+                range(len(cells))))
+
+    def _native_image_batch(self, unischema_field, cells, out):
         """Decode an image batch with the first-party native loops
         (``native/jpeg_batch.c`` / ``native/png_batch.c``); True when
         ``out`` is fully populated.
@@ -459,54 +589,78 @@ class CompressedImageCodec(DataframeColumnCodec):
         Set env ``PETASTORM_TPU_JPEG_FANCY=1`` to force fancy, which is
         bit-identical-to-cv2 output (both ride libjpeg-turbo; see
         ``native/jpeg_batch.c``; requires the default ``islow`` DCT — not
-        ``PETASTORM_TPU_JPEG_DCT=ifast``), or ``=0`` to force merged. On hosts
-        with real parallelism the batch is chunked across the shared
-        decode pool instead, each chunk one native call. Cells the native
-        loop rejects (not a 3-component 8-bit image of the declared shape)
+        ``PETASTORM_TPU_JPEG_DCT=ifast``), or ``=0`` to force merged.
+
+        Parallelism is ONE pool, never two (docs/env_knobs.md): with
+        ``PETASTORM_TPU_IMAGE_DECODER_THREADS`` > 1 and a current native
+        build, the whole column goes down in a SINGLE native call whose
+        internal C-level pthread pool fans the cells out (no Python task
+        churn, no GIL round trips between chunks); only a stale ``.so``
+        predating the ``threads`` argument falls back to chunking the
+        batch across the shared Python executor. Cells the native loop
+        rejects (not a 3-component 8-bit image of the declared shape)
         finish through ``_decode_into``, whose failures propagate to the
         caller's sequential fallback.
         """
         if out.dtype != np.uint8 or out.ndim != 4 or out.shape[3] != 3:
             return False
-        decode_args = ()
         if self._image_codec in ('.jpeg', '.jpg'):
             from petastorm_tpu.native import get_jpeg_module
             native_mod = get_jpeg_module()
             decode_fn = getattr(native_mod, 'decode_jpeg_batch', None)
-            if decode_fn is not None:
-                mode = _jpeg_upsampling_mode(decode_fn, cells, out.shape[1:])
-                if mode >= 0:
-                    decode_args = (mode,)
+            if decode_fn is None:
+                return False
+            mode = _jpeg_upsampling_mode(decode_fn, cells, out.shape[1:])
+            # the jpeg threads argument is positional AFTER the mode, so
+            # the threaded call always names the mode explicitly (-1 =
+            # the C env-default contract); the chunked fallback keeps the
+            # historical arity for stale builds
+            threaded_prefix = (mode,)
+            decode_args = (mode,) if mode >= 0 else ()
         elif self._image_codec == '.png':
             from petastorm_tpu.native import get_png_module
             native_mod = get_png_module()
             decode_fn = getattr(native_mod, 'decode_png_batch', None)
+            if decode_fn is None:
+                return False
+            threaded_prefix = ()
+            decode_args = ()
         else:
             return False
-        if decode_fn is None:
-            return False
 
-        def run(lo, hi):
+        def run(lo, hi, call_args):
             # prefix-count contract: decode natively, route ONLY the
             # rejected cell through the generic path, then re-enter the
             # native loop on the tail (one oddball must not demote the
             # whole remaining chunk to per-cell decode)
             while lo < hi:
-                done = decode_fn(cells[lo:hi], out[lo:hi], *decode_args)
+                done = decode_fn(cells[lo:hi], out[lo:hi], *call_args)
                 lo += done
                 if lo < hi:
                     self._decode_into(unischema_field, cells[lo], out[lo])
                     lo += 1
 
         n = len(cells)
+        threads = image_decoder_threads()
+        if threads > 1 and _native_supports_threads(decode_fn, out,
+                                                    threaded_prefix):
+            # ONE native call: the C pool fans the whole row-group column
+            # out with the GIL released. The Python executor is NOT also
+            # engaged (nor created) — the knob sizes exactly one pool per
+            # batch.
+            run(0, n, threaded_prefix + (threads,))
+            return True
+        # only the chunked fallback (stale .so / serial knob) consults
+        # the Python-side executor into existence
+        pool = _image_decode_pool()
         workers = getattr(pool, '_max_workers', 0) if pool is not None else 0
         if workers > 1 and n >= 2 * workers:
             chunk = -(-n // workers)
             bounds = [(lo, min(lo + chunk, n))
                       for lo in range(0, n, chunk)]
-            list(pool.map(lambda b: run(*b), bounds))
+            list(pool.map(lambda b: run(b[0], b[1], decode_args), bounds))
         else:
-            run(0, n)
+            run(0, n, decode_args)
         return True
 
     def arrow_type(self, unischema_field):
@@ -530,39 +684,70 @@ class NdarrayCodec(DataframeColumnCodec):
         arr = np.load(BytesIO(bytes(encoded)), allow_pickle=False)
         return arr
 
-    def decode_batch(self, unischema_field, encoded_iterable):
+    def decode_batch(self, unischema_field, encoded_iterable, out=None):
         """Fixed-shape numeric fields take the native batched decoder (one C
-        call memcpy-ing all payloads into a preallocated ``(n,)+shape``
-        array); anything else — wildcard dims, strings, or cells the native
-        parser rejects — flows through the per-cell Python path."""
+        call parsing all headers then memcpy-ing every payload with the GIL
+        released — fanned across the internal pthread pool when
+        ``PETASTORM_TPU_IMAGE_DECODER_THREADS`` > 1); anything else —
+        wildcard dims, strings, or cells the native parser rejects — flows
+        through the per-cell Python path. ``out=`` decodes into the
+        caller's preallocated slab (fused-decode destination contract:
+        fixed-shape fields only; surprises raise)."""
         cells = list(encoded_iterable)
         shape = unischema_field.shape
-        if not cells or not shape or any(d is None for d in shape):
-            return super().decode_batch(unischema_field, cells)
+        if out is not None and not cells:
+            return out
+        fixed = bool(cells) and bool(shape) \
+            and not any(d is None for d in shape)
         try:
             dtype = np.dtype(unischema_field.numpy_dtype)
         except TypeError:
+            dtype = None
+        if not fixed or dtype is None or dtype.kind not in 'iufb':
+            if out is not None:
+                raise ValueError(
+                    'decode_batch(out=) requires a fixed-shape numeric '
+                    'field; %r has shape %r' % (unischema_field.name, shape))
             return super().decode_batch(unischema_field, cells)
-        if dtype.kind not in 'iufb':
-            return super().decode_batch(unischema_field, cells)
+        dense = out
+        if dense is not None:
+            _check_out_destination(unischema_field, dense, len(cells))
         from petastorm_tpu.native import get_native_module
         native = get_native_module()
         if native is None:
+            if dense is not None:
+                return super().decode_batch(unischema_field, cells,
+                                            out=dense)
             return super().decode_batch(unischema_field, cells)
-        out = np.empty((len(cells),) + shape, dtype=dtype)
+        if dense is None:
+            dense = np.empty((len(cells),) + shape, dtype=dtype)
         # numpy's header writer emits the shape tuple with canonical repr
         # spacing ("'shape': (2, 3)"), so an exact substring match rejects
         # any cell whose true shape differs from the declared one even when
         # the byte counts coincide (e.g. (3,2) vs (2,3)); rejected cells
         # fall back to the Python path, which preserves the true shape.
         shape_str = "'shape': %r" % (tuple(int(d) for d in shape),)
-        done = native.decode_npy_batch(cells, out, dtype.str, shape_str)
+        threads = image_decoder_threads()
+        try:
+            done = native.decode_npy_batch(cells, dense, dtype.str,
+                                           shape_str, threads)
+        except TypeError:  # stale .so predating the threads argument
+            done = native.decode_npy_batch(cells, dense, dtype.str,
+                                           shape_str)
         if done == len(cells):
             # Return the contiguous batch itself: downstream collation
             # (arrow_worker._stack) passes it through, avoiding a second
             # full-batch copy via np.stack.
+            return dense
+        if out is not None:
+            # fused destination: the rejected tail decodes per-cell into
+            # its rows; a true-shape mismatch raises (the caller owns the
+            # buffer and a silent broadcast would corrupt it)
+            for i in range(done, len(cells)):
+                _assign_row(out, i, self.decode(unischema_field, cells[i]),
+                            unischema_field)
             return out
-        rows = list(out[:done])
+        rows = list(dense[:done])
         rows.extend(self.decode(unischema_field, c) for c in cells[done:])
         return rows
 

@@ -1,0 +1,182 @@
+"""Fused batch-native decode: encoded cells ride to the staging arena.
+
+The decode→collate→fill copy chain used to run in three passes: the
+row-group worker decoded image cells into a fresh ``(n,)+shape`` batch,
+the JAX loader's collate stage buffered/sliced that batch, and the
+staging arena copied the slices into a slot before ``device_put``. This
+module collapses the chain to ONE pass, the operator-fusion move of
+tf.data (PAPERS.md, arxiv 2101.12127) applied to the decode tentpole
+(ROADMAP "Batch-granularity native decode, fused into the staging
+arena"):
+
+* the worker, when the reader was built with ``defer_image_decode=True``
+  (requested by :func:`petastorm_tpu.jax.make_jax_loader` whenever its
+  own batch path can fuse), SKIPS decoding eligible image columns and
+  publishes an :class:`EncodedImageColumn` — the still-encoded cells plus
+  the field that knows how to decode them;
+* the encoded column travels the exact route a decoded one would (noop
+  re-batcher chunk views, provenance sidecars, part slicing) — a few KB
+  of jpeg bytes per row instead of 150 KB of pixels, so every buffered
+  hop is cheaper too;
+* the staging engine's fill pass (:mod:`petastorm_tpu.jax.staging`)
+  decodes the cells **directly into the arena slot's rows** (or the
+  fresh page-aligned assembly buffer on host-backed targets) through the
+  codecs' ``decode_batch(..., out=)`` destination API, under the
+  ``decode_fused`` stage span — decoded pixels are written exactly once,
+  at their final host address, by the native batch decoders' internal
+  C-level thread pool.
+
+Eligibility is decided at two gates and every decline falls back to the
+classic worker-side batched decode, counted in
+``petastorm_tpu_fused_decode_fallbacks_total{reason=…}`` (the
+"decode is batched but not fused" runbook in docs/troubleshoot.md reads
+these): the worker defers only fixed-shape, numeric, null-free image
+columns on the no-transform/no-ngram/no-cache path; the loader
+materializes early when staging is off, rows are shuffled, or a dtype
+cast retargets the column.
+
+Ownership contract (pipesan): ``EncodedImageColumn.cells`` hold ZERO-COPY
+views over the arrow column's data buffer — borrowed memory, registered
+as a borrow source in ``analysis/contracts.py`` (``column.cells``). The
+column object carries its ``owner`` (the arrow column) so the views
+outlive every hop by construction, and pickling across a process/service
+pool materializes the cells into owned copies.
+"""
+
+import logging
+
+import numpy as np
+
+from petastorm_tpu.codecs import decode_batch_with_nulls
+from petastorm_tpu.telemetry import get_registry, metrics_disabled
+
+logger = logging.getLogger(__name__)
+
+#: registry counters (docs/telemetry.md metric reference)
+FUSED_ROWS = 'petastorm_tpu_fused_decode_rows_total'
+FUSED_BYTES = 'petastorm_tpu_fused_decode_bytes_total'
+FUSED_FALLBACKS = 'petastorm_tpu_fused_decode_fallbacks_total'
+
+#: column slabs align to page boundaries: XLA:CPU zero-copies suitably
+#: aligned host arrays into device handles (measured, jax/staging.py),
+#: and the native decoders' parallel row writes stay cache-line clean
+SLAB_ALIGN = 4096
+
+
+def alloc_column_slab(shape, dtype):
+    """A writable ``np.empty(shape, dtype)`` equivalent whose data starts
+    on a :data:`SLAB_ALIGN` (page) boundary — the row-group worker's
+    decode destination (``decode_batch(out=)``) and the shape of buffer
+    the staging engine's fresh-assembly path zero-copies from. The
+    backing allocation rides the returned view's ``.base`` chain, so the
+    slab owns its memory like any fresh ndarray."""
+    dtype = np.dtype(dtype)
+    nbytes = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+    if nbytes <= 0:
+        return np.empty(shape, dtype)
+    raw = np.empty(nbytes + SLAB_ALIGN, np.uint8)
+    offset = (-raw.ctypes.data) % SLAB_ALIGN
+    return raw[offset:offset + nbytes].view(dtype).reshape(shape)
+
+
+def count_fallback(reason):
+    """One fused-decode decline, attributed: the bench/runbook read these
+    to explain a ``fused_decode_mode`` that is not ``fused-into-slot``."""
+    if not metrics_disabled():
+        get_registry().counter(FUSED_FALLBACKS, reason=reason).inc()
+
+
+class EncodedImageColumn:
+    """A column whose cells are STILL ENCODED: the deferred-decode
+    carrier between the row-group worker and the staging arena.
+
+    Mimics just enough of the decoded dense column's ndarray surface
+    (``shape``/``dtype``/``len``/slicing) that the batch path between the
+    two — provenance tagging, the noop re-batcher's chunk views, part
+    slicing — needs no special cases; the first consumer that needs
+    pixels calls :meth:`decode_into` (staging fill, zero extra copies) or
+    :meth:`materialize` (fallback paths).
+
+    ``cells`` is a sequence of encoded bytes-like objects (zero-copy
+    ``np.uint8`` views over the arrow data buffer on the in-process
+    path); ``owner`` pins the arrow column those views alias. Cells may
+    not be None here — the worker's eligibility gate routes nullable
+    row-groups to the classic decode so null semantics never change —
+    but :meth:`decode_into` still zero-fills defensively via
+    ``decode_batch_with_nulls``.
+    """
+
+    __slots__ = ('field', 'cells', 'owner')
+
+    def __init__(self, field, cells, owner=None):
+        self.field = field
+        # Intentional transfer of the worker's borrowed cell views: the
+        # arrow column that owns their memory rides along in `owner`, so
+        # the views stay valid for this object's whole lifetime (and a
+        # cross-process pickle materializes owned copies).  # pipesan: owns
+        self.cells = cells
+        self.owner = owner
+
+    # -- ndarray-like surface -------------------------------------------------
+
+    @property
+    def shape(self):
+        return (len(self.cells),) + tuple(self.field.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(self.field.numpy_dtype)
+
+    @property
+    def nbytes(self):
+        """DECODED size (what the fused fill will write), not the encoded
+        payload size — the surface downstream accounting expects."""
+        return int(np.prod(self.shape, dtype=np.int64)) * self.dtype.itemsize
+
+    def __len__(self):
+        return len(self.cells)
+
+    def __getitem__(self, index):
+        """Slicing returns a VIEW column over the same cells (the noop
+        re-batcher splits chunks with ``col[:take]`` / ``col[take:]``);
+        anything but a slice is a contract error — per-row access means
+        some consumer thinks this is decoded data."""
+        if not isinstance(index, slice):
+            raise TypeError(
+                'EncodedImageColumn is encoded data; decode it '
+                '(decode_into/materialize) before per-row indexing')
+        return EncodedImageColumn(self.field, self.cells[index],
+                                  owner=self.owner)
+
+    # -- decode ---------------------------------------------------------------
+
+    def decode_into(self, out):
+        """Decode every cell into the caller's ``(n,) + field.shape``
+        destination — a staging-arena slot slice or a fresh assembly
+        buffer — in one vectorized pass (native batch decoders' internal
+        thread pool; null positions zero-filled). Returns ``out``."""
+        return decode_batch_with_nulls(self.field, self.cells, out=out)
+
+    def materialize(self):
+        """Decode to a fresh page-aligned owned batch — the fallback for
+        consumers that cannot provide a destination (staging disabled,
+        shuffled rows, dtype recast)."""
+        out = alloc_column_slab(self.shape, self.dtype)
+        return self.decode_into(out)
+
+    # -- pickling (process/service pools) ------------------------------------
+
+    def __getstate__(self):
+        # drop the arrow owner: the cells pickle as owned byte copies, so
+        # the receiving process needs (and must not pay for) no second
+        # copy of the arrow buffer riding along
+        return (self.field,
+                [None if c is None else bytes(c) for c in self.cells])
+
+    def __setstate__(self, state):
+        self.field, self.cells = state
+        self.owner = None
+
+    def __repr__(self):
+        return ('EncodedImageColumn(%r, n=%d, shape=%s)'
+                % (self.field.name, len(self.cells), self.shape))

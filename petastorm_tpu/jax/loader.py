@@ -26,6 +26,8 @@ import time
 
 import numpy as np
 
+from petastorm_tpu import fused
+from petastorm_tpu.fused import EncodedImageColumn
 from petastorm_tpu.jax import staging
 from petastorm_tpu.telemetry import (
     STALL_NOTE_FLOOR_S, StallAttributor, note_consumer_wait,
@@ -135,6 +137,20 @@ def make_jax_loader(dataset_url_or_urls, batch_size, mesh=None, data_axes=None,
             'inmemory_cache_all caches exactly one epoch and replays it; '
             'pass num_epochs=1 (the default) and re-iterate the loader for '
             'more epochs (got num_epochs=%r)' % (num_epochs,))
+    if reader_factory is None:
+        # Fused decode hand-shake (petastorm_tpu/fused.py): ask the
+        # worker for still-encoded image cells whenever this loader's
+        # batch path can decode them straight into its staging buffers —
+        # the no-row-shuffle noop re-batcher with the arena live. Other
+        # configurations (and per-field surprises like a dtype recast)
+        # fall back via the loader's own materialization, so requesting
+        # here is an optimization hint, never a correctness bet. Custom
+        # reader factories are left untouched — their signatures may not
+        # know the kwarg.
+        reader_kwargs.setdefault(
+            'defer_image_decode',
+            not shuffle_rows and bucket_boundaries is None
+            and staging.staging_enabled())
     reader = factory(dataset_url_or_urls, schema_fields=fields,
                      num_epochs=1 if inmemory_cache_all else num_epochs,
                      **reader_kwargs)
@@ -259,6 +275,10 @@ class JaxLoader:
         # counters would blend epoch 1's full decode cost into every
         # later epoch's hit rate and misread healthy warm passes)
         self._pass_baseline = None
+        # fused decode (petastorm_tpu/fused.py): the reason this loader
+        # last MATERIALIZED a deferred column instead of letting the
+        # arena fuse it (None = never declined); feeds fused_decode_mode
+        self._fused_fallback = None
 
     # -- sharding ------------------------------------------------------------
 
@@ -582,6 +602,10 @@ class JaxLoader:
                 # staging-side trace events (collate/h2d spans below)
                 # attach to the pull just folded in; no-op when untraced
                 with tracing.activate(self._last_pull_ctx, track='stager'):
+                    # deferred image columns that THIS pass cannot fuse
+                    # (staging off, shuffled rows, dtype recast) decode
+                    # now, in one vectorized pass per column
+                    columns = self._materialize_encoded(columns)
                     with span('collate'):
                         # densify BEFORE the buffer: a variable field
                         # arrives as a dense (n, ...) array from a uniform
@@ -635,6 +659,8 @@ class JaxLoader:
         buffers = {}
         for columns in self._pull_batches():
             with tracing.activate(self._last_pull_ctx, track='stager'):
+                # bucketed batching gathers per-row — always materialize
+                columns = self._materialize_encoded(columns)
                 with span('collate'):
                     if self._pad_ragged:
                         columns = self._densify_ragged(columns)
@@ -746,6 +772,39 @@ class JaxLoader:
                        for k, v in columns.items()}
             subcols[len_name] = lens[rows]
             yield bound, subcols
+
+    def _materialize_encoded(self, columns):
+        """Decode deferred image columns the CURRENT pass cannot fuse —
+        the staging arena is off, rows are shuffled (the random buffer
+        gathers decoded rows), batching is bucketed, or a ``dtypes=``
+        policy retargets the column's dtype (the fused fill writes the
+        codec's native dtype only). One vectorized ``materialize()`` per
+        column (native batch decoders, internal thread pool) — still the
+        batched regime, just not fused into the destination; each decline
+        is counted in ``petastorm_tpu_fused_decode_fallbacks_total`` so
+        the troubleshoot runbook can name the reason."""
+        out = None
+        for name, column in columns.items():
+            if not isinstance(column, EncodedImageColumn):
+                continue
+            if self._stager is None:
+                reason = 'staging-off'
+            elif self._shuffle_rows:
+                reason = 'shuffled-rows'
+            elif self._bucket_field is not None:
+                reason = 'bucketed'
+            else:
+                want = self._dtypes.get(name)
+                if want is None or np.dtype(want) == column.dtype:
+                    continue  # fusable: the arena fill decodes it
+                reason = 'dtype-cast'
+            if out is None:
+                out = dict(columns)
+            with span('decode'):
+                out[name] = column.materialize()
+            fused.count_fallback(reason)
+            self._fused_fallback = reason
+        return out if out is not None else columns
 
     def _retrieve_and_emit(self, buf):
         """Pull one batch from ``buf`` and emit it. With the staging arena
@@ -1006,8 +1065,29 @@ class JaxLoader:
                                 else staging.staging_enabled()),
             'staging_slots_allocated': (stager.slabs_allocated
                                         if stager is not None else 0),
+            # fused decode (docs/troubleshoot.md "decode is batched but
+            # not fused"): where decode ran for this pass's batches
+            'fused_decode_mode': self._fused_decode_mode(),
+            'fused_decode_rows': (stager.fused_rows
+                                  if stager is not None else 0),
         })
+        if self._fused_fallback is not None:
+            diag['fused_decode_fallback'] = self._fused_fallback
         return diag
+
+    def _fused_decode_mode(self):
+        """Where image decode ran for this pass: ``'fused-into-slot'``
+        (arena slot ring — the zero-extra-copy regime),
+        ``'fused-into-slab'`` (host-backed fresh assembly, still one
+        decode-to-destination pass), ``'batched'`` (classic worker-side
+        or loader-materialized batch decode), or ``'pending'`` before
+        the first delivery says which."""
+        stager = self._stager
+        if stager is not None and stager.fused_rows:
+            return stager.fused_mode
+        if self._fused_fallback is not None or self._batches_delivered:
+            return 'batched'
+        return 'pending'
 
     def pipeline_report(self, wall_time_s=None):
         """Process-wide per-stage breakdown + stall attribution

@@ -71,6 +71,9 @@ import logging
 import numpy as np
 
 from petastorm_tpu import sanitizer
+from petastorm_tpu.fused import (
+    FUSED_BYTES, FUSED_ROWS, EncodedImageColumn, count_fallback,
+)
 from petastorm_tpu.telemetry import (
     get_registry, knobs, metrics_disabled, register_refresh, span,
 )
@@ -224,6 +227,13 @@ class StagingEngine:
         # a single attribute read, not a knob parse
         self._sanitize = sanitizer.sanitize_enabled()
         self.slabs_quarantined = 0
+        # fused-decode accounting (petastorm_tpu/fused.py): rows decoded
+        # straight into destination buffers by _fill, and WHERE the last
+        # fused fill landed — 'fused-into-slot' (recycled arena slot,
+        # the zero-extra-copy regime) or 'fused-into-slab' (host-backed
+        # fresh assembly; still one decode pass, buffer not recycled)
+        self.fused_rows = 0
+        self.fused_mode = None
 
     # -- arena ---------------------------------------------------------------
 
@@ -291,17 +301,28 @@ class StagingEngine:
         """Assemble + dispatch one batch; ``columns`` is one column dict
         or a LIST of column-dict parts (chunk views from the noop
         re-batcher, copied in sequentially so the concatenated
-        intermediate never exists). Returns the device batch WITHOUT
+        intermediate never exists). Parts may carry still-encoded
+        :class:`~petastorm_tpu.fused.EncodedImageColumn` columns — those
+        DECODE during the fill, straight into the destination buffer
+        (the fused path), so such a batch always takes an assembly path,
+        never the direct dispatch. Returns the device batch WITHOUT
         waiting for the transfer to complete."""
         parts = columns if isinstance(columns, list) else [columns]
-        parts = [{name: np.asarray(arr) for name, arr in p.items()}
+        parts = [{name: (arr if isinstance(arr, EncodedImageColumn)
+                         else np.asarray(arr))
+                  for name, arr in p.items()}
                  for p in parts]
+        has_encoded = False
         for p in parts:
             for name, arr in p.items():
+                if isinstance(arr, EncodedImageColumn):
+                    # fixed-shape numeric by the worker's deferral gate
+                    has_encoded = True
+                    continue
                 _check_deviceable(name, arr)
         with_mask = self._last_batch == 'pad'
         full = n_valid >= self._batch_size
-        if (len(parts) == 1 and (full or not with_mask)
+        if (not has_encoded and len(parts) == 1 and (full or not with_mask)
                 and all(self._target_dtype(name, arr) == arr.dtype
                         for name, arr in parts[0].items())):
             # one ready chunk view, no cast, no pad: dispatch the source
@@ -404,15 +425,19 @@ class StagingEngine:
 
     def _fill(self, buffers, parts, n, with_mask):
         """Cast/pad/mask-assemble ``parts`` into ``buffers``; returns the
-        dict to dispatch (``[:n]`` views for a maskless short tail)."""
+        dict to dispatch (``[:n]`` views for a maskless short tail).
+        Encoded image parts DECODE here — the fused pass: the native
+        batch decoders write pixels straight into the destination rows
+        (``decode_batch(out=)``, internal C thread pool), so decoded
+        bytes exist exactly once, at their final host address."""
         full = n >= self._batch_size
         for name in parts[0]:
             dst = buffers[name]
             offset = 0
             for p in parts:
-                arr = p[name]
-                m = len(arr)
-                if arr.shape[1:] != dst.shape[1:]:
+                column = p[name]
+                m = len(column)
+                if column.shape[1:] != dst.shape[1:]:
                     # explicit, BEFORE the copy: np.copyto would happily
                     # BROADCAST a narrower chunk into the slot — silent
                     # corruption where the legacy np.concatenate raised
@@ -420,10 +445,14 @@ class StagingEngine:
                         'staging: field %r chunk of shape %s does not '
                         'fit the batch slot of shape %s; variable-shape '
                         'fields need pad_ragged= or bucket_boundaries='
-                        % (name, arr.shape, dst.shape))
-                # cast-during-copy: the single copy this path performs
-                # (same 'unsafe' semantics as .astype())
-                np.copyto(dst[offset:offset + m], arr, casting='unsafe')
+                        % (name, column.shape, dst.shape))
+                if isinstance(column, EncodedImageColumn):
+                    self._fill_fused(column, dst[offset:offset + m])
+                else:
+                    # cast-during-copy: the single copy this path performs
+                    # (same 'unsafe' semantics as .astype())
+                    np.copyto(dst[offset:offset + m], column,
+                              casting='unsafe')
                 offset += m
             if with_mask and not full:
                 dst[n:] = 0
@@ -434,6 +463,32 @@ class StagingEngine:
         if full or with_mask:
             return buffers
         return {name: buf[:n] for name, buf in buffers.items()}
+
+    def _fill_fused(self, column, dst):
+        """Decode one encoded part into its destination rows under the
+        ``decode_fused`` stage. The destination is the fused contract's
+        whole point: a recycled arena slot (ring mode) or the fresh
+        page-aligned assembly buffer (host-backed mode) — either way the
+        transfer dispatches from the very rows the decoder wrote. A
+        dtype-retargeted slot (defensive; the loader materializes those
+        upstream) decodes to a scratch batch and cast-copies — that
+        branch is a FALLBACK and must not count as fused: the rows/bytes
+        counters and ``fused_mode`` are exactly what the troubleshoot
+        runbook and the bench attribution read."""
+        if dst.dtype != column.dtype:
+            count_fallback('dtype-cast')
+            with span('decode'):
+                np.copyto(dst, column.materialize(), casting='unsafe')
+            return
+        with span('decode_fused'):
+            column.decode_into(dst)
+        self.fused_rows += len(column)
+        self.fused_mode = ('fused-into-slab' if self._host_backed
+                           else 'fused-into-slot')
+        if not metrics_disabled():
+            registry = get_registry()
+            registry.counter(FUSED_ROWS).inc(len(column))
+            registry.counter(FUSED_BYTES).inc(dst.nbytes)
 
     def release(self):
         """Pass end: drop the slot slabs and the in-flight device-array

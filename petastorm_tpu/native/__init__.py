@@ -33,11 +33,59 @@ _loaded = {}            # name -> module
 _attempted = set()      # names whose build/load already failed this process
 
 
+def _build_script(name):
+    """The exact setup script that builds extension ``name`` — also the
+    build's IDENTITY: the script text embeds every compile/link flag, so
+    hashing it (:func:`_build_identity`) captures a flag change (e.g.
+    adding ``-pthread``) the .c-mtime staleness check cannot see."""
+    source, opts = _EXTENSIONS[name]
+    include_lines = ''
+    # -pthread on both sides: the batch decoders fan cells across an
+    # internal pthread pool (jpeg_batch.c / png_batch.c / npy_batch.c)
+    ext_kwargs = "extra_compile_args=['-O3', '-pthread'], " \
+                 "extra_link_args=['-pthread']"
+    if opts.get('numpy_include'):
+        include_lines = 'import numpy as np\n'
+        ext_kwargs += ', include_dirs=[np.get_include()]'
+    if opts.get('libraries'):
+        ext_kwargs += ', libraries=%r' % (opts['libraries'],)
+    return (
+        'import os\n'
+        'from setuptools import setup, Extension\n'
+        + include_lines +
+        'os.chdir(%r)\n'
+        "setup(name=%r, script_args=['build_ext', '--inplace'],\n"
+        '      ext_modules=[Extension(%r, [%r], %s)])\n'
+    ) % (_HERE, name, name, source, ext_kwargs)
+
+
+def _build_identity(name):
+    """Stable fingerprint of everything that determines the built .so
+    besides the C source bytes: the generated build script (flags,
+    libraries, include dirs) and the interpreter's ABI tag."""
+    import hashlib
+    abi = sysconfig.get_config_var('EXT_SUFFIX') or '.so'
+    return hashlib.md5(
+        (_build_script(name) + abi).encode('utf-8')).hexdigest()
+
+
+def _identity_path(name):
+    return os.path.join(_HERE, name + '.buildid')
+
+
 def _find_built_extension(name):
     """Path of a current compiled extension, or None.
 
-    A .so older than its C source is stale (the exported signature may have
-    changed) and is treated as absent so it gets rebuilt.
+    Two staleness probes, either of which forces a rebuild:
+
+    * the .so is older than its C source (the exported signature may have
+      changed);
+    * the recorded build identity (``<name>.buildid``, written by
+      :func:`_build_extension`) differs from the CURRENT build script's —
+      a compiler/linker-flag change (e.g. adding ``-pthread``) must not
+      load a stale extension whose binary never saw the flag. A missing
+      sidecar counts as stale for the same reason: the .so predates
+      identity tracking, so nothing vouches for its flags.
     """
     suffix = sysconfig.get_config_var('EXT_SUFFIX') or '.so'
     path = os.path.join(_HERE, name + suffix)
@@ -49,8 +97,16 @@ def _find_built_extension(name):
             return None
     except OSError:
         # Source missing (pruned install): a .so with no source to compare
-        # against cannot be stale — use it.
-        pass
+        # against cannot be stale — use it (identity is moot too: without
+        # the source a rebuild is impossible anyway).
+        return path
+    try:
+        with open(_identity_path(name)) as f:
+            recorded = f.read().strip()
+    except OSError:
+        return None
+    if recorded != _build_identity(name):
+        return None
     return path
 
 
@@ -63,22 +119,7 @@ def _build_extension(name):
     """
     import subprocess
     import sys
-    source, opts = _EXTENSIONS[name]
-    include_lines = ''
-    ext_kwargs = "extra_compile_args=['-O3']"
-    if opts.get('numpy_include'):
-        include_lines = 'import numpy as np\n'
-        ext_kwargs += ', include_dirs=[np.get_include()]'
-    if opts.get('libraries'):
-        ext_kwargs += ', libraries=%r' % (opts['libraries'],)
-    script = (
-        'import os\n'
-        'from setuptools import setup, Extension\n'
-        + include_lines +
-        'os.chdir(%r)\n'
-        "setup(name=%r, script_args=['build_ext', '--inplace'],\n"
-        '      ext_modules=[Extension(%r, [%r], %s)])\n'
-    ) % (_HERE, name, name, source, ext_kwargs)
+    script = _build_script(name)
     lock_path = os.path.join(_HERE, '.build.lock')
     with open(lock_path, 'w') as lock_file:
         try:
@@ -97,6 +138,11 @@ def _build_extension(name):
             subprocess.run(  # pipecheck: disable=blocking-under-lock
                 [sys.executable, '-c', script], check=True,
                 capture_output=True, timeout=120)
+            # record the build identity AFTER a successful build (still
+            # under the flock): the sidecar only ever describes a .so
+            # that really was produced by this script
+            with open(_identity_path(name), 'w') as f:
+                f.write(_build_identity(name))
 
 
 def native_disabled():

@@ -1,10 +1,16 @@
 /* Native batched JPEG decode: the hot inner loop of CompressedImageCodec.
  *
- * decode_jpeg_batch(cells, out): decode each JPEG cell straight into row i
- * of a preallocated (N, H, W, 3) uint8 batch with libjpeg(-turbo), RGB
- * output, ISLOW DCT (turbo's SIMD path). The whole loop runs with the GIL
- * RELEASED in one native call: no per-cell Python dispatch, no thread-pool
- * task churn, no intermediate Mat/ndarray per cell.
+ * decode_jpeg_batch(cells, out, fancy=-1, threads=0): decode each JPEG
+ * cell straight into row i of a preallocated (N, H, W, 3) uint8 batch
+ * with libjpeg(-turbo), RGB output, ISLOW DCT (turbo's SIMD path). The
+ * whole loop runs with the GIL RELEASED in one native call: no per-cell
+ * Python dispatch, no thread-pool task churn, no intermediate
+ * Mat/ndarray per cell. `threads > 1` fans the cells across an internal
+ * pthread pool (one decompress object + row scratch per thread, disjoint
+ * output rows) — true row-group-batch decode without Python-side task
+ * churn or GIL round trips between chunks; the pool is sized by the
+ * caller from PETASTORM_TPU_IMAGE_DECODER_THREADS so the C pool and the
+ * Python-side executor never multiply.
  *
  * Upsampling policy: WHICH of libjpeg's two 4:2:0/4:2:2 chroma paths is
  * faster depends on the host's libjpeg build — merged upsampling skips a
@@ -36,12 +42,18 @@
 
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
+#include <pthread.h>
 #include <setjmp.h>
 #include <stddef.h>
 #include <stdio.h>
+#include <stdlib.h>
 #include <string.h>
 #include <strings.h>  /* strcasecmp: POSIX, not ISO string.h */
 #include <jpeglib.h>
+
+/* clamp on the internal decode pool: beyond this, thread-spawn cost and
+ * memory-bandwidth contention dominate any decode parallelism win */
+#define PT_MAX_THREADS 32
 
 struct pt_jpeg_error_mgr {
     struct jpeg_error_mgr pub;
@@ -117,6 +129,101 @@ decode_one(struct jpeg_decompress_struct *cinfo, const unsigned char *buf,
     return 0;
 }
 
+/* One contiguous cell range decoded by one pool thread: each worker owns
+ * its decompress object, error jmp target and row-pointer scratch, so the
+ * only shared state is the disjoint output rows. `fail` is the first
+ * index in [lo, hi) whose cell was rejected (== hi when the whole range
+ * decoded); the dispatcher folds the per-range failures back into the
+ * batch-wide decoded-prefix contract. */
+struct pt_jpeg_task {
+    const Py_buffer *views;
+    unsigned char *out_base;
+    size_t row_bytes;
+    Py_ssize_t lo, hi;
+    Py_ssize_t fail;
+    int height, width;
+    boolean fancy;
+    J_DCT_METHOD dct;
+};
+
+static void *
+pt_jpeg_worker(void *arg)
+{
+    struct pt_jpeg_task *t = (struct pt_jpeg_task *)arg;
+    struct jpeg_decompress_struct cinfo;
+    struct pt_jpeg_error_mgr jerr;
+    JSAMPROW *rows;
+    /* mutated between setjmp and a possible longjmp: must be volatile or
+     * its post-longjmp value is indeterminate */
+    volatile Py_ssize_t i_v = t->lo;
+
+    t->fail = t->lo;
+    rows = (JSAMPROW *)malloc(sizeof(JSAMPROW)
+                              * (size_t)(t->height ? t->height : 1));
+    if (rows == NULL)
+        return NULL;
+    cinfo.err = jpeg_std_error(&jerr.pub);
+    jerr.pub.error_exit = pt_error_exit;
+    jerr.pub.emit_message = pt_emit_message;
+    if (setjmp(jerr.setjmp_buffer) == 0) {
+        jpeg_create_decompress(&cinfo);
+        for (; i_v < t->hi; i_v = i_v + 1) {
+            const Py_buffer *v = &t->views[i_v];
+            if (decode_one(&cinfo, (const unsigned char *)v->buf,
+                           (size_t)v->len,
+                           t->out_base + (size_t)i_v * t->row_bytes,
+                           t->height, t->width, rows, t->fancy,
+                           t->dct) != 0)
+                break;
+        }
+        t->fail = i_v;
+    } else {
+        /* corrupt-data longjmp mid-cell: that cell is the failure */
+        t->fail = i_v;
+    }
+    jpeg_destroy_decompress(&cinfo);
+    free(rows);
+    return NULL;
+}
+
+/* Fan n_views cells across `threads` pool threads (the calling thread is
+ * worker 0) and fold the per-range failures into the batch-wide decoded
+ * prefix: the first rejected index overall. Cells after that index in
+ * OTHER ranges were decoded too — harmless, the caller may re-dispatch
+ * them — but the prefix contract only promises the leading run. Runs
+ * with the GIL released; workers never touch the Python API. */
+static Py_ssize_t
+pt_jpeg_run(struct pt_jpeg_task *tasks, Py_ssize_t n_tasks,
+            Py_ssize_t n_views)
+{
+    pthread_t tids[PT_MAX_THREADS];
+    int created[PT_MAX_THREADS] = {0};
+    Py_ssize_t t, decoded;
+
+    for (t = 1; t < n_tasks; t++) {
+        if (pthread_create(&tids[t], NULL, pt_jpeg_worker,
+                           &tasks[t]) != 0) {
+            /* spawn failure: this range decodes 0 cells (fail == lo,
+             * pre-set by the dispatcher) and the prefix fold below
+             * reports it honestly */
+            tasks[t].fail = tasks[t].lo;
+            continue;
+        }
+        created[t] = 1;
+    }
+    pt_jpeg_worker(&tasks[0]);
+    for (t = 1; t < n_tasks; t++) {
+        if (created[t])
+            pthread_join(tids[t], NULL);
+    }
+    decoded = n_views;
+    for (t = 0; t < n_tasks; t++) {
+        if (tasks[t].fail < tasks[t].hi && tasks[t].fail < decoded)
+            decoded = tasks[t].fail;
+    }
+    return decoded;
+}
+
 static PyObject *
 decode_jpeg_batch(PyObject *self, PyObject *args)
 {
@@ -127,9 +234,11 @@ decode_jpeg_batch(PyObject *self, PyObject *args)
     Py_buffer *views = NULL;
     int height, width;
     int fancy_arg = -1;
+    int threads_arg = 0;
 
     (void)self;
-    if (!PyArg_ParseTuple(args, "OO|i", &cells, &out_obj, &fancy_arg))
+    if (!PyArg_ParseTuple(args, "OO|ii", &cells, &out_obj, &fancy_arg,
+                          &threads_arg))
         return NULL;
     /* C-contiguous + ND so shape[] is populated (a plain "w*" request
      * yields a 1-D view with no shape information) */
@@ -182,61 +291,56 @@ decode_jpeg_batch(PyObject *self, PyObject *args)
         Py_ssize_t n_views = i;
         size_t row_bytes = (size_t)height * (size_t)width * 3;
         unsigned char *out_base = (unsigned char *)out_view.buf;
-        JSAMPROW *rows = PyMem_Malloc(sizeof(JSAMPROW)
-                                      * (size_t)(height ? height : 1));
+        struct pt_jpeg_task tasks[PT_MAX_THREADS];
+        Py_ssize_t n_tasks, t, chunk;
+        boolean fancy;
+        J_DCT_METHOD dct;
 
-        decoded = 0;
-        if (rows != NULL) {
-            struct jpeg_decompress_struct cinfo;
-            struct pt_jpeg_error_mgr jerr;
-            boolean fancy;
-            if (fancy_arg >= 0) {
-                /* caller-selected mode (the Python calibration path) */
-                fancy = fancy_arg ? TRUE : FALSE;
-            } else {
-                /* value-parsed, not presence-tested: FANCY=0 / FANCY=
-                 * must keep the merged default (docs say "set ...=1") */
-                const char *fancy_env = getenv("PETASTORM_TPU_JPEG_FANCY");
-                fancy = (fancy_env != NULL && fancy_env[0] != '\0'
-                         && strcmp(fancy_env, "0") != 0) ? TRUE : FALSE;
-            }
-            /* DCT selector: "ifast" opts into turbo's fast integer DCT
-             * (a further ~few-%% rate win at a small accuracy cost some
-             * tf.data imagenet pipelines also take via INTEGER_FAST);
-             * default ISLOW — turbo's SIMD path, and the method cv2 /
-             * tf.data use by default, keeping the bit-exactness contract
-             * under PETASTORM_TPU_JPEG_FANCY=1 intact. */
-            const char *dct_env = getenv("PETASTORM_TPU_JPEG_DCT");
-            J_DCT_METHOD dct = (dct_env != NULL
-                                && strcasecmp(dct_env, "ifast") == 0)
-                                   ? JDCT_IFAST : JDCT_ISLOW;
-            /* mutated between setjmp and a possible longjmp: must be
-             * volatile or its post-longjmp value is indeterminate */
-            volatile Py_ssize_t done_v = 0;
-
-            Py_BEGIN_ALLOW_THREADS
-            cinfo.err = jpeg_std_error(&jerr.pub);
-            jerr.pub.error_exit = pt_error_exit;
-            jerr.pub.emit_message = pt_emit_message;
-            if (setjmp(jerr.setjmp_buffer) == 0) {
-                jpeg_create_decompress(&cinfo);
-                for (i = 0; i < n_views; i++) {
-                    if (decode_one(&cinfo,
-                                   (const unsigned char *)views[i].buf,
-                                   (size_t)views[i].len,
-                                   out_base + (size_t)i * row_bytes,
-                                   height, width, rows, fancy, dct) != 0)
-                        break;
-                    done_v = done_v + 1;
-                }
-            }
-            /* reached normally OR via a corrupt-data longjmp: either way
-             * the object exists and is destroyed exactly once */
-            jpeg_destroy_decompress(&cinfo);
-            Py_END_ALLOW_THREADS
-            PyMem_Free(rows);
-            decoded = done_v;
+        if (fancy_arg >= 0) {
+            /* caller-selected mode (the Python calibration path) */
+            fancy = fancy_arg ? TRUE : FALSE;
+        } else {
+            /* value-parsed, not presence-tested: FANCY=0 / FANCY=
+             * must keep the merged default (docs say "set ...=1") */
+            const char *fancy_env = getenv("PETASTORM_TPU_JPEG_FANCY");
+            fancy = (fancy_env != NULL && fancy_env[0] != '\0'
+                     && strcmp(fancy_env, "0") != 0) ? TRUE : FALSE;
         }
+        /* DCT selector: "ifast" opts into turbo's fast integer DCT
+         * (a further ~few-%% rate win at a small accuracy cost some
+         * tf.data imagenet pipelines also take via INTEGER_FAST);
+         * default ISLOW — turbo's SIMD path, and the method cv2 /
+         * tf.data use by default, keeping the bit-exactness contract
+         * under PETASTORM_TPU_JPEG_FANCY=1 intact. */
+        {
+            const char *dct_env = getenv("PETASTORM_TPU_JPEG_DCT");
+            dct = (dct_env != NULL && strcasecmp(dct_env, "ifast") == 0)
+                      ? JDCT_IFAST : JDCT_ISLOW;
+        }
+        n_tasks = threads_arg;
+        if (n_tasks > PT_MAX_THREADS)
+            n_tasks = PT_MAX_THREADS;
+        if (n_tasks > n_views)
+            n_tasks = n_views;
+        if (n_tasks < 1)
+            n_tasks = 1;
+        chunk = (n_views + n_tasks - 1) / (n_tasks ? n_tasks : 1);
+        for (t = 0; t < n_tasks; t++) {
+            tasks[t].views = views;
+            tasks[t].out_base = out_base;
+            tasks[t].row_bytes = row_bytes;
+            tasks[t].lo = t * chunk;
+            tasks[t].hi = (t + 1) * chunk < n_views
+                              ? (t + 1) * chunk : n_views;
+            tasks[t].fail = tasks[t].lo;
+            tasks[t].height = height;
+            tasks[t].width = width;
+            tasks[t].fancy = fancy;
+            tasks[t].dct = dct;
+        }
+        Py_BEGIN_ALLOW_THREADS
+        decoded = pt_jpeg_run(tasks, n_tasks, n_views);
+        Py_END_ALLOW_THREADS
 
         for (i = 0; i < n_views; i++)
             PyBuffer_Release(&views[i]);
@@ -248,10 +352,12 @@ decode_jpeg_batch(PyObject *self, PyObject *args)
 
 static PyMethodDef jpeg_batch_methods[] = {
     {"decode_jpeg_batch", decode_jpeg_batch, METH_VARARGS,
-     "decode_jpeg_batch(cells, out, fancy=-1): batched RGB JPEG decode "
-     "into a preallocated (N,H,W,3) uint8 array; returns the decoded "
-     "prefix count. fancy: 1 = fancy upsampling (cv2-bit-identical), "
-     "0 = merged, -1 = PETASTORM_TPU_JPEG_FANCY env default"},
+     "decode_jpeg_batch(cells, out, fancy=-1, threads=0): batched RGB "
+     "JPEG decode into a preallocated (N,H,W,3) uint8 array; returns the "
+     "decoded prefix count. fancy: 1 = fancy upsampling "
+     "(cv2-bit-identical), 0 = merged, -1 = PETASTORM_TPU_JPEG_FANCY env "
+     "default. threads > 1 fans the cells across an internal pthread "
+     "pool (GIL released; one decompress object per thread)"},
     {NULL, NULL, 0, NULL}
 };
 

@@ -18,7 +18,7 @@ import logging
 import time
 import warnings
 
-from petastorm_tpu.arrow_worker import RowGroupWorker
+from petastorm_tpu.arrow_worker import RowGroupWorker, defer_config_ok
 from petastorm_tpu.telemetry import note_consumer_wait, span, tracing
 from petastorm_tpu.cache import LocalDiskCache, NullCache
 from petastorm_tpu.errors import MetadataError, NoDataAvailableError
@@ -112,12 +112,22 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None,
                       shard_count=None, seed=0, cache_type='null',
                       cache_location=None, cache_size_limit=None,
                       cache_row_size_estimate=None, transform_spec=None,
-                      filters=None, storage_options=None, filesystem=None):
+                      filters=None, storage_options=None, filesystem=None,
+                      defer_image_decode=False):
     """Reader yielding whole row-groups as namedtuples of column arrays.
 
     Works on any Parquet store, petastorm metadata or not
     (parity: ``petastorm/reader.py:198-328``). ``filters`` and
     ``filesystem`` as in :func:`make_reader`.
+
+    :param defer_image_decode: the fused-decode hand-shake
+        (:mod:`petastorm_tpu.fused`): eligible image columns are
+        published as still-encoded
+        :class:`~petastorm_tpu.fused.EncodedImageColumn` stubs instead of
+        decoded pixels, for a consumer (the JAX loader's staging arena)
+        that decodes them straight into its destination buffers. Plain
+        batch consumers should leave this off — namedtuple batches would
+        carry encoded stubs.
     """
     info = ParquetDatasetInfo(dataset_url_or_urls, storage_options,
                               filesystem=filesystem)
@@ -133,7 +143,8 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None,
                                     cache_row_size_estimate,
                                     predicate=predicate),
                   transform_spec=transform_spec, ngram=None, filters=filters,
-                  batched_output=True)
+                  batched_output=True,
+                  defer_image_decode=defer_image_decode)
 
 
 def _make_cache(cache_type, location, size_limit, row_size_estimate,
@@ -249,7 +260,8 @@ class Reader:
                  shuffle_row_drop_partitions=1, predicate=None,
                  rowgroup_selector=None, num_epochs=1, cur_shard=None,
                  shard_count=None, seed=0, cache=None, transform_spec=None,
-                 ngram=None, filters=None, batched_output=True):
+                 ngram=None, filters=None, batched_output=True,
+                 defer_image_decode=False):
         self.dataset_info = dataset_info
         self.batched_output = batched_output and ngram is None
         self.ngram = ngram
@@ -352,6 +364,12 @@ class Reader:
 
         # (5) start workers; ventilation begins lazily on first read so that
         # load_state_dict can reposition the cursor first.
+        defer = defer_image_decode and self.batched_output
+        if defer and not defer_config_ok(transform_spec, ngram, cache):
+            # counted HERE, once per Reader — the N workers each re-derive
+            # the same gate silently (docs/troubleshoot.md reads this)
+            from petastorm_tpu.fused import count_fallback
+            count_fallback('worker-config')
         self._pool.start(RowGroupWorker,
                          worker_args={
                              'dataset_info': dataset_info,
@@ -362,6 +380,9 @@ class Reader:
                              'cache': cache,
                              'ngram': ngram,
                              'row_groups': all_pieces,
+                             # fused decode (petastorm_tpu/fused.py): only
+                             # batched consumers can host encoded stubs
+                             'defer_image_decode': defer,
                          },
                          ventilator=self._ventilator, start_ventilator=False)
 

@@ -86,6 +86,27 @@ class TestNativeDecoder:
         out = np.empty((1, 2, 2), np.float32)
         assert native.decode_npy_batch([_npy(arr)], out, '<f4', _shape_str(out)) == 0
 
+    def test_threads_arg_parity_and_prefix(self, native):
+        """The internal-pool spelling (trailing threads arg) decodes the
+        same bytes to the same rows as the serial call, and a mid-batch
+        oddball keeps the decoded-prefix contract."""
+        rng = np.random.RandomState(3)
+        arrs = [rng.rand(8, 16).astype(np.float32) for _ in range(24)]
+        cells = [_npy(a) for a in arrs]
+        serial = np.empty((24, 8, 16), np.float32)
+        pooled = np.empty_like(serial)
+        assert native.decode_npy_batch(cells, serial, '<f4',
+                                       _shape_str(serial)) == 24
+        assert native.decode_npy_batch(cells, pooled, '<f4',
+                                       _shape_str(pooled), 4) == 24
+        np.testing.assert_array_equal(serial, pooled)
+        bad = list(cells)
+        bad[5] = b'not-an-npy'
+        prefix = np.empty_like(serial)
+        assert native.decode_npy_batch(bad, prefix, '<f4',
+                                       _shape_str(prefix), 4) == 5
+        np.testing.assert_array_equal(prefix[:5], serial[:5])
+
 
 class TestCodecIntegration:
     def test_codec_batch_equals_per_cell(self):
@@ -128,6 +149,40 @@ class TestCodecIntegration:
         arrs = [np.ones((i + 1, 3), np.float32) for i in range(3)]
         batch = codec.decode_batch(field, [codec.encode(field, a) for a in arrs])
         assert [b.shape for b in batch] == [(1, 3), (2, 3), (3, 3)]
+
+
+class TestBuildStaleness:
+    """The staleness probe covers the BUILD IDENTITY, not just .c mtime:
+    a compiler/linker-flag change (e.g. adding -pthread) must trigger a
+    rebuild instead of loading a stale extension (ISSUE 9 satellite)."""
+
+    def test_current_build_is_found(self, native):
+        import petastorm_tpu.native as nat
+        assert nat._find_built_extension('_npy_batch') is not None
+
+    def test_flag_identity_change_marks_stale(self, native, monkeypatch):
+        import petastorm_tpu.native as nat
+        monkeypatch.setattr(nat, '_build_identity',
+                            lambda name: 'changed-flags')
+        assert nat._find_built_extension('_npy_batch') is None
+
+    def test_missing_identity_sidecar_marks_stale(self, native, monkeypatch,
+                                                  tmp_path):
+        # a .so that predates identity tracking has nothing vouching for
+        # its flags: rebuild once rather than trust it
+        import petastorm_tpu.native as nat
+        monkeypatch.setattr(nat, '_identity_path',
+                            lambda name: str(tmp_path / 'absent'))
+        assert nat._find_built_extension('_npy_batch') is None
+
+    def test_identity_covers_compile_flags(self):
+        # the identity hashes the generated build script, which embeds
+        # the flags — so the -pthread addition itself re-keys every build
+        import petastorm_tpu.native as nat
+        script = nat._build_script('_npy_batch')
+        assert '-pthread' in script
+        assert nat._build_identity('_npy_batch') \
+            != __import__('hashlib').md5(b'other').hexdigest()
 
 
 @pytest.fixture(scope='module')
@@ -229,6 +284,24 @@ class TestNativeJpegDecoder:
         out = np.empty((3, 48, 64, 3), np.uint8)
         assert jpeg_native.decode_jpeg_batch(
             [cells[0], enc.tobytes(), cells[1]], out) == 1
+
+    def test_threads_arg_parity_and_prefix(self, jpeg_native, monkeypatch):
+        """decode_jpeg_batch(cells, out, fancy, threads): the internal
+        pthread pool decodes bit-identically to the serial loop (same
+        mode, same libjpeg), and a corrupt mid-batch cell keeps the
+        decoded-prefix contract across chunk boundaries."""
+        monkeypatch.delenv('PETASTORM_TPU_JPEG_FANCY', raising=False)
+        cells, _ = _jpeg_cells(11)
+        serial = np.empty((11, 48, 64, 3), np.uint8)
+        pooled = np.empty_like(serial)
+        assert jpeg_native.decode_jpeg_batch(cells, serial, 1) == 11
+        assert jpeg_native.decode_jpeg_batch(cells, pooled, 1, 4) == 11
+        np.testing.assert_array_equal(serial, pooled)
+        bad = list(cells)
+        bad[3] = bad[3][:40]
+        prefix = np.empty_like(serial)
+        assert jpeg_native.decode_jpeg_batch(bad, prefix, 1, 4) == 3
+        np.testing.assert_array_equal(prefix[:3], serial[:3])
 
     def test_arrow_buffer_cells(self, jpeg_native):
         import pyarrow as pa
@@ -447,6 +520,53 @@ class TestNativePngDecoder:
             [cells[0], enc.tobytes(), cells[1]], out) == 1
         small = np.empty((2, 16, 16, 3), np.uint8)
         assert png_native.decode_png_batch(cells, small) == 0
+
+    def test_threads_arg_parity_and_prefix(self, png_native):
+        cells, images = self._png_cells(9)
+        serial = np.empty((9, 32, 32, 3), np.uint8)
+        pooled = np.empty_like(serial)
+        assert png_native.decode_png_batch(cells, serial) == 9
+        assert png_native.decode_png_batch(cells, pooled, 4) == 9
+        np.testing.assert_array_equal(serial, pooled)
+        bad = list(cells)
+        bad[2] = bad[2][:30]
+        prefix = np.empty_like(serial)
+        assert png_native.decode_png_batch(bad, prefix, 4) == 2
+        np.testing.assert_array_equal(prefix[:2], serial[:2])
+
+    def test_internal_pool_takes_one_native_call(self, png_native,
+                                                 monkeypatch):
+        """With PETASTORM_TPU_IMAGE_DECODER_THREADS > 1 and a current
+        build, the codec issues ONE native call carrying the threads
+        argument — the C pool fans out; the Python executor is never
+        engaged for the batch (the knob must not multiply into
+        threads x threads, docs/env_knobs.md)."""
+        from petastorm_tpu import codecs
+        from petastorm_tpu.codecs import CompressedImageCodec
+        monkeypatch.setenv('PETASTORM_TPU_IMAGE_DECODER_THREADS', '3')
+        # start from no cached executor so the assertion below really
+        # proves the native path never consults one into existence
+        monkeypatch.setattr(codecs, '_IMAGE_POOL', None)
+        calls = []
+        real = png_native.decode_png_batch
+
+        def spy(cells, out, *args):
+            calls.append((len(cells), args))
+            return real(cells, out, *args)
+
+        monkeypatch.setattr(png_native, 'decode_png_batch', spy)
+        monkeypatch.setitem(codecs._NATIVE_THREADS_SUPPORT, spy, True)
+        codec = CompressedImageCodec('png')
+        field = UnischemaField('im', np.uint8, (32, 32, 3), codec, False)
+        cells, images = self._png_cells(8, seed=11)
+        batch = codec.decode_batch(field, cells)
+        real_calls = [(n, args) for n, args in calls if n > 0]
+        assert real_calls == [(8, (3,))], calls
+        # the C pool took the batch, so the Python-side executor was
+        # never even created (one pool per batch, docs/env_knobs.md)
+        assert codecs._IMAGE_POOL is None
+        for i in range(8):
+            np.testing.assert_array_equal(batch[i], images[i])
 
     def test_codec_batch_uses_native_and_matches(self, png_native,
                                                  monkeypatch):
