@@ -90,6 +90,10 @@ METRIC_NAMES = frozenset([
     'petastorm_tpu_fused_decode_rows_total',
     'petastorm_tpu_fused_decode_bytes_total',
     'petastorm_tpu_fused_decode_fallbacks_total',
+    # live observability plane (telemetry/timeseries.py + obs_server.py)
+    'petastorm_tpu_anomaly_events_total',
+    'petastorm_tpu_obs_windows_total',
+    'petastorm_tpu_obs_scrapes_total',
 ])
 
 #: prefix of every operator-facing environment knob
@@ -120,7 +124,34 @@ KNOWN_KNOBS = frozenset([
     'PETASTORM_TPU_DECODED_CACHE_MEM_MB',
     'PETASTORM_TPU_DECODED_CACHE_DISK_MB',
     'PETASTORM_TPU_SANITIZE',
+    'PETASTORM_TPU_OBS_PORT',
+    'PETASTORM_TPU_OBS_HOST',
+    'PETASTORM_TPU_OBS_WINDOW_SEC',
+    'PETASTORM_TPU_OBS_WINDOWS',
+    'PETASTORM_TPU_OBS_COLLAPSE_FRAC',
+    'PETASTORM_TPU_OBS_SATURATED_SHARE',
+    'PETASTORM_TPU_OBS_FLAP_FLIPS',
 ])
+
+#: canonical anomaly event kinds the live observability plane's detector
+#: (:mod:`petastorm_tpu.telemetry.timeseries`) may emit, mapped to the
+#: docs/troubleshoot.md runbook HEADING that explains each one. The value
+#: rides on every emitted event as its ``runbook`` field, and
+#: ``tests/test_hygiene.py`` holds (a) every ``record_anomaly`` literal in
+#: the package to this set, (b) every kind to a row in docs/telemetry.md's
+#: anomaly table, and (c) every heading here to a real ``##`` section of
+#: docs/troubleshoot.md — an event that names a missing runbook is a
+#: hygiene failure, not an operator dead end.
+ANOMALY_KINDS = {
+    'throughput_collapse': 'Throughput collapsed mid-epoch',
+    'stall_flap': 'Stall verdict flaps between producer- and '
+                  'consumer-bound',
+    'queue_saturated': 'My pipeline is consumer-bound — is it the '
+                       'training step or the H2D link?',
+    'heartbeat_gap': 'Stale decode workers after a crash',
+    'h2d_starvation': 'My pipeline is consumer-bound — is it the '
+                      'training step or the H2D link?',
+}
 
 #: the one knob-truthiness rule for "disable"/"enable" env spellings —
 #: shared by every PETASTORM_TPU_* switch so spellings cannot drift

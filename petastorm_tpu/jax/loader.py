@@ -279,6 +279,13 @@ class JaxLoader:
         # last MATERIALIZED a deferred column instead of letting the
         # arena fuse it (None = never declined); feeds fused_decode_mode
         self._fused_fallback = None
+        # live observability plane (docs/telemetry.md): the loader
+        # contributes its staging-side gauges to /health and the live
+        # autotune verdict to /report; no-op when unarmed
+        from petastorm_tpu.telemetry import obs_server
+        self._obs_mount = obs_server.mount(
+            'jax-loader', health=self._obs_health,
+            report=self._obs_report)
 
     # -- sharding ------------------------------------------------------------
 
@@ -1264,7 +1271,29 @@ class JaxLoader:
             self._delivered_by_epoch = \
                 self._reader.consumption_record_for_resume(state)
 
+    def _obs_health(self):
+        """The loader's /health contribution: who waits on whom, right
+        now (the reader mounts its own section with the pool gauges)."""
+        return {
+            'epoch': self._epoch,
+            'exhausted': self._exhausted,
+            'batches_delivered': self._batches_delivered,
+            'stage_queue_depth': (self._out_queue.qsize()
+                                  if self._out_queue is not None else 0),
+            'prefetch': self._prefetch,
+            'consumer_wait_s': round(self._consumer_wait_s, 3),
+            'stage_backpressure_s': round(self._stage_blocked_s, 3),
+            'staging_enabled': self._stager is not None,
+            'fused_decode_mode': self._fused_decode_mode(),
+        }
+
+    def _obs_report(self):
+        """The loader's /report contribution: the live autotune verdict
+        + advice, so "what should I change" is scrapeable mid-run."""
+        return {'autotune': self.autotune_report()}
+
     def stop(self):
+        self._obs_mount.close()
         self._stop_event.set()
         # Stop the reader FIRST: it is what a staging thread blocked in
         # reader.__next__ is actually waiting on; the stop event alone

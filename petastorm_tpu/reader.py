@@ -407,6 +407,14 @@ class Reader:
             (it['piece_index'],) + tuple(it['shuffle_row_drop_partition'])
             for it in items]
 
+        # live observability plane (docs/telemetry.md): with
+        # PETASTORM_TPU_OBS_PORT set this process serves /metrics /report
+        # /health /trace over HTTP; the reader contributes its pool
+        # gauges + iteration state to /health. Unarmed: a shared no-op
+        # handle, no thread, no socket.
+        from petastorm_tpu.telemetry import obs_server
+        self._obs_mount = obs_server.mount('reader', health=self._obs_health)
+
     # -- construction helpers ------------------------------------------------
 
     def _apply_predicate_pushdown(self, piece_indices, predicate):
@@ -593,7 +601,26 @@ class Reader:
         # records would otherwise corrupt state_dict()'s resume math.
         self._consumed_by_epoch = {}
 
+    def _obs_health(self):
+        """This reader's /health contribution: iteration state + the
+        pool's liveness gauges (JSON-safe scalars only)."""
+        health = {
+            'started': self._started,
+            'stopped': self._stopped,
+            'last_row_consumed': self.last_row_consumed,
+            'num_epochs': self._num_epochs,
+            'row_groups': len(self._piece_indices),
+            'cur_shard': self.cur_shard,
+            'shard_count': self.shard_count,
+        }
+        try:
+            health.update(self._pool.diagnostics)
+        except Exception:  # noqa: BLE001 - health must answer regardless
+            pass
+        return health
+
     def stop(self):
+        self._obs_mount.close()
         self._pool.stop()
         self._stopped = True
 

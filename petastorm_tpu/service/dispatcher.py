@@ -137,6 +137,11 @@ class Dispatcher:
         self._duplicate_done_count = 0
         self._workers_seen = 0
         self._metrics_deltas_merged = 0
+        # identity -> latest heartbeat-piggybacked observability summary
+        # (JSON dict); the per-worker breakdown of the fleet view. Kept
+        # alongside _workers and pruned on deregister, so it is bounded
+        # by fleet size.
+        self._worker_obs = {}
         self._fatal_error = None
         self._no_workers_since = None
         # item_id -> _TraceEntry for traced items: the
@@ -201,6 +206,46 @@ class Dispatcher:
             'items_duplicate_done': self._duplicate_done_count,
             'metrics_deltas_merged': self._metrics_deltas_merged,
         }
+
+    def health(self):
+        """The dispatcher's /health contribution: fleet liveness plus
+        the back-pressure state an operator needs first — ``quiesced``
+        means completions are backlogged behind a full consumer queue,
+        so the fleet is idling by design, not broken."""
+        stats = self.stats()
+        stats['quiesced'] = bool(self._out_backlog)
+        stats['out_backlog'] = len(self._out_backlog)
+        stats['endpoint'] = self.endpoint
+        stats['items_completed'] = self._completed_count
+        return stats
+
+    def fleet_view(self):
+        """The merged fleet view the dispatcher's /report serves:
+        per-worker breakdown (liveness, in-flight load, and the latest
+        heartbeat-piggybacked observability summary — rates, pid, the
+        worker's own obs endpoint port) plus the scheduler totals. The
+        *aggregate* metrics (fleet-wide stage seconds, anomaly counters)
+        already live in this process's registry via the DONE-frame delta
+        merges, so `pipeline_report()` alongside this IS the merged
+        view."""
+        now = time.monotonic()
+        workers = {}
+        for identity, worker in list(self._workers.items()):
+            name = identity.decode('utf-8', 'replace')
+            entry = {
+                'alive': now - worker.last_heartbeat
+                <= self._liveness_timeout_s,
+                'ready': worker.ready,
+                'inflight': len(worker.inflight),
+                'heartbeat_age_s': round(now - worker.last_heartbeat, 3),
+            }
+            summary = self._worker_obs.get(identity)
+            if summary is not None:
+                entry['summary'] = summary
+            workers[name] = entry
+        view = {'workers': workers}
+        view.update(self.stats())
+        return view
 
     def _update_fleet_gauges(self):
         """Mirror fleet health into the process-wide registry so
@@ -337,6 +382,14 @@ class Dispatcher:
                 logger.info('Worker %s re-admitted after lapse', identity)
             else:
                 worker.last_heartbeat = now
+            if len(frames) > 2:
+                # optional trailing frame: the worker's per-heartbeat
+                # observability summary (docs/telemetry.md fleet view);
+                # absent from pre-observability builds, and a bad frame
+                # degrades to None — liveness never depends on it
+                summary = proto.load_obs_summary(frames[2])
+                if summary is not None:
+                    self._worker_obs[identity] = summary
             sock.send_multipart([identity, proto.MSG_HEARTBEAT_ACK])
         elif msg == proto.MSG_DONE:
             item_id = proto.unpack_item_id(frames[2])
@@ -522,6 +575,7 @@ class Dispatcher:
 
     def _deregister(self, identity, reason):
         worker = self._workers.pop(identity, None)
+        self._worker_obs.pop(identity, None)
         if worker is None:
             return
         reventilated = 0

@@ -8,7 +8,7 @@ The DEALER side sends ``[TYPE, ...]``; the ROUTER side sees
     worker ──► dispatcher                      dispatcher ──► worker
     REGISTER                                   SPEC <job payload>
     READY                                      WORK <item id> <item payload>
-    HEARTBEAT                                  HEARTBEAT_ACK
+    HEARTBEAT [<obs summary>]                  HEARTBEAT_ACK
     DONE <item id> <metrics> <result>*         STOP
     ERROR <item id> <exc payload> <metrics>
     BYE
@@ -17,7 +17,15 @@ The ``<metrics>`` frame piggybacks the worker server's telemetry delta
 (:meth:`~petastorm_tpu.telemetry.registry.MetricsRegistry.collect_delta`)
 on each completion — an empty frame when nothing changed — so the
 dispatcher aggregates stage timings and stall clocks fleet-wide without a
-separate metrics channel (docs/telemetry.md). With per-item tracing on
+separate metrics channel (docs/telemetry.md). The HEARTBEAT's optional
+trailing frame piggybacks the worker server's per-heartbeat
+observability summary (JSON: pid, uptime, headline counter rates, local
+anomaly counts, its own obs endpoint port) the same way — the
+dispatcher keeps the latest per worker and serves the merged fleet view
+with per-worker breakdown on its ``/report`` endpoint. Both directions
+stay compatible with builds lacking the frame: an old worker sends a
+bare HEARTBEAT, an old dispatcher ignores trailing frames. With per-item
+tracing on
 (``PETASTORM_TPU_TRACE=1``) the same frame also carries the server's
 flight-recorder batch (``trace_events``): a traced item's context rides
 in the WORK payload's kwargs, its worker-side events ride back here, and
@@ -104,6 +112,36 @@ def load_metrics_delta(frame):
     nothing more)."""
     from petastorm_tpu.telemetry.registry import load_delta_frame
     return load_delta_frame(frame)
+
+
+def dump_obs_summary(summary):
+    """Frame a worker server's per-heartbeat observability summary
+    (:class:`~petastorm_tpu.telemetry.timeseries.HeartbeatSummarizer`)
+    for the HEARTBEAT message's optional trailing frame. JSON, not dill:
+    the payload is plain scalars and the dispatcher must be able to
+    serve it to an HTTP scrape verbatim. Errors degrade to ``b''``
+    (observability must never fail a heartbeat)."""
+    import json
+
+    try:
+        return json.dumps(summary).encode()
+    except Exception:  # noqa: BLE001 - telemetry is advisory
+        return b''
+
+
+def load_obs_summary(frame):
+    """Inverse of :func:`dump_obs_summary`; None for empty, undecodable
+    or non-dict frames (a pre-observability worker build sends a bare
+    HEARTBEAT — the absence of the frame is the compatible case)."""
+    if not frame:
+        return None
+    import json
+
+    try:
+        summary = json.loads(frame)
+    except Exception:  # noqa: BLE001 - telemetry is advisory
+        return None
+    return summary if isinstance(summary, dict) else None
 
 
 def free_tcp_port(host='127.0.0.1'):

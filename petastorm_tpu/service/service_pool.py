@@ -104,6 +104,7 @@ class ServicePool:
         self._local_procs = []
         self._error = None
         self._joined = False
+        self._obs_mount = None
 
     @property
     def workers_count(self):
@@ -146,9 +147,21 @@ class ServicePool:
             self._spawn_workers()
         self._await_registrations()
 
+        # live observability plane: the dispatcher runs in THIS (consumer)
+        # process, so its fleet view — per-worker heartbeat summaries on
+        # top of the registry's already-merged fleet aggregate — mounts on
+        # the same endpoint the Reader/JaxLoader use (docs/service.md)
+        from petastorm_tpu.telemetry import obs_server
+        self._obs_mount = obs_server.mount(
+            'service-dispatcher', health=self._dispatcher.health,
+            report=self._fleet_report)
+
         self._ventilator = ventilator
         if ventilator is not None and start_ventilator:
             ventilator.start()
+
+    def _fleet_report(self):
+        return {'fleet': self._dispatcher.fleet_view()}
 
     def _spawn_workers(self):
         from petastorm_tpu.service.worker_server import serve
@@ -286,6 +299,8 @@ class ServicePool:
         if self._joined:
             return
         self._joined = True
+        if self._obs_mount is not None:
+            self._obs_mount.close()
         if self._dispatcher_thread is not None:
             # run() broadcasts STOP to every registered worker on its way out
             self._dispatcher_thread.join(_JOIN_TIMEOUT_S)

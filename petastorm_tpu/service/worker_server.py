@@ -37,7 +37,7 @@ import time
 import uuid
 
 from petastorm_tpu.service import protocol as proto
-from petastorm_tpu.telemetry import knobs, tracing
+from petastorm_tpu.telemetry import knobs, obs_server, timeseries, tracing
 
 logger = logging.getLogger(__name__)
 
@@ -111,11 +111,17 @@ def _reroot_decoded_cache(worker_args):
 
 
 def _run_job(sock, spec_payload, worker_id, heartbeat_interval_s,
-             ack_timeout_s, parent_pid):
+             ack_timeout_s, parent_pid, status=None):
     """One job lifetime: build the worker, stream items until STOP or the
     dispatcher vanishes. Returns True if the server should serve again."""
     worker_class, worker_args, serializer = proto.load_job_spec(spec_payload)
     _reroot_decoded_cache(worker_args)
+    # per-heartbeat observability summary (docs/telemetry.md fleet view):
+    # thread-free rates since the previous heartbeat, piggybacked on the
+    # HEARTBEAT frame so the dispatcher's endpoint can break the fleet
+    # down per worker
+    summarizer = timeseries.HeartbeatSummarizer(worker_id)
+    status = status if status is not None else {}
 
     buffer = []
     worker = worker_class(worker_id, buffer.append, worker_args)
@@ -148,12 +154,17 @@ def _run_job(sock, spec_payload, worker_id, heartbeat_interval_s,
                 frames = ([proto.MSG_DONE, proto.pack_item_id(item_id),
                            proto.dump_metrics_delta()]
                           + [serializer.serialize(v) for v in buffer])
+                status['items_done'] = status.get('items_done', 0) + 1
             except Exception as e:  # noqa: BLE001 - forwarded to consumer
                 logger.debug('Worker %d forwarding exception', worker_id,
                              exc_info=True)
                 frames = [proto.MSG_ERROR, proto.pack_item_id(item_id),
                           proto.dump_exception(e),
                           proto.dump_metrics_delta()]
+                # errored items are NOT done: the fleet view's per-worker
+                # breakdown must show a sick worker's completions stalling
+                status['items_errored'] = status.get('items_errored',
+                                                     0) + 1
             out_queue.put(frames)
 
     executor_thread = threading.Thread(target=executor, daemon=True)
@@ -170,7 +181,17 @@ def _run_job(sock, spec_payload, worker_id, heartbeat_interval_s,
             now = time.monotonic()
             if now - last_heartbeat_sent >= heartbeat_interval_s:
                 last_heartbeat_sent = now
-                sock.send_multipart([proto.MSG_HEARTBEAT])
+                try:
+                    summary = summarizer.summary(
+                        obs_port=obs_server.server_port())
+                    summary['items_done'] = status.get('items_done', 0)
+                    frame = proto.dump_obs_summary(summary)
+                except Exception:  # noqa: BLE001 - telemetry is advisory
+                    frame = b''
+                if frame:
+                    sock.send_multipart([proto.MSG_HEARTBEAT, frame])
+                else:
+                    sock.send_multipart([proto.MSG_HEARTBEAT])
             while True:
                 try:
                     sock.send_multipart(out_queue.get_nowait())
@@ -230,32 +251,49 @@ def serve(endpoint, worker_id=0, heartbeat_interval_s=1.0,
 
     if ack_timeout_s is None:
         ack_timeout_s = max(10 * heartbeat_interval_s, 10.0)
-    while True:
-        # Fresh socket (and identity) per job lifetime: a stale DEALER can
-        # hold buffered frames from the previous dispatcher incarnation.
-        context = zmq.Context()
-        sock = context.socket(zmq.DEALER)
-        sock.setsockopt(zmq.IDENTITY,
-                        ('worker-%d-%s' % (worker_id, uuid.uuid4().hex[:8]))
-                        .encode())
-        sock.setsockopt(zmq.LINGER, 500)
-        sock.connect(endpoint)
-        try:
-            spec_payload = _register(sock, parent_pid, register_timeout_s)
-            if spec_payload is None:
-                return
-            serve_again = _run_job(sock, spec_payload, worker_id,
-                                   heartbeat_interval_s, ack_timeout_s,
-                                   parent_pid)
+    # live observability plane: a worker server exposes its OWN /metrics
+    # /report /health /trace when PETASTORM_TPU_OBS_PORT is set (use 0 —
+    # ephemeral — for multi-worker hosts; the bound port rides every
+    # heartbeat summary, so the dispatcher's fleet view says where each
+    # worker's endpoint lives). Unarmed: a shared no-op handle.
+    status = {'worker_id': worker_id, 'state': 'registering',
+              'jobs_served': 0, 'items_done': 0, 'endpoint': endpoint}
+    obs_mount = obs_server.mount('worker-server',
+                                 health=lambda: dict(status))
+    try:
+        while True:
+            # Fresh socket (and identity) per job lifetime: a stale
+            # DEALER can hold buffered frames from the previous
+            # dispatcher incarnation.
+            context = zmq.Context()
+            sock = context.socket(zmq.DEALER)
+            sock.setsockopt(zmq.IDENTITY,
+                            ('worker-%d-%s'
+                             % (worker_id, uuid.uuid4().hex[:8])).encode())
+            sock.setsockopt(zmq.LINGER, 500)
+            sock.connect(endpoint)
             try:
-                sock.send_multipart([proto.MSG_BYE])
-            except Exception:  # noqa: BLE001 - dispatcher may be gone
-                pass
-        finally:
-            sock.close(linger=500)
-            context.term()
-        if once or not serve_again:
-            return
+                status['state'] = 'registering'
+                spec_payload = _register(sock, parent_pid,
+                                         register_timeout_s)
+                if spec_payload is None:
+                    return
+                status['state'] = 'serving'
+                serve_again = _run_job(sock, spec_payload, worker_id,
+                                       heartbeat_interval_s, ack_timeout_s,
+                                       parent_pid, status=status)
+                status['jobs_served'] += 1
+                try:
+                    sock.send_multipart([proto.MSG_BYE])
+                except Exception:  # noqa: BLE001 - dispatcher may be gone
+                    pass
+            finally:
+                sock.close(linger=500)
+                context.term()
+            if once or not serve_again:
+                return
+    finally:
+        obs_mount.close()
 
 
 def main(argv=None):
@@ -288,10 +326,19 @@ def main(argv=None):
                              'jobs over one dataset decode each row-group '
                              'once per host (same as setting '
                              'PETASTORM_TPU_DECODED_CACHE_DIR)')
+    parser.add_argument('--obs-port', type=int, default=None,
+                        help='expose this server\'s live observability '
+                             'endpoint (/metrics /report /health /trace) '
+                             'on this port; 0 picks a free one (same as '
+                             'setting PETASTORM_TPU_OBS_PORT; the bound '
+                             'port rides the heartbeat summaries into '
+                             "the dispatcher's fleet view)")
     parser.add_argument('-v', '--verbose', action='store_true')
     args = parser.parse_args(argv)
     if args.cache_dir:
         knobs.set_env('PETASTORM_TPU_DECODED_CACHE_DIR', args.cache_dir)
+    if args.obs_port is not None:
+        knobs.set_env('PETASTORM_TPU_OBS_PORT', str(args.obs_port))
     logging.basicConfig(
         level=logging.DEBUG if args.verbose else logging.INFO,
         format='%(asctime)s worker-server[%(process)d] %(message)s')

@@ -64,6 +64,12 @@ from petastorm_tpu.telemetry import tracing  # noqa: F401
 from petastorm_tpu.telemetry.tracing import (  # noqa: F401
     TRACE_CTX_KEY, TraceContext, dump_trace, refresh_trace, trace_enabled,
 )
+from petastorm_tpu.telemetry import timeseries  # noqa: F401
+from petastorm_tpu.telemetry.timeseries import (  # noqa: F401
+    AnomalyDetector, HeartbeatSummarizer, ObsCollector, WindowedRollup,
+    recent_anomalies, record_anomaly,
+)
+from petastorm_tpu.telemetry import obs_server  # noqa: F401
 
 #: registry counter names the wait clocks accumulate into (seconds)
 STALL_PRODUCER_WAIT = 'petastorm_tpu_stall_producer_wait_seconds_total'
@@ -109,18 +115,27 @@ def register_refresh(fn):
 def refresh():
     """Re-read EVERY cached knob — metrics enable, trace enable, sampling
     stride, autodump state, plus any registered subsystem knobs (the jax
-    staging arena's) — so tests and long-lived processes flip all of them
-    through one entry point (the per-module ``refresh_enabled``/
-    ``refresh_trace``/``refresh_staging`` remain as the halves)."""
+    staging arena's, the observability plane's) — so tests and long-lived
+    processes flip all of them through one entry point (the per-module
+    ``refresh_enabled``/``refresh_trace``/``refresh_staging``/
+    ``refresh_obs`` remain as the halves)."""
     refresh_enabled()
     refresh_trace()
     for fn in list(_extra_refreshers):
         fn()
 
 
+# the live-observability knobs (window length, anomaly thresholds) ride
+# the same one-entry-point refresh discipline as the staging arena's
+register_refresh(timeseries.refresh_obs)
+
+
 def reset_for_tests():
-    """Fresh process-wide registry + attributor + flight recorder and
-    re-read knobs (test isolation only)."""
+    """Fresh process-wide registry + attributor + flight recorder, the
+    observability plane torn down, and knobs re-read (test isolation
+    only)."""
+    obs_server._reset_for_tests()
+    timeseries._reset_for_tests()
     reset_registry()
     reset_attributor()
     reset_recorder()

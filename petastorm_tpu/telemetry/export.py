@@ -31,6 +31,13 @@ def write_jsonl_snapshot(path_or_file, registry=None, extra=None):
     record = dict(extra or {})
     record.update(registry.snapshot())
     record.setdefault('ts', time.time())
+    # structured anomaly events ride every snapshot line when any were
+    # recorded (live-observability plane, telemetry/timeseries.py): the
+    # counters alone say HOW MANY fired, the events say WHEN and WHY
+    from petastorm_tpu.telemetry import timeseries
+    events = timeseries.recent_anomalies()
+    if events:
+        record.setdefault('anomalies', events)
     line = json.dumps(record, sort_keys=True)
     if hasattr(path_or_file, 'write'):
         path_or_file.write(line + '\n')
@@ -224,6 +231,9 @@ def pipeline_report(registry=None, wall_time_s=None, baseline=None,
     pipesan = _sanitizer_section(registry)
     if pipesan is not None:
         report['pipesan'] = pipesan
+    anomalies = _anomalies_section(registry)
+    if anomalies is not None:
+        report['anomalies'] = anomalies
     return report
 
 
@@ -392,6 +402,29 @@ def _sanitizer_section(registry):
     }
 
 
+def _anomalies_section(registry):
+    """Anomaly-detector findings (live observability plane) — present
+    when events were ever recorded (counter includes fleet-aggregated
+    worker events) or a collector samples in this process, so pipelines
+    without the plane armed keep their report shape unchanged. ``recent``
+    carries the last few structured events from the in-process ring,
+    each naming its troubleshoot.md runbook."""
+    from petastorm_tpu.telemetry import timeseries
+    by_kind = {}
+    for key, value in registry.counters_with_prefix(
+            timeseries.ANOMALY_EVENTS).items():
+        kind = _label_of(key, 'kind') or 'unknown'
+        by_kind[kind] = by_kind.get(kind, 0) + int(value)
+    recent = timeseries.recent_anomalies(5)
+    if not by_kind and not recent and not timeseries.collector_running():
+        return None
+    return {
+        'total': sum(by_kind.values()),
+        'by_kind': by_kind,
+        'recent': recent,
+    }
+
+
 def format_pipeline_report(report):
     """Human-readable rendering of :func:`pipeline_report` (one stage per
     line, canonical pipeline order first, then any extra stages)."""
@@ -453,4 +486,14 @@ def format_pipeline_report(report):
                         p['violations'],
                         (' (%s)' % kinds) if kinds else '',
                         p['views_guarded'], p['canary_checks']))
+    if 'anomalies' in report:
+        a = report['anomalies']
+        kinds = ', '.join('%s: %d' % (k, v)
+                          for k, v in sorted(a['by_kind'].items()))
+        lines.append('anomalies: %d event(s)%s'
+                     % (a['total'], (' (%s)' % kinds) if kinds else ''))
+        for event in a['recent'][-3:]:
+            lines.append('  %s at %.0f — %s'
+                         % (event['kind'], event.get('ts') or 0.0,
+                            event.get('runbook', '')))
     return '\n'.join(lines)

@@ -1,0 +1,317 @@
+"""HTTP observability endpoint: scrape/report/health/trace, stdlib-only.
+
+The outside-the-process half of the live observability plane
+(docs/telemetry.md). One loopback-by-default HTTP server per process,
+armed ONLY when ``PETASTORM_TPU_OBS_PORT`` names a port (``0`` = pick a
+free one) and metrics are on; with the knob unset no server (and no
+sampler) thread is ever created. Routes:
+
+* ``/metrics`` — Prometheus text exposition (the same
+  :func:`~petastorm_tpu.telemetry.export.prometheus_text` the file
+  exporter writes), scrapeable by a live Prometheus.
+* ``/report`` — the live ``pipeline_report()`` JSON, plus the windowed
+  ``rollup`` section and any mounted component's report contribution
+  (the service dispatcher adds the merged ``fleet`` view with
+  per-worker breakdown).
+* ``/health`` — heartbeat: pid/uptime plus every mounted component's
+  health dict (reader pool gauges, loader queue depth, dispatcher
+  backlog/quiesce state, worker-server job state).
+* ``/trace`` — the flight recorder's Perfetto-viewable Chrome trace
+  JSON, pulled on demand — no SIGUSR1, no file path needed
+  (``PETASTORM_TPU_TRACE=1`` must have been on during the run for the
+  events to exist).
+
+Components *mount* themselves (:func:`mount`): the Reader, JaxLoader,
+service dispatcher (via the ServicePool) and worker servers each
+register a named health/report provider; the first armed mount starts
+the server and the sampler (:func:`~petastorm_tpu.telemetry.timeseries
+.ensure_collector`). The server then lives for the process — a standing
+observability plane — while mounts come and go with their components.
+
+Trust model: binds ``127.0.0.1`` by default; set
+``PETASTORM_TPU_OBS_HOST`` to expose on a private cluster network only —
+the endpoint is read-only but leaks operational detail (same stance as
+the service dispatcher, docs/service.md).
+"""
+
+import http.server
+import io
+import json
+import logging
+import os
+import threading
+import time
+
+from petastorm_tpu.telemetry import knobs
+from petastorm_tpu.telemetry import timeseries
+from petastorm_tpu.telemetry.spans import metrics_disabled
+
+logger = logging.getLogger(__name__)
+
+#: endpoint requests served, by route (observability self-metrics)
+OBS_SCRAPES = 'petastorm_tpu_obs_scrapes_total'
+
+_DEFAULT_HOST = '127.0.0.1'
+
+
+class _State:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.server = None
+        self.thread = None
+        self.mounts = {}
+        self.started_ts = None
+        self.bind_failed = False
+        self.seq = 0
+
+
+_state = _State()
+
+
+class Mount:
+    """Handle of one mounted component; ``close()`` detaches it."""
+
+    def __init__(self, key):
+        self._key = key
+
+    @property
+    def live(self):
+        return True
+
+    def close(self):
+        with _state.lock:
+            _state.mounts.pop(self._key, None)
+
+
+class _NoopMount:
+    """Returned when the plane is unarmed: nothing started, nothing to
+    close — the zero-cost contract of ``PETASTORM_TPU_METRICS=0`` / an
+    unset ``PETASTORM_TPU_OBS_PORT``."""
+
+    @property
+    def live(self):
+        return False
+
+    def close(self):
+        pass
+
+
+_NOOP_MOUNT = _NoopMount()
+
+
+class _Provider:
+    __slots__ = ('name', 'health', 'report')
+
+    def __init__(self, name, health, report):
+        self.name = name
+        self.health = health
+        self.report = report
+
+
+def requested_port():
+    """The knob's port, or None when unset/invalid (= plane disabled)."""
+    text = knobs.get_str('PETASTORM_TPU_OBS_PORT')
+    if text == '':
+        return None
+    port = knobs.get_int('PETASTORM_TPU_OBS_PORT', None, floor=0)
+    return port
+
+
+def mount(name, health=None, report=None):
+    """Attach one component to this process's observability endpoint.
+
+    Arms lazily: when ``PETASTORM_TPU_OBS_PORT`` is set (and metrics
+    on), the first mount binds the HTTP server and starts the rollup
+    sampler; otherwise a shared no-op handle is returned and no thread
+    or socket is ever created. ``health``/``report`` are zero-arg
+    callables returning JSON-ish dicts, polled per request (exceptions
+    are contained per provider). Returns a handle whose ``close()``
+    detaches the component."""
+    if metrics_disabled():
+        return _NOOP_MOUNT
+    port = requested_port()
+    if port is None:
+        return _NOOP_MOUNT
+    with _state.lock:
+        _state.seq += 1
+        key = '%s-%d' % (name, _state.seq)
+        _state.mounts[key] = _Provider(name, health, report)
+    _ensure_server(port)
+    timeseries.ensure_collector()
+    return Mount(key)
+
+
+def _ensure_server(port):
+    with _state.lock:
+        if _state.server is not None or _state.bind_failed:
+            return
+        host = knobs.get_str('PETASTORM_TPU_OBS_HOST') or _DEFAULT_HOST
+        try:
+            server = http.server.ThreadingHTTPServer((host, port),
+                                                     _Handler)
+        except OSError as e:
+            # a second process on the same fixed port (dispatcher +
+            # worker server on one host): observability is advisory, so
+            # log-and-continue — and remember, so every later mount does
+            # not retry the doomed bind
+            _state.bind_failed = True
+            logger.warning('Observability endpoint failed to bind %s:%s '
+                           '(%s); set PETASTORM_TPU_OBS_PORT=0 for an '
+                           'ephemeral per-process port', host, port, e)
+            return
+        server.daemon_threads = True
+        _state.server = server
+        _state.started_ts = time.time()
+        _state.thread = threading.Thread(
+            target=server.serve_forever, daemon=True,
+            name='petastorm-tpu-obs-http')
+        _state.thread.start()
+        logger.info('Observability endpoint listening on http://%s:%d '
+                    '(/metrics /report /health /trace)',
+                    *server.server_address[:2])
+
+
+def server_port():
+    """The bound port of this process's endpoint, or None."""
+    server = _state.server
+    return server.server_address[1] if server is not None else None
+
+
+def server_address():
+    """``(host, port)`` of the live endpoint, or None."""
+    server = _state.server
+    return tuple(server.server_address[:2]) if server is not None else None
+
+
+def _providers():
+    with _state.lock:
+        return list(_state.mounts.values())
+
+
+def _component_sections(attr):
+    """``{name: provider_result}`` over every mount's ``attr`` callable,
+    exceptions contained per provider; duplicate component names get a
+    numeric suffix so two Readers in one process both show."""
+    out = {}
+    for provider in _providers():
+        fn = getattr(provider, attr)
+        if fn is None:
+            continue
+        try:
+            value = fn()
+        except Exception as e:  # noqa: BLE001 - a scrape must not 500
+            value = {'error': repr(e)[:200]}
+        name = provider.name
+        n = 2
+        while name in out:
+            name = '%s-%d' % (provider.name, n)
+            n += 1
+        out[name] = value
+    return out
+
+
+def build_health():
+    """The ``/health`` document (also the programmatic probe)."""
+    started = _state.started_ts
+    return {
+        'status': 'ok',
+        'pid': os.getpid(),
+        'ts': time.time(),
+        'uptime_s': round(time.time() - started, 3) if started else None,
+        'components': _component_sections('health'),
+    }
+
+
+def build_report():
+    """The ``/report`` document: live ``pipeline_report()`` + the rollup
+    section + every mounted component's report contribution (the service
+    dispatcher's ``fleet`` view lands here)."""
+    from petastorm_tpu.telemetry.export import pipeline_report
+    report = pipeline_report()
+    rollup = timeseries.rollup_section()
+    if rollup is not None:
+        report['rollup'] = rollup
+    for section in _component_sections('report').values():
+        if not isinstance(section, dict):
+            continue
+        for key, value in section.items():
+            # never clobber: a second loader's 'autotune' (or a provider
+            # key colliding with a canonical pipeline_report section)
+            # gets a numeric suffix, same dedup rule as /health
+            out_key = key
+            n = 2
+            while out_key in report:
+                out_key = '%s-%d' % (key, n)
+                n += 1
+            report[out_key] = value
+    return report
+
+
+def _json_default(value):
+    try:
+        return float(value)
+    except (TypeError, ValueError):
+        return str(value)
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    # observability must not spam stderr per scrape
+    def log_message(self, fmt, *args):  # noqa: A003 - stdlib signature
+        logger.debug('obs-http ' + fmt, *args)
+
+    def do_GET(self):  # noqa: N802 - stdlib handler naming
+        route = self.path.split('?', 1)[0].rstrip('/') or '/'
+        try:
+            if route == '/metrics':
+                from petastorm_tpu.telemetry.export import prometheus_text
+                body = prometheus_text().encode()
+                content_type = 'text/plain; version=0.0.4'
+            elif route == '/report':
+                body = json.dumps(build_report(),
+                                  default=_json_default).encode()
+                content_type = 'application/json'
+            elif route == '/health':
+                body = json.dumps(build_health(),
+                                  default=_json_default).encode()
+                content_type = 'application/json'
+            elif route == '/trace':
+                from petastorm_tpu.telemetry.recorder import (
+                    export_chrome_trace,
+                )
+                buf = io.StringIO()
+                export_chrome_trace(buf)
+                body = buf.getvalue().encode()
+                content_type = 'application/json'
+            else:
+                self.send_error(404, 'routes: /metrics /report /health '
+                                     '/trace')
+                return
+        except Exception:  # noqa: BLE001 - a scrape must not kill serving
+            logger.debug('obs-http %s failed', route, exc_info=True)
+            self.send_error(500)
+            return
+        if not metrics_disabled():
+            from petastorm_tpu.telemetry.registry import get_registry
+            get_registry().counter(OBS_SCRAPES, route=route.strip('/')).inc()
+        self.send_response(200)
+        self.send_header('Content-Type', content_type)
+        self.send_header('Content-Length', str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+def _reset_for_tests():
+    """Shut the server down and drop every mount (test isolation only —
+    production servers deliberately live for the process)."""
+    with _state.lock:
+        server, thread = _state.server, _state.thread
+        _state.server = None
+        _state.thread = None
+        _state.mounts.clear()
+        _state.started_ts = None
+        _state.bind_failed = False
+    if server is not None:
+        server.shutdown()
+        server.server_close()
+    if thread is not None:
+        thread.join(timeout=5)

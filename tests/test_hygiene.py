@@ -15,6 +15,7 @@ SOURCES = sorted(
               recursive=True)
     + glob.glob(os.path.join(REPO, 'examples', '**', '*.py'), recursive=True)
     + glob.glob(os.path.join(REPO, 'tests', '*.py'))
+    + glob.glob(os.path.join(REPO, 'tools', '*.py'))
     + [os.path.join(REPO, p) for p in ('setup.py', 'bench.py',
                                        '__graft_entry__.py')])
 
@@ -123,6 +124,51 @@ def test_exported_metric_names_are_documented():
     assert not undocumented, \
         'canonical metric names missing from docs/telemetry.md: %s' \
         % undocumented
+
+
+def test_anomaly_kinds_are_canonical_and_documented():
+    """Anomaly-event chain of custody, hubbed on analysis/contracts.py:
+    every literal kind the package passes to ``record_anomaly`` (or a
+    detector's ``_fire``/``_emit``) is a member of contracts.ANOMALY_KINDS;
+    every canonical kind has a row in docs/telemetry.md's anomaly table;
+    and every runbook heading a kind names is a real ``##`` section of
+    docs/troubleshoot.md — an event can never point an operator at a
+    runbook that does not exist."""
+    from petastorm_tpu.analysis.contracts import ANOMALY_KINDS
+    emitting_calls = ('record_anomaly', '_fire', '_emit')
+    offenders = []
+    emitted = set()
+    for rel, source in _package_sources():
+        for node in ast.walk(ast.parse(source, filename=rel)):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            if _call_name(node) not in emitting_calls:
+                continue
+            first = node.args[0]
+            if isinstance(first, ast.Constant) and \
+                    isinstance(first.value, str):
+                emitted.add(first.value)
+                if first.value not in ANOMALY_KINDS:
+                    offenders.append('%s:%d: %r' % (rel, node.lineno,
+                                                    first.value))
+    assert not offenders, \
+        'anomaly kinds missing from contracts.ANOMALY_KINDS: %s' % offenders
+    assert emitted >= set(ANOMALY_KINDS), \
+        'canonical kinds never emitted anywhere (dead contract entries): ' \
+        '%s' % sorted(set(ANOMALY_KINDS) - emitted)
+    with open(os.path.join(REPO, 'docs', 'telemetry.md')) as f:
+        telemetry_doc = f.read()
+    undocumented = sorted(k for k in ANOMALY_KINDS
+                          if '`%s`' % k not in telemetry_doc)
+    assert not undocumented, \
+        'anomaly kinds missing from docs/telemetry.md: %s' % undocumented
+    with open(os.path.join(REPO, 'docs', 'troubleshoot.md')) as f:
+        troubleshoot = f.read()
+    missing = sorted(k for k, heading in ANOMALY_KINDS.items()
+                     if '## %s' % heading not in troubleshoot)
+    assert not missing, \
+        'runbook headings missing from docs/troubleshoot.md for: %s' \
+        % missing
 
 
 def test_no_print_in_library_code():

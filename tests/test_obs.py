@@ -1,0 +1,695 @@
+"""Live observability plane: windowed rollups, the anomaly detector, the
+HTTP endpoint (port-0 smoke over thread/process/service pools), fleet
+aggregation, and the structural zero-thread guard — the ISSUE 10
+acceptance criteria.
+
+All network traffic is loopback-only and every port is ephemeral
+(``PETASTORM_TPU_OBS_PORT=0``); service tests are marked ``service``
+like tests/test_service.py.
+"""
+
+import importlib
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from petastorm_tpu import telemetry as T
+from petastorm_tpu.telemetry import obs_server, timeseries
+from petastorm_tpu.telemetry.registry import metric_key
+from petastorm_tpu.telemetry.spans import STAGE_CALLS, STAGE_SECONDS
+from petastorm_tpu.telemetry.timeseries import (
+    AnomalyDetector, HeartbeatSummarizer, WindowedRollup,
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _bench_trend():
+    tools_dir = os.path.join(_REPO, 'tools')
+    if tools_dir not in sys.path:
+        sys.path.insert(0, tools_dir)
+    return importlib.import_module('bench_trend')
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    T.reset_for_tests()
+    yield
+    T.reset_for_tests()
+
+
+def _arm(monkeypatch, window_sec='0.2', **extra):
+    """Arm the observability plane with an ephemeral port and a fast
+    test window; refresh so cached knobs notice."""
+    monkeypatch.setenv('PETASTORM_TPU_OBS_PORT', '0')
+    monkeypatch.setenv('PETASTORM_TPU_OBS_WINDOW_SEC', window_sec)
+    for name, value in extra.items():
+        monkeypatch.setenv(name, value)
+    T.refresh()
+
+
+def _get(route, port=None, timeout=10):
+    port = port or obs_server.server_port()
+    assert port, 'no obs server bound'
+    return urllib.request.urlopen(
+        'http://127.0.0.1:%d%s' % (port, route), timeout=timeout).read()
+
+
+def _get_json(route, port=None):
+    return json.loads(_get(route, port=port))
+
+
+def _wait_for(predicate, timeout_s=20, interval_s=0.05):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        value = predicate()
+        if value:
+            return value
+        time.sleep(interval_s)
+    return predicate()
+
+
+# -- WindowedRollup ----------------------------------------------------------
+
+
+def test_rollup_rates_and_verdict():
+    rollup = WindowedRollup(max_windows=4)
+    reg = T.get_registry()
+    assert rollup.sample(reg.snapshot(), now=0.0, wall=100.0) is None
+    reg.counter(STAGE_CALLS, stage='queue_wait').inc(20)
+    reg.counter(T.STALL_PRODUCER_WAIT).inc(1.8)
+    reg.gauge('depth').set(7)
+    window = rollup.sample(reg.snapshot(), now=2.0, wall=102.0)
+    assert window['dur_s'] == pytest.approx(2.0)
+    key = metric_key(STAGE_CALLS, {'stage': 'queue_wait'})
+    assert window['rates'][key] == pytest.approx(10.0)
+    assert window['throughput'] == pytest.approx(10.0)
+    assert window['producer_wait_s'] == pytest.approx(1.8)
+    # producer wait dominates 90% of the window -> consumer-bound
+    assert window['verdict'] == T.CONSUMER_BOUND
+    assert window['gauges']['depth'] == 7
+
+
+def test_rollup_quantiles_from_bucket_deltas():
+    rollup = WindowedRollup(max_windows=4)
+    reg = T.get_registry()
+    hist = reg.histogram('lat', buckets=(0.01, 0.1, 1.0))
+    rollup.sample(reg.snapshot(), now=0.0)
+    for _ in range(90):
+        hist.observe(0.005)   # first bucket
+    for _ in range(10):
+        hist.observe(0.5)     # third bucket
+    window = rollup.sample(reg.snapshot(), now=1.0)
+    q = window['quantiles']['lat']
+    assert q['p50'] == pytest.approx(0.01)
+    assert q['p95'] == pytest.approx(1.0)
+    assert q['p99'] == pytest.approx(1.0)
+    # the NEXT window sees only new increments, not lifetime counts
+    hist.observe(0.05)
+    window = rollup.sample(reg.snapshot(), now=2.0)
+    assert window['quantiles']['lat']['p50'] == pytest.approx(0.1)
+
+
+def test_rollup_ring_is_bounded():
+    rollup = WindowedRollup(max_windows=3)
+    reg = T.get_registry()
+    for i in range(10):
+        rollup.sample(reg.snapshot(), now=float(i))
+    assert len(rollup.windows()) == 3
+    assert rollup.closed_total == 9
+
+
+# -- AnomalyDetector (synthetic windows) -------------------------------------
+
+
+def _window(dur=1.0, producer=0.0, consumer=0.0, rates=None, gauges=None,
+            verdict=T.BALANCED, throughput=None, start=0.0):
+    return {'start': start, 'dur_s': dur, 'rates': dict(rates or {}),
+            'quantiles': {}, 'gauges': dict(gauges or {}),
+            'producer_wait_s': producer, 'consumer_wait_s': consumer,
+            'verdict': verdict, 'throughput': throughput}
+
+
+def _detector():
+    events = []
+
+    def emit(kind, detail=None, window_start=None):
+        event = {'kind': kind, 'detail': detail,
+                 'window_start': window_start}
+        events.append(event)
+        return event
+
+    return AnomalyDetector(emit=emit), events
+
+
+def test_detector_queue_saturated_edge_and_rearm():
+    detector, events = _detector()
+    for _ in range(2):
+        detector.observe(_window(producer=0.8))
+    assert not events  # 3 consecutive windows required
+    detector.observe(_window(producer=0.8))
+    assert [e['kind'] for e in events] == ['queue_saturated']
+    # persisting condition must NOT flood the ring (hysteresis)
+    detector.observe(_window(producer=0.9))
+    assert len(events) == 1
+    # clears, then re-establishes -> exactly one more event
+    detector.observe(_window(producer=0.0))
+    for _ in range(3):
+        detector.observe(_window(producer=0.8))
+    assert [e['kind'] for e in events] == ['queue_saturated'] * 2
+
+
+def test_detector_throughput_collapse_needs_waiting_consumer():
+    detector, events = _detector()
+    for _ in range(6):
+        detector.observe(_window(throughput=100.0, consumer=0.1))
+    # a stream that FINISHES (throughput gone, consumer no longer
+    # waiting) is not a collapse
+    for _ in range(3):
+        detector.observe(_window(throughput=0.0, consumer=0.0))
+    assert not events
+    # rebuild the trailing mean, then collapse WITH the consumer starving
+    for _ in range(6):
+        detector.observe(_window(throughput=100.0, consumer=0.1))
+    detector.observe(_window(throughput=5.0, consumer=0.4))
+    assert not events  # one collapsed window is noise
+    detector.observe(_window(throughput=5.0, consumer=0.4))
+    assert [e['kind'] for e in events] == ['throughput_collapse']
+    assert events[0]['detail']['trailing_mean'] == pytest.approx(100.0)
+
+
+def test_detector_collapse_baseline_excludes_collapsed_windows():
+    """A sustained collapse must not drag the trailing mean down to
+    itself and self-clear while the pipeline is still stalled."""
+    detector, events = _detector()
+    for _ in range(6):
+        detector.observe(_window(throughput=100.0, consumer=0.1))
+    for _ in range(10):
+        detector.observe(_window(throughput=5.0, consumer=0.4))
+    assert len(events) == 1  # fired once, never cleared/re-fired
+
+
+def test_detector_stall_flap():
+    detector, events = _detector()
+    verdicts = [T.PRODUCER_BOUND, T.CONSUMER_BOUND] * 3
+    for verdict in verdicts:
+        detector.observe(_window(verdict=verdict))
+    assert [e['kind'] for e in events] == ['stall_flap']
+    assert events[0]['detail']['flips'] >= 3
+
+
+def test_detector_steady_verdicts_do_not_flap():
+    detector, events = _detector()
+    for _ in range(10):
+        detector.observe(_window(verdict=T.PRODUCER_BOUND))
+    assert not events
+
+
+def test_detector_flap_rearms_after_calm_stretch():
+    """A calm (balanced/idle) stretch ends the episode: the next genuine
+    flap must fire a SECOND event instead of being swallowed by the
+    frozen verdict history."""
+    detector, events = _detector()
+    for verdict in [T.PRODUCER_BOUND, T.CONSUMER_BOUND] * 3:
+        detector.observe(_window(verdict=verdict))
+    assert [e['kind'] for e in events] == ['stall_flap']
+    for _ in range(AnomalyDetector._CALM_RESET):
+        detector.observe(_window(verdict=T.BALANCED))
+    for verdict in [T.PRODUCER_BOUND, T.CONSUMER_BOUND] * 3:
+        detector.observe(_window(verdict=verdict))
+    assert [e['kind'] for e in events] == ['stall_flap'] * 2
+
+
+def test_detector_heartbeat_gap_from_gauges_and_reventilation():
+    detector, events = _detector()
+    detector.observe(_window(gauges={
+        'petastorm_tpu_service_workers_alive': 2,
+        'petastorm_tpu_service_workers_registered': 2}))
+    assert not events
+    detector.observe(_window(gauges={
+        'petastorm_tpu_service_workers_alive': 1,
+        'petastorm_tpu_service_workers_registered': 2}))
+    assert [e['kind'] for e in events] == ['heartbeat_gap']
+    # re-ventilation rate alone is also gap evidence (edge-triggered)
+    detector2, events2 = _detector()
+    detector2.observe(_window(rates={
+        'petastorm_tpu_service_reventilated_total': 2.0}))
+    assert [e['kind'] for e in events2] == ['heartbeat_gap']
+
+
+def test_detector_h2d_starvation():
+    detector, events = _detector()
+    ready_key = metric_key(STAGE_SECONDS, {'stage': 'h2d_ready'})
+    for _ in range(3):
+        detector.observe(_window(rates={ready_key: 0.7}))
+    assert [e['kind'] for e in events] == ['h2d_starvation']
+
+
+def test_record_anomaly_rejects_unknown_kind():
+    with pytest.raises(ValueError, match='ANOMALY_KINDS'):
+        timeseries.record_anomaly('made_up_kind')
+
+
+def test_record_anomaly_counts_and_runbook():
+    event = timeseries.record_anomaly('queue_saturated', detail={'x': 1})
+    assert 'troubleshoot.md' in event['runbook']
+    assert T.get_registry().counter_value(
+        timeseries.ANOMALY_EVENTS, kind='queue_saturated') == 1
+    report = T.pipeline_report()
+    assert report['anomalies']['by_kind'] == {'queue_saturated': 1}
+    assert report['anomalies']['recent'][-1]['kind'] == 'queue_saturated'
+    # the rendered report mentions them too
+    assert 'anomalies: 1 event(s)' in T.format_pipeline_report(report)
+
+
+def test_jsonl_snapshot_carries_anomalies():
+    import io
+    timeseries.record_anomaly('stall_flap')
+    buf = io.StringIO()
+    T.write_jsonl_snapshot(buf)
+    record = json.loads(buf.getvalue())
+    assert record['anomalies'][-1]['kind'] == 'stall_flap'
+    assert 'runbook' in record['anomalies'][-1]
+
+
+def test_report_has_no_anomaly_section_when_plane_idle():
+    assert 'anomalies' not in T.pipeline_report()
+
+
+# -- structural zero-cost guards ---------------------------------------------
+
+
+def _obs_threads():
+    return [t for t in threading.enumerate()
+            if t.name.startswith('petastorm-tpu-obs')]
+
+
+def test_no_threads_or_server_without_port(small_scalar_dataset):
+    """The acceptance gate's structural half: with the knob unset, a
+    full reader pass creates NO observability thread, server or
+    collector — mounts are the shared no-op."""
+    from petastorm_tpu.reader import make_batch_reader
+    assert not timeseries.obs_enabled()
+    with make_batch_reader(small_scalar_dataset, num_epochs=1,
+                           shuffle_row_groups=False) as reader:
+        assert reader._obs_mount is obs_server._NOOP_MOUNT
+        for _ in reader:
+            pass
+    assert obs_server._state.server is None
+    assert obs_server._state.thread is None
+    assert timeseries._collector is None
+    assert not _obs_threads()
+
+
+def test_no_threads_when_metrics_disabled(monkeypatch):
+    monkeypatch.setenv('PETASTORM_TPU_METRICS', '0')
+    monkeypatch.setenv('PETASTORM_TPU_OBS_PORT', '0')
+    T.refresh()
+    try:
+        assert obs_server.mount('x') is obs_server._NOOP_MOUNT
+        assert timeseries.ensure_collector() is None
+        assert obs_server._state.server is None
+        assert not _obs_threads()
+    finally:
+        monkeypatch.delenv('PETASTORM_TPU_METRICS')
+        T.refresh()
+
+
+# -- endpoint smoke: thread AND process AND service pools --------------------
+
+
+@pytest.fixture
+def small_scalar_dataset(tmp_path):
+    from tests.test_common import create_test_scalar_dataset
+    url = 'file://' + str(tmp_path / 'dataset')
+    create_test_scalar_dataset(url, num_rows=80, num_files=8)
+    return url
+
+
+def _assert_routes_live(expect_component):
+    metrics = _get('/metrics').decode()
+    assert 'petastorm_tpu_stage_seconds_total' in metrics
+    report = _get_json('/report')
+    assert 'stages' in report and 'stall' in report
+    assert 'rollup' in report  # the collector runs alongside the server
+    health = _get_json('/health')
+    assert health['status'] == 'ok'
+    assert any(name.startswith(expect_component)
+               for name in health['components'])
+    trace = _get_json('/trace')
+    assert 'traceEvents' in trace
+    return report, health
+
+
+def _consume(url, pool):
+    from petastorm_tpu.reader import make_batch_reader
+    with make_batch_reader(url, reader_pool_type=pool, workers_count=1,
+                           num_epochs=1, shuffle_row_groups=False) as reader:
+        for _ in reader:
+            pass
+        return _assert_routes_live('reader')
+
+
+def test_endpoint_routes_thread_pool(small_scalar_dataset, monkeypatch):
+    _arm(monkeypatch)
+    report, health = _consume(small_scalar_dataset, 'thread')
+    reader_health = next(v for k, v in health['components'].items()
+                         if k.startswith('reader'))
+    assert reader_health['started'] and not reader_health['stopped']
+    assert 'items_processed' in reader_health
+
+
+def test_endpoint_routes_process_pool(small_scalar_dataset, monkeypatch):
+    _arm(monkeypatch)
+    _consume(small_scalar_dataset, 'process')
+
+
+def test_endpoint_routes_jax_loader(small_scalar_dataset, monkeypatch):
+    """The acceptance shape: a running make_jax_loader job exposes all
+    four routes; /health carries both the loader's and the reader's
+    sections, /report the loader's live autotune verdict."""
+    _arm(monkeypatch)
+    from petastorm_tpu.jax import make_jax_loader
+    with make_jax_loader(small_scalar_dataset, batch_size=8,
+                         fields=['^id$'], num_epochs=1,
+                         shuffle_row_groups=False) as loader:
+        for _ in loader:
+            pass
+        report, health = _assert_routes_live('jax-loader')
+        assert any(k.startswith('reader') for k in health['components'])
+        assert 'autotune' in report
+        assert report['autotune']['bottleneck'] in (
+            'input', 'compute', 'balanced', 'undetermined')
+
+
+@pytest.mark.service
+def test_endpoint_fleet_view_service_pool(small_scalar_dataset,
+                                          monkeypatch):
+    """Fleet aggregation end to end: the dispatcher's endpoint serves a
+    merged fleet view whose per-worker breakdown carries the
+    heartbeat-piggybacked summaries — including each worker server's own
+    obs port, which must itself answer /metrics."""
+    _arm(monkeypatch)
+    from petastorm_tpu.reader import make_batch_reader
+    from petastorm_tpu.service import ServicePool
+    pool = ServicePool(spawn_local_workers=1, heartbeat_interval_s=0.2,
+                       connect_timeout_s=60)
+    with make_batch_reader(small_scalar_dataset, reader_pool_type=pool,
+                           num_epochs=1, shuffle_row_groups=False) as reader:
+        for _ in reader:
+            pass
+
+        def fleet_with_summary():
+            fleet = _get_json('/report').get('fleet') or {}
+            workers = fleet.get('workers') or {}
+            if any('summary' in w for w in workers.values()):
+                return fleet
+            return None
+
+        fleet = _wait_for(fleet_with_summary)
+        assert fleet, 'no worker summary reached the dispatcher'
+        assert fleet['workers_registered'] >= 1
+        summary = next(w['summary'] for w in fleet['workers'].values()
+                       if 'summary' in w)
+        assert summary['pid'] != obs_server.build_health()['pid']
+        assert summary['uptime_s'] >= 0
+        # drill down into the worker server's OWN endpoint
+        worker_port = summary.get('obs_port')
+        assert worker_port, 'worker summary lacks its obs port'
+        worker_metrics = _get('/metrics', port=worker_port).decode()
+        assert 'petastorm_tpu_stage_seconds_total' in worker_metrics
+        worker_health = _get_json('/health', port=worker_port)
+        assert any(k.startswith('worker-server')
+                   for k in worker_health['components'])
+        # dispatcher /health: quiesce/backlog state
+        health = _get_json('/health')
+        dispatcher_health = next(
+            v for k, v in health['components'].items()
+            if k.startswith('service-dispatcher'))
+        assert dispatcher_health['quiesced'] in (False, True)
+        assert 'out_backlog' in dispatcher_health
+
+
+# -- seeded anomaly fixtures (the acceptance criteria) -----------------------
+
+
+def test_slow_consumer_fires_queue_saturated(small_scalar_dataset,
+                                             monkeypatch):
+    """Acceptance: a seeded slow consumer over a tiny results queue
+    produces a `queue_saturated` event visible in BOTH the live /report
+    and the final pipeline_report()."""
+    _arm(monkeypatch, window_sec='0.2')
+    from petastorm_tpu.reader import make_batch_reader
+    with make_batch_reader(small_scalar_dataset, reader_pool_type='thread',
+                           workers_count=2, results_queue_size=1,
+                           num_epochs=4, shuffle_row_groups=False) as reader:
+        saw_live = None
+        for _ in reader:
+            time.sleep(0.12)  # deliberately slow consumer
+            if saw_live is None:
+                live = _get_json('/report').get('anomalies') or {}
+                if 'queue_saturated' in (live.get('by_kind') or {}):
+                    saw_live = live
+        # the stream may end before a poll caught it live; one more
+        # scrape while the server still runs settles it
+        if saw_live is None:
+            saw_live = _get_json('/report').get('anomalies') or {}
+        assert 'queue_saturated' in (saw_live.get('by_kind') or {}), \
+            saw_live
+    final = T.pipeline_report()['anomalies']
+    assert final['by_kind'].get('queue_saturated', 0) >= 1
+    kinds = {e['kind'] for e in final['recent']}
+    assert 'queue_saturated' in kinds or final['by_kind'][
+        'queue_saturated'] >= 1
+
+
+@pytest.mark.service
+def test_dead_worker_fires_heartbeat_gap(small_scalar_dataset,
+                                         monkeypatch):
+    """Acceptance: SIGKILLing a worker server mid-read must surface as a
+    `heartbeat_gap` anomaly event (via the re-ventilation counter and
+    the alive<registered gauge dip the dispatcher mirrors)."""
+    import os
+    import signal
+
+    from petastorm_tpu.reader import make_batch_reader
+    from petastorm_tpu.service import ServicePool
+    from petastorm_tpu.transform import TransformSpec
+    _arm(monkeypatch, window_sec='0.2')
+    pool = ServicePool(spawn_local_workers=2, heartbeat_interval_s=0.2,
+                       liveness_timeout_s=0.8, connect_timeout_s=60)
+    with make_batch_reader(small_scalar_dataset, reader_pool_type=pool,
+                           transform_spec=TransformSpec(_slow_identity),
+                           num_epochs=2, shuffle_row_groups=False) as reader:
+        first = True
+        for _ in reader:
+            if first:
+                os.kill(pool._local_procs[0].pid, signal.SIGKILL)
+                first = False
+        event = _wait_for(lambda: [
+            e for e in timeseries.recent_anomalies()
+            if e['kind'] == 'heartbeat_gap'])
+    assert event, 'no heartbeat_gap event after a worker SIGKILL'
+    assert T.pipeline_report()['anomalies']['by_kind'][
+        'heartbeat_gap'] >= 1
+
+
+def _slow_identity(frame):
+    time.sleep(0.05)
+    return frame
+
+
+# -- refresh / knobs ---------------------------------------------------------
+
+
+def test_refresh_reconfigures_live_collector(monkeypatch):
+    _arm(monkeypatch, window_sec='0.2')
+    collector = timeseries.ensure_collector()
+    assert collector is not None
+    assert collector.window_s == pytest.approx(0.2)
+    detector = collector.detector
+    assert detector._saturated_share == pytest.approx(0.5)
+    # refresh mid-condition must NOT reset hysteresis: an active anomaly
+    # would otherwise re-fire its edge after every knob re-read
+    detector._active.add('queue_saturated')
+    detector._sat_streak = 3
+    monkeypatch.setenv('PETASTORM_TPU_OBS_WINDOW_SEC', '0.7')
+    monkeypatch.setenv('PETASTORM_TPU_OBS_SATURATED_SHARE', '0.25')
+    T.refresh()  # the ONE knob re-read entry point covers obs knobs
+    assert collector.window_s == pytest.approx(0.7)
+    assert collector.detector is detector  # state survives in place
+    assert detector._saturated_share == pytest.approx(0.25)
+    assert 'queue_saturated' in detector._active
+    assert detector._sat_streak == 3
+
+
+def test_report_sections_never_clobber(monkeypatch):
+    """Two mounted components returning the same report key (two loaders'
+    'autotune') must BOTH appear — and no provider can overwrite a
+    canonical pipeline_report section."""
+    _arm(monkeypatch)
+    obs_server.mount('a', report=lambda: {'autotune': {'who': 'a'},
+                                          'stall': 'clobber-attempt'})
+    obs_server.mount('b', report=lambda: {'autotune': {'who': 'b'}})
+    report = obs_server.build_report()
+    assert report['autotune'] == {'who': 'a'}
+    assert report['autotune-2'] == {'who': 'b'}
+    assert isinstance(report['stall'], dict)  # canonical section intact
+    assert report['stall-2'] == 'clobber-attempt'
+
+
+def test_sampler_thread_ticks_and_counts(monkeypatch):
+    _arm(monkeypatch, window_sec='0.1')
+    collector = timeseries.ensure_collector()
+    assert _wait_for(lambda: collector.rollup.closed_total >= 2)
+    assert T.get_registry().counter_value(timeseries.OBS_WINDOWS) >= 1
+    section = timeseries.rollup_section()
+    assert section['headline']['windows_sampled'] >= 2
+    assert len(section['windows']) <= 12
+
+
+# -- heartbeat summarizer / protocol -----------------------------------------
+
+
+def test_heartbeat_summarizer_rates_and_caps():
+    summarizer = HeartbeatSummarizer(worker_id=3)
+    first = summarizer.summary(obs_port=1234)
+    assert first['worker_id'] == 3 and first['obs_port'] == 1234
+    assert 'rates' not in first  # first call primes the baseline
+    T.get_registry().counter(STAGE_CALLS, stage='decode').inc(50)
+    time.sleep(0.02)
+    second = summarizer.summary()
+    key = metric_key(STAGE_CALLS, {'stage': 'decode'})
+    assert second['rates'][key] > 0
+    assert len(second['rates']) <= HeartbeatSummarizer._MAX_RATES
+
+
+def test_obs_summary_protocol_roundtrip_and_compat():
+    from petastorm_tpu.service import protocol as proto
+    summary = {'pid': 1, 'rates': {'x': 1.5}}
+    assert proto.load_obs_summary(
+        proto.dump_obs_summary(summary)) == summary
+    assert proto.load_obs_summary(b'') is None
+    assert proto.load_obs_summary(b'\x80garbage') is None
+    assert proto.load_obs_summary(b'[1,2]') is None  # non-dict shapes
+    # unserializable summaries degrade to the empty frame, never raise
+    assert proto.dump_obs_summary({'bad': object()}) == b''
+
+
+def test_dispatcher_heartbeat_summary_capture():
+    """The dispatcher's _handle must capture the optional summary frame
+    (and stay compatible with bare heartbeats) — unit-level, no fleet."""
+    from petastorm_tpu.service import protocol as proto
+    from petastorm_tpu.service.dispatcher import Dispatcher
+
+    class _Sock:
+        def send_multipart(self, frames, **kw):
+            pass
+
+    dispatcher = Dispatcher('tcp://127.0.0.1:0', b'', lambda e: True,
+                            threading.Event())
+    sock = _Sock()
+    dispatcher._handle(sock, [b'w1', proto.MSG_REGISTER])
+    dispatcher._handle(sock, [b'w1', proto.MSG_HEARTBEAT])  # bare: ok
+    assert dispatcher.fleet_view()['workers']['w1'].get('summary') is None
+    frame = proto.dump_obs_summary({'pid': 42, 'uptime_s': 1.0})
+    dispatcher._handle(sock, [b'w1', proto.MSG_HEARTBEAT, frame])
+    view = dispatcher.fleet_view()
+    assert view['workers']['w1']['summary']['pid'] == 42
+    dispatcher._handle(sock, [b'w1', proto.MSG_HEARTBEAT, b'garbage'])
+    assert dispatcher.fleet_view()['workers']['w1']['summary'][
+        'pid'] == 42  # bad frame never clobbers the last good one
+    health = dispatcher.health()
+    assert health['quiesced'] is False
+    assert health['workers_registered'] == 1
+
+
+# -- bench trend tool --------------------------------------------------------
+
+
+def _bench_round(tmp_path, n, value, extra):
+    headline = {'metric': 'hello_world_read_rate', 'value': value,
+                'unit': 'samples/sec', 'vs_baseline': 1.0,
+                'headline': True, 'extra': extra}
+    record = {'n': n, 'cmd': 'python bench.py', 'rc': 0,
+              'tail': 'noise line\n%s\n' % json.dumps(headline)}
+    (tmp_path / ('BENCH_r%02d.json' % n)).write_text(json.dumps(record))
+
+
+def test_bench_trend_fold_and_regression_flag(tmp_path):
+    bench_trend = _bench_trend()
+    _bench_round(tmp_path, 1, 1000.0, {'vs_tfdata': 1.0})
+    _bench_round(tmp_path, 2, 2000.0, {'vs_tfdata': 1.2,
+                                       'lm_train_mfu': 0.4})
+    _bench_round(tmp_path, 3, 1500.0, {'vs_tfdata': 1.19})
+    rounds = bench_trend.load_rounds(str(tmp_path))
+    assert [n for n, _ in rounds] == [1, 2, 3]
+    report = bench_trend.trend(rounds)
+    assert report['metrics']['value']['series'] == [1000.0, 2000.0,
+                                                    1500.0]
+    # 1500 < 0.9 * 2000 -> the headline metric regressed
+    assert 'value' in report['regressions']
+    # within 10% of best -> not a regression
+    assert 'vs_tfdata' not in report['regressions']
+    # measured only once: no earlier baseline, can never flag
+    assert not report['metrics']['lm_train_mfu']['regressed']
+    table = bench_trend.format_table(report)
+    assert 'REGRESSED' in table and 'r03' in table
+    # CLI contract: exit 1 only under --fail-on-regression
+    assert bench_trend.main(['--dir', str(tmp_path)]) == 0
+    assert bench_trend.main(['--dir', str(tmp_path),
+                             '--fail-on-regression', '--json']) == 1
+    assert bench_trend.main(['--dir', str(tmp_path / 'empty')]) == 2
+
+
+def test_bench_trend_stale_metrics_never_flag(tmp_path):
+    """A metric the LATEST round did not record (skipped section, wedged
+    chip) must not regress on stale data — only the latest round's own
+    measurement can flag."""
+    bench_trend = _bench_trend()
+    _bench_round(tmp_path, 1, 1000.0, {'lm_train_mfu': 0.5})
+    _bench_round(tmp_path, 2, 1000.0, {'lm_train_mfu': 0.2})
+    _bench_round(tmp_path, 3, 1000.0, {})  # section skipped this round
+    report = bench_trend.trend(bench_trend.load_rounds(str(tmp_path)))
+    assert not report['metrics']['lm_train_mfu']['regressed']
+    assert report['regressions'] == []
+
+
+def test_bench_trend_skips_unparseable_tails(tmp_path):
+    bench_trend = _bench_trend()
+    (tmp_path / 'BENCH_r01.json').write_text(json.dumps(
+        {'n': 1, 'rc': 124, 'tail': 'clipped {not json'}))
+    _bench_round(tmp_path, 2, 500.0, {})
+    rounds = bench_trend.load_rounds(str(tmp_path))
+    assert [n for n, _ in rounds] == [2]
+
+
+# -- overhead guard ----------------------------------------------------------
+
+
+@pytest.mark.perf
+def test_collector_overhead_budget(monkeypatch):
+    """The sampler must not tax the span hot path: a tight span loop
+    with the collector running stays within 4x of the loop without it
+    (deliberately loose: shared-box noise must not flake this — it
+    catches order-of-magnitude regressions like per-span locking)."""
+
+    def rate():
+        n = 20000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            with T.span('decode'):
+                pass
+        return n / (time.perf_counter() - t0)
+
+    rate()  # warm
+    baseline = rate()
+    _arm(monkeypatch, window_sec='0.05')
+    assert timeseries.ensure_collector() is not None
+    armed = rate()
+    assert armed >= 0.25 * baseline, (armed, baseline)

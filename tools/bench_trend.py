@@ -1,0 +1,201 @@
+"""Fold every BENCH_r*.json round record into a perf trend table.
+
+The driver stores each benchmark round as ``BENCH_r0N.json`` — a wrapper
+``{"n": N, "rc": ..., "tail": "<last stdout chars>"}`` whose tail ends
+with bench.py's compact headline JSON line (the cumulative line may be
+clipped by the tail window; the headline line is emitted last and sized
+to always fit — see bench.py). Rounds were not previously
+self-describing as a SEQUENCE: answering "did the hello-world rate
+regress between r03 and r05" meant hand-parsing five tails. This tool is
+the fold:
+
+    python tools/bench_trend.py            # table + one trend JSON line
+    python tools/bench_trend.py --fail-on-regression   # CI gate shape
+
+A **regression** is flagged when a tracked higher-is-better metric's
+latest value falls below ``--threshold`` (default 0.9) x the best value
+any earlier round recorded. Missing values (skipped sections, wedged
+chips) are shown as ``-`` and never flagged — absence of evidence is not
+a regression.
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+#: higher-is-better metrics tracked for the regression flag, in display
+#: order. ``value`` is the headline metric itself (hello-world rows/sec).
+TRACKED = (
+    'value',
+    'vs_tfdata',
+    'hello_world_warm_epoch_rows_per_sec',
+    'cache_hit_share',
+    'native_decode_speedup',
+    'imagenet_batch_rows_per_sec',
+    'imagenet_jax_rows_per_sec',
+    'imagenet_jax_h2d_overlap_share',
+    'vit_train_steps_per_sec',
+    'vit_train_mfu',
+    'lm_train_steps_per_sec',
+    'lm_train_mfu',
+    'lm_train_tuned_mfu',
+    'lm_decode_decode_tokens_per_sec',
+    'lm_decode_gqa_decode_speedup',
+)
+
+_ROUND_RE = re.compile(r'BENCH_r(\d+)\.json$')
+
+
+def parse_round(path):
+    """``(round_number, headline_dict)`` from one BENCH_r*.json wrapper,
+    or None when no parseable headline line survives in the tail."""
+    match = _ROUND_RE.search(os.path.basename(path))
+    if not match:
+        return None
+    with open(path) as f:
+        record = json.load(f)
+    number = int(record.get('n', match.group(1)))
+    tail = record.get('tail', '')
+    headline = None
+    for line in tail.splitlines():
+        line = line.strip()
+        if not (line.startswith('{') and line.endswith('}')):
+            continue
+        try:
+            parsed = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(parsed, dict) and 'value' in parsed:
+            headline = parsed  # keep the LAST parseable headline line
+    if headline is None:
+        return None
+    return number, headline
+
+
+def load_rounds(directory):
+    """Every parseable round in ``directory``, oldest first:
+    ``[(n, headline), ...]``. Unparseable wrappers (clipped tails of the
+    rounds lost to the old single-line format) are skipped, not fatal —
+    the trend is built from whatever rounds survive."""
+    rounds = []
+    for path in sorted(glob.glob(os.path.join(directory, 'BENCH_r*.json'))):
+        try:
+            parsed = parse_round(path)
+        except (OSError, ValueError):
+            parsed = None
+        if parsed is not None:
+            rounds.append(parsed)
+    rounds.sort(key=lambda pair: pair[0])
+    return rounds
+
+
+def metric_value(headline, key):
+    if key == 'value':
+        value = headline.get('value')
+    else:
+        value = (headline.get('extra') or {}).get(key)
+    return value if isinstance(value, (int, float)) \
+        and not isinstance(value, bool) else None
+
+
+def trend(rounds, threshold=0.9):
+    """The fold: per-metric series across rounds plus regression flags.
+
+    Returns ``{'rounds': [n, ...], 'metrics': {key: {'series': [...],
+    'latest': x, 'best': y, 'regressed': bool}}, 'regressions': [key,
+    ...]}``. A metric regresses when its LATEST recorded value is below
+    ``threshold`` x the best of the EARLIER rounds (so a new all-time
+    best can never flag, and a metric first measured this round has no
+    baseline to regress from).
+    """
+    numbers = [n for n, _ in rounds]
+    metrics = {}
+    regressions = []
+    for key in TRACKED:
+        series = [metric_value(headline, key) for _, headline in rounds]
+        present = [(i, v) for i, v in enumerate(series) if v is not None]
+        if not present:
+            continue
+        latest_i, latest = present[-1]
+        earlier = [v for i, v in present if i < latest_i]
+        best_earlier = max(earlier) if earlier else None
+        # only the LATEST round's own measurement can flag: a metric the
+        # recent rounds stopped recording (skipped section, wedged chip)
+        # must not fail CI forever on stale data
+        regressed = (latest_i == len(series) - 1
+                     and best_earlier is not None
+                     and latest < threshold * best_earlier)
+        metrics[key] = {
+            'series': series,
+            'latest': latest,
+            'best': max(v for _, v in present),
+            'regressed': regressed,
+        }
+        if regressed:
+            regressions.append(key)
+    return {'rounds': numbers, 'metrics': metrics,
+            'threshold': threshold, 'regressions': regressions}
+
+
+def format_table(report):
+    """Human rendering: one metric per row, one column per round, the
+    regression flag trailing."""
+    numbers = report['rounds']
+    header = ['metric'.ljust(38)] + ['r%02d' % n for n in numbers] \
+        + ['flag']
+    lines = ['  '.join(h.rjust(10) if i else h
+                       for i, h in enumerate(header))]
+    for key, info in report['metrics'].items():
+        cells = [key.ljust(38)]
+        for value in info['series']:
+            cells.append(('%.4g' % value if value is not None
+                          else '-').rjust(10))
+        cells.append('REGRESSED' if info['regressed'] else 'ok')
+        lines.append('  '.join(cells))
+    if report['regressions']:
+        lines.append('regressions (latest < %.0f%% of best earlier '
+                     'round): %s' % (100 * report['threshold'],
+                                     ', '.join(report['regressions'])))
+    else:
+        lines.append('no regressions at the %.0f%% threshold'
+                     % (100 * report['threshold']))
+    return '\n'.join(lines)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description='Fold BENCH_r*.json rounds into a perf trend table '
+                    'with a regression flag')
+    parser.add_argument('--dir', default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))),
+        help='directory holding the BENCH_r*.json round records '
+             '(default: the repo root)')
+    parser.add_argument('--threshold', type=float, default=0.9,
+                        help='regression threshold: latest < threshold x '
+                             'best earlier round (default 0.9)')
+    parser.add_argument('--json', action='store_true',
+                        help='print only the machine-readable trend line')
+    parser.add_argument('--fail-on-regression', action='store_true',
+                        help='exit 1 when any tracked metric regressed')
+    args = parser.parse_args(argv)
+
+    rounds = load_rounds(args.dir)
+    if not rounds:
+        print('no parseable BENCH_r*.json rounds under %s' % args.dir)
+        return 2
+    report = trend(rounds, threshold=args.threshold)
+    if args.json:
+        print(json.dumps(report, sort_keys=True))
+    else:
+        print(format_table(report))
+        print(json.dumps(report, sort_keys=True))
+    if args.fail_on_regression and report['regressions']:
+        return 1
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
