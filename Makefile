@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: test test-fast analyze lint typecheck bench dryrun docker clean
+.PHONY: test test-fast analyze lint trend ci typecheck bench dryrun docker clean
 
 # full suite (~10 min: includes the compile-heavy model/attention tests)
 test:
@@ -23,6 +23,23 @@ analyze:
 
 lint: analyze
 	$(PYTHON) -m flake8 petastorm_tpu tests examples
+
+# perf-trend regression gate: folds every BENCH_r*.json round and fails
+# when a tracked higher-is-better metric's latest value drops below 0.9x
+# the best earlier round (r03/r04 were lost once to a silent parse
+# regression — this keeps the trajectory self-defending in CI).
+# Allowances (strict-on-new, like pipecheck --baseline) with reasons:
+#   lm_train_steps_per_sec   — r02 measured a tiny smoke config (789/s);
+#                              r05's 1.55/s is the real model. Next
+#                              bench round rebaselines and this drops.
+#   imagenet_jax_rows_per_sec — r05 ran pre-PR7/9 (no decoded cache, no
+#                              fused decode); superseded next round.
+trend:
+	$(PYTHON) tools/bench_trend.py --fail-on-regression \
+	  --allow lm_train_steps_per_sec --allow imagenet_jax_rows_per_sec
+
+# the CI gate sequence: static contracts, perf trend, tier-1 tests
+ci: analyze trend test-fast
 
 typecheck:
 	$(PYTHON) -m mypy petastorm_tpu

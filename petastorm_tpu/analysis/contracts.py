@@ -39,6 +39,8 @@ EVENT_NAMES = frozenset([
     'ventilate',        # recorded via the ventilator's stage span
     'dispatch',         # dispatcher assigned the item to a worker (instant)
     'reventilate',      # heartbeat lapse sent the item back to pending
+    'retry',            # failed attempt rescheduled with backoff (instant)
+    'poisoned',         # retry budget exhausted; item quarantined
     'done',             # the item's single delivered completion
     'duplicate_done',   # a raced second completion, deduped (dropped)
 ])
@@ -82,6 +84,15 @@ METRIC_NAMES = frozenset([
     'petastorm_tpu_service_workers_registered',
     'petastorm_tpu_service_items_pending',
     'petastorm_tpu_service_items_assigned',
+    # failure-domain hardening (service/dispatcher.py, faults.py,
+    # telemetry/__init__.py)
+    'petastorm_tpu_service_retries_total',
+    'petastorm_tpu_service_items_poisoned_total',
+    'petastorm_tpu_swallowed_errors_total',
+    'petastorm_tpu_faults_injected_total',
+    # decoded-cache failure domain (materialized_cache.py)
+    'petastorm_tpu_decoded_cache_disk_failures_total',
+    'petastorm_tpu_decoded_cache_degraded',
     # pipesan runtime zero-copy sanitizer (sanitizer.py)
     'petastorm_tpu_sanitizer_violations_total',
     'petastorm_tpu_sanitizer_views_guarded_total',
@@ -131,6 +142,10 @@ KNOWN_KNOBS = frozenset([
     'PETASTORM_TPU_OBS_COLLAPSE_FRAC',
     'PETASTORM_TPU_OBS_SATURATED_SHARE',
     'PETASTORM_TPU_OBS_FLAP_FLIPS',
+    'PETASTORM_TPU_FAULTS',
+    'PETASTORM_TPU_SERVICE_MAX_RETRIES',
+    'PETASTORM_TPU_SERVICE_RETRY_BACKOFF_S',
+    'PETASTORM_TPU_SERVICE_READ_DEADLINE_S',
 ])
 
 #: canonical anomaly event kinds the live observability plane's detector
@@ -151,6 +166,36 @@ ANOMALY_KINDS = {
     'heartbeat_gap': 'Stale decode workers after a crash',
     'h2d_starvation': 'My pipeline is consumer-bound — is it the '
                       'training step or the H2D link?',
+    'row_group_poisoned': 'A row-group was quarantined '
+                          '(row_group_poisoned)',
+    'cache_degraded': 'The decoded cache degraded to decode-through',
+}
+
+#: every registered fault-injection site (:mod:`petastorm_tpu.faults`),
+#: mapped to a one-line description of the seam it sits on. The
+#: ``faultpoint`` analysis pass holds every ``fault_hit()`` literal in
+#: the package to this set, an armed hit of an unregistered name raises
+#: at runtime, and docs/development.md's authoring guide renders this
+#: table — a faultpoint can never exist off the books. ``drop`` is only
+#: meaningful at the message-send sites; the data-path sites take the
+#: error/oserror/delay modes.
+FAULTPOINTS = {
+    'io.read': 'parquet row-group read (arrow_worker._load_rowgroup)',
+    'decode.rowgroup': 'whole row-group decode, incl. the native batch '
+                       'decoders (arrow_worker._load_rowgroup)',
+    'decode.batch': 'one column batch decode (codecs.'
+                    'decode_batch_with_nulls; fused + per-cell paths)',
+    'cache.read': 'decoded-cache entry open/mmap (materialized_cache)',
+    'cache.write': 'decoded-cache entry publish (materialized_cache)',
+    'zmq.recv': 'dispatcher inbound message (drop = lose the frame)',
+    'zmq.work': 'dispatcher WORK send (drop = assignment lost in '
+                'flight; the consumer-read deadline is the backstop)',
+    'zmq.done': 'worker DONE/ERROR send (drop = completion lost)',
+    'zmq.heartbeat': 'worker heartbeat send (drop = dispatcher sees a '
+                     'lapse and re-ventilates)',
+    'zmq.stop': 'dispatcher STOP broadcast (drop = dispatcher dies '
+                'without goodbye — the restart/reconnect drill)',
+    'staging.h2d': 'staging-arena host->device dispatch (jax/staging)',
 }
 
 #: the one knob-truthiness rule for "disable"/"enable" env spellings —

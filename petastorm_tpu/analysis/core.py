@@ -39,6 +39,9 @@ RULE_DESCRIPTIONS = {
     'canonical-name':
         'span()/trace-event/metric name literals are members of the '
         'canonical sets in analysis/contracts.py',
+    'faultpoint':
+        'every fault_hit() call site names a fault-injection site '
+        'registered in contracts.FAULTPOINTS',
     'blocking-under-lock':
         'no indefinitely-blocking call (queue get/put sans timeout, ZMQ '
         'sans NOBLOCK, join()/wait() sans timeout, block_until_ready, '

@@ -19,6 +19,7 @@ import logging
 import numpy as np
 import pyarrow.parquet as pq
 
+from petastorm_tpu import faults
 from petastorm_tpu.cache import NullCache
 from petastorm_tpu.codecs import CompressedImageCodec, decode_batch_with_nulls
 from petastorm_tpu.fused import (
@@ -297,6 +298,13 @@ class RowGroupWorker(WorkerBase):
         else:
             keep = None
 
+        # faultpoint key: one stable identity per row-group, so chaos
+        # specs can poison a specific one (match=) or rate-sample reads;
+        # '#' not ':' as the separator — ':' is the spec grammar's own
+        # field separator, so a match= value could never contain it
+        if faults.ARMED:
+            faults.fault_hit('io.read', key='%s#rg%d'
+                             % (piece.path, piece.row_group))
         with span('io'):
             table = pf.read_row_group(piece.row_group, columns=file_columns)
         num_rows = table.num_rows
@@ -309,6 +317,9 @@ class RowGroupWorker(WorkerBase):
 
         select_all = row_indices.size == num_rows
 
+        if faults.ARMED:
+            faults.fault_hit('decode.rowgroup', key='%s#rg%d'
+                             % (piece.path, piece.row_group))
         columns = {}
         with span('decode'):
             for name in file_columns:

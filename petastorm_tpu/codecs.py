@@ -31,6 +31,7 @@ from io import BytesIO
 import numpy as np
 import pyarrow as pa
 
+from petastorm_tpu import faults
 from petastorm_tpu.telemetry import knobs
 from petastorm_tpu.unischema import numpy_to_arrow_type
 
@@ -329,6 +330,12 @@ def decode_batch_with_nulls(unischema_field, values, out=None):
     the slab may be a recycled staging-arena slot whose stale pixels
     would otherwise leak into "null" rows). Returns ``out``.
     """
+    if faults.ARMED:
+        # the one decode seam every path shares — native batch, cv2
+        # fallback, fused-into-slot — so an injected "poisoned cell"
+        # exercises whichever decoder actually runs
+        faults.fault_hit('decode.batch',
+                         key=getattr(unischema_field, 'name', None))
     if out is not None:
         codec = unischema_field.codec
         n = len(values)

@@ -27,3 +27,31 @@ class MetadataGenerationError(MetadataError):
 
 class DecodeFieldError(PetastormTpuError):
     """A field value failed codec decode (``petastorm/utils.py:48``)."""
+
+
+class RowGroupPoisonedError(PetastormTpuError):
+    """A service work item exhausted its retry budget and was quarantined
+    (docs/service.md, "Failure semantics").
+
+    Raised by :class:`~petastorm_tpu.service.service_pool.ServicePool`
+    under ``poison_policy='raise'`` when the dispatcher quarantines an
+    item whose failures carried no worker exception (a row-group that
+    *kills* its workers rather than erroring). ``info`` is the
+    dispatcher's quarantine descriptor (item id, attempts, reason)."""
+
+    def __init__(self, message, info=None):
+        super().__init__(message)
+        self.info = info or {}
+
+
+class ServiceWedgedError(PetastormTpuError):
+    """A service consumer read made no progress for the configured
+    deadline while work was outstanding (``PETASTORM_TPU_SERVICE_READ_
+    DEADLINE_S``) — the diagnosable replacement for wedging forever.
+    ``fleet`` carries the dispatcher's live fleet view at raise time
+    (per-worker liveness, in-flight loads, queue state), so the failure
+    mode is in the traceback, not lost with the hung process."""
+
+    def __init__(self, message, fleet=None):
+        super().__init__(message)
+        self.fleet = fleet or {}

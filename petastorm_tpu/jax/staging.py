@@ -70,7 +70,7 @@ import logging
 
 import numpy as np
 
-from petastorm_tpu import sanitizer
+from petastorm_tpu import faults, sanitizer
 from petastorm_tpu.fused import (
     FUSED_BYTES, FUSED_ROWS, EncodedImageColumn, count_fallback,
 )
@@ -343,7 +343,7 @@ class StagingEngine:
             cols = dict(cols)
             cols[MASK_FIELD] = self._full_mask
         with span('h2d_dispatch'):
-            device_batch = self._put_fn(cols)
+            device_batch = self._put(cols)
         self._account(cols.values())
         self._learn_backend(device_batch)
         return device_batch
@@ -358,7 +358,7 @@ class StagingEngine:
                                         guarded=False)
             views = self._fill(buffers, parts, n, with_mask)
         with span('h2d_dispatch'):
-            device_batch = self._put_fn(views)
+            device_batch = self._put(views)
         self._account(views.values())
         return device_batch
 
@@ -381,7 +381,7 @@ class StagingEngine:
             # from the slot's own reference to its buffers
             views = {name: v[:] for name, v in views.items()}
         with span('h2d_dispatch'):
-            device_batch = self._put_fn(views)
+            device_batch = self._put(views)
         self._account(views.values())
         if self._learn_backend(device_batch):
             # first dispatch revealed a host-backed target: the runtime
@@ -498,6 +498,15 @@ class StagingEngine:
         counters."""
         self._rings = {}
         self._full_mask = None
+
+    def _put(self, cols):
+        """The one H2D dispatch seam (all three staging modes route
+        here): the ``staging.h2d`` faultpoint sits in front of the
+        loader's ``put_fn`` so chaos runs can inject transfer errors or
+        link latency without touching a runtime."""
+        if faults.ARMED:
+            faults.fault_hit('staging.h2d')
+        return self._put_fn(cols)
 
     def _account(self, arrays):
         self.batches_staged += 1

@@ -26,6 +26,7 @@ decode-bound. Ragged/object columns fall back to an embedded pickle cell
 row-groups skip the filesystem entirely.
 """
 
+import errno
 import hashlib
 import json
 import logging
@@ -39,12 +40,13 @@ from collections import OrderedDict
 
 import numpy as np
 
-from petastorm_tpu import sanitizer
+from petastorm_tpu import faults, sanitizer
 from petastorm_tpu.cache import (
     CacheBase, attach_scan, evict_lru, publish_entry,
 )
 from petastorm_tpu.telemetry import span
 from petastorm_tpu.telemetry.registry import get_registry
+from petastorm_tpu.telemetry.timeseries import record_anomaly
 
 logger = logging.getLogger(__name__)
 
@@ -60,6 +62,29 @@ DECODED_CACHE_BYTES_READ = 'petastorm_tpu_decoded_cache_bytes_read_total'
 DECODED_CACHE_MMAP_READS = 'petastorm_tpu_decoded_cache_mmap_reads_total'
 DECODED_CACHE_COPY_READS = 'petastorm_tpu_decoded_cache_copy_reads_total'
 DECODED_CACHE_SIZE_BYTES = 'petastorm_tpu_decoded_cache_size_bytes'
+DECODED_CACHE_DISK_FAILURES = \
+    'petastorm_tpu_decoded_cache_disk_failures_total'
+DECODED_CACHE_DEGRADED = 'petastorm_tpu_decoded_cache_degraded'
+
+#: errnos that mean the MEDIUM (or the directory) is the problem, not
+#: one entry, when a STORE fails: disk full, quota, read-only remount,
+#: directory permissions, I/O error. One of these degrades the disk
+#: tier immediately — retrying per-row-group would fail the same way
+#: and bill every row-group an fsync-deep error path.
+_STORE_FAULT_ERRNOS = frozenset(
+    e for e in (errno.ENOSPC, getattr(errno, 'EDQUOT', None), errno.EROFS,
+                errno.EACCES, errno.EPERM, errno.EIO) if e is not None)
+
+#: on a READ only EIO indicts the medium. EACCES/EPERM are frequently
+#: ENTRY-shaped there — one foreign-UID file in a shared per-host
+#: directory must not disarm the tier for every other readable entry —
+#: so they ride the consecutive-failure ramp instead.
+_READ_FAULT_ERRNOS = frozenset((errno.EIO,))
+
+#: entry-shaped failures (serialization oddities, transient weirdness)
+#: tolerate this many CONSECUTIVE occurrences before degrading anyway —
+#: a tier failing every single store is not caching, just burning time
+_CONSECUTIVE_FAILURE_LIMIT = 5
 
 #: dtype kinds whose flat buffer round-trips through np.frombuffer —
 #: these columns mmap back zero-copy; everything else ('O' object arrays:
@@ -251,6 +276,10 @@ def dataset_file_fingerprint(dataset_info, path):
         mtime = info.get('mtime') or info.get('LastModified')
         return '%s-%s' % (size, mtime)
     except Exception:  # noqa: BLE001 - exotic fs: fall back to path-only
+        # counted: the path-only fallback weakens invalidation (a
+        # rewritten file could serve stale rows), so it must be visible
+        from petastorm_tpu.telemetry import count_swallowed
+        count_swallowed('cache-fingerprint-stat')
         return 'nostat'
 
 
@@ -384,6 +413,14 @@ class MaterializedRowGroupCache(CacheBase):
         self._lock = threading.Lock()
         self._mem = OrderedDict()   # key -> (columns, length, nbytes)
         self._mem_bytes = 0
+        # degrade-don't-die state (docs/troubleshoot.md, "The decoded
+        # cache degraded to decode-through"): a disk-fault errno (or a
+        # run of consecutive store failures) disarms the DISK tier for
+        # the rest of this process — reads decode through, the memory
+        # tier keeps serving — instead of failing the epoch or paying a
+        # failing syscall per row-group forever.
+        self._degraded = False
+        self._consecutive_failures = 0
         self._attach(path)
 
     def _attach(self, path):
@@ -392,14 +429,27 @@ class MaterializedRowGroupCache(CacheBase):
         # one walk: purge dead writers' tmp files + total the entries
         self._total = attach_scan(path)
 
+    @property
+    def degraded(self):
+        """True when the disk tier disarmed itself after disk faults."""
+        return self._degraded
+
     def reroot(self, path):
         """Re-point the cache at a different directory (the service
         worker server's ``PETASTORM_TPU_DECODED_CACHE_DIR`` override, so
         every job landing on a host shares that host's local-SSD tier
-        regardless of what directory the client baked into the spec)."""
+        regardless of what directory the client baked into the spec).
+        Re-arms a degraded tier: the fault belonged to the OLD medium."""
         with self._lock:
             self._mem.clear()
             self._mem_bytes = 0
+        if self._degraded:
+            # clear the stale telemetry too: a re-armed tier must not
+            # keep reporting degraded=1 to /metrics and the fleet view
+            self._registry().gauge(DECODED_CACHE_DEGRADED,
+                                   pid=str(os.getpid())).set(0)
+        self._degraded = False
+        self._consecutive_failures = 0
         self._attach(path)
 
     def __getstate__(self):
@@ -495,36 +545,55 @@ class MaterializedRowGroupCache(CacheBase):
                 pass
             columns, length, _ = hit
             return ColumnBatch(dict(columns), length) if length else None
-        try:
-            # stat BEFORE the span: a plain miss must not record a
-            # cache_hit_read call or bill its failed open as hit time
-            # (that would inflate the hit_side term the cache-phase
-            # verdict weighs decode time against)
-            size = os.stat(entry).st_size
-            with span('cache_hit_read'):
-                columns, length, mmaped, copied = read_entry(entry)
-            os.utime(entry)  # LRU touch
-            registry.counter(DECODED_CACHE_HITS).inc()
-            registry.counter(DECODED_CACHE_BYTES_READ).inc(size)
-            registry.counter(DECODED_CACHE_MMAP_READS).inc(mmaped)
-            registry.counter(DECODED_CACHE_COPY_READS).inc(copied)
-            self._mem_put(key, columns, length)
-            # a fresh wrapper per hit: workers stamp item_index/epoch on
-            # the returned batch, and concurrent hits of one key (two
-            # epochs in flight on a thread pool) must not race that write
-            return ColumnBatch(dict(columns), length) if length else None
-        except OSError:
-            pass  # plain miss (no entry)
-        except Exception:  # noqa: BLE001 - truncated/corrupt/foreign entry
-            logger.warning('decoded cache entry %s unreadable; refilling',
-                           entry, exc_info=True)
-            self._remove_entry(entry)
+        if not self._degraded:
+            try:
+                if faults.ARMED:
+                    faults.fault_hit('cache.read', key=entry)
+                # stat BEFORE the span: a plain miss must not record a
+                # cache_hit_read call or bill its failed open as hit time
+                # (that would inflate the hit_side term the cache-phase
+                # verdict weighs decode time against)
+                size = os.stat(entry).st_size
+                with span('cache_hit_read'):
+                    columns, length, mmaped, copied = read_entry(entry)
+                os.utime(entry)  # LRU touch
+                registry.counter(DECODED_CACHE_HITS).inc()
+                registry.counter(DECODED_CACHE_BYTES_READ).inc(size)
+                registry.counter(DECODED_CACHE_MMAP_READS).inc(mmaped)
+                registry.counter(DECODED_CACHE_COPY_READS).inc(copied)
+                self._consecutive_failures = 0
+                self._mem_put(key, columns, length)
+                # a fresh wrapper per hit: workers stamp item_index/epoch
+                # on the returned batch, and concurrent hits of one key
+                # (two epochs in flight on a thread pool) must not race
+                # that write
+                return (ColumnBatch(dict(columns), length) if length
+                        else None)
+            except OSError as e:
+                # ENOENT is the plain miss; anything else is the MEDIUM
+                # failing (EIO, EACCES after a remount, ...) — counted,
+                # and disk-fault errnos degrade the tier
+                if e.errno not in (None, errno.ENOENT):
+                    self._note_disk_failure('read', e)
+            except Exception:  # noqa: BLE001 - truncated/corrupt entry
+                logger.warning('decoded cache entry %s unreadable; '
+                               'refilling', entry, exc_info=True)
+                registry.counter(DECODED_CACHE_DISK_FAILURES,
+                                 op='corrupt').inc()
+                self._remove_entry(entry)
         registry.counter(DECODED_CACHE_MISSES).inc()
         batch = fill_cache_func()
         columns = dict(batch.columns) if batch is not None else {}
         length = batch.length if batch is not None else 0
+        if self._degraded:
+            # decode-through: the memory tier still serves repeats, the
+            # broken disk is never touched again this process
+            self._mem_put(key, columns, length)
+            return batch
         try:
             with span('cache_fill'):
+                if faults.ARMED:
+                    faults.fault_hit('cache.write', key=entry)
                 size, replaced = publish_entry(
                     entry, lambda tmp: write_entry(tmp, columns, length))
             registry.counter(DECODED_CACHE_BYTES_WRITTEN).inc(size)
@@ -532,13 +601,49 @@ class MaterializedRowGroupCache(CacheBase):
                 self._total += size - replaced
                 over_limit = self._total > self._disk_limit
             self._size_gauge().set(self._total)
+            self._consecutive_failures = 0
             self._mem_put(key, columns, length)
             if over_limit:
                 self._maybe_evict()
-        except (OSError, ValueError, pickle.PicklingError):
+        except (OSError, ValueError, pickle.PicklingError) as e:
             logger.warning('decoded cache failed to store %r', key,
                            exc_info=True)
+            self._note_disk_failure('store', e)
         return batch
+
+    def _note_disk_failure(self, op, exc):
+        """Count one swallowed disk-tier failure; degrade to
+        decode-through on medium-indicting errnos (immediately — the set
+        depends on the operation, see the errno-set comments above) or a
+        run of consecutive failures of any shape. Every swallow is
+        visible: the counter carries the op, the anomaly event carries
+        the cause."""
+        self._registry().counter(DECODED_CACHE_DISK_FAILURES, op=op).inc()
+        self._consecutive_failures += 1
+        errno_ = getattr(exc, 'errno', None)
+        immediate = (_STORE_FAULT_ERRNOS if op == 'store'
+                     else _READ_FAULT_ERRNOS)
+        if errno_ in immediate:
+            self._degrade('%s failed with %s (%s)'
+                          % (op, errno.errorcode.get(errno_, errno_), exc))
+        elif self._consecutive_failures >= _CONSECUTIVE_FAILURE_LIMIT:
+            self._degrade('%d consecutive disk-tier failures (last: %s)'
+                          % (self._consecutive_failures, exc))
+
+    def _degrade(self, reason):
+        """Disarm the disk tier for the rest of this process and say so
+        loudly ONCE: gauge, ``cache_degraded`` anomaly event (with its
+        runbook), log. Reads decode through from here on — an epoch on a
+        full disk finishes slower, it does not fail."""
+        if self._degraded:
+            return
+        self._degraded = True
+        self._registry().gauge(DECODED_CACHE_DEGRADED,
+                               pid=str(os.getpid())).set(1)
+        record_anomaly('cache_degraded',
+                       detail={'path': self._path, 'reason': reason})
+        logger.warning('Decoded cache at %s degraded to decode-through: '
+                       '%s', self._path, reason)
 
     def _remove_entry(self, entry):
         try:

@@ -54,12 +54,18 @@ def make_reader(dataset_url, schema_fields=None, reader_pool_type='thread',
                 shard_count=None, seed=0, cache_type='null', cache_location=None,
                 cache_size_limit=None, cache_row_size_estimate=None,
                 transform_spec=None, ngram=None, filters=None,
-                storage_options=None, filesystem=None):
+                storage_options=None, filesystem=None, poison_policy=None):
     """Reader over a petastorm_tpu/petastorm materialized dataset, iterating
     rows as namedtuples with all codecs decoded.
 
     Parity: ``petastorm/reader.py:61-196``. Use :func:`make_batch_reader` for
     plain Parquet stores or column-batch output.
+
+    :param poison_policy: service pools only (docs/service.md, "Failure
+        semantics") — what a quarantined (retry-budget-exhausted)
+        row-group does to this reader: ``'raise'`` (default) surfaces
+        the poison; ``'skip'`` reads past it, with the loss recorded on
+        the pool's ``poisoned_items`` and the dispatcher's ``/health``.
 
     :param filters: pyarrow-style DNF filters (``[(col, op, value), ...]`` or
         an OR-list of such AND-lists). Row-groups that provably cannot match
@@ -101,7 +107,7 @@ def make_reader(dataset_url, schema_fields=None, reader_pool_type='thread',
                                     cache_row_size_estimate,
                                     predicate=predicate),
                   transform_spec=transform_spec, ngram=ngram, filters=filters,
-                  batched_output=False)
+                  batched_output=False, poison_policy=poison_policy)
 
 
 def make_batch_reader(dataset_url_or_urls, schema_fields=None,
@@ -113,7 +119,7 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None,
                       cache_location=None, cache_size_limit=None,
                       cache_row_size_estimate=None, transform_spec=None,
                       filters=None, storage_options=None, filesystem=None,
-                      defer_image_decode=False):
+                      defer_image_decode=False, poison_policy=None):
     """Reader yielding whole row-groups as namedtuples of column arrays.
 
     Works on any Parquet store, petastorm metadata or not
@@ -144,7 +150,8 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None,
                                     predicate=predicate),
                   transform_spec=transform_spec, ngram=None, filters=filters,
                   batched_output=True,
-                  defer_image_decode=defer_image_decode)
+                  defer_image_decode=defer_image_decode,
+                  poison_policy=poison_policy)
 
 
 def _make_cache(cache_type, location, size_limit, row_size_estimate,
@@ -194,8 +201,12 @@ def _make_cache(cache_type, location, size_limit, row_size_estimate,
     raise ValueError('Unknown cache_type %r' % cache_type)
 
 
-def _make_pool(reader_pool_type, workers_count, results_queue_size):
+def _make_pool(reader_pool_type, workers_count, results_queue_size,
+               poison_policy=None):
     import os
+    if poison_policy not in (None, 'raise', 'skip'):
+        raise ValueError("poison_policy must be 'raise' or 'skip'; got %r"
+                         % (poison_policy,))
     if not isinstance(reader_pool_type, str):
         # A pre-built pool instance (any object honoring the pool contract):
         # lets callers configure endpoints/timeouts a string cannot carry,
@@ -207,7 +218,21 @@ def _make_pool(reader_pool_type, workers_count, results_queue_size):
         if missing:
             raise ValueError('reader_pool_type instance %r lacks pool '
                              'contract member(s) %s' % (pool, missing))
+        if poison_policy is not None:
+            if not hasattr(pool, 'poison_policy'):
+                # silently dropping the policy would surprise exactly
+                # when it matters (a poison arriving) — same fail-loud
+                # stance as the local-pool check below
+                raise ValueError(
+                    'poison_policy given but pool instance %r has no '
+                    'poison_policy support' % (pool,))
+            pool.poison_policy = poison_policy
         return pool
+    if poison_policy is not None and reader_pool_type != 'service':
+        # local pools have no quarantine machinery: a worker error is
+        # in-process and raises directly — fail loud, not silently no-op
+        raise ValueError('poison_policy is only supported with '
+                         "reader_pool_type='service'")
     if workers_count is None:
         # Auto-size to the host: decode is CPU-bound (cv2/numpy release the
         # GIL but still need a core each), so extra workers on a small box
@@ -236,9 +261,11 @@ def _make_pool(reader_pool_type, workers_count, results_queue_size):
             return ServicePool(endpoint=endpoint,
                                expected_workers=int(expected) if expected
                                else None,
-                               results_queue_size=results_queue_size)
+                               results_queue_size=results_queue_size,
+                               poison_policy=poison_policy or 'raise')
         return ServicePool(spawn_local_workers=workers_count,
-                           results_queue_size=results_queue_size)
+                           results_queue_size=results_queue_size,
+                           poison_policy=poison_policy or 'raise')
     if reader_pool_type == 'dummy':
         return DummyPool()
     raise ValueError("reader_pool_type must be one of 'thread', 'process', "
@@ -261,7 +288,7 @@ class Reader:
                  rowgroup_selector=None, num_epochs=1, cur_shard=None,
                  shard_count=None, seed=0, cache=None, transform_spec=None,
                  ngram=None, filters=None, batched_output=True,
-                 defer_image_decode=False):
+                 defer_image_decode=False, poison_policy=None):
         self.dataset_info = dataset_info
         self.batched_output = batched_output and ngram is None
         self.ngram = ngram
@@ -350,7 +377,9 @@ class Reader:
                               'shuffle_row_drop_partition':
                                   (drop, shuffle_row_drop_partitions),
                               'item_index': len(items)})
-        self._pool = _make_pool(reader_pool_type, workers_count, results_queue_size)
+        self._pool = _make_pool(reader_pool_type, workers_count,
+                                results_queue_size,
+                                poison_policy=poison_policy)
         self._num_epochs = num_epochs
         # The bound is a callable so pools whose fleet grows at runtime
         # (service pool: worker servers can register with a RUNNING job)

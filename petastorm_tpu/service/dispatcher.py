@@ -26,23 +26,55 @@ Fault tolerance — the exactly-once core:
   single DONE, so a worker killed mid-item has delivered nothing for it
   and the re-run is not a duplicate. Together: every item's row set reaches
   the consumer exactly once.
+
+Failure-domain hardening (docs/service.md, "Failure semantics"):
+
+* **Retry budgets**: every failed attempt of an item — a worker ERROR or
+  a heartbeat-lapse re-ventilation — counts against the item's budget
+  (``PETASTORM_TPU_SERVICE_MAX_RETRIES`` total attempts). Failed items
+  re-enter the queue after an exponential, deterministically-jittered
+  backoff instead of immediately, so a deterministic crasher cannot
+  hot-loop the fleet.
+* **Suspect isolation**: an item with a failed attempt behind it is only
+  ever assigned ALONE to an idle worker. A poisoned row-group therefore
+  burns exactly one worker per attempt and never drags co-assigned
+  innocent items' budgets down with it.
+* **Poison quarantine**: an item that exhausts its budget is quarantined
+  — skipped with a ``('poisoned', info)`` delivery (the pool applies the
+  reader's ``poison_policy``), recorded on :meth:`health` (the /health
+  endpoint), counted, and announced as a ``row_group_poisoned`` anomaly
+  event — instead of crash-looping the fleet forever.
+* **Incarnation token**: SPEC replies and heartbeat ACKs carry this
+  dispatcher's random token; a worker that suddenly sees a different
+  token knows its dispatcher was replaced (client restart on the same
+  endpoint) and re-registers instead of decoding for a job spec the new
+  dispatcher never sent it.
 """
 
 import collections
+import heapq
 import logging
 import threading
 import time
+import uuid
 
+from petastorm_tpu import faults
 from petastorm_tpu.service import protocol as proto
 from petastorm_tpu.telemetry import (
-    get_registry, merge_worker_delta, metrics_disabled, note_producer_wait,
-    tracing,
+    count_swallowed, get_registry, knobs, merge_worker_delta,
+    metrics_disabled, note_producer_wait, tracing,
 )
+from petastorm_tpu.telemetry.timeseries import record_anomaly
 
 logger = logging.getLogger(__name__)
 
 _POLL_INTERVAL_MS = 50
 _STOP_BROADCASTS = 3
+
+#: quarantined-item descriptors retained for /health (count is unbounded,
+#: the descriptor list is not — an operator needs the recent offenders,
+#: not an ever-growing ledger in a long-lived daemon)
+_POISONED_KEEP = 100
 
 # Fleet-health metric names (docs/telemetry.md): the dispatcher runs in
 # the CONSUMER process, so these land straight in its process-wide
@@ -54,6 +86,8 @@ SERVICE_WORKERS_ALIVE = 'petastorm_tpu_service_workers_alive'
 SERVICE_WORKERS_REGISTERED = 'petastorm_tpu_service_workers_registered'
 SERVICE_ITEMS_PENDING = 'petastorm_tpu_service_items_pending'
 SERVICE_ITEMS_ASSIGNED = 'petastorm_tpu_service_items_assigned'
+SERVICE_RETRIES = 'petastorm_tpu_service_retries_total'
+SERVICE_POISONED = 'petastorm_tpu_service_items_poisoned_total'
 
 
 class _WorkerState:
@@ -101,7 +135,8 @@ class Dispatcher:
 
     def __init__(self, endpoint, job_spec_payload, deliver, stop_event,
                  heartbeat_interval_s=1.0, liveness_timeout_s=4.0,
-                 max_inflight_per_worker=2, no_workers_timeout_s=30.0):
+                 max_inflight_per_worker=2, no_workers_timeout_s=30.0,
+                 max_retries=None, retry_backoff_s=None):
         self._requested_endpoint = endpoint
         self._job_spec_payload = job_spec_payload
         self._deliver = deliver
@@ -110,6 +145,21 @@ class Dispatcher:
         self._liveness_timeout_s = liveness_timeout_s
         self._max_inflight_per_worker = max_inflight_per_worker
         self._no_workers_timeout_s = no_workers_timeout_s
+        # per-item retry budget (total attempts) + backoff base; knob
+        # defaults so a standing fleet is governed without code changes
+        self._max_retries = (max_retries if max_retries is not None
+                             else knobs.get_int(
+                                 'PETASTORM_TPU_SERVICE_MAX_RETRIES', 3,
+                                 floor=1))
+        self._retry_backoff_s = (retry_backoff_s
+                                 if retry_backoff_s is not None
+                                 else knobs.get_float(
+                                     'PETASTORM_TPU_SERVICE_RETRY'
+                                     '_BACKOFF_S', 0.05, floor=0.0))
+        #: this dispatcher incarnation's identity, riding every SPEC and
+        #: HEARTBEAT_ACK: a worker that sees the token change knows its
+        #: dispatcher was replaced and must re-register for the new job
+        self.token = uuid.uuid4().hex[:16].encode()
 
         self.endpoint = None
         self._bound = threading.Event()
@@ -127,6 +177,19 @@ class Dispatcher:
         # completions. Both stay bounded by failure churn, not stream length.
         self._risky_ids = set()
         self._done = set()
+        # failure-domain state: failed-attempt counts (an item present
+        # here is a SUSPECT and is assigned in isolation), the last
+        # worker exception per suspect (delivered on quarantine so
+        # poison_policy='raise' surfaces the real error), the backoff
+        # heap of (ready_at, seq, item_id, payload), and the quarantine
+        # ledger. All bounded by failure churn, never by stream length.
+        self._attempts = {}
+        self._last_error = {}
+        self._retry = []
+        self._retry_seq = 0
+        self._poisoned = collections.OrderedDict()
+        self._poisoned_count = 0
+        self._retried_count = 0
         # Results awaiting consumer-queue space. Bounded in steady state:
         # while it is non-empty no new items are assigned, so it can never
         # exceed the completions already in flight when the consumer
@@ -201,9 +264,11 @@ class Dispatcher:
             'workers_registered': len(self._workers),
             'workers_seen': self._workers_seen,
             'items_assigned': len(self._inflight),
-            'items_pending': pending,
+            'items_pending': pending + len(self._retry),
             'items_reventilated': self._reventilated_count,
             'items_duplicate_done': self._duplicate_done_count,
+            'items_retried': self._retried_count,
+            'items_poisoned': self._poisoned_count,
             'metrics_deltas_merged': self._metrics_deltas_merged,
         }
 
@@ -211,12 +276,17 @@ class Dispatcher:
         """The dispatcher's /health contribution: fleet liveness plus
         the back-pressure state an operator needs first — ``quiesced``
         means completions are backlogged behind a full consumer queue,
-        so the fleet is idling by design, not broken."""
+        so the fleet is idling by design, not broken — plus the
+        quarantine ledger: every recently-poisoned item with its attempt
+        count and last failure, so "which row-group is killing my
+        workers" is a /health read, not a log dig."""
         stats = self.stats()
         stats['quiesced'] = bool(self._out_backlog)
         stats['out_backlog'] = len(self._out_backlog)
         stats['endpoint'] = self.endpoint
         stats['items_completed'] = self._completed_count
+        stats['max_retries'] = self._max_retries
+        stats['poisoned'] = list(self._poisoned.values())
         return stats
 
     def fleet_view(self):
@@ -262,7 +332,10 @@ class Dispatcher:
         registry.gauge(SERVICE_WORKERS_REGISTERED).set(len(workers))
         with self._lock:
             pending = len(self._pending)
-        registry.gauge(SERVICE_ITEMS_PENDING).set(pending)
+        # backoff-delayed retries are pending work too — stats()/health()
+        # already count them, and the gauge must agree
+        registry.gauge(SERVICE_ITEMS_PENDING).set(pending
+                                                  + len(self._retry))
         registry.gauge(SERVICE_ITEMS_ASSIGNED).set(len(self._inflight))
 
     # -- dispatcher thread ---------------------------------------------------
@@ -327,6 +400,11 @@ class Dispatcher:
                             frames = sock.recv_multipart(zmq.NOBLOCK)
                         except zmq.Again:
                             break
+                        if faults.ARMED and faults.fault_hit(
+                                'zmq.recv',
+                                key=frames[1] if len(frames) > 1
+                                else b'') == 'drop':
+                            continue  # injected: message lost in flight
                         self._handle(sock, frames)
                 self._assign(sock)
                 now = time.monotonic()
@@ -340,11 +418,14 @@ class Dispatcher:
         finally:
             for _ in range(_STOP_BROADCASTS):
                 for identity in list(self._workers):
+                    if faults.ARMED and faults.fault_hit(
+                            'zmq.stop', key=identity) == 'drop':
+                        continue  # injected: died without goodbye
                     try:
                         sock.send_multipart([identity, proto.MSG_STOP],
                                             flags=zmq.NOBLOCK)
                     except Exception:  # noqa: BLE001 - peer may be gone
-                        pass
+                        count_swallowed('dispatcher-stop-broadcast')
                 time.sleep(_POLL_INTERVAL_MS / 1000.0)
             sock.close(linger=500)
             context.term()
@@ -363,7 +444,7 @@ class Dispatcher:
             else:
                 self._workers[identity].last_heartbeat = now
             sock.send_multipart([identity, proto.MSG_SPEC,
-                                 self._job_spec_payload])
+                                 self._job_spec_payload, self.token])
             self._update_fleet_gauges()
         elif msg == proto.MSG_READY:
             worker = self._workers.get(identity)
@@ -371,26 +452,41 @@ class Dispatcher:
                 worker.ready = True
                 worker.last_heartbeat = now
         elif msg == proto.MSG_HEARTBEAT:
+            summary = None
+            if len(frames) > 2:
+                # optional trailing frames: the worker's per-heartbeat
+                # observability summary (docs/telemetry.md fleet view;
+                # b'' when its advisory path degraded) and — its own
+                # frame, never inside the summary, because correctness
+                # must not ride an advisory channel — the worker's job
+                # token. Absent from older builds; a bad summary frame
+                # degrades to None and liveness never depends on either.
+                summary = proto.load_obs_summary(frames[2])
+            # a worker still serving ANOTHER dispatcher incarnation's
+            # job (this one replaced it on the endpoint) advertises that
+            # incarnation's token: keep its liveness, never assign it
+            # work — our ACK's token will send it back to registration
+            foreign = len(frames) > 3 and frames[3] != self.token
             worker = self._workers.get(identity)
             if worker is None:
                 # A lapsed worker resurfacing (its items were already
                 # re-ventilated): re-admit it with a clean slate — it
                 # already holds the spec and a live decode worker.
                 worker = _WorkerState(identity, now)
-                worker.ready = True
+                worker.ready = not foreign
                 self._workers[identity] = worker
-                logger.info('Worker %s re-admitted after lapse', identity)
+                logger.info('Worker %s re-admitted after lapse%s',
+                            identity,
+                            ' (foreign incarnation; not assignable)'
+                            if foreign else '')
             else:
                 worker.last_heartbeat = now
-            if len(frames) > 2:
-                # optional trailing frame: the worker's per-heartbeat
-                # observability summary (docs/telemetry.md fleet view);
-                # absent from pre-observability builds, and a bad frame
-                # degrades to None — liveness never depends on it
-                summary = proto.load_obs_summary(frames[2])
-                if summary is not None:
-                    self._worker_obs[identity] = summary
-            sock.send_multipart([identity, proto.MSG_HEARTBEAT_ACK])
+                if foreign:
+                    worker.ready = False
+            if summary is not None:
+                self._worker_obs[identity] = summary
+            sock.send_multipart([identity, proto.MSG_HEARTBEAT_ACK,
+                                 self.token])
         elif msg == proto.MSG_DONE:
             item_id = proto.unpack_item_id(frames[2])
             # frames: [identity, DONE, item_id, metrics, result*]. The
@@ -412,7 +508,7 @@ class Dispatcher:
             exc = proto.load_exception(frames[3])
             if len(frames) > 4:
                 self._merge_metrics(frames[4])
-            self._complete(identity, item_id, ('error', exc), now)
+            self._fail(identity, item_id, exc, now)
         elif msg == proto.MSG_BYE:
             self._deregister(identity, 'said goodbye')
         else:
@@ -458,20 +554,22 @@ class Dispatcher:
         assignment = self._inflight.pop(item_id, None)
         if assignment is None:
             # Ghost completion: the item lapsed back onto the pending queue
-            # but its original owner finished after all. Accept the result
-            # and withdraw the pending copy so it is not run twice.
-            with self._lock:
-                if item_id not in self._pending_ids:
-                    logger.warning('Completion of unknown item %d from %s '
-                                   'dropped', item_id, identity)
-                    return
-                self._pending_ids.discard(item_id)
-                self._pending = collections.deque(
-                    (i, p) for i, p in self._pending if i != item_id)
+            # (or the retry backoff heap) but its original owner finished
+            # after all. Accept the result and withdraw the waiting copy
+            # so it is not run twice.
+            if not self._withdraw_waiting(item_id):
+                logger.warning('Completion of unknown item %d from %s '
+                               'dropped', item_id, identity)
+                return
         else:
             owner = self._workers.get(assignment[0])
             if owner is not None:
                 owner.inflight.discard(item_id)
+        # a delivered completion clears the item's suspect record: its
+        # budget was for THIS traversal, and innocent items that shared a
+        # dying worker must not carry the black mark forever
+        self._attempts.pop(item_id, None)
+        self._last_error.pop(item_id, None)
         if item_id in self._risky_ids:
             self._done.add(item_id)
             # a risky item keeps its trace entry so a RACED second DONE
@@ -512,6 +610,154 @@ class Dispatcher:
                 return
             self._out_backlog.popleft()
 
+    # -- failure handling: retry budget, backoff, quarantine -----------------
+
+    def _withdraw_waiting(self, item_id):
+        """Remove a waiting (pending or backoff-heap) copy of ``item_id``
+        after a ghost completion delivered it; False when no copy was
+        waiting (a genuinely unknown completion)."""
+        with self._lock:
+            if item_id not in self._pending_ids:
+                return False
+            self._pending_ids.discard(item_id)
+            self._pending = collections.deque(
+                (i, p) for i, p in self._pending if i != item_id)
+        if any(entry[2] == item_id for entry in self._retry):
+            self._retry = [entry for entry in self._retry
+                           if entry[2] != item_id]
+            heapq.heapify(self._retry)
+        return True
+
+    def _fail(self, identity, item_id, exc, now):
+        """One failed worker attempt (an ERROR frame): charge the item's
+        retry budget and reschedule with backoff — or quarantine."""
+        worker = self._workers.get(identity)
+        if worker is not None:
+            worker.last_heartbeat = now
+            worker.inflight.discard(item_id)
+        if item_id in self._done:
+            # raced failure of an item whose ghost already delivered —
+            # same dedup shape as a duplicate DONE
+            self._duplicate_done_count += 1
+            if not metrics_disabled():
+                get_registry().counter(SERVICE_DUPLICATE_DONE).inc()
+            return
+        assignment = self._inflight.get(item_id)
+        if assignment is None:
+            # ghost failure from a lapsed owner; the re-ventilated copy
+            # is already waiting (or assigned) and will speak for itself
+            return
+        if assignment[0] != identity:
+            # ghost ERROR from a PRIOR owner racing its replacement: the
+            # live assignment stands — cancelling it here would charge a
+            # phantom attempt and let the item run twice concurrently
+            return
+        self._inflight.pop(item_id)
+        self._record_failure(item_id, assignment[1],
+                             'worker error: %s: %s'
+                             % (type(exc).__name__, exc), exc, now)
+
+    @staticmethod
+    def _jitter(item_id, attempt):
+        """Deterministic backoff jitter factor in [0.5, 1.5): seeded by
+        the item identity so replayed chaos runs reschedule identically
+        (no ``random`` module state involved)."""
+        return 0.5 + ((item_id * 2654435761 + attempt * 40503)
+                      % 4093) / 4093.0
+
+    def _record_failure(self, item_id, payload, reason, exc, now):
+        """Charge one failed attempt. Under budget: backoff-requeue.
+        Budget exhausted: quarantine."""
+        attempt = self._attempts.get(item_id, 0) + 1
+        self._attempts[item_id] = attempt
+        if exc is not None:
+            self._last_error[item_id] = exc
+        if attempt >= self._max_retries:
+            self._quarantine(item_id, reason, now)
+            return
+        delay = (self._retry_backoff_s * (2 ** (attempt - 1))
+                 * self._jitter(item_id, attempt))
+        heapq.heappush(self._retry,
+                       (now + delay, self._retry_seq, item_id, payload))
+        self._retry_seq += 1
+        with self._lock:
+            self._pending_ids.add(item_id)
+        self._retried_count += 1
+        if not metrics_disabled():
+            get_registry().counter(SERVICE_RETRIES).inc()
+        entry = self._trace_ctx.get(item_id)
+        if entry is not None:
+            tracing.record_instant('retry', entry.ctx, 'dispatcher',
+                                   attempt=attempt, reason=reason,
+                                   backoff_s=round(delay, 4))
+        logger.warning('Item %d failed attempt %d/%d (%s); retrying in '
+                       '%.3fs', item_id, attempt, self._max_retries,
+                       reason, delay)
+
+    def _quarantine(self, item_id, reason, now):
+        """Retry budget exhausted: skip the item, record it, surface it.
+        The consumer receives a ``('poisoned', info)`` entry (policy
+        applied pool-side) plus the accounting marker, so the epoch
+        completes with the loss REPORTED instead of the fleet
+        crash-looping or the read wedging."""
+        attempts = self._attempts.pop(item_id, 0)
+        exc = self._last_error.pop(item_id, None)
+        # late ghost completions of a quarantined item must dedup away:
+        # its rows were declared lost, and delivering them afterwards
+        # would turn "reported loss" into silent duplication
+        self._done.add(item_id)
+        info = {'item_id': item_id, 'attempts': attempts,
+                'reason': reason, 'error': exc,
+                'max_retries': self._max_retries}
+        descriptor = {'item_id': item_id, 'attempts': attempts,
+                      'reason': reason,
+                      'error': repr(exc) if exc is not None else None,
+                      'quarantined_at': time.time()}
+        self._poisoned[item_id] = descriptor
+        while len(self._poisoned) > _POISONED_KEEP:
+            self._poisoned.popitem(last=False)
+        self._poisoned_count += 1
+        if not metrics_disabled():
+            get_registry().counter(SERVICE_POISONED).inc()
+        record_anomaly('row_group_poisoned',
+                       detail={k: v for k, v in descriptor.items()
+                               if k != 'quarantined_at'})
+        trace_entry = self._trace_ctx.pop(item_id, None)
+        if trace_entry is not None:
+            tracing.record_instant('poisoned', trace_entry.ctx,
+                                   'dispatcher', attempts=attempts,
+                                   reason=reason)
+        self._emit(('poisoned', info))
+        self._emit(('marker', item_id))
+
+    def _promote_due_retries(self, now):
+        """Move backoff-expired retries to the FRONT of the pending queue
+        (oldest first): lapsed work is the oldest and gates epoch
+        completion through the ventilator's in-flight bound."""
+        due = []
+        while self._retry and self._retry[0][0] <= now:
+            _, _, item_id, payload = heapq.heappop(self._retry)
+            due.append((item_id, payload))
+        if due:
+            with self._lock:
+                for item_id, payload in reversed(due):
+                    if item_id in self._pending_ids:
+                        self._pending.appendleft((item_id, payload))
+
+    def _pop_assignable(self, allow_suspect):
+        """Pop the leftmost assignable pending item. Suspects (items with
+        a failed attempt) are skipped unless ``allow_suspect`` — they are
+        only ever assigned alone to an idle worker."""
+        with self._lock:
+            for idx in range(len(self._pending)):
+                item_id, payload = self._pending[idx]
+                if not allow_suspect and item_id in self._attempts:
+                    continue
+                del self._pending[idx]
+                self._pending_ids.discard(item_id)
+                return item_id, payload
+        return None
+
     # -- scheduling ----------------------------------------------------------
 
     def _assign(self, sock):
@@ -520,21 +766,32 @@ class Dispatcher:
             # the backlog unboundedly. Workers idle (heartbeating, acked)
             # until the consumer drains — quiescence, not decay.
             return
+        self._promote_due_retries(time.monotonic())
         # Least-loaded first, so a fresh (or re-admitted) worker fills up
         # before busy ones receive more.
         workers = sorted((w for w in self._workers.values() if w.ready),
                          key=lambda w: len(w.inflight))
         for worker in workers:
+            if any(i in self._attempts for i in worker.inflight):
+                # suspect isolation: a worker running a retried item gets
+                # NOTHING else — if the item kills it, it dies alone and
+                # no innocent item's budget is charged for the crash
+                continue
             while len(worker.inflight) < self._max_inflight_per_worker:
-                with self._lock:
-                    if not self._pending:
-                        return
-                    item_id, payload = self._pending.popleft()
-                    self._pending_ids.discard(item_id)
+                popped = self._pop_assignable(
+                    allow_suspect=not worker.inflight)
+                if popped is None:
+                    break
+                item_id, payload = popped
                 if item_id in self._done:
                     continue
-                sock.send_multipart([worker.identity, proto.MSG_WORK,
-                                     proto.pack_item_id(item_id), payload])
+                if faults.ARMED and faults.fault_hit(
+                        'zmq.work', key=item_id) == 'drop':
+                    pass  # injected: WORK frame lost; accounting intact
+                else:
+                    sock.send_multipart([worker.identity, proto.MSG_WORK,
+                                         proto.pack_item_id(item_id),
+                                         payload])
                 self._inflight[item_id] = (worker.identity, payload)
                 worker.inflight.add(item_id)
                 entry = self._trace_ctx.get(item_id)
@@ -544,6 +801,8 @@ class Dispatcher:
                         'dispatch', entry.ctx, 'dispatcher',
                         worker=worker.identity.decode('utf-8', 'replace'),
                         attempt=entry.attempts)
+                if item_id in self._attempts:
+                    break  # nothing rides along with a suspect
 
     def _sweep(self, now):
         for identity, worker in list(self._workers.items()):
@@ -561,7 +820,8 @@ class Dispatcher:
         for item_id in stale:
             self._trace_ctx.pop(item_id, None)
         with self._lock:
-            outstanding = bool(self._pending) or bool(self._inflight)
+            outstanding = bool(self._pending) or bool(self._inflight) \
+                or bool(self._retry)
         if outstanding and not self._workers:
             if self._no_workers_since is None:
                 self._no_workers_since = now
@@ -578,16 +838,12 @@ class Dispatcher:
         self._worker_obs.pop(identity, None)
         if worker is None:
             return
+        now = time.monotonic()
         reventilated = 0
         for item_id in worker.inflight:
             entry = self._inflight.pop(item_id, None)
             if entry is None or item_id in self._done:
                 continue
-            with self._lock:
-                # Front of the queue: lapsed work is the oldest and gates
-                # epoch completion through the ventilator's in-flight bound.
-                self._pending.appendleft((item_id, entry[1]))
-                self._pending_ids.add(item_id)
             # From here the item can complete twice (ghost + reassigned
             # copy); only such items need completion dedup.
             self._risky_ids.add(item_id)
@@ -598,6 +854,15 @@ class Dispatcher:
                     'reventilate', trace_entry.ctx, 'dispatcher',
                     worker=identity.decode('utf-8', 'replace'),
                     reason=reason)
+            # every re-ventilation charges the item's retry budget: a
+            # row-group that deterministically kills its worker runs out
+            # of budget and quarantines instead of crash-looping the
+            # whole fleet forever (docs/service.md, failure semantics)
+            self._record_failure(
+                item_id, entry[1],
+                'worker %s %s' % (identity.decode('utf-8', 'replace'),
+                                  reason),
+                None, now)
         self._reventilated_count += reventilated
         if reventilated and not metrics_disabled():
             get_registry().counter(SERVICE_REVENTILATED).inc(reventilated)

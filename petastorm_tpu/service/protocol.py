@@ -6,12 +6,28 @@ The DEALER side sends ``[TYPE, ...]``; the ROUTER side sees
 ``[identity, TYPE, ...]`` and addresses replies with the same identity.
 
     worker ──► dispatcher                      dispatcher ──► worker
-    REGISTER                                   SPEC <job payload>
+    REGISTER                                   SPEC <job payload> [<token>]
     READY                                      WORK <item id> <item payload>
-    HEARTBEAT [<obs summary>]                  HEARTBEAT_ACK
+    HEARTBEAT [<obs summary> [<token>]]        HEARTBEAT_ACK [<token>]
     DONE <item id> <metrics> <result>*         STOP
     ERROR <item id> <exc payload> <metrics>
     BYE
+
+The optional trailing ``<token>`` frames carry the dispatcher
+*incarnation token* (random per Dispatcher instance). A worker
+remembers the token its SPEC carried, echoes it on every HEARTBEAT (its
+own frame after the — possibly empty — summary frame, deliberately NOT
+a field inside the advisory summary: the dispatcher's is-this-my-worker
+check is a correctness signal and must survive the summary path
+degrading), and treats an ack bearing a DIFFERENT token as proof that a
+new dispatcher took the endpoint (client restart) — its job spec and
+item-id numbering are dead, so it abandons the job and re-registers
+instead of mixing two incarnations' item ids (docs/service.md,
+"Failure semantics"). The dispatcher, symmetrically, re-admits a
+foreign-token worker for liveness but never assigns it work. Both
+directions stay compatible with token-less builds: an old worker
+ignores the trailing frames, an old dispatcher simply never sends one
+(the worker then falls back to the ack-timeout path).
 
 The ``<metrics>`` frame piggybacks the worker server's telemetry delta
 (:meth:`~petastorm_tpu.telemetry.registry.MetricsRegistry.collect_delta`)
@@ -89,6 +105,8 @@ def dump_exception(exc):
     try:
         return dill.dumps(exc)
     except Exception:  # noqa: BLE001 - unpicklable exception
+        from petastorm_tpu.telemetry import count_swallowed
+        count_swallowed('exception-pickle')
         return dill.dumps(RuntimeError('%s: %s' % (type(exc).__name__, exc)))
 
 
@@ -126,6 +144,8 @@ def dump_obs_summary(summary):
     try:
         return json.dumps(summary).encode()
     except Exception:  # noqa: BLE001 - telemetry is advisory
+        from petastorm_tpu.telemetry import count_swallowed
+        count_swallowed('obs-summary-encode')
         return b''
 
 

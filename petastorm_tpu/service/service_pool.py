@@ -33,10 +33,11 @@ import queue
 import threading
 import time
 
+from petastorm_tpu.errors import RowGroupPoisonedError, ServiceWedgedError
 from petastorm_tpu.serializers import PickleSerializer
 from petastorm_tpu.service import protocol as proto
 from petastorm_tpu.service.dispatcher import Dispatcher
-from petastorm_tpu.telemetry import tracing
+from petastorm_tpu.telemetry import knobs, tracing
 from petastorm_tpu.workers import (
     EmptyResultError, TimeoutWaitingForResultError,
 )
@@ -59,7 +60,9 @@ class ServicePool:
                  serializer=None, heartbeat_interval_s=1.0,
                  liveness_timeout_s=None, connect_timeout_s=30.0,
                  no_workers_timeout_s=30.0, max_inflight_per_worker=2,
-                 worker_ack_timeout_s=None):
+                 worker_ack_timeout_s=None, max_retries=None,
+                 retry_backoff_s=None, poison_policy='raise',
+                 read_deadline_s=None):
         """
         :param endpoint: ``tcp://host:port`` the dispatcher binds (port 0 =
             random). Default: random loopback port (local fleet mode).
@@ -78,7 +81,27 @@ class ServicePool:
             worker server tolerates missing dispatcher heartbeat acks
             before abandoning the job (default: the server's own
             ``max(10 * heartbeat_interval, 10s)``).
+        :param max_retries: per-item retry budget, total attempts
+            (default ``PETASTORM_TPU_SERVICE_MAX_RETRIES``); an item
+            exhausting it is quarantined, not crash-looped.
+        :param retry_backoff_s: base of the exponential retry backoff
+            (default ``PETASTORM_TPU_SERVICE_RETRY_BACKOFF_S``).
+        :param poison_policy: what a quarantined item does to this
+            consumer: ``'raise'`` (default — surface the poison; the
+            original worker exception when one exists, else
+            :class:`~petastorm_tpu.errors.RowGroupPoisonedError`) or
+            ``'skip'`` (drop the quarantined item's rows, record it in
+            :attr:`poisoned_items`, keep reading — degrade, don't die).
+        :param read_deadline_s: ``get_results`` no-progress deadline with
+            work outstanding, after which
+            :class:`~petastorm_tpu.errors.ServiceWedgedError` (carrying
+            the live fleet view) is raised instead of wedging forever
+            (default ``PETASTORM_TPU_SERVICE_READ_DEADLINE_S``; 0
+            disables).
         """
+        if poison_policy not in ('raise', 'skip'):
+            raise ValueError("poison_policy must be 'raise' or 'skip'; "
+                             'got %r' % (poison_policy,))
         self._endpoint_requested = endpoint or 'tcp://127.0.0.1:0'
         self._expected_workers = expected_workers
         self._spawn_local_workers = spawn_local_workers
@@ -92,6 +115,19 @@ class ServicePool:
         self._no_workers_timeout_s = no_workers_timeout_s
         self._max_inflight_per_worker = max_inflight_per_worker
         self._worker_ack_timeout_s = worker_ack_timeout_s
+        self._max_retries = max_retries
+        self._retry_backoff_s = retry_backoff_s
+        self.poison_policy = poison_policy
+        self._read_deadline_s = (read_deadline_s
+                                 if read_deadline_s is not None
+                                 else knobs.get_float(
+                                     'PETASTORM_TPU_SERVICE_READ'
+                                     '_DEADLINE_S', 300.0, floor=0.0))
+        #: quarantine descriptors seen by THIS consumer (poison_policy=
+        #: 'skip' keeps reading past them; the reader's report and the
+        #: dispatcher /health carry the same records)
+        self.poisoned_items = []
+        self._last_progress = None
 
         self._results_queue = queue.Queue(maxsize=results_queue_size)
         self._stop_event = threading.Event()
@@ -136,7 +172,9 @@ class ServicePool:
             heartbeat_interval_s=self._heartbeat_interval_s,
             liveness_timeout_s=self._liveness_timeout_s,
             max_inflight_per_worker=self._max_inflight_per_worker,
-            no_workers_timeout_s=self._no_workers_timeout_s)
+            no_workers_timeout_s=self._no_workers_timeout_s,
+            max_retries=self._max_retries,
+            retry_backoff_s=self._retry_backoff_s)
         self._dispatcher_thread = threading.Thread(
             target=self._dispatcher.run, daemon=True,
             name='service-dispatcher')
@@ -240,6 +278,11 @@ class ServicePool:
 
     def get_results(self, timeout=None):
         deadline = None if timeout is None else time.monotonic() + timeout
+        # the wedge clock measures time blocked INSIDE this call: a
+        # consumer pausing between calls (recompile, checkpoint save) is
+        # not service starvation and must not trip the deadline on
+        # re-entry
+        self._last_progress = time.monotonic()
         while True:
             if self._error is not None:
                 raise self._error
@@ -274,12 +317,18 @@ class ServicePool:
                     raise EmptyResultError()
                 if deadline is not None and time.monotonic() > deadline:
                     raise TimeoutWaitingForResultError()
+                if not all_done:
+                    self._check_read_deadline()
                 continue
+            self._last_progress = time.monotonic()
             if kind == 'marker':
                 with self._counter_lock:
                     self._processed_items += 1
                 if self._ventilator is not None:
                     self._ventilator.processed_item()
+                continue
+            if kind == 'poisoned':
+                self._note_poisoned(payload)
                 continue
             if kind == 'error':
                 self._error = payload
@@ -287,6 +336,67 @@ class ServicePool:
                 self.join()
                 raise self._error
             return self._serializer.deserialize(payload)
+
+    def _note_poisoned(self, info):
+        """One quarantined item reached this consumer: apply the
+        ``poison_policy``. ``'skip'`` records and reads on (the item's
+        marker keeps the accounting exact, so the epoch ends with the
+        loss reported, not wedged); ``'raise'`` surfaces the poison —
+        the original worker exception when the failures carried one,
+        else :class:`RowGroupPoisonedError`."""
+        descriptor = {k: (repr(v) if k == 'error' and v is not None else v)
+                      for k, v in info.items()}
+        self.poisoned_items.append(descriptor)
+        if self.poison_policy == 'skip':
+            logger.warning(
+                'Skipping quarantined item %s after %s attempt(s) (%s) — '
+                "poison_policy='skip'", info.get('item_id'),
+                info.get('attempts'), info.get('reason'))
+            return
+        error = info.get('error')
+        if error is None:
+            error = RowGroupPoisonedError(
+                'Service work item %s was quarantined after %s failed '
+                'attempt(s) (%s). Its workers died without reporting an '
+                "exception; see the dispatcher's /health `poisoned` list. "
+                "Pass poison_policy='skip' to read past quarantined "
+                'row-groups.' % (info.get('item_id'),
+                                 info.get('attempts'),
+                                 info.get('reason')),
+                info=descriptor)
+        self._error = error
+        self.stop()
+        self.join()
+        raise self._error
+
+    def _check_read_deadline(self):
+        """Raise the diagnosable wedge error when no entry reached this
+        consumer for ``read_deadline_s`` with work outstanding — carrying
+        the live fleet view, so the operator sees WHICH failure domain
+        wedged (lost WORK frame, dead-but-undetected workers, network
+        partition) instead of a silent hang."""
+        if not self._read_deadline_s:
+            return
+        waited = time.monotonic() - self._last_progress
+        if waited <= self._read_deadline_s:
+            return
+        fleet = {}
+        try:
+            fleet = self._dispatcher.fleet_view()
+        except Exception:  # noqa: BLE001 - diagnosis must not mask itself
+            pass
+        with self._counter_lock:
+            inflight = self._ventilated_items - self._processed_items
+        error = ServiceWedgedError(
+            'Service read made no progress for %.1fs with %d item(s) '
+            'outstanding (deadline PETASTORM_TPU_SERVICE_READ_DEADLINE_S'
+            '=%.1fs). Live fleet view: %r'
+            % (waited, inflight, self._read_deadline_s, fleet),
+            fleet=fleet)
+        self._error = error
+        self.stop()
+        self.join()
+        raise error
 
     def stop(self):
         if self._ventilator is not None:
@@ -340,7 +450,8 @@ class ServicePool:
             diag.update({'workers_alive': 0, 'workers_registered': 0,
                          'workers_seen': 0, 'items_assigned': 0,
                          'items_pending': 0, 'items_reventilated': 0,
-                         'items_duplicate_done': 0,
+                         'items_duplicate_done': 0, 'items_retried': 0,
+                         'items_poisoned': 0,
                          'metrics_deltas_merged': 0})
         return diag
 

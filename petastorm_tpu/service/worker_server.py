@@ -25,6 +25,17 @@ Design points:
   and goes back to registering, tf.data-service style, so one fleet of
   worker servers outlives any number of reader lifetimes. ``--once`` (or a
   dead ``--parent-pid``) exits instead.
+* **Dispatcher-restart survival**: the SPEC carries the dispatcher
+  incarnation's random token and every HEARTBEAT_ACK echoes it. When the
+  acks suddenly carry a DIFFERENT token, a new dispatcher has taken the
+  endpoint (client restart) — this server's job spec and item-id space
+  are dead, so it abandons the job immediately and re-registers (fresh
+  socket, fresh identity, registration backoff) instead of decoding the
+  new dispatcher's items against the old job's spec or waiting out the
+  full ack timeout. A vanished-and-silent dispatcher is still caught by
+  the ack timeout; both paths converge on re-registration, so a standing
+  fleet survives any number of dispatcher restarts
+  (docs/service.md, "Failure semantics").
 """
 
 import argparse
@@ -36,8 +47,11 @@ import threading
 import time
 import uuid
 
+from petastorm_tpu import faults
 from petastorm_tpu.service import protocol as proto
-from petastorm_tpu.telemetry import knobs, obs_server, timeseries, tracing
+from petastorm_tpu.telemetry import (
+    count_swallowed, knobs, obs_server, timeseries, tracing,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -57,8 +71,9 @@ def _parent_died(parent_pid):
 def _register(sock, parent_pid, register_timeout_s):
     """REGISTER with exponential backoff until the SPEC arrives.
 
-    Returns the spec payload, or None when the server should exit
-    (orphaned, or the registration window closed).
+    Returns ``(spec payload, dispatcher token)`` — token None from a
+    pre-token dispatcher build — or ``(None, None)`` when the server
+    should exit (orphaned, or the registration window closed).
     """
     backoff_s = 0.1
     deadline = (None if register_timeout_s is None
@@ -71,7 +86,8 @@ def _register(sock, parent_pid, register_timeout_s):
             if sock.poll(_POLL_INTERVAL_MS):
                 frames = sock.recv_multipart()
                 if frames[0] == proto.MSG_SPEC:
-                    return frames[1]
+                    return frames[1], (frames[2] if len(frames) > 2
+                                       else None)
                 # STOP/stray frames during registration are meaningless
                 continue
             now = time.monotonic()
@@ -79,11 +95,11 @@ def _register(sock, parent_pid, register_timeout_s):
                 last_parent_check = now
                 if _parent_died(parent_pid):
                     logger.info('Parent %s died; exiting', parent_pid)
-                    return None
+                    return None, None
             if deadline is not None and now > deadline:
                 logger.error('No dispatcher answered REGISTER within %.1fs',
                              register_timeout_s)
-                return None
+                return None, None
         backoff_s = min(backoff_s * 2, _REGISTER_BACKOFF_MAX_S)
 
 
@@ -111,9 +127,11 @@ def _reroot_decoded_cache(worker_args):
 
 
 def _run_job(sock, spec_payload, worker_id, heartbeat_interval_s,
-             ack_timeout_s, parent_pid, status=None):
-    """One job lifetime: build the worker, stream items until STOP or the
-    dispatcher vanishes. Returns True if the server should serve again."""
+             ack_timeout_s, parent_pid, status=None, token=None):
+    """One job lifetime: build the worker, stream items until STOP, the
+    dispatcher vanishes (ack timeout), or a DIFFERENT dispatcher
+    incarnation takes the endpoint (heartbeat-ack token mismatch).
+    Returns True if the server should serve again."""
     worker_class, worker_args, serializer = proto.load_job_spec(spec_payload)
     _reroot_decoded_cache(worker_args)
     # per-heartbeat observability summary (docs/telemetry.md fleet view):
@@ -181,22 +199,39 @@ def _run_job(sock, spec_payload, worker_id, heartbeat_interval_s,
             now = time.monotonic()
             if now - last_heartbeat_sent >= heartbeat_interval_s:
                 last_heartbeat_sent = now
-                try:
-                    summary = summarizer.summary(
-                        obs_port=obs_server.server_port())
-                    summary['items_done'] = status.get('items_done', 0)
-                    frame = proto.dump_obs_summary(summary)
-                except Exception:  # noqa: BLE001 - telemetry is advisory
-                    frame = b''
-                if frame:
-                    sock.send_multipart([proto.MSG_HEARTBEAT, frame])
+                if faults.ARMED and faults.fault_hit(
+                        'zmq.heartbeat', key=worker_id) == 'drop':
+                    pass  # injected: heartbeat lost; dispatcher will lapse
                 else:
-                    sock.send_multipart([proto.MSG_HEARTBEAT])
+                    try:
+                        summary = summarizer.summary(
+                            obs_port=obs_server.server_port())
+                        summary['items_done'] = status.get('items_done', 0)
+                        frame = proto.dump_obs_summary(summary)
+                    except Exception:  # noqa: BLE001 - advisory telemetry
+                        count_swallowed('worker-obs-summary')
+                        frame = b''
+                    if token is not None:
+                        # the token rides its OWN frame, never inside the
+                        # advisory summary: the dispatcher cross-checks
+                        # it to spot foreign-incarnation workers, and
+                        # that correctness signal must survive the
+                        # summary path degrading to b''
+                        sock.send_multipart([proto.MSG_HEARTBEAT, frame,
+                                             token])
+                    elif frame:
+                        sock.send_multipart([proto.MSG_HEARTBEAT, frame])
+                    else:
+                        sock.send_multipart([proto.MSG_HEARTBEAT])
             while True:
                 try:
-                    sock.send_multipart(out_queue.get_nowait())
+                    result_frames = out_queue.get_nowait()
                 except queue.Empty:
                     break
+                if faults.ARMED and faults.fault_hit(
+                        'zmq.done', key=result_frames[1]) == 'drop':
+                    continue  # injected: completion lost in flight
+                sock.send_multipart(result_frames)
             if sock.poll(_POLL_INTERVAL_MS):
                 frames = sock.recv_multipart()
                 msg = frames[0]
@@ -208,6 +243,18 @@ def _run_job(sock, spec_payload, worker_id, heartbeat_interval_s,
                     break
                 elif msg == proto.MSG_HEARTBEAT_ACK:
                     last_ack = now
+                    if token is not None and len(frames) > 1 \
+                            and frames[1] != token:
+                        # a NEW dispatcher incarnation answered on this
+                        # endpoint: our job spec and item-id space are
+                        # dead — re-register for the new job instead of
+                        # decoding against the old spec or waiting out
+                        # the full ack timeout
+                        logger.warning(
+                            'Dispatcher incarnation changed (token %r -> '
+                            '%r); abandoning job to re-register',
+                            token, frames[1])
+                        break
                 elif msg == proto.MSG_SPEC:
                     pass  # duplicate reply to a re-sent REGISTER
             if now - last_ack > ack_timeout_s:
@@ -237,7 +284,7 @@ def _run_job(sock, spec_payload, worker_id, heartbeat_interval_s,
             try:
                 worker.shutdown()
             except Exception:  # noqa: BLE001 - best-effort shutdown
-                pass
+                count_swallowed('worker-shutdown')
     return serve_again
 
 
@@ -274,19 +321,20 @@ def serve(endpoint, worker_id=0, heartbeat_interval_s=1.0,
             sock.connect(endpoint)
             try:
                 status['state'] = 'registering'
-                spec_payload = _register(sock, parent_pid,
-                                         register_timeout_s)
+                spec_payload, token = _register(sock, parent_pid,
+                                                register_timeout_s)
                 if spec_payload is None:
                     return
                 status['state'] = 'serving'
                 serve_again = _run_job(sock, spec_payload, worker_id,
                                        heartbeat_interval_s, ack_timeout_s,
-                                       parent_pid, status=status)
+                                       parent_pid, status=status,
+                                       token=token)
                 status['jobs_served'] += 1
                 try:
                     sock.send_multipart([proto.MSG_BYE])
                 except Exception:  # noqa: BLE001 - dispatcher may be gone
-                    pass
+                    count_swallowed('worker-bye-send')
             finally:
                 sock.close(linger=500)
                 context.term()

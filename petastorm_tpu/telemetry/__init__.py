@@ -75,6 +75,22 @@ from petastorm_tpu.telemetry import obs_server  # noqa: F401
 STALL_PRODUCER_WAIT = 'petastorm_tpu_stall_producer_wait_seconds_total'
 STALL_CONSUMER_WAIT = 'petastorm_tpu_stall_consumer_wait_seconds_total'
 
+#: swallowed-failure counter (docs/telemetry.md): every broad exception
+#: handler that intentionally continues — best-effort shutdowns, advisory
+#: telemetry frames, peer-may-be-gone sends — increments this with its
+#: site label, so "silent" degradation is never invisible to the
+#: observability plane (ISSUE 11 satellite: no swallow without a count)
+SWALLOWED_ERRORS = 'petastorm_tpu_swallowed_errors_total'
+
+
+def count_swallowed(site):
+    """Count one intentionally-swallowed failure at ``site`` (a short
+    kebab-case label). Deliberately exception-free and metrics-gated:
+    the callers are already in degraded paths."""
+    if metrics_disabled():
+        return
+    get_registry().counter(SWALLOWED_ERRORS, site=site).inc()
+
 #: waits shorter than this are scheduling noise, not stalls; callers skip
 #: noting them so fast balanced pipelines don't accumulate phantom waits
 STALL_NOTE_FLOOR_S = 0.001

@@ -355,7 +355,8 @@ def _service_section(registry):
     machinery's activity visible without reading dispatcher logs."""
     from petastorm_tpu.service.dispatcher import (
         SERVICE_DUPLICATE_DONE, SERVICE_ITEMS_ASSIGNED,
-        SERVICE_ITEMS_PENDING, SERVICE_REVENTILATED, SERVICE_WORKERS_ALIVE,
+        SERVICE_ITEMS_PENDING, SERVICE_POISONED, SERVICE_RETRIES,
+        SERVICE_REVENTILATED, SERVICE_WORKERS_ALIVE,
         SERVICE_WORKERS_REGISTERED,
     )
     gauges = registry.gauges_with_prefix('petastorm_tpu_service_')
@@ -370,6 +371,8 @@ def _service_section(registry):
         'reventilated': int(registry.counter_value(SERVICE_REVENTILATED)),
         'duplicate_done': int(
             registry.counter_value(SERVICE_DUPLICATE_DONE)),
+        'retried': int(registry.counter_value(SERVICE_RETRIES)),
+        'poisoned': int(registry.counter_value(SERVICE_POISONED)),
     }
 
 
@@ -472,10 +475,12 @@ def format_pipeline_report(report):
         s = report['service']
         lines.append('service fleet: %d alive / %d registered worker(s), '
                      '%d pending / %d assigned item(s), %d re-ventilated, '
-                     '%d duplicate completion(s) dropped'
+                     '%d duplicate completion(s) dropped, %d retried, '
+                     '%d poisoned'
                      % (s['workers_alive'], s['workers_registered'],
                         s['items_pending'], s['items_assigned'],
-                        s['reventilated'], s['duplicate_done']))
+                        s['reventilated'], s['duplicate_done'],
+                        s.get('retried', 0), s.get('poisoned', 0)))
     if 'pipesan' in report:
         p = report['pipesan']
         kinds = ', '.join('%s: %d' % (k, v)

@@ -1,0 +1,13 @@
+"""Fixture: fault_hit() sites naming unregistered faultpoints."""
+from petastorm_tpu import faults
+
+_CONSTANT_SITE = 'decode.rowgrup'  # typo'd constant resolves too
+
+
+def hot_path(piece):
+    if faults.ARMED:
+        faults.fault_hit('io.reed', key=piece)          # line 9: typo
+    if faults.ARMED:
+        faults.fault_hit(_CONSTANT_SITE, key=piece)     # line 11: constant
+    if faults.ARMED:
+        faults.fault_hit('io.read', key=piece)          # registered: clean

@@ -1,5 +1,6 @@
 """Stub workers for runtime tests (model: workers_pool/tests/stub_workers.py)."""
 
+import os
 import time
 
 from petastorm_tpu.workers.worker_base import WorkerBase
@@ -25,6 +26,20 @@ class ExceptionOnFiveWorker(WorkerBase):
     def process(self, value):
         if value == 5:
             raise ValueError('value was 5')
+        self.publish_func(value)
+
+
+class ExitOnFiveWorker(WorkerBase):
+    """Publishes its input unless it equals 5, then hard-kills its OWN
+    process (``os._exit`` — no exception frame, no BYE, no heartbeat
+    goodbye): the deterministic worker-killer fixture for poison-
+    quarantine tests. A small sleep keeps other items in flight when
+    the kill lands."""
+
+    def process(self, value, sleep_s=0.02):
+        if value == 5:
+            os._exit(13)
+        time.sleep(sleep_s)
         self.publish_func(value)
 
 

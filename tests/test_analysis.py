@@ -59,7 +59,7 @@ def test_registered_knobs_are_documented():
 
 def test_every_rule_has_a_description():
     assert set(ALL_RULES) == set(RULE_DESCRIPTIONS)
-    assert len(ALL_RULES) == 8
+    assert len(ALL_RULES) == 9
 
 
 # -- rule self-tests over the fixtures ---------------------------------------
@@ -77,6 +77,17 @@ def test_canonical_name_rule_fires():
     assert [f.line for f in findings] == [11, 15, 16], findings
     # the metric finding resolved through a module-level constant
     assert 'petastorm_tpu_reventilated_totl' in findings[2].message
+
+
+def test_faultpoint_rule_fires():
+    """Every fault_hit() call site must name a registered faultpoint —
+    literal or resolved through a module constant; the registered site
+    at the fixture's tail stays clean."""
+    findings = _fixture_findings('bad_faultpoint.py', 'faultpoint')
+    assert [f.line for f in findings] == [9, 11], findings
+    assert 'io.reed' in findings[0].message
+    assert 'contracts.FAULTPOINTS' in findings[0].message
+    assert 'decode.rowgrup' in findings[1].message
 
 
 def test_blocking_under_lock_rule_fires():

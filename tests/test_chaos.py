@@ -1,0 +1,456 @@
+"""Chaos suite: deterministic fault injection driving the failure-domain
+hardening end to end (docs/service.md, "Failure semantics").
+
+Every scenario here was impossible to provoke before the faultpoint
+harness existed: poisoned row-groups that exhaust their retry budget and
+quarantine instead of crash-looping, a dispatcher replaced without a
+goodbye whose fleet re-registers, a lost WORK frame surfacing as a
+diagnosable wedge error, a full cache disk degrading to decode-through,
+and a seeded chaos soak over a full loader epoch asserting exact
+delivery. Timing mirrors tests/test_service.py (tight heartbeats,
+generous outer deadlines)."""
+
+import collections
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from petastorm_tpu import faults, telemetry
+from petastorm_tpu.errors import RowGroupPoisonedError, ServiceWedgedError
+from petastorm_tpu.service import ServicePool
+from petastorm_tpu.service.protocol import free_tcp_port
+from petastorm_tpu.workers import EmptyResultError
+from tests.stub_workers import (
+    ExceptionOnFiveWorker, ExitOnFiveWorker, SleepyIdentityWorker,
+)
+
+pytestmark = pytest.mark.service
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_FAST = dict(heartbeat_interval_s=0.15, liveness_timeout_s=0.75,
+             connect_timeout_s=60, no_workers_timeout_s=20)
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry_and_faults():
+    # plain os.environ, NOT monkeypatch: _arm() writes the var directly
+    # (so spawned worker fleets inherit it), and monkeypatch.delenv's
+    # undo would RESTORE a var it saw at delete time — leaking an armed
+    # spec into every later test module
+    telemetry.reset_for_tests()
+    yield
+    os.environ.pop('PETASTORM_TPU_FAULTS', None)
+    faults.refresh_faults()
+    assert faults.ARMED is None
+    telemetry.reset_for_tests()
+
+
+def _drain(pool, per_result_timeout_s=60):
+    out = []
+    while True:
+        try:
+            out.append(pool.get_results(timeout=per_result_timeout_s))
+        except EmptyResultError:
+            return out
+
+
+def _arm(spec):
+    os.environ['PETASTORM_TPU_FAULTS'] = spec
+    faults.refresh_faults()
+
+
+# -- retry budget + quarantine ------------------------------------------------
+
+
+def test_deterministic_error_quarantines_after_exact_budget():
+    """A deterministically-erroring item is retried exactly
+    ``max_retries`` times in total, then quarantined — visible in
+    diagnostics, /health, the anomaly ring and pipeline_report — while
+    every other item is delivered exactly once (skip policy)."""
+    pool = ServicePool(spawn_local_workers=2, max_retries=2,
+                       retry_backoff_s=0.02, poison_policy='skip',
+                       **_FAST)
+    pool.start(ExceptionOnFiveWorker)
+    try:
+        for i in range(10):
+            pool.ventilate(i)
+        results = _drain(pool)
+        assert sorted(results) == [i for i in range(10) if i != 5]
+        diag = pool.diagnostics
+        assert diag['items_poisoned'] == 1
+        # budget 2 = one backoff retry, then quarantine on the 2nd fail
+        assert diag['items_retried'] == 1
+        health = pool._dispatcher.health()
+        assert health['items_poisoned'] == 1
+        (descriptor,) = health['poisoned']
+        assert descriptor['attempts'] == 2
+        assert 'value was 5' in descriptor['error']
+        assert pool.poisoned_items[0]['attempts'] == 2
+        events = telemetry.recent_anomalies()
+        poisoned = [e for e in events if e['kind'] == 'row_group_poisoned']
+        assert len(poisoned) == 1
+        assert poisoned[0]['detail']['attempts'] == 2
+        assert 'row_group_poisoned' in \
+            telemetry.pipeline_report()['anomalies']['by_kind']
+    finally:
+        pool.stop()
+        pool.join()
+
+
+def test_worker_killing_item_quarantines_instead_of_crash_looping():
+    """THE acceptance scenario: a row-group that SIGKILLs every worker
+    that touches it (no exception frame ever comes back). Each death
+    re-ventilates and charges the budget; after exactly max_retries
+    worker corpses the item quarantines, surviving workers finish the
+    epoch, and the loss is reported — the fleet does not crash-loop."""
+    pool = ServicePool(spawn_local_workers=4, max_retries=2,
+                       retry_backoff_s=0.02, poison_policy='skip',
+                       **_FAST)
+    pool.start(ExitOnFiveWorker)
+    try:
+        for i in range(20):
+            pool.ventilate(i)
+        results = _drain(pool)
+        assert sorted(results) == [i for i in range(20) if i != 5]
+        diag = pool.diagnostics
+        assert diag['items_poisoned'] == 1
+        assert diag['items_reventilated'] >= 2  # one per burned worker
+        (descriptor,) = pool._dispatcher.health()['poisoned']
+        assert descriptor['attempts'] == 2
+        assert 'lapsed' in descriptor['reason']
+        assert descriptor['error'] is None  # died, never errored
+        # exactly max_retries workers were burned, the rest survived
+        assert sum(1 for p in pool._local_procs
+                   if p.poll() is not None) == 2
+    finally:
+        pool.stop()
+        pool.join()
+
+
+def test_poison_policy_raise_surfaces_rowgroup_poisoned_error():
+    pool = ServicePool(spawn_local_workers=3, max_retries=2,
+                       retry_backoff_s=0.02, **_FAST)  # default: raise
+    pool.start(ExitOnFiveWorker)
+    try:
+        for i in range(8):
+            pool.ventilate(i)
+        with pytest.raises(RowGroupPoisonedError) as info:
+            _drain(pool)
+        assert info.value.info['attempts'] == 2
+        assert "poison_policy='skip'" in str(info.value)
+    finally:
+        pool.stop()
+        pool.join()
+
+
+def test_ghost_error_from_prior_owner_does_not_cancel_live_assignment():
+    """A lapsed worker's late ERROR for an item already reassigned must
+    be ignored: cancelling the live assignment would charge a phantom
+    attempt and let the item run twice concurrently (review finding)."""
+    import threading
+    from petastorm_tpu.service.dispatcher import Dispatcher, _WorkerState
+    d = Dispatcher('tcp://127.0.0.1:0', b'', lambda e: True,
+                   threading.Event(), max_retries=3, retry_backoff_s=0.01)
+    item = d.submit(b'payload')
+    now = time.monotonic()
+    live = _WorkerState(b'B', now)
+    d._workers[b'B'] = live
+    d._pending.clear()
+    d._pending_ids.clear()
+    d._inflight[item] = (b'B', b'payload')
+    live.inflight.add(item)
+    d._fail(b'A', item, ValueError('late ghost'), now)
+    assert d._inflight[item][0] == b'B', 'live assignment was cancelled'
+    assert item not in d._attempts, 'phantom attempt was charged'
+    # the real owner's failure still charges and requeues
+    d._fail(b'B', item, ValueError('real'), now)
+    assert d._attempts[item] == 1
+    assert item not in d._inflight
+
+
+def test_poison_policy_rejected_for_pools_without_support():
+    from petastorm_tpu.reader import _make_pool
+
+    class ContractOnlyPool:
+        start = ventilate = get_results = stop = join = lambda self: None
+        workers_count = 1
+        diagnostics = {}
+
+    with pytest.raises(ValueError, match='poison_policy'):
+        _make_pool(ContractOnlyPool(), None, 10, poison_policy='skip')
+    with pytest.raises(ValueError, match='poison_policy'):
+        _make_pool('thread', 1, 10, poison_policy='skip')
+
+
+# -- consumer-read deadline (wedge -> diagnosable error) ---------------------
+
+
+def test_lost_work_frame_raises_wedge_error_with_fleet_view():
+    """Drop exactly one WORK frame on the dispatcher->worker wire: the
+    item stays assigned to a live, heartbeating worker forever — the
+    silent-wedge shape. The read deadline must convert it into
+    ServiceWedgedError carrying the live fleet view."""
+    _arm('zmq.work:drop:1:times=1')
+    pool = ServicePool(spawn_local_workers=1, read_deadline_s=2.0,
+                       **_FAST)
+    pool.start(SleepyIdentityWorker)
+    try:
+        for i in range(4):
+            pool.ventilate(i, sleep_s=0.01)
+        with pytest.raises(ServiceWedgedError) as info:
+            _drain(pool)
+        assert info.value.fleet['workers'], 'fleet view missing'
+        assert 'no progress' in str(info.value)
+        assert info.value.fleet['items_assigned'] >= 1
+    finally:
+        pool.stop()
+        pool.join()
+
+
+# -- dispatcher restart: reconnect + re-registration --------------------------
+
+
+def _spawn_cli_worker(endpoint, heartbeat_interval_s=0.2):
+    env = dict(os.environ,
+               PYTHONPATH=os.pathsep.join(
+                   [_REPO_ROOT, os.path.join(_REPO_ROOT, 'tests')]),
+               JAX_PLATFORMS='cpu')
+    env.pop('PETASTORM_TPU_FAULTS', None)  # faults stay client-side here
+    return subprocess.Popen(
+        [sys.executable, '-m', 'petastorm_tpu.service.worker_server',
+         '--endpoint', endpoint,
+         '--heartbeat-interval', str(heartbeat_interval_s),
+         '--parent-pid', str(os.getpid())],
+        env=env)
+
+
+def _start_pool_with_bind_retry(endpoint, deadline_s=15, **kwargs):
+    """The previous dispatcher's ROUTER may linger on the port briefly;
+    retry the bind window like a restarting client would."""
+    deadline = time.monotonic() + deadline_s
+    while True:
+        pool = ServicePool(endpoint=endpoint, expected_workers=1, **_FAST)
+        try:
+            pool.start(SleepyIdentityWorker, **kwargs)
+            return pool
+        except RuntimeError:
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.3)
+
+
+def test_worker_fleet_survives_dispatcher_crash_and_restart():
+    """Kill a dispatcher WITHOUT its STOP goodbye (zmq.stop:drop — the
+    crash drill), start a new pool on the same endpoint: the standing
+    worker process must detect the incarnation change via the
+    heartbeat-ack token, abandon the dead job, re-register with backoff
+    and serve the new job — same pid, zero manual intervention."""
+    endpoint = 'tcp://127.0.0.1:%d' % free_tcp_port()
+    proc = _spawn_cli_worker(endpoint)
+    try:
+        pool1 = _start_pool_with_bind_retry(endpoint)
+        for i in range(6):
+            pool1.ventilate(i, sleep_s=0.01)
+        assert sorted(_drain(pool1)) == list(range(6))
+        # crash the dispatcher: suppress every STOP broadcast, so the
+        # worker never hears a goodbye and stays bound to the dead job
+        _arm('zmq.stop:drop')
+        pool1.stop()
+        pool1.join()
+        os.environ.pop('PETASTORM_TPU_FAULTS')
+        faults.refresh_faults()
+
+        pool2 = _start_pool_with_bind_retry(endpoint)
+        try:
+            for i in range(10, 16):
+                pool2.ventilate(i, sleep_s=0.01)
+            assert sorted(_drain(pool2)) == list(range(10, 16))
+            assert proc.poll() is None, 'worker process died in restart'
+        finally:
+            pool2.stop()
+            pool2.join()
+    finally:
+        if proc.poll() is None:
+            proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+
+
+# -- decoded-cache degrade-to-decode ------------------------------------------
+
+
+@pytest.fixture
+def scalar_dataset(tmp_path):
+    from tests.test_common import create_test_scalar_dataset
+    url = 'file://' + str(tmp_path / 'dataset')
+    create_test_scalar_dataset(url, num_rows=50, num_files=5)
+    return url
+
+
+def _read_ids(url, **kwargs):
+    from petastorm_tpu.reader import make_batch_reader
+    ids = collections.Counter()
+    with make_batch_reader(url, num_epochs=1, shuffle_row_groups=False,
+                           **kwargs) as reader:
+        for batch in reader:
+            ids.update(int(x) for x in batch.id)
+    return ids
+
+
+def test_cache_disk_full_degrades_to_decode_through(scalar_dataset,
+                                                    tmp_path):
+    """Every decoded-cache store hits injected ENOSPC: the tier must
+    disarm itself ONCE (cache_degraded anomaly + gauge), the epoch must
+    deliver the exact row set of an uncached read, and the broken disk
+    must not be touched per-row-group afterwards."""
+    expected = _read_ids(scalar_dataset)
+    _arm('cache.write:oserror:1:errno=28')
+    got = _read_ids(scalar_dataset, cache_type='decoded',
+                    cache_location=str(tmp_path / 'cache'),
+                    cache_size_limit=64 * 2**20)
+    assert got == expected
+    events = [e for e in telemetry.recent_anomalies()
+              if e['kind'] == 'cache_degraded']
+    assert len(events) == 1, 'degrade must announce exactly once'
+    assert 'ENOSPC' in events[0]['detail']['reason']
+    failures = telemetry.get_registry().counters_with_prefix(
+        'petastorm_tpu_decoded_cache_disk_failures_total')
+    assert sum(failures.values()) == 1, \
+        'a degraded tier must stop paying the failing syscall per item'
+    report = telemetry.pipeline_report()
+    assert report['anomalies']['by_kind'].get('cache_degraded') == 1
+    # no entries ever published onto the "full" disk
+    arrow_files = [f for _, _, files in os.walk(str(tmp_path / 'cache'))
+                   for f in files if f.endswith('.arrow')]
+    assert not arrow_files
+
+
+def test_cache_read_eio_counts_and_serves_decode(scalar_dataset, tmp_path):
+    """EIO on entry reads (bad medium under a warm cache): reads decode
+    through, failures are counted with op=read, and the tier degrades
+    (EIO is a disk-fault errno)."""
+    cache_dir = str(tmp_path / 'cache')
+    expected = _read_ids(scalar_dataset, cache_type='decoded',
+                         cache_location=cache_dir,
+                         cache_size_limit=64 * 2**20)  # warm fill
+    _arm('cache.read:oserror:1:errno=5:times=1')
+    got = _read_ids(scalar_dataset, cache_type='decoded',
+                    cache_location=cache_dir,
+                    cache_size_limit=64 * 2**20)
+    assert got == expected
+    failures = telemetry.get_registry().counters_with_prefix(
+        'petastorm_tpu_decoded_cache_disk_failures_total')
+    assert any('read' in key for key in failures)
+    assert [e for e in telemetry.recent_anomalies()
+            if e['kind'] == 'cache_degraded']
+
+
+def test_read_eacces_is_entry_shaped_not_medium_shaped(scalar_dataset,
+                                                       tmp_path):
+    """One foreign-UID unreadable entry in a shared directory must NOT
+    disarm the whole disk tier (review finding): a single read EACCES
+    rides the consecutive-failure ramp, the rest of the warm cache
+    keeps serving."""
+    cache_dir = str(tmp_path / 'cache')
+    expected = _read_ids(scalar_dataset, cache_type='decoded',
+                         cache_location=cache_dir,
+                         cache_size_limit=64 * 2**20)  # warm fill
+    _arm('cache.read:oserror:1:errno=13:times=1')  # one EACCES read
+    got = _read_ids(scalar_dataset, cache_type='decoded',
+                    cache_location=cache_dir,
+                    cache_size_limit=64 * 2**20)
+    assert got == expected
+    assert not [e for e in telemetry.recent_anomalies()
+                if e['kind'] == 'cache_degraded'], \
+        'one entry-shaped EACCES must not degrade the tier'
+    failures = telemetry.get_registry().counters_with_prefix(
+        'petastorm_tpu_decoded_cache_disk_failures_total')
+    assert sum(failures.values()) == 1
+
+
+def test_reroot_rearms_degraded_tier_and_clears_gauge(tmp_path):
+    """reroot() must re-arm a degraded tier AND reset the degraded gauge
+    — stale degraded=1 telemetry after recovery sends operators chasing
+    a fault that no longer exists (review finding)."""
+    from petastorm_tpu.arrow_worker import ColumnBatch
+    from petastorm_tpu.materialized_cache import (
+        DECODED_CACHE_DEGRADED, MaterializedRowGroupCache,
+    )
+    import numpy as np
+    cache = MaterializedRowGroupCache(str(tmp_path / 'a'), 64 * 2**20)
+    _arm('cache.write:oserror:1:errno=28')
+    fill = lambda: ColumnBatch({'x': np.arange(3)}, 3)  # noqa: E731
+    cache.get('k1', fill)
+    assert cache.degraded
+    gauge_key = '%s{pid=%d}' % (DECODED_CACHE_DEGRADED, os.getpid())
+    gauges = telemetry.get_registry().gauges_with_prefix(
+        DECODED_CACHE_DEGRADED)
+    assert gauges and all(v == 1 for v in gauges.values()), gauge_key
+    os.environ.pop('PETASTORM_TPU_FAULTS')
+    faults.refresh_faults()
+    cache.reroot(str(tmp_path / 'b'))
+    assert not cache.degraded
+    gauges = telemetry.get_registry().gauges_with_prefix(
+        DECODED_CACHE_DEGRADED)
+    assert all(v == 0 for v in gauges.values())
+    cache.get('k1', fill)  # healthy medium: stores again
+    assert not cache.degraded
+
+
+# -- seeded chaos soak over a full loader epoch -------------------------------
+
+
+@pytest.mark.slow
+def test_chaos_soak_loader_epoch_delivers_exact_rows(scalar_dataset):
+    """Transient multi-site faults over a full make_jax_loader epoch
+    through the service pool: retries absorb every transient, and the
+    delivered row set is EXACTLY the dataset — nothing lost, nothing
+    duplicated, quarantines reported (none expected: the budget exceeds
+    the worst-case fault stacking). ``times=1`` per clause fires each
+    fault exactly once per WORKER process regardless of which worker
+    drew which item, so injections are guaranteed without depending on
+    scheduling — rate-based draws here would be flaky, since the
+    per-worker hit sequences vary run to run."""
+    import numpy as np
+    from petastorm_tpu.jax import make_jax_loader
+
+    # armed in THIS process and inherited by the spawned worker fleet's
+    # environment — transient because each clause is one-shot per
+    # process, so a retried item passes on a later attempt/worker
+    _arm('io.read:error:1:times=1,decode.rowgroup:error:1:times=1')
+    try:
+        # budget 6 > the 4 one-shot faults even if ONE unlucky item ate
+        # every single one of them across both workers
+        pool = ServicePool(spawn_local_workers=2, retry_backoff_s=0.02,
+                           max_retries=6, poison_policy='skip', **_FAST)
+        loader = make_jax_loader(scalar_dataset, batch_size=10,
+                                 fields=['id'], num_epochs=1,
+                                 last_batch='short',
+                                 reader_pool_type=pool,
+                                 shuffle_row_groups=False)
+        seen = collections.Counter()
+        with loader:
+            for batch in loader:
+                seen.update(int(x) for x in np.asarray(batch['id']))
+        quarantined = pool.poisoned_items
+        assert not quarantined, \
+            'transient-rate faults must never exhaust the budget: %s' \
+            % quarantined
+        assert sorted(seen.elements()) == list(range(50))
+        # the faults fired in the WORKER processes; the evidence here is
+        # the dispatcher's retry accounting plus the fleet-aggregated
+        # injection counter riding the ERROR frames' metric deltas
+        assert pool.diagnostics['items_retried'] >= 1
+        injected = telemetry.get_registry().counters_with_prefix(
+            faults.FAULTS_INJECTED)
+        assert sum(injected.values()) >= 1
+    finally:
+        os.environ.pop('PETASTORM_TPU_FAULTS', None)
+        faults.refresh_faults()
