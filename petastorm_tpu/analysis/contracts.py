@@ -27,10 +27,16 @@ telemetry back).
 #: reconstruct) · ``cache_fill`` decoded batch serialized to Arrow IPC +
 #: atomically published into the cache · ``decode_fused`` deferred image
 #: cells decoded by the staging arena straight into the destination
-#: buffer (slot ring or fresh assembly; petastorm_tpu/fused.py)
+#: buffer (slot ring or fresh assembly; petastorm_tpu/fused.py) ·
+#: ``rowgroup_prune`` plan-time statistics pruning at Reader
+#: construction (footer fetch + prover; petastorm_tpu/pushdown.py) ·
+#: ``late_materialize`` survivor-only decode of heavy columns after the
+#: predicate mask — the late-materialization specialization of
+#: ``decode`` (arrow_worker._load_rowgroup)
 STAGES = ('ventilate', 'io', 'decode', 'filter', 'transform', 'queue_wait',
           'collate', 'h2d', 'h2d_ready', 'stage_fill', 'h2d_dispatch',
-          'cache_hit_read', 'cache_fill', 'decode_fused')
+          'cache_hit_read', 'cache_fill', 'decode_fused',
+          'rowgroup_prune', 'late_materialize')
 
 #: every trace-event name the package records outside the canonical stage
 #: spans (docs/telemetry.md, tracing section)
@@ -105,6 +111,12 @@ METRIC_NAMES = frozenset([
     'petastorm_tpu_anomaly_events_total',
     'petastorm_tpu_obs_windows_total',
     'petastorm_tpu_obs_scrapes_total',
+    # query-shaped reads: statistics pruning + late materialization
+    # (pushdown.py, arrow_worker.py, materialized_cache.py)
+    'petastorm_tpu_rowgroups_pruned_total',
+    'petastorm_tpu_rows_pruned_total',
+    'petastorm_tpu_late_materialized_rows_total',
+    'petastorm_tpu_decoded_cache_skipped_total',
 ])
 
 #: prefix of every operator-facing environment knob
@@ -146,6 +158,9 @@ KNOWN_KNOBS = frozenset([
     'PETASTORM_TPU_SERVICE_MAX_RETRIES',
     'PETASTORM_TPU_SERVICE_RETRY_BACKOFF_S',
     'PETASTORM_TPU_SERVICE_READ_DEADLINE_S',
+    'PETASTORM_TPU_PUSHDOWN',
+    'PETASTORM_TPU_PUSHDOWN_PRUNE',
+    'PETASTORM_TPU_PUSHDOWN_WORKERS',
 ])
 
 #: canonical anomaly event kinds the live observability plane's detector
@@ -180,7 +195,10 @@ ANOMALY_KINDS = {
 #: meaningful at the message-send sites; the data-path sites take the
 #: error/oserror/delay modes.
 FAULTPOINTS = {
-    'io.read': 'parquet row-group read (arrow_worker._load_rowgroup)',
+    'io.read': 'parquet row-group read (arrow_worker._load_rowgroup) '
+               'and the pushdown planner\'s footer-statistics fetch '
+               '(pushdown.StatsIndex, keys end in #footer — a footer '
+               'fault degrades to unpruned reads, never a wrong answer)',
     'decode.rowgroup': 'whole row-group decode, incl. the native batch '
                        'decoders (arrow_worker._load_rowgroup)',
     'decode.batch': 'one column batch decode (codecs.'

@@ -36,7 +36,11 @@ def defer_config_ok(transform_spec, ngram, cache):
 from petastorm_tpu.materialized_cache import (
     MaterializedRowGroupCache, dataset_file_fingerprint, decode_fingerprint,
 )
-from petastorm_tpu.telemetry import span
+# the planner half of the selective-read fast path (docs/telemetry.md
+# "Query-shaped reads"); also the ONE owner of the
+# late-materialized-rows counter name this worker increments
+from petastorm_tpu import pushdown
+from petastorm_tpu.telemetry import get_registry, metrics_disabled, span
 from petastorm_tpu.workers.worker_base import WorkerBase
 
 logger = logging.getLogger(__name__)
@@ -154,6 +158,10 @@ class RowGroupWorker(WorkerBase):
                               and defer_config_ok(self._transform_spec,
                                                   self._ngram, self._cache))
         self._parquet_files = {}
+        # PETASTORM_TPU_PUSHDOWN=0: the decode-everything-then-filter
+        # oracle shape (exact-parity baseline + the bench's full-scan
+        # rung) — resolved once per worker, in the worker's own process
+        self._fullscan_oracle = pushdown.fullscan_oracle()
         # decoded-cache key identity, resolved lazily (per process, per
         # parquet file) — see _decoded_fingerprint
         self._decode_fp = None
@@ -285,35 +293,55 @@ class RowGroupWorker(WorkerBase):
                 if f.name in self._stored_schema.fields]
 
     def _load_rowgroup(self, piece, worker_predicate, drop_partition):
+        if self._fullscan_oracle and worker_predicate is not None:
+            return self._load_rowgroup_fullscan(piece, worker_predicate,
+                                                drop_partition)
         needed = self._needed_stored_fields()
         partition_keys = [k for k in piece.partition_values if k in needed]
         file_columns = [n for n in needed if n not in piece.partition_values]
 
         pf = self._parquet_file(piece.path)
 
+        pred_columns = {}
         if worker_predicate is not None:
-            keep = self._predicate_mask(pf, piece, worker_predicate)
+            keep, pred_columns = self._predicate_mask(pf, piece,
+                                                      worker_predicate)
             if keep is not None and not keep.any():
                 return None
         else:
             keep = None
 
-        # faultpoint key: one stable identity per row-group, so chaos
-        # specs can poison a specific one (match=) or rate-sample reads;
-        # '#' not ':' as the separator — ':' is the spec grammar's own
-        # field separator, so a match= value could never contain it
-        if faults.ARMED:
-            faults.fault_hit('io.read', key='%s#rg%d'
-                             % (piece.path, piece.row_group))
-        with span('io'):
-            table = pf.read_row_group(piece.row_group, columns=file_columns)
-        num_rows = table.num_rows
-        row_indices = np.arange(num_rows) if keep is None else np.flatnonzero(keep)
+        # Late materialization (docs/telemetry.md "Query-shaped reads"):
+        # under a predicate, the two-phase read is the general shape —
+        # the heavy non-predicate columns are read only HERE, after the
+        # surviving-row mask proved non-empty, and the predicate columns
+        # already decoded for the mask are reused instead of being read
+        # and decoded a second time.
+        late = keep is not None
+        reuse = {n: pred_columns[n] for n in file_columns
+                 if n in pred_columns}
+        read_columns = [n for n in file_columns if n not in reuse]
 
         overlap = self._ngram.length - 1 if self._ngram is not None else 0
-        row_indices = self._apply_row_drop(row_indices, drop_partition, overlap)
-        if row_indices.size == 0:
-            return None
+        if late:
+            # survivors + row-drop partition decided BEFORE the heavy
+            # read (the mask already knows the row count): a drop
+            # partition whose survivors all landed elsewhere must not
+            # pay the heavy-column I/O just to return None
+            num_rows = len(keep)
+            row_indices = self._apply_row_drop(np.flatnonzero(keep),
+                                               drop_partition, overlap)
+            if row_indices.size == 0:
+                return None
+            table = (self._read_columns(pf, piece, read_columns)
+                     if read_columns else None)
+        else:
+            table = self._read_columns(pf, piece, read_columns)
+            num_rows = table.num_rows
+            row_indices = self._apply_row_drop(np.arange(num_rows),
+                                               drop_partition, overlap)
+            if row_indices.size == 0:
+                return None
 
         select_all = row_indices.size == num_rows
 
@@ -321,29 +349,146 @@ class RowGroupWorker(WorkerBase):
             faults.fault_hit('decode.rowgroup', key='%s#rg%d'
                              % (piece.path, piece.row_group))
         columns = {}
-        with span('decode'):
-            for name in file_columns:
-                arrow_col = table.column(name)
-                selected = (arrow_col if select_all
-                            else arrow_col.take(row_indices))
-                columns[name] = self._decode_column(name, selected,
-                                                    allow_defer=True)
+        if read_columns:
+            if late:
+                # the late-materialization specialization of the `decode`
+                # stage: only SURVIVING rows of the heavy columns decode
+                with span('late_materialize'):
+                    for name in read_columns:
+                        columns[name] = self._decode_survivors(
+                            name, table.column(name), row_indices,
+                            select_all)
+                if not metrics_disabled():
+                    get_registry().counter(
+                        pushdown.LATE_MATERIALIZED_ROWS).inc(
+                            int(row_indices.size))
+            else:
+                with span('decode'):
+                    for name in read_columns:
+                        arrow_col = table.column(name)
+                        selected = (arrow_col if select_all
+                                    else arrow_col.take(row_indices))
+                        columns[name] = self._decode_column(
+                            name, selected, allow_defer=True)
+        for name, decoded in reuse.items():
+            # projection pushdown: the predicate phase decoded the full
+            # column; serving survivors is a select, not a re-decode
+            columns[name] = decoded if select_all else decoded[row_indices]
+        return self._finish_batch(columns, piece, partition_keys,
+                                  row_indices.size)
+
+    def _read_columns(self, pf, piece, read_columns):
+        """One row-group read under the ``io`` span and its faultpoint.
+        Faultpoint key: one stable identity per row-group, so chaos
+        specs can poison a specific one (match=) or rate-sample reads;
+        '#' not ':' as the separator — ':' is the spec grammar's own
+        field separator, so a match= value could never contain it."""
+        if faults.ARMED:
+            faults.fault_hit('io.read', key='%s#rg%d'
+                             % (piece.path, piece.row_group))
+        with span('io'):
+            return pf.read_row_group(piece.row_group, columns=read_columns)
+
+    def _finish_batch(self, columns, piece, partition_keys, count):
+        """Shared batch tail: fill partition-key columns from the hive
+        path values, then run the TransformSpec."""
         for name in partition_keys:
             field = self._stored_schema.fields.get(name)
             value = self._typed_partition_value(field, piece.partition_values[name])
             dtype = np.dtype(field.numpy_dtype) if field is not None else np.dtype(object)
-            columns[name] = np.full(row_indices.size, value,
+            columns[name] = np.full(count, value,
                                     dtype=dtype if dtype.kind in 'iufb' else object)
 
-        batch = ColumnBatch(columns, row_indices.size)
+        batch = ColumnBatch(columns, count)
         if self._transform_spec is not None:
             with span('transform'):
                 batch = self._apply_transform(batch)
         return batch
 
+    def _load_rowgroup_fullscan(self, piece, worker_predicate,
+                                drop_partition):
+        """The decode-everything-then-filter ORACLE
+        (``PETASTORM_TPU_PUSHDOWN=0``): one read of every needed +
+        predicate column, every row of every column decoded, the
+        predicate evaluated over the fully-decoded columns, survivors
+        sliced out after the fact. The exact-parity comparison baseline
+        and the bench ``selective_read`` section's full-scan-priced
+        rung — never the production path (the default is the two-phase
+        late-materializing read above)."""
+        needed = self._needed_stored_fields()
+        partition_keys = [k for k in piece.partition_values if k in needed]
+        file_columns = [n for n in needed if n not in piece.partition_values]
+        pred_fields = sorted(worker_predicate.get_fields())
+        missing = [f for f in pred_fields
+                   if f not in self._stored_schema.fields
+                   and f not in piece.partition_values]
+        if missing:
+            raise ValueError('Predicate references unknown fields: %s'
+                             % missing)
+        pred_file_fields = [f for f in pred_fields
+                            if f not in piece.partition_values]
+        read_columns = list(dict.fromkeys(file_columns + pred_file_fields))
+
+        pf = self._parquet_file(piece.path)
+        table = self._read_columns(pf, piece, read_columns)
+        num_rows = table.num_rows
+        if faults.ARMED:
+            faults.fault_hit('decode.rowgroup', key='%s#rg%d'
+                             % (piece.path, piece.row_group))
+        with span('decode'):
+            decoded = {name: self._decode_column(name, table.column(name))
+                       for name in read_columns}
+
+        values = {}
+        for name in pred_fields:
+            if name in piece.partition_values:
+                field = self._stored_schema.fields.get(name)
+                value = self._typed_partition_value(
+                    field, piece.partition_values[name])
+                values[name] = np.full(num_rows, value, dtype=object)
+            else:
+                values[name] = decoded[name]
+        with span('filter'):
+            mask = worker_predicate.do_include_batch(values)
+            if mask is None:
+                mask = np.fromiter(
+                    (worker_predicate.do_include(
+                        {f: values[f][i] for f in pred_fields})
+                     for i in range(num_rows)), dtype=bool, count=num_rows)
+            mask = np.asarray(mask, dtype=bool)
+            if mask.shape != (num_rows,):
+                # same fail-loud contract as _predicate_mask: a
+                # malformed predicate must not silently mis-select in
+                # the ORACLE either — a parity mismatch would otherwise
+                # be blamed on the fast path
+                raise ValueError(
+                    'Predicate %s.do_include_batch returned mask of '
+                    'shape %s for %d rows'
+                    % (type(worker_predicate).__name__, mask.shape,
+                       num_rows))
+        row_indices = np.flatnonzero(mask)
+
+        overlap = self._ngram.length - 1 if self._ngram is not None else 0
+        row_indices = self._apply_row_drop(row_indices, drop_partition,
+                                           overlap)
+        if row_indices.size == 0:
+            return None
+        select_all = row_indices.size == num_rows
+        columns = {name: (decoded[name] if select_all
+                          else decoded[name][row_indices])
+                   for name in file_columns}
+        return self._finish_batch(columns, piece, partition_keys,
+                                  row_indices.size)
+
     def _predicate_mask(self, pf, piece, predicate):
         """Two-phase read: evaluate the predicate on its own columns first
-        (reference: ``py_dict_reader_worker.py:188-236``)."""
+        (reference: ``py_dict_reader_worker.py:188-236``).
+
+        Returns ``(mask, decoded)`` where ``decoded`` maps each predicate
+        FILE column to its decoded full-row-group array — the
+        late-materialization path reuses these for output columns so a
+        predicate column is read and decoded exactly once per row-group.
+        """
         pred_fields = sorted(predicate.get_fields())
         missing = [f for f in pred_fields
                    if f not in self._stored_schema.fields
@@ -364,6 +509,7 @@ class RowGroupWorker(WorkerBase):
                 field = self._stored_schema.fields.get(name)
                 value = self._typed_partition_value(field, piece.partition_values[name])
                 decoded[name] = np.full(n, value, dtype=object)
+        reusable = {name: decoded[name] for name in file_fields}
         with span('filter'):
             mask = predicate.do_include_batch(
                 {f: decoded[f] for f in pred_fields})
@@ -374,14 +520,14 @@ class RowGroupWorker(WorkerBase):
                         'Predicate %s.do_include_batch returned mask of '
                         'shape %s for %d rows'
                         % (type(predicate).__name__, mask.shape, n))
-                return mask
+                return mask, reusable
             # fallback: per-row loop for predicates without a columnar form
             # (e.g. in_lambda), matching the reference's evaluation exactly
             mask = np.empty(n, dtype=bool)
             for i in range(n):
                 mask[i] = predicate.do_include(
                     {f: decoded[f][i] for f in pred_fields})
-        return mask
+        return mask, reusable
 
     @staticmethod
     def _typed_partition_value(field, value):
@@ -407,6 +553,29 @@ class RowGroupWorker(WorkerBase):
             borrow = np.concatenate(parts[j + 1:])[:overlap]
             selected = np.concatenate([selected, borrow])
         return selected
+
+    def _decode_survivors(self, name, arrow_col, row_indices, select_all):
+        """Decode ONLY the surviving rows of a heavy column — the
+        late-materialization path. Image columns compact the survivor
+        indices over zero-copy cell views of the FULL arrow column (no
+        ``take()`` copy of the encoded bytes) and feed the compacted
+        cells to the batched decode — or ship them still-encoded when
+        the consumer deferred decode (``EncodedImageColumn`` carries
+        only survivor cells, so ``decode_fused`` in the staging arena
+        decodes survivors straight into slot rows). Other codecs fall
+        back to ``take()`` + the classic decode."""
+        if select_all:
+            return self._decode_column(name, arrow_col, allow_defer=True)
+        field = (self._loaded_schema.fields.get(name)
+                 or self._stored_schema.fields.get(name))
+        if field is not None and isinstance(field.codec, CompressedImageCodec):
+            cells = _binary_cell_views(arrow_col)
+            if cells is not None:
+                survivors = [cells[i] for i in row_indices]
+                return self._image_column(field, survivors, arrow_col,
+                                          allow_defer=True)
+        return self._decode_column(name, arrow_col.take(row_indices),
+                                   allow_defer=True)
 
     def _decode_column(self, name, arrow_col, allow_defer=False):
         """Arrow column → decoded numpy values (vectorized where possible).

@@ -20,9 +20,6 @@ tuples (ANDed), or a list of such lists (OR of AND-clauses). Supported ops:
 ``= == != < > <= >= in not in``.
 """
 
-import threading
-from concurrent.futures import ThreadPoolExecutor
-
 import numpy as np
 
 from petastorm_tpu.predicates import PredicateBase
@@ -173,102 +170,31 @@ class FiltersPredicate(PredicateBase):
 # Row-group pruning
 # ---------------------------------------------------------------------------
 
-def _term_maybe_matches(term, partition_values, typed_partition, stats):
-    """Conservative per-row-group test: False only when the row-group
-    provably contains no matching row."""
+def _term_maybe_matches(term, partition_values, typed_partition):
+    """Conservative per-row-group test on PARTITION evidence only: False
+    only when a hive partition value proves the term can match no row.
+    File-column terms always maybe-match here — the statistics pass
+    (:mod:`petastorm_tpu.pushdown`) owns that half."""
     col, op, value = term
-    if col in partition_values:
-        try:
-            return bool(_eval_term(op, typed_partition(col), value))
-        except TypeError:
-            return True  # incomparable types: keep, the worker decides
-    st = (stats or {}).get(col)
-    if st is None:
-        return True  # no statistics: cannot exclude
-    lo, hi, has_nulls = st
+    if col not in partition_values:
+        return True
     try:
-        if op in ('=', '=='):
-            return bool(lo <= value <= hi) or has_nulls
-        if op == '!=':
-            return not (lo == hi == value) or has_nulls
-        if op == '<':
-            return bool(lo < value) or has_nulls
-        if op == '>':
-            return bool(hi > value) or has_nulls
-        if op == '<=':
-            return bool(lo <= value) or has_nulls
-        if op == '>=':
-            return bool(hi >= value) or has_nulls
-        if op == 'in':
-            return any(lo <= v <= hi for v in value) or has_nulls
-        # 'not in': excluded only when the whole range is one excluded value
-        return not (lo == hi and lo in set(value)) or has_nulls
+        return bool(_eval_term(op, typed_partition(col), value))
     except TypeError:
-        return True  # incomparable types (e.g. str filter on int stats)
-
-
-class _StatsIndex:
-    """Per-file parquet footer statistics, fetched lazily and in parallel.
-
-    One footer read per *file* (not per row-group); row-groups of files whose
-    footers fail to load are conservatively kept.
-    """
-
-    def __init__(self, dataset_info, columns, workers=8):
-        self._info = dataset_info
-        self._columns = set(columns)
-        self._per_file = {}
-        self._lock = threading.Lock()
-        self._workers = workers
-
-    def prefetch(self, paths):
-        todo = sorted(set(paths) - set(self._per_file))
-        if not todo:
-            return
-        with ThreadPoolExecutor(max_workers=min(self._workers, len(todo))) as ex:
-            for path, stats in zip(todo, ex.map(self._load_file, todo)):
-                with self._lock:
-                    self._per_file[path] = stats
-
-    def _load_file(self, path):
-        import pyarrow.parquet as pq
-        try:
-            with self._info.fs.open(path, 'rb') as f:
-                meta = pq.ParquetFile(f).metadata
-            out = []
-            for rg in range(meta.num_row_groups):
-                row_group = meta.row_group(rg)
-                cols = {}
-                for ci in range(row_group.num_columns):
-                    col = row_group.column(ci)
-                    name = col.path_in_schema.split('.')[0]
-                    if name not in self._columns:
-                        continue
-                    st = col.statistics
-                    if st is None or not st.has_min_max:
-                        continue
-                    has_nulls = bool(st.null_count) if st.has_null_count else True
-                    cols[name] = (st.min, st.max, has_nulls)
-                out.append(cols)
-            return out
-        except Exception:  # noqa: BLE001 - conservative: keep the file
-            return None
-
-    def get(self, path, row_group):
-        stats = self._per_file.get(path)
-        if stats is None or row_group >= len(stats):
-            return None
-        return stats[row_group]
+        return True  # incomparable types: keep, the worker decides
 
 
 def prune_row_group_indices(dataset_info, pieces, piece_indices, clauses,
                             stored_schema=None):
     """Drop row-group indices that provably cannot satisfy the filters.
 
-    Two passes, cheapest first: hive partition values prune with zero I/O;
-    parquet footer statistics are then fetched (one footer per file, in
-    parallel) only for the surviving pieces, and only when a filtered
-    column actually lives in the files.
+    Two passes, cheapest first: hive partition values prune with zero
+    I/O; the pushdown planner's footer-statistics prover
+    (:func:`petastorm_tpu.pushdown.plan_rowgroup_pruning` — one footer
+    read per file, in parallel, memoized process-wide) then runs over
+    the survivors, and only when a filtered column actually lives in the
+    files. ``PETASTORM_TPU_PUSHDOWN=0`` limits pruning to the
+    partition-value pass (the statistics-pruning oracle escape hatch).
     """
     from petastorm_tpu.arrow_worker import typed_partition_value
 
@@ -279,15 +205,15 @@ def prune_row_group_indices(dataset_info, pieces, piece_indices, clauses,
             return typed_partition_value(field, piece.partition_values[col])
         return typed
 
-    def keep(piece, stats):
+    def keep(piece):
         return any(
             all(_term_maybe_matches(t, piece.partition_values,
-                                    typed_for(piece), stats)
+                                    typed_for(piece))
                 for t in clause)
             for clause in clauses)
 
-    # pass 1: partition values only (stats=None keeps every file-column term)
-    survivors = [i for i in piece_indices if keep(pieces[i], None)]
+    # pass 1: partition values only (zero I/O)
+    survivors = [i for i in piece_indices if keep(pieces[i])]
 
     needs_stats = any(
         t[0] not in pieces[i].partition_values
@@ -295,13 +221,15 @@ def prune_row_group_indices(dataset_info, pieces, piece_indices, clauses,
     if not needs_stats:
         return survivors
 
-    # pass 2: footer statistics for the survivors
-    filter_columns = {t[0] for clause in clauses for t in clause}
-    index = _StatsIndex(dataset_info, filter_columns)
-    index.prefetch([pieces[i].path for i in survivors])
-    return [i for i in survivors
-            if keep(pieces[i], index.get(pieces[i].path,
-                                         pieces[i].row_group))]
+    # pass 2: footer statistics for the survivors, through the memoized
+    # planner (lazy import: pushdown imports this module at its top)
+    from petastorm_tpu import pushdown
+    if not pushdown.pushdown_enabled():
+        return survivors
+    plan = pushdown.plan_rowgroup_pruning(dataset_info, pieces, survivors,
+                                          clauses=clauses,
+                                          stored_schema=stored_schema)
+    return plan.kept
 
 
 def describe_clauses(clauses):

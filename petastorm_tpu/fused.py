@@ -17,7 +17,11 @@ arena"):
 * the encoded column travels the exact route a decoded one would (noop
   re-batcher chunk views, provenance sidecars, part slicing) — a few KB
   of jpeg bytes per row instead of 150 KB of pixels, so every buffered
-  hop is cheaper too;
+  hop is cheaper too; under a predicate it carries ONLY the surviving
+  rows' cells (the worker's late-materialization path compacts survivor
+  indices over the zero-copy cell views, ``arrow_worker.
+  _decode_survivors``), so ``decode_fused`` decodes survivors straight
+  into slot-ring rows and non-matching rows never cross the wire;
 * the staging engine's fill pass (:mod:`petastorm_tpu.jax.staging`)
   decodes the cells **directly into the arena slot's rows** (or the
   fresh page-aligned assembly buffer on host-backed targets) through the

@@ -65,6 +65,19 @@ DECODED_CACHE_SIZE_BYTES = 'petastorm_tpu_decoded_cache_size_bytes'
 DECODED_CACHE_DISK_FAILURES = \
     'petastorm_tpu_decoded_cache_disk_failures_total'
 DECODED_CACHE_DEGRADED = 'petastorm_tpu_decoded_cache_degraded'
+DECODED_CACHE_SKIPPED = 'petastorm_tpu_decoded_cache_skipped_total'
+
+
+def count_cache_skip(reason):
+    """One reader left uncached by the decoded-cache arming logic, by
+    reason (today: ``predicate`` — an arbitrary predicate has no stable
+    cache identity, so ``PETASTORM_TPU_DECODED_CACHE=1`` reads it
+    uncached; ``FiltersPredicate`` readers DO cache, their clause digest
+    joins the key). Documented in docs/telemetry.md — a silently
+    uncached fleet knob was previously invisible."""
+    from petastorm_tpu.telemetry.spans import metrics_disabled
+    if not metrics_disabled():
+        get_registry().counter(DECODED_CACHE_SKIPPED, reason=reason).inc()
 
 #: errnos that mean the MEDIUM (or the directory) is the problem, not
 #: one entry, when a STORE fails: disk full, quota, read-only remount,

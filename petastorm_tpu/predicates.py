@@ -40,11 +40,27 @@ class PredicateBase(metaclass=ABCMeta):
 
 
 class in_set(PredicateBase):
-    """Keep rows whose field value is in a given set."""
+    """Keep rows whose field value is in a given set.
+
+    Note ``in_set`` is a plain membership test: ``None`` in the value
+    set **matches null rows** — unlike DNF ``filters`` terms, where
+    nulls never match. The statistics planner
+    (:mod:`petastorm_tpu.pushdown`) relies on this distinction for
+    null-safe row-group pruning.
+    """
 
     def __init__(self, inclusion_values, predicate_field):
         self._values = set(inclusion_values)
         self._field = predicate_field
+
+    @property
+    def values(self):
+        """The inclusion set (read-only view for the pushdown planner)."""
+        return frozenset(self._values)
+
+    @property
+    def field(self):
+        return self._field
 
     def get_fields(self):
         return {self._field}
@@ -124,6 +140,16 @@ class in_reduce(PredicateBase):
     def __init__(self, predicate_list, reduce_func):
         self._predicates = list(predicate_list)
         self._reduce_func = reduce_func
+
+    @property
+    def predicates(self):
+        """The child predicates (read-only view for the pushdown
+        planner, which prunes through ``all``/``any`` compositions)."""
+        return tuple(self._predicates)
+
+    @property
+    def reduce_func(self):
+        return self._reduce_func
 
     def get_fields(self):
         return set().union(*(p.get_fields() for p in self._predicates))

@@ -160,13 +160,28 @@ def _make_cache(cache_type, location, size_limit, row_size_estimate,
     if cache_type in (None, 'null', 'none'):
         # operators can arm the decoded tier fleet-wide without touching
         # reader call sites: PETASTORM_TPU_DECODED_CACHE=1 upgrades the
-        # default no-cache readers to the materialized cache. Readers
-        # with an arbitrary predicate stay uncached (a predicate has no
-        # stable identity to key on): the knob must never turn a
+        # default no-cache readers to the materialized cache. A
+        # FiltersPredicate participates — its clause digest joins the
+        # cache key (arrow_worker._cache_key), so filtered results are
+        # served from the cache instead of silently bypassing it.
+        # Readers with an ARBITRARY predicate stay uncached (no stable
+        # identity to key on): the knob must never turn a
         # previously-working job into Reader's cache+predicate
-        # RuntimeError — that check is for EXPLICIT cache requests.
-        if knobs.is_enabled('PETASTORM_TPU_DECODED_CACHE') \
-                and predicate is None:
+        # RuntimeError — that check is for EXPLICIT cache requests — but
+        # the skip is counted, never invisible
+        # (petastorm_tpu_decoded_cache_skipped_total{reason=predicate}).
+        if knobs.is_enabled('PETASTORM_TPU_DECODED_CACHE'):
+            from petastorm_tpu.filters import FiltersPredicate
+            if predicate is not None \
+                    and not isinstance(predicate, FiltersPredicate):
+                from petastorm_tpu.materialized_cache import count_cache_skip
+                count_cache_skip('predicate')
+                logger.info(
+                    'PETASTORM_TPU_DECODED_CACHE=1: reader with an '
+                    'arbitrary predicate stays uncached (no stable cache '
+                    'identity); use DNF filters/FiltersPredicate for '
+                    'cacheable selective reads')
+                return NullCache()
             cache_type = 'decoded'
             implicit = True
         else:
@@ -297,25 +312,46 @@ class Reader:
                 shuffle_row_drop_partitions > 1:
             raise NotImplementedError('Using timestamp deduplication with '
                                       'shuffle_row_drop_partitions is not supported')
-        if predicate is not None and cache is not None and \
-                not isinstance(cache, NullCache):
-            # A cached row-group must be predicate-independent; predicates
-            # have no stable content identity to key on (reference forbids
-            # the combination too, ``reader.py:416-418``). DNF `filters` ARE
-            # cacheable (stable tuple identity) and stay allowed below.
-            raise RuntimeError('Local cache is not supported together with '
-                               'predicates')
 
+        from petastorm_tpu.filters import FiltersPredicate
         self._filter_clauses = None
+        self._filters_born = None
         if filters:
-            from petastorm_tpu.filters import FiltersPredicate
             filters_predicate = FiltersPredicate(filters)
             self._filter_clauses = filters_predicate.clauses
             if predicate is not None:
                 from petastorm_tpu.predicates import in_reduce
                 predicate = in_reduce([predicate, filters_predicate], all)
             else:
+                # pure-filters predicate: the pre-shard prune below
+                # already proved everything statistics can prove, so the
+                # post-shard planner run is skipped for exactly this
+                # object (a composed predicate may still prune more)
                 predicate = filters_predicate
+                self._filters_born = filters_predicate
+
+        if predicate is not None and cache is not None and \
+                not isinstance(cache, NullCache) and \
+                not isinstance(predicate, FiltersPredicate):
+            # A cached row-group must carry its predicate's identity in
+            # the key; only DNF filters / FiltersPredicate have one (a
+            # stable clause digest, see arrow_worker._cache_key) — those
+            # cache. Anything else (in_lambda, in_set, a composed
+            # in_reduce) cannot: an EXPLICIT cache request fails loud
+            # (reference forbids the combination too,
+            # ``reader.py:416-418``); the knob-armed implicit upgrade
+            # degrades to uncached with the skip counted — the fleet
+            # knob must never break a running job.
+            if getattr(cache, 'implicit_upgrade', False):
+                from petastorm_tpu.materialized_cache import count_cache_skip
+                count_cache_skip('predicate')
+                logger.info('PETASTORM_TPU_DECODED_CACHE=1: composed '
+                            'predicate has no stable cache identity; '
+                            'reading uncached')
+                cache = NullCache()
+            else:
+                raise RuntimeError('Local cache is not supported together '
+                                   'with predicates')
 
         # (1) schema
         self.stored_schema = infer_or_load_unischema(dataset_info)
@@ -377,6 +413,32 @@ class Reader:
                               'shuffle_row_drop_partition':
                                   (drop, shuffle_row_drop_partitions),
                               'item_index': len(items)})
+
+        # (4b) plan-time statistics pruning (petastorm_tpu/pushdown.py,
+        # docs/telemetry.md "Query-shaped reads"): row-groups PROVABLY
+        # empty against the predicate never reach the pool. Pruning runs
+        # AFTER sharding and keeps every item in the list, so shard
+        # assignment, item indices and checkpoint identities are
+        # bit-identical to an unpruned (PETASTORM_TPU_PUSHDOWN=0) reader;
+        # the pruned items are simply never ventilated and the epoch
+        # accounting below treats them as completed-with-zero-rows.
+        self._pruned_items = frozenset()
+        self._pushdown_plan = None
+        if worker_predicate is not None \
+                and worker_predicate is not self._filters_born:
+            from petastorm_tpu import pushdown
+            if pushdown.pushdown_enabled():
+                with span('rowgroup_prune'):
+                    self._pushdown_plan = pushdown.plan_rowgroup_pruning(
+                        dataset_info, all_pieces, piece_indices,
+                        predicate=worker_predicate,
+                        stored_schema=self.stored_schema)
+                if self._pushdown_plan.pruned:
+                    pruned_pieces = set(self._pushdown_plan.pruned)
+                    self._pruned_items = frozenset(
+                        it['item_index'] for it in items
+                        if it['piece_index'] in pruned_pieces)
+
         self._pool = _make_pool(reader_pool_type, workers_count,
                                 results_queue_size,
                                 poison_policy=poison_policy)
@@ -389,7 +451,8 @@ class Reader:
             max_ventilation_queue_size=lambda: (
                 self._pool.workers_count + _VENTILATE_EXTRA_ROWGROUPS),
             randomize_item_order=shuffle_row_groups, random_seed=seed,
-            pass_epoch=True, trace_shard=self.cur_shard)
+            pass_epoch=True, trace_shard=self.cur_shard,
+            always_exclude=self._pruned_items)
 
         # (5) start workers; ventilation begins lazily on first read so that
         # load_state_dict can reposition the cursor first.
@@ -641,6 +704,9 @@ class Reader:
             'row_groups': len(self._piece_indices),
             'cur_shard': self.cur_shard,
             'shard_count': self.shard_count,
+            # plan-time pushdown (docs/telemetry.md "Query-shaped
+            # reads"): items proven empty and skipped this run
+            'pruned_items': len(self._pruned_items),
         }
         try:
             health.update(self._pool.diagnostics)
@@ -716,6 +782,17 @@ class Reader:
         downstream buffering consumers (JaxLoader) whose notion of
         "consumed" is delivery to the user, which lags this reader's."""
         vent_seed = self._ventilator.state_dict()['seed']
+        # Statistics-pruned items (petastorm_tpu/pushdown.py) are
+        # completed-with-zero-rows: they are never ventilated, so no
+        # delivery can ever mark them consumed — without counting them
+        # here, every epoch would read forever-incomplete and resume
+        # would rewind to re-read row-groups PROVEN to deliver nothing.
+        pruned = self._pruned_items
+
+        def consumed_in(epoch):
+            done = set(consumed_by_epoch.get(epoch, ()))
+            return done | pruned if pruned else done
+
         epochs_seen = sorted(consumed_by_epoch)
         if not epochs_seen:
             resume_epoch, consumed = 0, []
@@ -727,13 +804,16 @@ class Reader:
             # lose its rows on resume.
             resume_epoch = None
             for e in range(epochs_seen[-1] + 1):
-                if len(consumed_by_epoch.get(e, ())) < self._num_items:
+                if len(consumed_in(e)) < self._num_items:
                     resume_epoch = e
                     break
             if resume_epoch is None:
+                # every seen epoch complete: resume into a FRESH epoch —
+                # nothing consumed there yet (the new reader's own
+                # planner re-derives its pruned set)
                 resume_epoch, consumed = epochs_seen[-1] + 1, []
             else:
-                consumed = sorted(consumed_by_epoch.get(resume_epoch, ()))
+                consumed = sorted(consumed_in(resume_epoch))
         if self._num_epochs is None:
             iterations_remaining = None
         else:
@@ -764,13 +844,37 @@ class Reader:
         ``_items_identity`` and drop out, which is exactly right: each new
         shard skips the consumed subset of its own items.
         """
-        if 'consumed_global' not in state:
+        if 'consumed_global' in state:
+            consumed = {tuple(ident) for ident in state['consumed_global']}
+            local = [i for i, ident in enumerate(self._items_identity)
+                     if ident in consumed]
+            state = dict(state)
+            state['consumed_items'] = local
             return state
-        consumed = {tuple(ident) for ident in state['consumed_global']}
-        local = [i for i, ident in enumerate(self._items_identity)
-                 if ident in consumed]
-        state = dict(state)
-        state['consumed_items'] = local
+        saved = state.get('items_global')
+        if saved is not None:
+            saved = [tuple(ident) for ident in saved]
+            if saved != self._items_identity:
+                # Index-space drift: the SAVING reader's item list differs
+                # from ours — a PETASTORM_TPU_PUSHDOWN flip across a
+                # resume changes the filters= pre-shard prune, rewritten
+                # files change the statistics. Local indices would then
+                # silently name DIFFERENT row-groups (row loss), so
+                # translate through the saver's per-index identities:
+                # identities absent from our list drop (their row-groups
+                # are not in this sweep), our extra items are simply
+                # re-read (at-least-once; zero rows for provably-empty
+                # groups). items_global is rewritten to OURS so a second
+                # localization (consumption_record_for_resume) is a no-op.
+                position = {ident: i for i, ident
+                            in enumerate(self._items_identity)}
+                local = sorted(
+                    position[saved[i]] for i in state['consumed_items']
+                    if i < len(saved) and saved[i] in position)
+                state = dict(state)
+                state['consumed_items'] = local
+                state['items_global'] = [list(ident) for ident
+                                         in self._items_identity]
         return state
 
     def load_state_dict(self, state):

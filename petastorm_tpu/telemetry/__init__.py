@@ -148,12 +148,15 @@ register_refresh(timeseries.refresh_obs)
 
 def reset_for_tests():
     """Fresh process-wide registry + attributor + flight recorder, the
-    observability plane torn down, and knobs re-read (test isolation
-    only)."""
+    observability plane torn down, planner summaries cleared, and knobs
+    re-read (test isolation only)."""
     obs_server._reset_for_tests()
     timeseries._reset_for_tests()
     reset_registry()
     reset_attributor()
     reset_recorder()
     tracing._reset_for_tests()
+    # lazy: pushdown imports telemetry at its module top
+    from petastorm_tpu import pushdown
+    pushdown.reset_for_tests()
     refresh_enabled()

@@ -228,6 +228,9 @@ def pipeline_report(registry=None, wall_time_s=None, baseline=None,
     service = _service_section(registry)
     if service is not None:
         report['service'] = service
+    pushdown = _pushdown_section(registry)
+    if pushdown is not None:
+        report['pushdown'] = pushdown
     pipesan = _sanitizer_section(registry)
     if pipesan is not None:
         report['pipesan'] = pipesan
@@ -376,6 +379,38 @@ def _service_section(registry):
     }
 
 
+def _pushdown_section(registry):
+    """Selective-read (query-shaped reads) activity: plan-time pruning
+    from the consumer-local planner summary, late-materialized rows from
+    the fleet-merged worker counters — present only when a predicate
+    planner ever ran (or workers late-materialized), so predicate-free
+    pipelines keep their report shape unchanged. ``declines`` carries
+    the reasons pruning proved nothing (``arbitrary-predicate``,
+    ``no-statistics``, ``low-selectivity``) — the "My selective read is
+    still full-scan-priced" runbook in docs/troubleshoot.md reads them.
+    """
+    from petastorm_tpu import pushdown
+    summary = pushdown.planner_summary()
+    pruned = registry.counter_value(pushdown.ROWGROUPS_PRUNED)
+    late = registry.counter_value(pushdown.LATE_MATERIALIZED_ROWS)
+    if not summary['planner_runs'] and not pruned and not late:
+        return None
+    considered = summary['rowgroups_considered']
+    return {
+        'planner_runs': summary['planner_runs'],
+        'rowgroups_considered': considered,
+        'rowgroups_pruned': int(pruned),
+        'rows_pruned': int(registry.counter_value(pushdown.ROWS_PRUNED)),
+        'late_materialized_rows': int(late),
+        # share of considered row-groups proven empty, from the LOCAL
+        # planner's tallies (the registry counter can include other
+        # processes' plans; mixing denominators would lie)
+        'prune_share': (round(summary['rowgroups_pruned'] / considered, 4)
+                        if considered else None),
+        'declines': summary['declines'],
+    }
+
+
 def _sanitizer_section(registry):
     """pipesan runtime-sanitizer findings — present when the sanitizer is
     armed (``PETASTORM_TPU_SANITIZE=1``) or violations were recorded, so
@@ -481,6 +516,18 @@ def format_pipeline_report(report):
                         s['items_pending'], s['items_assigned'],
                         s['reventilated'], s['duplicate_done'],
                         s.get('retried', 0), s.get('poisoned', 0)))
+    if 'pushdown' in report:
+        p = report['pushdown']
+        share = p['prune_share']
+        declines = ', '.join('%s: %d' % (k, v)
+                             for k, v in sorted(p['declines'].items()))
+        lines.append('pushdown: %d/%d row-group(s) pruned%s (%d rows '
+                     'skipped), %d row(s) late-materialized%s'
+                     % (p['rowgroups_pruned'], p['rowgroups_considered'],
+                        (' = %.1f%%' % (100 * share)
+                         if share is not None else ''),
+                        p['rows_pruned'], p['late_materialized_rows'],
+                        (' — declines: %s' % declines) if declines else ''))
     if 'pipesan' in report:
         p = report['pipesan']
         kinds = ', '.join('%s: %d' % (k, v)

@@ -92,7 +92,15 @@ class ConcurrentVentilator(Ventilator):
 
     def __init__(self, ventilate_fn, items_to_ventilate, iterations=1,
                  max_ventilation_queue_size=None, randomize_item_order=False,
-                 random_seed=0, pass_epoch=False, trace_shard=None):
+                 random_seed=0, pass_epoch=False, trace_shard=None,
+                 always_exclude=None):
+        """``always_exclude``: item indices skipped in EVERY epoch (and
+        across resets) — the Reader's statistics-pruned row-groups
+        (:mod:`petastorm_tpu.pushdown`): items proven to deliver zero
+        rows stay in the list (so item indices, shard assignment and
+        checkpoint identities are unchanged) but are never handed to the
+        pool. Distinct from :meth:`exclude_from_next_epoch`, which is a
+        one-epoch resume exclusion."""
         super().__init__(ventilate_fn)
         if iterations is not None and iterations <= 0:
             raise ValueError('iterations must be positive or None, got %r' % iterations)
@@ -114,6 +122,7 @@ class ConcurrentVentilator(Ventilator):
         self._epoch = 0
         self._cursor = 0
         self._exclude_once = frozenset()
+        self._exclude_always = frozenset(always_exclude or ())
         self._in_flight = 0
         self._cv = threading.Condition()
         self._stop_requested = False
@@ -127,7 +136,14 @@ class ConcurrentVentilator(Ventilator):
         with self._cv:
             if self._thread is not None:
                 raise RuntimeError('Ventilator already started')
-            if not self._items:
+            if not self._items or (
+                    self._exclude_always
+                    and self._exclude_always.issuperset(
+                        range(len(self._items)))):
+                # nothing will ever be ventilated (empty list, or every
+                # item statistics-pruned): complete immediately — even
+                # for infinite iterations, where spinning through empty
+                # epochs would burn a core delivering nothing forever
                 self._completed = True
                 return
             if self._stop_requested:
@@ -256,6 +272,8 @@ class ConcurrentVentilator(Ventilator):
                     self._completed = True
                     break
             order = self._epoch_order(self._epoch)
+            if self._exclude_always:
+                order = [i for i in order if i not in self._exclude_always]
             if self._exclude_once:
                 order = [i for i in order if i not in self._exclude_once]
                 self._exclude_once = frozenset()

@@ -33,6 +33,7 @@ TRACKED = (
     'vs_tfdata',
     'hello_world_warm_epoch_rows_per_sec',
     'cache_hit_share',
+    'selective_read_1pct_rows_per_sec',
     'native_decode_speedup',
     'imagenet_batch_rows_per_sec',
     'imagenet_jax_rows_per_sec',
