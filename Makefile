@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: test test-fast analyze lint trend ci typecheck bench dryrun docker clean
+.PHONY: test test-fast analyze lint trend chaos chaos-soak ci typecheck bench dryrun docker clean
 
 # full suite (~10 min: includes the compile-heavy model/attention tests)
 test:
@@ -38,8 +38,21 @@ trend:
 	$(PYTHON) tools/bench_trend.py --fail-on-regression \
 	  --allow lm_train_steps_per_sec --allow imagenet_jax_rows_per_sec
 
-# the CI gate sequence: static contracts, perf trend, tier-1 tests
-ci: analyze trend test-fast
+# seeded chaos suite (docs/service.md "Failure semantics" + "Standing
+# service"): deterministic fault injection, poison quarantine, dispatcher
+# restart, daemon SIGKILL/restart, lease lapse, breaker trips. The fast
+# subset is tier-1; the soak variant runs the slow-marked full-epoch
+# drills on top.
+chaos:
+	$(PYTHON) -m pytest tests/test_chaos.py tests/test_daemon.py -q -m "not slow"
+
+chaos-soak:
+	$(PYTHON) -m pytest tests/test_chaos.py tests/test_daemon.py -q
+
+# the CI gate sequence: static contracts, perf trend, the seeded chaos
+# drills (fast subset — also inside test-fast, but a named early gate
+# fails the failure-domain story first and fast), then tier-1 tests
+ci: analyze trend chaos test-fast
 
 typecheck:
 	$(PYTHON) -m mypy petastorm_tpu

@@ -49,6 +49,16 @@ EVENT_NAMES = frozenset([
     'poisoned',         # retry budget exhausted; item quarantined
     'done',             # the item's single delivered completion
     'duplicate_done',   # a raced second completion, deduped (dropped)
+    # standing-service supervision (service/supervisor.py + daemon.py):
+    # every scaling/repair action on the fleet is an instant on the
+    # 'supervisor'/'daemon' track, so a Perfetto export shows WHY the
+    # fleet changed size or membership
+    'worker_spawn',     # supervisor started a worker-server process
+    'worker_release',   # supervisor drained + released an idle worker
+    'breaker_open',     # crash-looping slot tripped its circuit breaker
+    'breaker_close',    # a breaker's respawned worker proved stable
+    'job_register',     # daemon admitted a client job into the registry
+    'job_gone',         # a job left the registry (goodbye or lease GC)
 ])
 
 #: every metric series name the package exports — the registry namespace
@@ -94,6 +104,11 @@ METRIC_NAMES = frozenset([
     # telemetry/__init__.py)
     'petastorm_tpu_service_retries_total',
     'petastorm_tpu_service_items_poisoned_total',
+    # standing decode service (service/daemon.py + supervisor.py)
+    'petastorm_tpu_service_jobs_active',
+    'petastorm_tpu_service_workers_spawned_total',
+    'petastorm_tpu_service_workers_released_total',
+    'petastorm_tpu_service_breaker_open',
     'petastorm_tpu_swallowed_errors_total',
     'petastorm_tpu_faults_injected_total',
     # decoded-cache failure domain (materialized_cache.py)
@@ -158,6 +173,13 @@ KNOWN_KNOBS = frozenset([
     'PETASTORM_TPU_SERVICE_MAX_RETRIES',
     'PETASTORM_TPU_SERVICE_RETRY_BACKOFF_S',
     'PETASTORM_TPU_SERVICE_READ_DEADLINE_S',
+    'PETASTORM_TPU_SERVICE_DAEMON',
+    'PETASTORM_TPU_SERVICE_LEASE_S',
+    'PETASTORM_TPU_SERVICE_MAX_JOBS',
+    'PETASTORM_TPU_SERVICE_MIN_WORKERS',
+    'PETASTORM_TPU_SERVICE_MAX_WORKERS',
+    'PETASTORM_TPU_SERVICE_BREAKER_DEATHS',
+    'PETASTORM_TPU_SERVICE_BREAKER_WINDOW_S',
     'PETASTORM_TPU_PUSHDOWN',
     'PETASTORM_TPU_PUSHDOWN_PRUNE',
     'PETASTORM_TPU_PUSHDOWN_WORKERS',
@@ -184,6 +206,9 @@ ANOMALY_KINDS = {
     'row_group_poisoned': 'A row-group was quarantined '
                           '(row_group_poisoned)',
     'cache_degraded': 'The decoded cache degraded to decode-through',
+    'worker_flapping': 'A worker slot is crash-looping (worker_flapping)',
+    'job_lease_expired': 'A job lease expired and was reclaimed '
+                         '(job_lease_expired)',
 }
 
 #: every registered fault-injection site (:mod:`petastorm_tpu.faults`),
@@ -214,6 +239,10 @@ FAULTPOINTS = {
     'zmq.stop': 'dispatcher STOP broadcast (drop = dispatcher dies '
                 'without goodbye — the restart/reconnect drill)',
     'staging.h2d': 'staging-arena host->device dispatch (jax/staging)',
+    'service.spawn': 'supervisor worker-server process spawn '
+                     '(service/supervisor.py; error = the spawn fails, '
+                     'feeding the crash-loop circuit breaker — the '
+                     'breaker drill without burning real processes)',
 }
 
 #: the one knob-truthiness rule for "disable"/"enable" env spellings —

@@ -31,6 +31,39 @@ from jax import lax
 from petastorm_tpu.parallel.mesh import PIPE_AXIS
 
 
+def pipeline_supported():
+    """Whether this jax can run :func:`pipeline_apply` soundly: the
+    modern ``jax.shard_map`` (``check_vma=True``) plus the varying-
+    manual-axes primitives (``lax.pcast``/``lax.pvary``) that make the
+    replicated-input transpose correct. On older jax builds —
+    ``jax.experimental.shard_map``'s ``check_rep=False`` era — input
+    gradients through replicated in_specs are silently wrong, which is
+    strictly worse than refusing; callers (and tests) should gate on
+    this instead of catching the ImportError."""
+    try:
+        from jax import shard_map  # noqa: F401
+    except ImportError:
+        return False
+    return hasattr(lax, 'pcast') or hasattr(lax, 'pvary')
+
+
+def _require_shard_map():
+    """The guarded import of the modern ``jax.shard_map`` — a clear,
+    actionable error instead of a bare ImportError mid-trace."""
+    if not pipeline_supported():
+        raise RuntimeError(
+            'pipeline_apply requires the modern jax.shard_map with '
+            'sound vma tracking (jax.shard_map + lax.pcast/pvary; '
+            'jax >= 0.6). This jax (%s) lacks it, and the experimental '
+            'check_rep=False fallback would produce silently wrong '
+            'input gradients — upgrade jax to use pipeline '
+            'parallelism; every other parallelism family '
+            '(data/tensor/expert/sequence) works on this build.'
+            % jax.__version__)
+    from jax import shard_map
+    return shard_map
+
+
 def shard_stage_params(stage_params, mesh, axis_name=PIPE_AXIS,
                        inner_specs=None):
     """Place a stacked-stage parameter pytree so each leaf's leading
@@ -184,7 +217,9 @@ def pipeline_apply(stage_fn, stage_params, x, mesh, axis_name=PIPE_AXIS,
     # batch enters replicated, and only the varying-manual-axes machinery
     # transposes that correctly (see _to_varying). No check_rep=False
     # fallback — on a jax too old for it, wrong input gradients would be
-    # silent, which is strictly worse than an ImportError.
+    # silent, which is strictly worse than an error
+    # (pipeline_supported() is the capability probe; _require_shard_map
+    # turns its absence into an actionable RuntimeError).
     #
     # Manual ONLY over the pipe axis (+ seq_axis for pp×sp): any other
     # mesh axes (data, model, expert) stay auto, so the batch rides in
@@ -192,7 +227,7 @@ def pipeline_apply(stage_fn, stage_params, x, mesh, axis_name=PIPE_AXIS,
     # layout, and XLA inserts the dp/tp/ep collectives inside each stage
     # as usual — this is what lets pp compose with the other axes in ONE
     # jitted step.
-    from jax import shard_map
+    shard_map = _require_shard_map()
     # the aux scalar leaves replicated over EVERY manual axis: psum'd over
     # pipe in _pipeline_local, and (for pp×sp×ep) made seq-invariant by
     # the stage's own psum of its routing statistics over seq_axis

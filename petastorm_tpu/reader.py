@@ -266,6 +266,16 @@ def _make_pool(reader_pool_type, workers_count, results_queue_size,
         # 'process', but through the full network stack).
         from petastorm_tpu.service import ServicePool
         from petastorm_tpu.telemetry import knobs
+        daemon = knobs.get_str('PETASTORM_TPU_SERVICE_DAEMON') or None
+        if daemon:
+            # STANDING service (docs/service.md, "Standing service"):
+            # register this reader as one job with the long-lived daemon
+            # at the given endpoint — many concurrent readers share its
+            # supervised fleet; no dispatcher runs in this process.
+            from petastorm_tpu.service.daemon import DaemonClientPool
+            return DaemonClientPool(daemon,
+                                    results_queue_size=results_queue_size,
+                                    poison_policy=poison_policy or 'raise')
         endpoint = knobs.get_str('PETASTORM_TPU_SERVICE_DISPATCHER') or None
         if endpoint:
             # workers_count deliberately does NOT feed expected_workers: it

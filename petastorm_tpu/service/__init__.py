@@ -20,10 +20,21 @@ package is that lever for petastorm_tpu:
   :class:`~petastorm_tpu.workers.process_pool.ProcessPool`, so
   ``Reader(..., reader_pool_type='service')`` and ``make_jax_loader(...)``
   work unchanged.
+* :mod:`~petastorm_tpu.service.daemon` — the STANDING service
+  (``python -m petastorm_tpu.service``): a daemonized dispatcher that
+  outlives any single job (job registry, leases, per-job fair sharing,
+  admission control) with the client-side
+  :class:`~petastorm_tpu.service.daemon.DaemonClientPool`, plus
+  :mod:`~petastorm_tpu.service.supervisor` — the self-healing fleet
+  loop (replacement, recruitment, release, circuit breaker).
 
 See ``docs/service.md`` for the topology, the heartbeat/re-ventilation
-semantics, and when to disaggregate (keyed to
-``JaxLoader.autotune_report()``).
+semantics, the standing-service lifecycle, and when to disaggregate
+(keyed to ``JaxLoader.autotune_report()``).
 """
 
+from petastorm_tpu.service.daemon import (  # noqa: F401
+    DaemonClientPool, ServiceDaemon,
+)
 from petastorm_tpu.service.service_pool import ServicePool  # noqa: F401
+from petastorm_tpu.service.supervisor import WorkerSupervisor  # noqa: F401

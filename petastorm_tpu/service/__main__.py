@@ -1,0 +1,77 @@
+"""CLI of the standing decode service daemon::
+
+    python -m petastorm_tpu.service --endpoint tcp://0.0.0.0:7777 \\
+        --workers 2 --max-workers 8 --obs-port 0
+
+Runs the daemonized dispatcher (job registry, leases, admission
+control) plus the self-healing worker supervisor until SIGTERM/SIGINT
+drains the registry empty (a second signal stops hard). See
+docs/service.md, "Standing service".
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+from petastorm_tpu.service.daemon import ServiceDaemon
+from petastorm_tpu.telemetry import knobs
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog='python -m petastorm_tpu.service',
+        description='petastorm_tpu standing decode-service daemon')
+    parser.add_argument('--endpoint', default='tcp://127.0.0.1:0',
+                        help='tcp://host:port to bind (port 0 = random; '
+                             'the resolved endpoint is logged)')
+    parser.add_argument('--workers', type=int, default=1,
+                        help='initial supervised worker-server fleet size')
+    parser.add_argument('--min-workers', type=int, default=None,
+                        help='release floor (default '
+                             'PETASTORM_TPU_SERVICE_MIN_WORKERS)')
+    parser.add_argument('--max-workers', type=int, default=None,
+                        help='recruitment ceiling (default '
+                             'PETASTORM_TPU_SERVICE_MAX_WORKERS)')
+    parser.add_argument('--no-supervisor', action='store_true',
+                        help='serve an externally-managed fleet: no '
+                             'worker processes are spawned, replaced or '
+                             'released by this daemon')
+    parser.add_argument('--heartbeat-interval', type=float, default=1.0)
+    parser.add_argument('--liveness-timeout', type=float, default=None,
+                        help='heartbeat silence after which a worker is '
+                             'declared dead (default 4 intervals)')
+    parser.add_argument('--max-jobs', type=int, default=None,
+                        help='admission ceiling (default '
+                             'PETASTORM_TPU_SERVICE_MAX_JOBS)')
+    parser.add_argument('--lease', type=float, default=None,
+                        help='default job lease seconds (default '
+                             'PETASTORM_TPU_SERVICE_LEASE_S)')
+    parser.add_argument('--obs-port', type=int, default=None,
+                        help='serve /metrics /report /health /trace on '
+                             'this port (0 = ephemeral; same as setting '
+                             'PETASTORM_TPU_OBS_PORT)')
+    parser.add_argument('-v', '--verbose', action='store_true')
+    args = parser.parse_args(argv)
+    if args.obs_port is not None:
+        knobs.set_env('PETASTORM_TPU_OBS_PORT', str(args.obs_port))
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format='%(asctime)s service-daemon[%(process)d] %(message)s')
+    # the daemon itself must never touch an accelerator; its supervised
+    # workers re-pin themselves the same way (exec_in_new_process)
+    os.environ['JAX_PLATFORMS'] = 'cpu'
+    daemon = ServiceDaemon(
+        args.endpoint, initial_workers=args.workers,
+        min_workers=args.min_workers, max_workers=args.max_workers,
+        heartbeat_interval_s=args.heartbeat_interval,
+        liveness_timeout_s=args.liveness_timeout,
+        max_jobs=args.max_jobs, lease_s=args.lease,
+        supervise=not args.no_supervisor)
+    daemon.start()
+    daemon.run_forever()
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,11 +1,39 @@
-"""Dispatcher: the service's item scheduler and liveness tracker.
+"""Dispatcher: the service's item scheduler, job registry and liveness
+tracker.
 
 Runs as a single thread that owns the ROUTER socket (ZMQ sockets are not
 thread-safe; every socket operation happens here). Other threads interact
 through three thread-safe surfaces only: :meth:`submit` (the ventilator
-hands in work items), the ``deliver`` callback (results flow out to the
-:class:`~petastorm_tpu.service.service_pool.ServicePool`'s bounded queue),
-and :meth:`stats` (gauges).
+hands in work items), the per-job ``deliver`` callback (results flow out
+to the :class:`~petastorm_tpu.service.service_pool.ServicePool`'s bounded
+queue), and :meth:`stats` (gauges).
+
+Since the standing-service refactor (docs/service.md, "Standing
+service") the dispatcher is **multi-job**: a *job registry* maps job ids
+to their spec payload, their result destination, and their slice of the
+worker fleet. Two kinds of job share one scheduler:
+
+* the **local job** (id 0) — the embedded :class:`ServicePool` case:
+  spec fixed at construction, results delivered through a callback into
+  the consumer's bounded queue. At most one exists, so the embedded
+  topology behaves exactly as before the registry existed.
+* **client jobs** — registered over the wire (REGISTER_JOB) by remote
+  :class:`~petastorm_tpu.service.daemon.DaemonClientPool` consumers.
+  Results travel back as RESULT frames, items are keyed by the client's
+  own item ids on the wire (the dispatcher's global item counter keeps
+  the *internal* id space collision-free across jobs), delivery is
+  gated by a per-job **credit** (the client's bounded-queue capacity),
+  and a **lease** reclaims everything — pending, in-flight, workers —
+  when a client dies without a goodbye.
+
+Worker servers are **partitioned** across jobs: each worker is bound to
+one job at registration (the job spec IS the worker build, so a worker
+can only ever decode for one job at a time), the binding is chosen
+least-loaded-first, and the sweep rebalances by STOPping one idle worker
+of an over-served job per interval — the worker re-registers with a
+fresh identity and lands on the starved job. Per-worker credit
+(``max_inflight_per_worker``) is unchanged, so fair sharing composes
+out of fair partitioning × per-worker credit.
 
 Scheduling is credit-based: each live, READY worker server holds at most
 ``max_inflight_per_worker`` assigned items, so a slow worker never hoards
@@ -17,8 +45,8 @@ Fault tolerance — the exactly-once core:
 * Every ventilated item gets a monotonically increasing id; ownership
   (``item id -> worker identity``) is recorded at assignment.
 * A worker whose heartbeat lapses past ``liveness_timeout_s`` is
-  deregistered and its in-flight items go back to the FRONT of the pending
-  queue (**re-ventilation**) for reassignment.
+  deregistered and its in-flight items go back to the FRONT of its job's
+  pending queue (**re-ventilation**) for reassignment.
 * Completions are deduplicated by item id: a lapsed-but-actually-alive
   worker (GC pause, network stall) racing its replacement can produce two
   DONEs for one item — the first wins and is delivered, the second is
@@ -49,6 +77,16 @@ Failure-domain hardening (docs/service.md, "Failure semantics"):
   token knows its dispatcher was replaced (client restart on the same
   endpoint) and re-registers instead of decoding for a job spec the new
   dispatcher never sent it.
+* **Job leases**: a client job whose SUBMIT/CLIENT_HB traffic goes
+  silent past its lease is garbage-collected — in-flight work reclaimed
+  (late completions dedup away), pending purged, its workers STOPped
+  back into the registration pool — announced as a ``job_lease_expired``
+  anomaly event, with zero effect on co-tenant jobs.
+* **Drain**: :meth:`begin_drain` (the daemon's SIGTERM path) makes every
+  new REGISTER_JOB answer a retryable BUSY while registered jobs finish;
+  admission control answers the same BUSY when the registry is full
+  (``PETASTORM_TPU_SERVICE_MAX_JOBS``) — clients back off and retry
+  instead of erroring.
 """
 
 import collections
@@ -71,15 +109,27 @@ logger = logging.getLogger(__name__)
 _POLL_INTERVAL_MS = 50
 _STOP_BROADCASTS = 3
 
+#: liveness floor for workers WAITING for a job (job_id None): their
+#: only liveness signal is the REGISTER re-send, whose worker-side
+#: backoff caps at 2s — a tight heartbeat-tuned window would lapse and
+#: re-admit every healthy idle worker in a pointless churn loop. They
+#: hold no in-flight work, so the slow detection costs nothing.
+_UNBOUND_LIVENESS_FLOOR_S = 5.0
+
 #: quarantined-item descriptors retained for /health (count is unbounded,
 #: the descriptor list is not — an operator needs the recent offenders,
 #: not an ever-growing ledger in a long-lived daemon)
 _POISONED_KEEP = 100
 
+#: the embedded (callback-delivery) job's fixed id; client jobs count up
+#: from 1
+LOCAL_JOB_ID = 0
+
 # Fleet-health metric names (docs/telemetry.md): the dispatcher runs in
-# the CONSUMER process, so these land straight in its process-wide
-# registry and surface through pipeline_report()'s `service` section —
-# re-ventilation/dedupe activity visible without reading dispatcher logs.
+# the CONSUMER process (or the standing daemon), so these land straight
+# in its process-wide registry and surface through pipeline_report()'s
+# `service` section — re-ventilation/dedupe activity visible without
+# reading dispatcher logs.
 SERVICE_REVENTILATED = 'petastorm_tpu_service_reventilated_total'
 SERVICE_DUPLICATE_DONE = 'petastorm_tpu_service_duplicate_done_total'
 SERVICE_WORKERS_ALIVE = 'petastorm_tpu_service_workers_alive'
@@ -88,16 +138,100 @@ SERVICE_ITEMS_PENDING = 'petastorm_tpu_service_items_pending'
 SERVICE_ITEMS_ASSIGNED = 'petastorm_tpu_service_items_assigned'
 SERVICE_RETRIES = 'petastorm_tpu_service_retries_total'
 SERVICE_POISONED = 'petastorm_tpu_service_items_poisoned_total'
+SERVICE_JOBS = 'petastorm_tpu_service_jobs_active'
 
 
 class _WorkerState:
-    __slots__ = ('identity', 'last_heartbeat', 'ready', 'inflight')
+    __slots__ = ('identity', 'last_heartbeat', 'ready', 'inflight',
+                 'job_id', 'cordoned', 'pid')
 
     def __init__(self, identity, now):
         self.identity = identity
         self.last_heartbeat = now
         self.ready = False
         self.inflight = set()
+        #: the job this worker was built for (its SPEC); None while the
+        #: worker awaits a job to exist
+        self.job_id = None
+        #: True once the supervisor marked this worker for release: no
+        #: new assignments, terminated once idle
+        self.cordoned = False
+        #: learned from the REGISTER pid frame (new-build workers) or
+        #: the heartbeat summaries; None on old builds until the first
+        #: summary arrives
+        self.pid = None
+
+
+class _Job:
+    """One registry entry: where a job's items come from and where its
+    results go. ``deliver`` set = the local (embedded-pool) job;
+    ``client`` set = a remote client job speaking RESULT frames."""
+
+    __slots__ = ('job_id', 'name', 'spec_payload', 'deliver', 'client',
+                 'client_key', 'lease_s', 'last_client_seen', 'credit',
+                 'markers_sent', 'markers_acked', 'pending', 'pending_ids',
+                 'client_item_ids', 'live_cids', 'out', 'workers',
+                 'submitted', 'completed', 'created_at')
+
+    def __init__(self, job_id, spec_payload, deliver=None, client=None,
+                 client_key=None, lease_s=None, credit=None, name=None):
+        self.job_id = job_id
+        self.name = name or 'job-%d' % job_id
+        self.spec_payload = spec_payload
+        self.deliver = deliver
+        self.client = client
+        self.client_key = client_key
+        self.lease_s = lease_s
+        self.last_client_seen = time.monotonic()
+        self.credit = credit
+        # delivery-credit clock for client jobs: markers sent vs markers
+        # the client reports consumed; the gap bounds everything buffered
+        # between the two processes, so a stalled consumer quiesces ITS
+        # job's slice of the fleet without touching co-tenants
+        self.markers_sent = 0
+        self.markers_acked = 0
+        self.pending = collections.deque()    # (item_id, payload)
+        self.pending_ids = set()
+        self.client_item_ids = {}             # item_id -> client item id
+        #: live client item ids — dedups a reconnected client's
+        #: re-submission of items this job still holds (its marker was
+        #: in flight when the client's socket reset)
+        self.live_cids = set()
+        # undelivered outbound entries: local = delivery tuples awaiting
+        # queue space; client = RESULT frame lists awaiting socket space
+        self.out = collections.deque()
+        self.workers = set()                  # bound worker identities
+        self.submitted = 0
+        self.completed = 0
+        self.created_at = time.time()
+
+    @property
+    def is_local(self):
+        return self.deliver is not None
+
+    def gated(self):
+        """True when assigning more of this job's items would only grow
+        an unbounded buffer: the local consumer's queue is full
+        (backlog), or a client job's delivery credit is spent."""
+        if self.out:
+            return True
+        return (self.credit is not None
+                and self.markers_sent - self.markers_acked >= self.credit)
+
+    def descriptor(self):
+        return {
+            'job_id': self.job_id,
+            'name': self.name,
+            'local': self.is_local,
+            'pending': len(self.pending),
+            'workers': len(self.workers),
+            'submitted': self.submitted,
+            'completed': self.completed,
+            'unacked': self.markers_sent - self.markers_acked,
+            'credit': self.credit,
+            'lease_s': self.lease_s,
+            'out_backlog': len(self.out),
+        }
 
 
 class _TraceEntry:
@@ -114,13 +248,15 @@ class _TraceEntry:
 
 
 class Dispatcher:
-    """Single-threaded scheduler loop behind a :class:`ServicePool`.
+    """Single-threaded scheduler loop behind a :class:`ServicePool` or a
+    standing :class:`~petastorm_tpu.service.daemon.ServiceDaemon`.
 
     :param endpoint: ``tcp://host:port`` to bind; port ``0`` binds a random
         free port (the resolved endpoint appears as :attr:`endpoint` once
         :meth:`wait_bound` returns).
     :param job_spec_payload: :func:`protocol.dump_job_spec` bytes replied to
-        every REGISTER.
+        every REGISTER for the embedded local job; ``None`` for a standing
+        daemon (jobs arrive over the wire instead).
     :param deliver: NON-BLOCKING callable ``(kind, payload) -> bool``
         pushing ``('result', bytes)`` / ``('error', exc)`` /
         ``('marker', None)`` entries to the consumer; returns False when
@@ -129,22 +265,27 @@ class Dispatcher:
         pool is stopping. It must never block: this thread also acks
         worker heartbeats, and a consumer pause (recompile, checkpoint
         save) must quiesce the fleet, not starve its liveness protocol.
+        ``None`` for a standing daemon.
     :param stop_event: shared :class:`threading.Event`; setting it makes
         :meth:`run` broadcast STOP to all workers and exit.
+    :param standing: True for a daemonized dispatcher: zero live workers
+        with work outstanding is a supervisor condition to repair, not a
+        fatal error, and client REGISTER_JOB frames are expected traffic.
     """
 
     def __init__(self, endpoint, job_spec_payload, deliver, stop_event,
                  heartbeat_interval_s=1.0, liveness_timeout_s=4.0,
                  max_inflight_per_worker=2, no_workers_timeout_s=30.0,
-                 max_retries=None, retry_backoff_s=None):
+                 max_retries=None, retry_backoff_s=None, standing=False,
+                 max_jobs=None, default_lease_s=None):
         self._requested_endpoint = endpoint
-        self._job_spec_payload = job_spec_payload
         self._deliver = deliver
         self._stop_event = stop_event
         self._heartbeat_interval_s = heartbeat_interval_s
         self._liveness_timeout_s = liveness_timeout_s
         self._max_inflight_per_worker = max_inflight_per_worker
         self._no_workers_timeout_s = no_workers_timeout_s
+        self._standing = standing
         # per-item retry budget (total attempts) + backoff base; knob
         # defaults so a standing fleet is governed without code changes
         self._max_retries = (max_retries if max_retries is not None
@@ -156,6 +297,17 @@ class Dispatcher:
                                  else knobs.get_float(
                                      'PETASTORM_TPU_SERVICE_RETRY'
                                      '_BACKOFF_S', 0.05, floor=0.0))
+        # job-registry governance (standing service): admission ceiling
+        # and the default lease clients inherit when they name none
+        self._max_jobs = (max_jobs if max_jobs is not None
+                          else knobs.get_int(
+                              'PETASTORM_TPU_SERVICE_MAX_JOBS', 16,
+                              floor=1))
+        self._default_lease_s = (default_lease_s
+                                 if default_lease_s is not None
+                                 else knobs.get_float(
+                                     'PETASTORM_TPU_SERVICE_LEASE_S',
+                                     30.0, floor=1.0))
         #: this dispatcher incarnation's identity, riding every SPEC and
         #: HEARTBEAT_ACK: a worker that sees the token change knows its
         #: dispatcher was replaced and must re-register for the new job
@@ -164,17 +316,27 @@ class Dispatcher:
         self.endpoint = None
         self._bound = threading.Event()
         self._lock = threading.Lock()
-        self._pending = collections.deque()   # (item_id, payload)
-        self._pending_ids = set()
+        # the job registry: LOCAL_JOB_ID (embedded callback delivery) +
+        # wire-registered client jobs; _item_job maps every live item's
+        # GLOBAL id to its job, which is what keeps N jobs' item spaces
+        # collision-free over one worker wire protocol
+        self._jobs = collections.OrderedDict()
+        self._job_seq = LOCAL_JOB_ID
+        self._item_job = {}
+        self._draining = False
+        if job_spec_payload is not None:
+            self._jobs[LOCAL_JOB_ID] = _Job(LOCAL_JOB_ID, job_spec_payload,
+                                            deliver=deliver, name='local')
         self._next_item_id = 0
         self._workers = {}                    # identity -> _WorkerState
         self._inflight = {}                   # item_id -> (identity, payload)
         # Completion dedup applies ONLY to items that were ever
-        # re-ventilated: a single-assignment item produces exactly one DONE
-        # (one WORK message -> one completion), so keeping every finished id
-        # would leak memory across an infinite-epoch stream for nothing.
-        # _risky_ids marks re-ventilated items; _done records their
-        # completions. Both stay bounded by failure churn, not stream length.
+        # re-ventilated (or reclaimed by a job GC): a single-assignment
+        # item produces exactly one DONE (one WORK message -> one
+        # completion), so keeping every finished id would leak memory
+        # across an infinite-epoch stream for nothing. _risky_ids marks
+        # re-ventilated items; _done records their completions. Both stay
+        # bounded by failure churn, not stream length.
         self._risky_ids = set()
         self._done = set()
         # failure-domain state: failed-attempt counts (an item present
@@ -185,28 +347,45 @@ class Dispatcher:
         # ledger. All bounded by failure churn, never by stream length.
         self._attempts = {}
         self._last_error = {}
+        # item_id -> identities EVER assigned that item by THIS
+        # dispatcher incarnation. Completion acceptance is gated on it:
+        # a ghost DONE from a lapsed prior owner is legitimate (the
+        # exactly-once dedup handles it), but a STALE DONE from another
+        # incarnation's worker — its socket flushing on reconnect after
+        # a daemon restart, carrying an item id that COLLIDES with this
+        # incarnation's id space — must be dropped, or it completes the
+        # wrong item with the wrong rows (duplicate + loss). Entries
+        # drop with their item (completion/quarantine/job GC).
+        self._item_owners = {}
         self._retry = []
         self._retry_seq = 0
         self._poisoned = collections.OrderedDict()
         self._poisoned_count = 0
         self._retried_count = 0
-        # Results awaiting consumer-queue space. Bounded in steady state:
-        # while it is non-empty no new items are assigned, so it can never
-        # exceed the completions already in flight when the consumer
-        # stalled (≈ max_inflight_per_worker × workers).
-        self._out_backlog = collections.deque()
         self._completed_count = 0
         self._reventilated_count = 0
         self._duplicate_done_count = 0
         self._workers_seen = 0
+        self._jobs_seen = 1 if job_spec_payload is not None else 0
+        self._jobs_expired = 0
         self._metrics_deltas_merged = 0
         # identity -> latest heartbeat-piggybacked observability summary
         # (JSON dict); the per-worker breakdown of the fleet view. Kept
         # alongside _workers and pruned on deregister, so it is bounded
         # by fleet size.
         self._worker_obs = {}
+        # identity -> job_id at deregistration time: a lapsed worker
+        # resurfacing via heartbeat is still RUNNING the spec of the job
+        # it lapsed from — re-binding it anywhere else would hand job
+        # B's items to job A's decode worker. Bounded: lapse churn only.
+        self._lapsed_bindings = collections.OrderedDict()
         self._fatal_error = None
         self._no_workers_since = None
+        # the ROUTER socket, owned by the dispatcher thread; deep
+        # delivery paths (quarantine inside a sweep) reach it here
+        # instead of threading it through six call layers. Only the
+        # dispatcher thread may touch it.
+        self._sock = None
         # item_id -> _TraceEntry for traced items: the
         # work payload is opaque dill here, so the ServicePool registers
         # the context at submit time and the dispatcher stamps lifecycle
@@ -221,15 +400,25 @@ class Dispatcher:
 
     # -- thread-safe surface (called from pool / ventilator threads) ---------
 
-    def submit(self, payload, trace_ctx=None):
-        """Enqueue one dill-framed work item; returns its item id.
-        ``trace_ctx`` (when the item is traced) keys the dispatcher's
-        lifecycle instants to the trace minted at ventilation."""
+    def submit(self, payload, trace_ctx=None, job_id=LOCAL_JOB_ID,
+               client_item_id=None):
+        """Enqueue one dill-framed work item for ``job_id``; returns its
+        GLOBAL item id (unique across every job this dispatcher ever
+        scheduled). ``trace_ctx`` (when the item is traced) keys the
+        dispatcher's lifecycle instants to the trace minted at
+        ventilation; ``client_item_id`` is the wire id RESULT frames echo
+        back to a client job."""
         with self._lock:
+            job = self._jobs[job_id]
             item_id = self._next_item_id
             self._next_item_id += 1
-            self._pending.append((item_id, payload))
-            self._pending_ids.add(item_id)
+            job.pending.append((item_id, payload))
+            job.pending_ids.add(item_id)
+            job.submitted += 1
+            self._item_job[item_id] = job_id
+            if client_item_id is not None:
+                job.client_item_ids[item_id] = client_item_id
+                job.live_cids.add(client_item_id)
             if trace_ctx is not None:
                 self._trace_ctx[item_id] = _TraceEntry(trace_ctx)
             return item_id
@@ -246,12 +435,31 @@ class Dispatcher:
     def fatal_error(self):
         return self._fatal_error
 
+    @property
+    def draining(self):
+        return self._draining
+
+    def begin_drain(self):
+        """Enter drain mode (the daemon's SIGTERM path): every later
+        REGISTER_JOB answers a retryable BUSY; already-registered jobs
+        keep running until they finish or their lease lapses."""
+        self._draining = True
+        logger.warning('Dispatcher draining: new jobs refused (BUSY), '
+                       '%d job(s) finishing', len(self._jobs))
+
     def registered_workers(self):
         return len(self._workers)
 
+    def active_jobs(self):
+        """Live registry size (local + client jobs)."""
+        return len(self._jobs)
+
+    def _pending_total_locked(self):
+        return sum(len(j.pending) for j in self._jobs.values())
+
     def stats(self):
         with self._lock:
-            pending = len(self._pending)
+            pending = self._pending_total_locked()
         # list() snapshots the dict at C level (atomic under the GIL):
         # the dispatcher thread may register/deregister workers while a
         # consumer thread polls diagnostics.
@@ -270,34 +478,43 @@ class Dispatcher:
             'items_retried': self._retried_count,
             'items_poisoned': self._poisoned_count,
             'metrics_deltas_merged': self._metrics_deltas_merged,
+            'jobs_active': len(self._jobs),
+            'jobs_seen': self._jobs_seen,
+            'jobs_expired': self._jobs_expired,
         }
 
     def health(self):
         """The dispatcher's /health contribution: fleet liveness plus
         the back-pressure state an operator needs first — ``quiesced``
-        means completions are backlogged behind a full consumer queue,
-        so the fleet is idling by design, not broken — plus the
-        quarantine ledger: every recently-poisoned item with its attempt
-        count and last failure, so "which row-group is killing my
-        workers" is a /health read, not a log dig."""
+        means completions are backlogged behind a full consumer queue
+        (or a client job's spent delivery credit), so the fleet is
+        idling by design, not broken — plus the job registry (per-job
+        pending/credit/lease state) and the quarantine ledger: every
+        recently-poisoned item with its attempt count and last failure,
+        so "which row-group is killing my workers" is a /health read,
+        not a log dig."""
         stats = self.stats()
-        stats['quiesced'] = bool(self._out_backlog)
-        stats['out_backlog'] = len(self._out_backlog)
+        jobs = list(self._jobs.values())
+        stats['quiesced'] = any(job.gated() for job in jobs)
+        stats['out_backlog'] = sum(len(job.out) for job in jobs)
         stats['endpoint'] = self.endpoint
         stats['items_completed'] = self._completed_count
         stats['max_retries'] = self._max_retries
+        stats['draining'] = self._draining
+        stats['max_jobs'] = self._max_jobs
+        stats['jobs'] = [job.descriptor() for job in jobs]
         stats['poisoned'] = list(self._poisoned.values())
         return stats
 
     def fleet_view(self):
         """The merged fleet view the dispatcher's /report serves:
-        per-worker breakdown (liveness, in-flight load, and the latest
-        heartbeat-piggybacked observability summary — rates, pid, the
-        worker's own obs endpoint port) plus the scheduler totals. The
-        *aggregate* metrics (fleet-wide stage seconds, anomaly counters)
-        already live in this process's registry via the DONE-frame delta
-        merges, so `pipeline_report()` alongside this IS the merged
-        view."""
+        per-worker breakdown (liveness, job binding, in-flight load, and
+        the latest heartbeat-piggybacked observability summary — rates,
+        pid, the worker's own obs endpoint port) plus the scheduler
+        totals and the job registry. The *aggregate* metrics (fleet-wide
+        stage seconds, anomaly counters) already live in this process's
+        registry via the DONE-frame delta merges, so `pipeline_report()`
+        alongside this IS the merged view."""
         now = time.monotonic()
         workers = {}
         for identity, worker in list(self._workers.items()):
@@ -308,14 +525,66 @@ class Dispatcher:
                 'ready': worker.ready,
                 'inflight': len(worker.inflight),
                 'heartbeat_age_s': round(now - worker.last_heartbeat, 3),
+                'job_id': worker.job_id,
             }
+            if worker.cordoned:
+                entry['cordoned'] = True
             summary = self._worker_obs.get(identity)
             if summary is not None:
                 entry['summary'] = summary
             workers[name] = entry
-        view = {'workers': workers}
+        view = {'workers': workers,
+                'jobs': [job.descriptor()
+                         for job in list(self._jobs.values())]}
         view.update(self.stats())
         return view
+
+    # -- supervisor surface (called from the supervisor thread) --------------
+
+    def _worker_pid(self, identity, worker):
+        if worker.pid is not None:
+            return worker.pid
+        summary = self._worker_obs.get(identity)
+        if summary and summary.get('pid'):
+            return int(summary['pid'])
+        return None
+
+    def alive_worker_pids(self):
+        """Pids of workers inside the liveness window — what the
+        supervisor diffs against its spawned processes to find a
+        wedged-but-running worker (process alive, heartbeats gone). A
+        worker between jobs counts: its REGISTER re-sends refresh
+        liveness and carry its pid."""
+        now = time.monotonic()
+        pids = set()
+        for identity, worker in list(self._workers.items()):
+            if now - worker.last_heartbeat > self._liveness_timeout_s:
+                continue
+            pid = self._worker_pid(identity, worker)
+            if pid is not None:
+                pids.add(pid)
+        return pids
+
+    def cordon_worker_by_pid(self, pid):
+        """Stop assigning work to the worker running as ``pid`` (the
+        supervisor's two-phase release: cordon, wait idle, terminate).
+        Returns True when a live worker matched. The flag writes are
+        benign cross-thread (single bool stores read by the dispatcher
+        thread's next scheduling pass)."""
+        for identity, worker in list(self._workers.items()):
+            if self._worker_pid(identity, worker) == pid:
+                worker.cordoned = True
+                worker.ready = False
+                return True
+        return False
+
+    def worker_inflight_by_pid(self, pid):
+        """In-flight item count of the worker running as ``pid``; None
+        when no such worker is registered (already gone)."""
+        for identity, worker in list(self._workers.items()):
+            if self._worker_pid(identity, worker) == pid:
+                return len(worker.inflight)
+        return None
 
     def _update_fleet_gauges(self):
         """Mirror fleet health into the process-wide registry so
@@ -331,12 +600,13 @@ class Dispatcher:
         registry.gauge(SERVICE_WORKERS_ALIVE).set(live)
         registry.gauge(SERVICE_WORKERS_REGISTERED).set(len(workers))
         with self._lock:
-            pending = len(self._pending)
+            pending = self._pending_total_locked()
         # backoff-delayed retries are pending work too — stats()/health()
         # already count them, and the gauge must agree
         registry.gauge(SERVICE_ITEMS_PENDING).set(pending
                                                   + len(self._retry))
         registry.gauge(SERVICE_ITEMS_ASSIGNED).set(len(self._inflight))
+        registry.gauge(SERVICE_JOBS).set(len(self._jobs))
 
     # -- dispatcher thread ---------------------------------------------------
 
@@ -361,6 +631,7 @@ class Dispatcher:
             sock.close(linger=0)
             context.term()
             return
+        self._sock = sock
         self._bound.set()
 
         last_sweep = time.monotonic()
@@ -368,19 +639,21 @@ class Dispatcher:
         backlog_prev = False
         try:
             while not self._stop_event.is_set():
-                self._flush_backlog()
+                self._flush_backlogs()
                 # Time spent with completions backlogged behind a full
-                # consumer queue is the service-side back-pressure clock:
-                # the fleet is quiesced because the CONSUMER is slow —
-                # producer wait, consumer-bound evidence (the remote
-                # workers never block locally; their out channel is the
-                # dispatcher, so this is measured here). An interval
-                # counts only when the backlog existed at BOTH of its
-                # ends: charging the interval in which a backlog first
-                # appeared would bill message-handling time that preceded
-                # it as a stall.
+                # LOCAL consumer queue is the service-side back-pressure
+                # clock: the fleet is quiesced because the CONSUMER is
+                # slow — producer wait, consumer-bound evidence (the
+                # remote workers never block locally; their out channel
+                # is the dispatcher, so this is measured here). Client
+                # jobs' credit gates are deliberately NOT on this clock:
+                # a remote consumer's stall is that job's back-pressure,
+                # not this process's. An interval counts only when the
+                # backlog existed at BOTH of its ends: charging the
+                # interval in which a backlog first appeared would bill
+                # message-handling time that preceded it as a stall.
                 tick = time.monotonic()
-                backlogged = bool(self._out_backlog)
+                backlogged = self._local_backlogged()
                 if backlogged and backlog_prev:
                     note_producer_wait(tick - last_tick)
                 backlog_prev = backlogged
@@ -391,7 +664,7 @@ class Dispatcher:
                 # ~5ms instead of a full poll interval (otherwise every
                 # marker behind a full queue costs the consumer a phantom
                 # ~50ms starvation wait).
-                poll_ms = 5 if self._out_backlog else _POLL_INTERVAL_MS
+                poll_ms = 5 if backlogged else _POLL_INTERVAL_MS
                 if sock.poll(poll_ms):
                     # Drain everything queued before scheduling: completions
                     # free credit that the assignment pass below can use.
@@ -427,6 +700,7 @@ class Dispatcher:
                     except Exception:  # noqa: BLE001 - peer may be gone
                         count_swallowed('dispatcher-stop-broadcast')
                 time.sleep(_POLL_INTERVAL_MS / 1000.0)
+            self._sock = None
             sock.close(linger=500)
             context.term()
 
@@ -436,20 +710,33 @@ class Dispatcher:
         identity, msg = frames[0], frames[1]
         now = time.monotonic()
         if msg == proto.MSG_REGISTER:
-            if identity not in self._workers:
-                self._workers[identity] = _WorkerState(identity, now)
+            worker = self._workers.get(identity)
+            if worker is None:
+                worker = _WorkerState(identity, now)
+                self._workers[identity] = worker
                 self._workers_seen += 1
                 logger.info('Worker %s registered (%d registered)',
                             identity, len(self._workers))
             else:
-                self._workers[identity].last_heartbeat = now
-            sock.send_multipart([identity, proto.MSG_SPEC,
-                                 self._job_spec_payload, self.token])
+                worker.last_heartbeat = now
+            if len(frames) > 2:
+                try:
+                    worker.pid = int(frames[2])
+                except ValueError:
+                    pass  # old/foreign build: pid arrives via summaries
+            if worker.job_id is None:
+                self._bind_worker(worker)
+            job = self._jobs.get(worker.job_id)
+            if job is not None:
+                sock.send_multipart([identity, proto.MSG_SPEC,
+                                     job.spec_payload, self.token])
+            # no job to serve yet: stay silent — the worker re-sends
+            # REGISTER with backoff (its re-sends double as liveness)
             self._update_fleet_gauges()
         elif msg == proto.MSG_READY:
             worker = self._workers.get(identity)
             if worker is not None:
-                worker.ready = True
+                worker.ready = not worker.cordoned
                 worker.last_heartbeat = now
         elif msg == proto.MSG_HEARTBEAT:
             summary = None
@@ -470,15 +757,32 @@ class Dispatcher:
             worker = self._workers.get(identity)
             if worker is None:
                 # A lapsed worker resurfacing (its items were already
-                # re-ventilated): re-admit it with a clean slate — it
-                # already holds the spec and a live decode worker.
+                # re-ventilated): it still holds the spec and a live
+                # decode worker OF THE JOB IT LAPSED FROM, so it may
+                # only re-bind there — never to the least-loaded job,
+                # which under multi-tenancy could be a different spec.
                 worker = _WorkerState(identity, now)
-                worker.ready = not foreign
                 self._workers[identity] = worker
+                lapsed_job = self._jobs.get(
+                    self._lapsed_bindings.pop(identity, None))
+                if foreign:
+                    worker.ready = False
+                elif lapsed_job is not None:
+                    worker.job_id = lapsed_job.job_id
+                    lapsed_job.workers.add(identity)
+                    worker.ready = True
+                else:
+                    # its job is gone (or the binding aged out): STOP it
+                    # back through registration so it picks up a LIVE
+                    # job's spec instead of idling on a dead one
+                    worker.ready = False
+                    self._send_worker(identity, [proto.MSG_STOP])
                 logger.info('Worker %s re-admitted after lapse%s',
                             identity,
                             ' (foreign incarnation; not assignable)'
-                            if foreign else '')
+                            if foreign else
+                            ('' if lapsed_job is not None
+                             else ' (job gone; sent back to register)'))
             else:
                 worker.last_heartbeat = now
                 if foreign:
@@ -511,9 +815,291 @@ class Dispatcher:
             self._fail(identity, item_id, exc, now)
         elif msg == proto.MSG_BYE:
             self._deregister(identity, 'said goodbye')
+        elif msg in (proto.MSG_REGISTER_JOB, proto.MSG_SUBMIT,
+                     proto.MSG_CLIENT_HB, proto.MSG_JOB_GONE):
+            # client frames are OTHER PROCESSES' input: a malformed one
+            # (truncated multipart, unparseable field) must cost that
+            # frame, never the daemon — run()'s catch-all treats an
+            # escaped exception as fatal for every co-tenant job
+            try:
+                self._handle_client_frame(sock, identity, msg, frames,
+                                          now)
+            except Exception:  # noqa: BLE001 - one bad client, not all
+                logger.warning('Malformed client frame %r from %s '
+                               'dropped', msg, identity, exc_info=True)
+                count_swallowed('daemon-malformed-client-frame')
         else:
             logger.warning('Unknown service message type %r from %s',
                            msg, identity)
+
+    def _handle_client_frame(self, sock, identity, msg, frames, now):
+        if msg == proto.MSG_REGISTER_JOB:
+            self._handle_register_job(sock, identity, frames, now)
+        elif msg == proto.MSG_SUBMIT:
+            self._handle_submit(sock, identity, frames, now)
+        elif msg == proto.MSG_CLIENT_HB:
+            self._handle_client_hb(sock, identity, frames, now)
+        elif msg == proto.MSG_JOB_GONE:
+            job = self._job_for_client(identity, frames[2])
+            if job is not None:
+                self._remove_job(job, 'client goodbye')
+
+    # -- client-job handling (the standing-service registry) -----------------
+
+    def _job_for_client(self, identity, job_id_frame):
+        """The registry entry for a client frame, or None (expired /
+        never existed / spoofed identity)."""
+        try:
+            job_id = int(job_id_frame)
+        except (TypeError, ValueError):
+            return None
+        job = self._jobs.get(job_id)
+        if job is None or job.client != identity:
+            return None
+        return job
+
+    def _handle_register_job(self, sock, identity, frames, now):
+        params = proto.load_json_params(frames[3] if len(frames) > 3
+                                        else b'')
+        client_key = params.get('key')
+        # idempotent re-registration: a client whose JOB_OK was lost, who
+        # timed out waiting, or who reconnected on a FRESH socket (new
+        # ZMQ identity after an ack-timeout blip) re-sends REGISTER_JOB
+        # with the same key — answer with the existing job instead of
+        # double-registering. Matching is on the key ALONE (a 32-hex
+        # client-minted uuid): the identity changes with every socket,
+        # so requiring it to match would defeat exactly the reconnect
+        # case; the rebind below points the job's results at the
+        # client's live identity.
+        if client_key:
+            for job in self._jobs.values():
+                if job.client is not None and job.client_key == client_key:
+                    job.client = identity
+                    # reconcile the delivery-credit clock: markers sent
+                    # toward the OLD identity during the blip were
+                    # dropped by the ROUTER and will never be acked —
+                    # left counted, they would inflate the unacked
+                    # window forever (a full window would gate the job
+                    # permanently). Zeroing the window is safe: the
+                    # client re-submits every un-markered item, and
+                    # each re-delivery re-counts. If the identity never
+                    # actually changed (a lost JOB_OK re-send), live
+                    # in-flight markers go briefly under-counted — the
+                    # gate opens LATE by at most one credit window,
+                    # bounded, never wedged.
+                    job.markers_sent = job.markers_acked
+                    # frames backlogged for the old socket must NOT
+                    # flush to the new one: a stale bare marker (its
+                    # result frames died with the old socket) would make
+                    # the client count the item delivered with zero rows
+                    # and drop the re-decoded real delivery. Every
+                    # out-resident item is un-markered client-side, and
+                    # every registration is followed by re-submission —
+                    # dropping the backlog loses nothing.
+                    job.out.clear()
+                    job.last_client_seen = now
+                    sock.send_multipart([identity, proto.MSG_JOB_OK,
+                                         b'%d' % job.job_id, self.token])
+                    return
+        refusal = None
+        if self._draining:
+            refusal = {'reason': 'draining'}
+        elif len(self._jobs) >= self._max_jobs:
+            refusal = {'reason': 'saturated', 'jobs': len(self._jobs),
+                       'max_jobs': self._max_jobs}
+        if refusal is not None:
+            # admission control: a retryable refusal, never an error —
+            # the client backs off and retries within its own deadline
+            sock.send_multipart([identity, proto.MSG_BUSY,
+                                 proto.dump_json_params(refusal)])
+            return
+        lease_s = params.get('lease_s')
+        lease_s = float(lease_s) if lease_s else self._default_lease_s
+        credit = params.get('credit')
+        credit = int(credit) if credit else None
+        with self._lock:
+            self._job_seq += 1
+            job = _Job(self._job_seq, frames[2], client=identity,
+                       client_key=client_key, lease_s=lease_s,
+                       credit=credit, name=params.get('name'))
+            job.last_client_seen = now
+            self._jobs[job.job_id] = job
+        self._jobs_seen += 1
+        logger.info('Job %d (%s) registered; %d active', job.job_id,
+                    job.name, len(self._jobs))
+        tracing.record_instant('job_register', tracing.mint(job.job_id),
+                               'daemon', job=job.job_id,
+                               job_name=job.name)
+        sock.send_multipart([identity, proto.MSG_JOB_OK,
+                             b'%d' % job.job_id, self.token])
+        self._rebalance_for(job)
+        self._update_fleet_gauges()
+
+    def _handle_submit(self, sock, identity, frames, now):
+        job = self._job_for_client(identity, frames[2])
+        if job is None:
+            # the job is gone (lease lapsed, daemon restarted): tell the
+            # client so it can re-register and re-submit what its own
+            # accounting says is still owed — never silently eat work
+            sock.send_multipart([identity, proto.MSG_JOB_EXPIRED,
+                                 frames[2]])
+            return
+        job.last_client_seen = now
+        cid = int(frames[3])
+        if cid in job.live_cids:
+            # a reconnected client re-submitting an item this job still
+            # holds (registration survived the socket reset): one copy
+            # is enough — the client's own cid accounting would drop the
+            # second delivery anyway, so dedup here saves the decode
+            return
+        self.submit(frames[4], job_id=job.job_id, client_item_id=cid)
+
+    def _handle_client_hb(self, sock, identity, frames, now):
+        job = self._job_for_client(identity, frames[2])
+        if job is None:
+            sock.send_multipart([identity, proto.MSG_JOB_EXPIRED,
+                                 frames[2]])
+            return
+        job.last_client_seen = now
+        try:
+            acked = int(frames[3])
+        except (IndexError, ValueError):
+            acked = job.markers_acked
+        # monotonic: a reordered older heartbeat must not re-open credit
+        job.markers_acked = max(job.markers_acked, acked)
+        status = {
+            'workers_alive': sum(
+                1 for w in self._workers.values()
+                if now - w.last_heartbeat <= self._liveness_timeout_s),
+            'workers_registered': len(self._workers),
+            'job_workers': len(job.workers),
+            'jobs_active': len(self._jobs),
+            'pending': len(job.pending),
+            'unacked': job.markers_sent - job.markers_acked,
+            'draining': self._draining,
+        }
+        sock.send_multipart([identity, proto.MSG_CLIENT_HB_ACK, self.token,
+                             proto.dump_json_params(status)])
+
+    def _remove_job(self, job, reason):
+        """Take one job out of the registry: purge its waiting items,
+        reclaim its in-flight work (late completions dedup away), and
+        STOP its workers back into the registration pool so surviving
+        jobs inherit them. Co-tenant jobs are untouched."""
+        with self._lock:
+            # under the lock: submit() (any pool/ventilator thread)
+            # inserts into _item_job concurrently, and iterating it
+            # unlocked would be a dict-changed-size crash in the
+            # scheduler thread
+            owned = {i for i, j in self._item_job.items()
+                     if j == job.job_id}
+            self._jobs.pop(job.job_id, None)
+            purged_pending = len(job.pending)
+            job.pending.clear()
+            job.pending_ids.clear()
+        if self._retry and any(e[2] in owned for e in self._retry):
+            self._retry = [e for e in self._retry if e[2] not in owned]
+            heapq.heapify(self._retry)
+        reclaimed = 0
+        for item_id in owned:
+            self._item_job.pop(item_id, None)
+            entry = self._inflight.pop(item_id, None)
+            if entry is not None:
+                reclaimed += 1
+                owner = self._workers.get(entry[0])
+                if owner is not None:
+                    owner.inflight.discard(item_id)
+                # a late DONE for reclaimed work must dedup away: the
+                # job it belonged to no longer exists to deliver to
+                self._done.add(item_id)
+            self._attempts.pop(item_id, None)
+            self._last_error.pop(item_id, None)
+            self._trace_ctx.pop(item_id, None)
+            self._item_owners.pop(item_id, None)
+        job.client_item_ids.clear()
+        job.live_cids.clear()
+        job.out.clear()
+        for identity in list(job.workers):
+            worker = self._workers.get(identity)
+            if worker is not None:
+                worker.job_id = None
+                worker.ready = False
+                self._send_worker(identity, [proto.MSG_STOP])
+        job.workers.clear()
+        logger.warning('Job %d (%s) removed (%s): %d pending purged, '
+                       '%d in-flight reclaimed', job.job_id, job.name,
+                       reason, purged_pending, reclaimed)
+        tracing.record_instant('job_gone', tracing.mint(job.job_id),
+                               'daemon', job=job.job_id, reason=reason,
+                               pending=purged_pending, inflight=reclaimed)
+        self._update_fleet_gauges()
+
+    def _send_worker(self, identity, frames):
+        """Best-effort dispatcher-thread send to a worker peer."""
+        import zmq
+        if self._sock is None:
+            return
+        try:
+            self._sock.send_multipart([identity] + frames,
+                                      flags=zmq.NOBLOCK)
+        except Exception:  # noqa: BLE001 - peer may be gone
+            count_swallowed('dispatcher-worker-send')
+
+    # -- worker <-> job binding ----------------------------------------------
+
+    def _bind_worker(self, worker):
+        """Bind a fresh/unbound worker to the job that needs it most
+        (fewest bound workers; ties to the oldest job)."""
+        candidates = [job for job in self._jobs.values()]
+        if not candidates:
+            return None
+        job = min(candidates, key=lambda j: (len(j.workers), j.job_id))
+        worker.job_id = job.job_id
+        job.workers.add(worker.identity)
+        return job
+
+    def _rebalance_for(self, needy_job):
+        """A newly-registered job with zero workers steals ONE idle
+        worker from the best-served job (STOP → the worker re-registers
+        with a fresh identity and lands on the needy job via
+        :meth:`_bind_worker`); further convergence happens one worker
+        per sweep, bounding churn."""
+        if needy_job.workers:
+            return
+        self._rebalance_step()
+
+    def _rebalance_step(self):
+        """At most one worker moves per call: find the most-served and
+        least-served jobs; when the gap exceeds one worker (or the
+        least-served has none), STOP one IDLE worker of the donor. Idle
+        only: STOPping a busy worker would re-ventilate its items and
+        charge their retry budgets for a scheduling decision."""
+        jobs = list(self._jobs.values())
+        if len(jobs) < 2:
+            return
+        donor = max(jobs, key=lambda j: len(j.workers))
+        needy = min(jobs, key=lambda j: len(j.workers))
+        starved = len(needy.workers) == 0 and (len(donor.workers) >= 2
+                                               or bool(needy.pending))
+        if len(donor.workers) - len(needy.workers) < 2 and not starved:
+            # a zero-worker job WITH pending work may steal an idle
+            # worker even from a one-worker donor: with more jobs than
+            # workers that degenerates to time-multiplexing at sweep
+            # cadence (the donor steals back when ITS queue is the
+            # starved one) — crude, but strictly better than the 9th
+            # job wedging against a fully-partitioned fleet
+            return
+        for identity in list(donor.workers):
+            worker = self._workers.get(identity)
+            if worker is None or worker.inflight or worker.cordoned:
+                continue
+            worker.job_id = None
+            worker.ready = False
+            donor.workers.discard(identity)
+            self._send_worker(identity, [proto.MSG_STOP])
+            logger.info('Rebalancing: moved worker %s off job %d toward '
+                        'job %d', identity, donor.job_id, needy.job_id)
+            return
 
     def _merge_metrics(self, frame):
         """Fold one worker server's piggybacked telemetry delta into this
@@ -536,8 +1122,10 @@ class Dispatcher:
             worker.last_heartbeat = now
             worker.inflight.discard(item_id)
         if item_id in self._done:
-            # Duplicate completion from a lapsed-then-reassigned race; the
-            # first DONE already delivered this item's rows.
+            # Duplicate completion from a lapsed-then-reassigned race (or
+            # a late completion of lease-reclaimed work); the first DONE
+            # already delivered this item's rows — or the job that owned
+            # them was already declared gone.
             logger.debug('Dropping duplicate completion of item %d from %s',
                          item_id, identity)
             self._duplicate_done_count += 1
@@ -551,6 +1139,18 @@ class Dispatcher:
                     'duplicate_done', dup_entry.ctx, 'dispatcher',
                     worker=identity.decode('utf-8', 'replace'))
             return
+        if identity not in self._item_owners.get(item_id, ()):
+            # a completion from a worker this dispatcher NEVER assigned
+            # the item to: stale cross-incarnation traffic (a restarted
+            # daemon's id space collides with its predecessor's) — the
+            # rows belong to some OTHER item/job and accepting them
+            # would be silent duplication plus silent loss
+            logger.warning('Dropping completion of item %d from %s: not '
+                           'an owner (stale cross-incarnation frame?)',
+                           item_id, identity)
+            count_swallowed('dispatcher-stale-completion')
+            return
+        job = self._jobs.get(self._item_job.get(item_id))
         assignment = self._inflight.pop(item_id, None)
         if assignment is None:
             # Ghost completion: the item lapsed back onto the pending queue
@@ -565,6 +1165,13 @@ class Dispatcher:
             owner = self._workers.get(assignment[0])
             if owner is not None:
                 owner.inflight.discard(item_id)
+        if job is None:
+            # unreachable in practice (jobless live items are purged with
+            # their job), kept as a loud guard instead of a KeyError in
+            # the scheduler thread
+            logger.warning('Completion of item %d belongs to no live job',
+                           item_id)
+            return
         # a delivered completion clears the item's suspect record: its
         # budget was for THIS traversal, and innocent items that shared a
         # dying worker must not carry the black mark forever
@@ -590,25 +1197,82 @@ class Dispatcher:
                 worker=identity.decode('utf-8', 'replace'),
                 attempts=trace_entry.attempts, outcome=outcome[0])
         self._completed_count += 1
+        self._item_job.pop(item_id, None)
+        self._item_owners.pop(item_id, None)
+        job.completed += 1
         kind, payload = outcome
         if kind == 'result':
             for result_frame in payload:
-                self._emit(('result', result_frame))
+                self._emit(job, item_id, ('result', result_frame))
         else:
-            self._emit(('error', payload))
-        self._emit(('marker', item_id))
+            self._emit(job, item_id, ('error', payload))
+        self._emit(job, item_id, ('marker', item_id))
 
-    def _emit(self, entry):
-        """Hand one entry toward the consumer, preserving order: direct
-        only while the backlog is empty AND the queue has room."""
-        if self._out_backlog or not self._deliver(entry):
-            self._out_backlog.append(entry)
+    # -- delivery (local callback or client RESULT frames) -------------------
 
-    def _flush_backlog(self):
-        while self._out_backlog:
-            if not self._deliver(self._out_backlog[0]):
-                return
-            self._out_backlog.popleft()
+    def _emit(self, job, item_id, entry):
+        """Hand one entry toward ``job``'s consumer, preserving order:
+        direct only while the job's backlog is empty AND the destination
+        (bounded queue / socket) has room."""
+        if job.is_local:
+            if job.out or not self._deliver(entry):
+                job.out.append(entry)
+            return
+        kind = entry[0]
+        cid = job.client_item_ids.get(item_id)
+        if kind == 'marker':
+            job.client_item_ids.pop(item_id, None)
+            if cid is not None:
+                job.live_cids.discard(cid)
+            job.markers_sent += 1
+        cid_frame = b'%d' % (cid if cid is not None else -1)
+        if kind == 'result':
+            frames = [proto.MSG_RESULT, b'result', cid_frame, entry[1]]
+        elif kind == 'error':
+            frames = [proto.MSG_RESULT, b'error', cid_frame,
+                      proto.dump_exception(entry[1])]
+        elif kind == 'poisoned':
+            frames = [proto.MSG_RESULT, b'poisoned', cid_frame,
+                      proto.dump_poisoned_info(entry[1])]
+        else:
+            frames = [proto.MSG_RESULT, b'marker', cid_frame]
+        if job.out or not self._send_client(job, frames):
+            job.out.append(frames)
+
+    def _send_client(self, job, frames):
+        """Non-blocking RESULT send toward a client job; False when the
+        socket's peer pipe is momentarily full (the frames then wait in
+        the job's backlog — rare: credit gating keeps in-flight results
+        far below the HWM)."""
+        import zmq
+        if self._sock is None:
+            return True  # shutting down: accounting no longer matters
+        try:
+            self._sock.send_multipart([job.client] + frames,
+                                      flags=zmq.NOBLOCK)
+            return True
+        except zmq.Again:
+            return False
+        except Exception:  # noqa: BLE001 - peer gone; lease will reap
+            count_swallowed('daemon-client-send')
+            return True
+
+    def _local_backlogged(self):
+        job = self._jobs.get(LOCAL_JOB_ID)
+        return bool(job is not None and job.out)
+
+    def _flush_backlogs(self):
+        for job in list(self._jobs.values()):
+            if job.is_local:
+                while job.out:
+                    if not self._deliver(job.out[0]):
+                        break
+                    job.out.popleft()
+            else:
+                while job.out:
+                    if not self._send_client(job, job.out[0]):
+                        break
+                    job.out.popleft()
 
     # -- failure handling: retry budget, backoff, quarantine -----------------
 
@@ -616,12 +1280,15 @@ class Dispatcher:
         """Remove a waiting (pending or backoff-heap) copy of ``item_id``
         after a ghost completion delivered it; False when no copy was
         waiting (a genuinely unknown completion)."""
+        job = self._jobs.get(self._item_job.get(item_id))
+        if job is None:
+            return False
         with self._lock:
-            if item_id not in self._pending_ids:
+            if item_id not in job.pending_ids:
                 return False
-            self._pending_ids.discard(item_id)
-            self._pending = collections.deque(
-                (i, p) for i, p in self._pending if i != item_id)
+            job.pending_ids.discard(item_id)
+            job.pending = collections.deque(
+                (i, p) for i, p in job.pending if i != item_id)
         if any(entry[2] == item_id for entry in self._retry):
             self._retry = [entry for entry in self._retry
                            if entry[2] != item_id]
@@ -668,12 +1335,15 @@ class Dispatcher:
     def _record_failure(self, item_id, payload, reason, exc, now):
         """Charge one failed attempt. Under budget: backoff-requeue.
         Budget exhausted: quarantine."""
+        job = self._jobs.get(self._item_job.get(item_id))
+        if job is None:
+            return  # the owning job is gone; nothing left to retry FOR
         attempt = self._attempts.get(item_id, 0) + 1
         self._attempts[item_id] = attempt
         if exc is not None:
             self._last_error[item_id] = exc
         if attempt >= self._max_retries:
-            self._quarantine(item_id, reason, now)
+            self._quarantine(job, item_id, reason, now)
             return
         delay = (self._retry_backoff_s * (2 ** (attempt - 1))
                  * self._jitter(item_id, attempt))
@@ -681,7 +1351,7 @@ class Dispatcher:
                        (now + delay, self._retry_seq, item_id, payload))
         self._retry_seq += 1
         with self._lock:
-            self._pending_ids.add(item_id)
+            job.pending_ids.add(item_id)
         self._retried_count += 1
         if not metrics_disabled():
             get_registry().counter(SERVICE_RETRIES).inc()
@@ -694,7 +1364,7 @@ class Dispatcher:
                        '%.3fs', item_id, attempt, self._max_retries,
                        reason, delay)
 
-    def _quarantine(self, item_id, reason, now):
+    def _quarantine(self, job, item_id, reason, now):
         """Retry budget exhausted: skip the item, record it, surface it.
         The consumer receives a ``('poisoned', info)`` entry (policy
         applied pool-side) plus the accounting marker, so the epoch
@@ -712,6 +1382,7 @@ class Dispatcher:
         descriptor = {'item_id': item_id, 'attempts': attempts,
                       'reason': reason,
                       'error': repr(exc) if exc is not None else None,
+                      'job_id': job.job_id,
                       'quarantined_at': time.time()}
         self._poisoned[item_id] = descriptor
         while len(self._poisoned) > _POISONED_KEEP:
@@ -727,13 +1398,16 @@ class Dispatcher:
             tracing.record_instant('poisoned', trace_entry.ctx,
                                    'dispatcher', attempts=attempts,
                                    reason=reason)
-        self._emit(('poisoned', info))
-        self._emit(('marker', item_id))
+        self._item_job.pop(item_id, None)
+        self._item_owners.pop(item_id, None)
+        job.completed += 1
+        self._emit(job, item_id, ('poisoned', info))
+        self._emit(job, item_id, ('marker', item_id))
 
     def _promote_due_retries(self, now):
-        """Move backoff-expired retries to the FRONT of the pending queue
-        (oldest first): lapsed work is the oldest and gates epoch
-        completion through the ventilator's in-flight bound."""
+        """Move backoff-expired retries to the FRONT of their job's
+        pending queue (oldest first): lapsed work is the oldest and gates
+        epoch completion through the ventilator's in-flight bound."""
         due = []
         while self._retry and self._retry[0][0] <= now:
             _, _, item_id, payload = heapq.heappop(self._retry)
@@ -741,37 +1415,40 @@ class Dispatcher:
         if due:
             with self._lock:
                 for item_id, payload in reversed(due):
-                    if item_id in self._pending_ids:
-                        self._pending.appendleft((item_id, payload))
+                    job = self._jobs.get(self._item_job.get(item_id))
+                    if job is not None and item_id in job.pending_ids:
+                        job.pending.appendleft((item_id, payload))
 
-    def _pop_assignable(self, allow_suspect):
-        """Pop the leftmost assignable pending item. Suspects (items with
-        a failed attempt) are skipped unless ``allow_suspect`` — they are
-        only ever assigned alone to an idle worker."""
+    def _pop_assignable(self, job, allow_suspect):
+        """Pop the leftmost assignable pending item of ``job``. Suspects
+        (items with a failed attempt) are skipped unless
+        ``allow_suspect`` — they are only ever assigned alone to an idle
+        worker."""
         with self._lock:
-            for idx in range(len(self._pending)):
-                item_id, payload = self._pending[idx]
+            for idx in range(len(job.pending)):
+                item_id, payload = job.pending[idx]
                 if not allow_suspect and item_id in self._attempts:
                     continue
-                del self._pending[idx]
-                self._pending_ids.discard(item_id)
+                del job.pending[idx]
+                job.pending_ids.discard(item_id)
                 return item_id, payload
         return None
 
     # -- scheduling ----------------------------------------------------------
 
     def _assign(self, sock):
-        if self._out_backlog:
-            # The consumer is stalled; assigning more work would just grow
-            # the backlog unboundedly. Workers idle (heartbeating, acked)
-            # until the consumer drains — quiescence, not decay.
-            return
         self._promote_due_retries(time.monotonic())
         # Least-loaded first, so a fresh (or re-admitted) worker fills up
-        # before busy ones receive more.
+        # before busy ones receive more. Each worker draws ONLY from the
+        # job it was built for; a gated job (stalled local consumer /
+        # spent client credit) idles its slice of the fleet — quiescence,
+        # not decay — while co-tenant jobs keep flowing.
         workers = sorted((w for w in self._workers.values() if w.ready),
                          key=lambda w: len(w.inflight))
         for worker in workers:
+            job = self._jobs.get(worker.job_id)
+            if job is None or job.gated():
+                continue
             if any(i in self._attempts for i in worker.inflight):
                 # suspect isolation: a worker running a retried item gets
                 # NOTHING else — if the item kills it, it dies alone and
@@ -779,7 +1456,7 @@ class Dispatcher:
                 continue
             while len(worker.inflight) < self._max_inflight_per_worker:
                 popped = self._pop_assignable(
-                    allow_suspect=not worker.inflight)
+                    job, allow_suspect=not worker.inflight)
                 if popped is None:
                     break
                 item_id, payload = popped
@@ -794,6 +1471,8 @@ class Dispatcher:
                                          payload])
                 self._inflight[item_id] = (worker.identity, payload)
                 worker.inflight.add(item_id)
+                self._item_owners.setdefault(item_id,
+                                             set()).add(worker.identity)
                 entry = self._trace_ctx.get(item_id)
                 if entry is not None:
                     entry.attempts += 1
@@ -806,10 +1485,37 @@ class Dispatcher:
 
     def _sweep(self, now):
         for identity, worker in list(self._workers.items()):
-            if now - worker.last_heartbeat > self._liveness_timeout_s:
+            window = self._liveness_timeout_s if worker.job_id is not None \
+                else max(self._liveness_timeout_s,
+                         _UNBOUND_LIVENESS_FLOOR_S)
+            if now - worker.last_heartbeat > window:
                 self._deregister(
                     identity, 'heartbeat lapsed (%.1fs > %.1fs)'
-                    % (now - worker.last_heartbeat, self._liveness_timeout_s))
+                    % (now - worker.last_heartbeat, window))
+        # job leases: a client that died without a goodbye stops
+        # submitting AND heartbeating — reclaim its job so the fleet
+        # serves the living (docs/service.md, "Standing service")
+        for job in [j for j in list(self._jobs.values())
+                    if not j.is_local and j.lease_s]:
+            silent_s = now - job.last_client_seen
+            if silent_s > job.lease_s:
+                self._jobs_expired += 1
+                # count in-flight via _inflight (dispatcher-thread-only;
+                # iterating _item_job here would race submit()'s
+                # under-lock inserts from pool threads)
+                inflight_n = sum(
+                    1 for iid in self._inflight
+                    if self._item_job.get(iid) == job.job_id)
+                record_anomaly('job_lease_expired', detail={
+                    'job_id': job.job_id, 'name': job.name,
+                    'silent_s': round(silent_s, 3),
+                    'lease_s': job.lease_s,
+                    'pending': len(job.pending),
+                    'inflight': inflight_n})
+                self._remove_job(
+                    job, 'lease expired (%.1fs > %.1fs silent)'
+                    % (silent_s, job.lease_s))
+        self._rebalance_step()
         # age out trace entries retained past completion for dedup marking
         # (see _complete): a ghost DONE races within ZMQ buffering of one
         # lapse, so several liveness timeouts is a generous window
@@ -820,12 +1526,17 @@ class Dispatcher:
         for item_id in stale:
             self._trace_ctx.pop(item_id, None)
         with self._lock:
-            outstanding = bool(self._pending) or bool(self._inflight) \
-                or bool(self._retry)
+            outstanding = self._pending_total_locked() > 0 \
+                or bool(self._inflight) or bool(self._retry)
         if outstanding and not self._workers:
             if self._no_workers_since is None:
                 self._no_workers_since = now
-            elif now - self._no_workers_since > self._no_workers_timeout_s:
+            elif not self._standing \
+                    and now - self._no_workers_since \
+                    > self._no_workers_timeout_s:
+                # embedded pools fail fast; a STANDING dispatcher keeps
+                # serving — zero workers is the supervisor's condition to
+                # repair (respawn), not a reason to take jobs down
                 raise RuntimeError(
                     'No live worker servers for %.1fs with work outstanding; '
                     'is the dispatcher endpoint (%s) reachable from the '
@@ -833,11 +1544,22 @@ class Dispatcher:
         else:
             self._no_workers_since = None
 
+    _LAPSED_BINDINGS_KEEP = 512
+
     def _deregister(self, identity, reason):
         worker = self._workers.pop(identity, None)
         self._worker_obs.pop(identity, None)
         if worker is None:
             return
+        job = self._jobs.get(worker.job_id)
+        if job is not None:
+            job.workers.discard(identity)
+            # remember the binding: if this worker resurfaces (it was
+            # stalled, not dead) it must re-bind HERE — it still runs
+            # this job's spec
+            self._lapsed_bindings[identity] = worker.job_id
+            while len(self._lapsed_bindings) > self._LAPSED_BINDINGS_KEEP:
+                self._lapsed_bindings.popitem(last=False)
         now = time.monotonic()
         reventilated = 0
         for item_id in worker.inflight:

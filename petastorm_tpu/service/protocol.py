@@ -13,6 +13,18 @@ The DEALER side sends ``[TYPE, ...]``; the ROUTER side sees
     ERROR <item id> <exc payload> <metrics>
     BYE
 
+A *standing* daemonized dispatcher (docs/service.md, "Standing
+service") additionally speaks a client vocabulary on the SAME ROUTER
+socket — clients are DEALER peers exactly like workers, told apart by
+message type alone:
+
+    client ──► daemon                          daemon ──► client
+    REGISTER_JOB <spec> <params json>          JOB_OK <job id> <token>
+                                               BUSY <info json>  (retryable)
+    SUBMIT <job id> <client item id> <item>    RESULT <kind> <cid> <payload>*
+    CLIENT_HB <job id> <acked count>           CLIENT_HB_ACK <token> <status>
+    JOB_GONE <job id>                          JOB_EXPIRED <job id>
+
 The optional trailing ``<token>`` frames carry the dispatcher
 *incarnation token* (random per Dispatcher instance). A worker
 remembers the token its SPEC carried, echoes it on every HEARTBEAT (its
@@ -74,6 +86,26 @@ MSG_SPEC = b'SPEC'
 MSG_WORK = b'WORK'
 MSG_STOP = b'STOP'
 MSG_HEARTBEAT_ACK = b'HBACK'
+
+# client -> daemonized dispatcher (docs/service.md, "Standing service").
+# These frames are ADDITIVE: a standing daemon still speaks the whole
+# worker vocabulary above unchanged (an old-build worker server needs no
+# REGISTER_JOB awareness), and an old embedded dispatcher that receives
+# one of these simply logs an unknown message type — both directions
+# stay compatible with frame-less builds.
+MSG_REGISTER_JOB = b'REGJOB'     # [REGJOB, <spec payload>, <params json>]
+MSG_SUBMIT = b'SUBMIT'           # [SUBMIT, <job id>, <client item id>, <payload>]
+MSG_CLIENT_HB = b'CHB'           # [CHB, <job id>, <acked count>]
+MSG_JOB_GONE = b'JOBGONE'        # [JOBGONE, <job id>]
+
+# daemonized dispatcher -> client
+MSG_JOB_OK = b'JOBOK'            # [JOBOK, <job id>, <token>]
+MSG_BUSY = b'BUSY'               # [BUSY, <info json>] — retryable refusal
+MSG_JOB_EXPIRED = b'JOBEXP'      # [JOBEXP, <job id>] — lease lapsed / unknown
+MSG_CLIENT_HB_ACK = b'CHBACK'    # [CHBACK, <token>, <status json>]
+MSG_RESULT = b'RES'              # [RES, <kind>, <client item id>, <payload>*]
+# MSG_RESULT's kind frame carries b'result' / b'error' / b'marker' /
+# b'poisoned' — the wire form of the dispatcher's local delivery tuples
 
 
 def pack_item_id(item_id):
@@ -162,6 +194,67 @@ def load_obs_summary(frame):
     except Exception:  # noqa: BLE001 - telemetry is advisory
         return None
     return summary if isinstance(summary, dict) else None
+
+
+def dump_poisoned_info(info):
+    """Frame a quarantine descriptor for a client job's RESULT channel.
+    dill-first so ``poison_policy='raise'`` can surface the ORIGINAL
+    worker exception on the client; an unpicklable member degrades the
+    ``error`` field to its repr (the loss is cosmetic — the quarantine
+    itself, the attempts count and the reason always arrive)."""
+    import dill
+
+    try:
+        return dill.dumps(info)
+    except Exception:  # noqa: BLE001 - unpicklable member
+        from petastorm_tpu.telemetry import count_swallowed
+        count_swallowed('poisoned-info-pickle')
+        degraded = dict(info)
+        if degraded.get('error') is not None:
+            degraded['error'] = RuntimeError(repr(degraded['error']))
+        try:
+            return dill.dumps(degraded)
+        except Exception:  # noqa: BLE001 - give the client SOMETHING
+            return dill.dumps({'item_id': info.get('item_id'),
+                               'attempts': info.get('attempts'),
+                               'reason': str(info.get('reason')),
+                               'error': None})
+
+
+def load_poisoned_info(payload):
+    import dill
+
+    return dill.loads(payload)
+
+
+def dump_json_params(params):
+    """Frame a small scalar dict (job params, BUSY info, heartbeat-ack
+    status) as JSON — NOT dill: these frames cross trust-relevant
+    client/daemon boundaries where arbitrary-code payloads are reserved
+    for the job spec alone, and the daemon must be able to serve them to
+    an HTTP scrape verbatim. Errors degrade to ``b'{}'``."""
+    import json
+
+    try:
+        return json.dumps(params or {}).encode()
+    except Exception:  # noqa: BLE001 - params are advisory metadata
+        from petastorm_tpu.telemetry import count_swallowed
+        count_swallowed('json-params-encode')
+        return b'{}'
+
+
+def load_json_params(frame):
+    """Inverse of :func:`dump_json_params`; ``{}`` for empty, undecodable
+    or non-dict frames (a missing param falls back to its default)."""
+    if not frame:
+        return {}
+    import json
+
+    try:
+        params = json.loads(frame)
+    except Exception:  # noqa: BLE001 - advisory metadata
+        return {}
+    return params if isinstance(params, dict) else {}
 
 
 def free_tcp_port(host='127.0.0.1'):

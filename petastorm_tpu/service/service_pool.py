@@ -52,6 +52,45 @@ _JOIN_TIMEOUT_S = 10.0
 _WORKERS_COUNT_HINT = 4
 
 
+def apply_poison_policy(pool, info, health_owner):
+    """Shared consumer-side handling of one quarantined-item delivery —
+    the ONE implementation of ``poison_policy`` semantics for both
+    service pool flavors (embedded :class:`ServicePool` and the standing
+    service's :class:`~petastorm_tpu.service.daemon.DaemonClientPool`),
+    so the policy can never drift between topologies.
+
+    ``'skip'`` records the descriptor on ``pool.poisoned_items`` and
+    reads on (the item's marker keeps the accounting exact, so the
+    epoch ends with the loss reported, not wedged); ``'raise'``
+    surfaces the poison — the original worker exception when the
+    failures carried one, else :class:`RowGroupPoisonedError` — after
+    stopping the pool. ``health_owner`` names where the operator finds
+    the quarantine ledger (the error message's pointer)."""
+    descriptor = {k: (repr(v) if k == 'error' and v is not None else v)
+                  for k, v in info.items()}
+    pool.poisoned_items.append(descriptor)
+    if pool.poison_policy == 'skip':
+        logger.warning(
+            'Skipping quarantined item %s after %s attempt(s) (%s) — '
+            "poison_policy='skip'", info.get('item_id'),
+            info.get('attempts'), info.get('reason'))
+        return
+    error = info.get('error')
+    if error is None:
+        error = RowGroupPoisonedError(
+            'Service work item %s was quarantined after %s failed '
+            'attempt(s) (%s). Its workers died without reporting an '
+            'exception; see %s `poisoned` list. '
+            "Pass poison_policy='skip' to read past quarantined "
+            'row-groups.' % (info.get('item_id'), info.get('attempts'),
+                             info.get('reason'), health_owner),
+            info=descriptor)
+    pool._error = error
+    pool.stop()
+    pool.join()
+    raise pool._error
+
+
 class ServicePool:
     """Client pool backed by remote worker servers over ``tcp://``."""
 
@@ -339,35 +378,8 @@ class ServicePool:
 
     def _note_poisoned(self, info):
         """One quarantined item reached this consumer: apply the
-        ``poison_policy``. ``'skip'`` records and reads on (the item's
-        marker keeps the accounting exact, so the epoch ends with the
-        loss reported, not wedged); ``'raise'`` surfaces the poison —
-        the original worker exception when the failures carried one,
-        else :class:`RowGroupPoisonedError`."""
-        descriptor = {k: (repr(v) if k == 'error' and v is not None else v)
-                      for k, v in info.items()}
-        self.poisoned_items.append(descriptor)
-        if self.poison_policy == 'skip':
-            logger.warning(
-                'Skipping quarantined item %s after %s attempt(s) (%s) — '
-                "poison_policy='skip'", info.get('item_id'),
-                info.get('attempts'), info.get('reason'))
-            return
-        error = info.get('error')
-        if error is None:
-            error = RowGroupPoisonedError(
-                'Service work item %s was quarantined after %s failed '
-                'attempt(s) (%s). Its workers died without reporting an '
-                "exception; see the dispatcher's /health `poisoned` list. "
-                "Pass poison_policy='skip' to read past quarantined "
-                'row-groups.' % (info.get('item_id'),
-                                 info.get('attempts'),
-                                 info.get('reason')),
-                info=descriptor)
-        self._error = error
-        self.stop()
-        self.join()
-        raise self._error
+        ``poison_policy`` (shared semantics: :func:`apply_poison_policy`)."""
+        apply_poison_policy(self, info, "the dispatcher's /health")
 
     def _check_read_deadline(self):
         """Raise the diagnosable wedge error when no entry reached this

@@ -42,6 +42,7 @@ import argparse
 import logging
 import os
 import queue
+import signal
 import sys
 import threading
 import time
@@ -68,21 +69,29 @@ def _parent_died(parent_pid):
     return not psutil.pid_exists(parent_pid)
 
 
-def _register(sock, parent_pid, register_timeout_s):
+def _register(sock, parent_pid, register_timeout_s, term_event=None):
     """REGISTER with exponential backoff until the SPEC arrives.
 
     Returns ``(spec payload, dispatcher token)`` — token None from a
     pre-token dispatcher build — or ``(None, None)`` when the server
-    should exit (orphaned, or the registration window closed).
+    should exit (orphaned, SIGTERMed, or the registration window
+    closed).
     """
     backoff_s = 0.1
     deadline = (None if register_timeout_s is None
                 else time.monotonic() + register_timeout_s)
     last_parent_check = 0.0
     while True:
-        sock.send_multipart([proto.MSG_REGISTER])
+        # the trailing pid frame is ADVISORY and additive (an old
+        # dispatcher ignores extra REGISTER frames): it lets a standing
+        # daemon's supervisor tell a worker that is merely between jobs
+        # (re-registering, not yet heartbeating) from a wedged one
+        sock.send_multipart([proto.MSG_REGISTER, b'%d' % os.getpid()])
         poll_deadline = time.monotonic() + backoff_s
         while time.monotonic() < poll_deadline:
+            if term_event is not None and term_event.is_set():
+                logger.info('SIGTERM during registration; exiting')
+                return None, None
             if sock.poll(_POLL_INTERVAL_MS):
                 frames = sock.recv_multipart()
                 if frames[0] == proto.MSG_SPEC:
@@ -127,7 +136,8 @@ def _reroot_decoded_cache(worker_args):
 
 
 def _run_job(sock, spec_payload, worker_id, heartbeat_interval_s,
-             ack_timeout_s, parent_pid, status=None, token=None):
+             ack_timeout_s, parent_pid, status=None, token=None,
+             term_event=None):
     """One job lifetime: build the worker, stream items until STOP, the
     dispatcher vanishes (ack timeout), or a DIFFERENT dispatcher
     incarnation takes the endpoint (heartbeat-ack token mismatch).
@@ -267,6 +277,14 @@ def _run_job(sock, spec_payload, worker_id, heartbeat_interval_s,
                     logger.info('Parent %s died; exiting', parent_pid)
                     serve_again = False
                     break
+            if term_event is not None and term_event.is_set():
+                # graceful release (the supervisor's scale-down path):
+                # stop taking work, say BYE, exit — never a heartbeat
+                # lapse, so nothing is re-ventilated for a scaling
+                # decision
+                logger.info('SIGTERM: finishing job and exiting')
+                serve_again = False
+                break
     finally:
         stop_flag.set()
         executor_thread.join(_EXECUTOR_JOIN_TIMEOUT_S)
@@ -298,6 +316,17 @@ def serve(endpoint, worker_id=0, heartbeat_interval_s=1.0,
 
     if ack_timeout_s is None:
         ack_timeout_s = max(10 * heartbeat_interval_s, 10.0)
+    # graceful SIGTERM (the supervisor's release path, and any process
+    # manager's polite stop): finish the in-flight item, send BYE, exit
+    # — instead of the default instant death that reads as a lapse and
+    # re-ventilates work. Signal handlers only install on the main
+    # thread; an embedded serve() (tests) just skips the grace.
+    term_event = threading.Event()
+    try:
+        signal.signal(signal.SIGTERM,
+                      lambda signum, frame: term_event.set())
+    except ValueError:
+        pass
     # live observability plane: a worker server exposes its OWN /metrics
     # /report /health /trace when PETASTORM_TPU_OBS_PORT is set (use 0 —
     # ephemeral — for multi-worker hosts; the bound port rides every
@@ -322,14 +351,15 @@ def serve(endpoint, worker_id=0, heartbeat_interval_s=1.0,
             try:
                 status['state'] = 'registering'
                 spec_payload, token = _register(sock, parent_pid,
-                                                register_timeout_s)
+                                                register_timeout_s,
+                                                term_event=term_event)
                 if spec_payload is None:
                     return
                 status['state'] = 'serving'
                 serve_again = _run_job(sock, spec_payload, worker_id,
                                        heartbeat_interval_s, ack_timeout_s,
                                        parent_pid, status=status,
-                                       token=token)
+                                       token=token, term_event=term_event)
                 status['jobs_served'] += 1
                 try:
                     sock.send_multipart([proto.MSG_BYE])

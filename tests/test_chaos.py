@@ -159,8 +159,9 @@ def test_ghost_error_from_prior_owner_does_not_cancel_live_assignment():
     now = time.monotonic()
     live = _WorkerState(b'B', now)
     d._workers[b'B'] = live
-    d._pending.clear()
-    d._pending_ids.clear()
+    local_job = d._jobs[0]
+    local_job.pending.clear()
+    local_job.pending_ids.clear()
     d._inflight[item] = (b'B', b'payload')
     live.inflight.add(item)
     d._fail(b'A', item, ValueError('late ghost'), now)

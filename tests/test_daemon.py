@@ -1,0 +1,844 @@
+"""Standing decode service tests: daemonized dispatcher, job registry,
+leases, supervisor self-healing, and the chaos drills of docs/service.md
+("Standing service").
+
+Timing mirrors tests/test_service.py: tight heartbeats so failures are
+detected in well under a second, generous outer deadlines so slow CI
+never flakes, and every ``get_results`` call bounded internally (no
+pytest-timeout in this environment)."""
+
+import collections
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from petastorm_tpu import faults, telemetry
+from petastorm_tpu.serializers import PickleSerializer
+from petastorm_tpu.service import protocol as proto
+from petastorm_tpu.service.daemon import DaemonClientPool, ServiceDaemon
+from petastorm_tpu.service.protocol import free_tcp_port
+from petastorm_tpu.service.supervisor import WorkerSupervisor
+from petastorm_tpu.workers import EmptyResultError
+from tests.stub_workers import IdentityWorker, SleepyIdentityWorker
+
+pytestmark = pytest.mark.service
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# tight-but-safe: lapse detection well under a second; outer deadlines
+# generous so shared-box scheduling noise cannot flake the suite
+_HB = 0.15
+_TICK = 0.15
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry_and_faults():
+    telemetry.reset_for_tests()
+    yield
+    os.environ.pop('PETASTORM_TPU_FAULTS', None)
+    faults.refresh_faults()
+    assert faults.ARMED is None
+    telemetry.reset_for_tests()
+
+
+def _drain(pool, per_result_timeout_s=60):
+    out = []
+    while True:
+        try:
+            out.append(pool.get_results(timeout=per_result_timeout_s))
+        except EmptyResultError:
+            return out
+
+
+def _make_daemon(workers=2, **kwargs):
+    kwargs.setdefault('heartbeat_interval_s', _HB)
+    kwargs.setdefault('supervisor_tick_s', _TICK)
+    daemon = ServiceDaemon('tcp://127.0.0.1:0', initial_workers=workers,
+                           **kwargs)
+    daemon.start()
+    return daemon
+
+
+def _client(endpoint, **kwargs):
+    kwargs.setdefault('heartbeat_interval_s', _HB)
+    return DaemonClientPool(endpoint, **kwargs)
+
+
+def _await(predicate, deadline_s=30, interval_s=0.05, message='condition'):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(interval_s)
+    raise AssertionError('timed out waiting for %s' % message)
+
+
+# -- multi-job registry -------------------------------------------------------
+
+
+def test_two_jobs_share_one_fleet_exact_delivery():
+    """The registry core: two concurrent client jobs on ONE daemonized
+    fleet each receive their exact row multiset — no loss, no
+    duplication, no cross-job leakage — and the fleet is partitioned
+    across them (both jobs hold workers while both run)."""
+    daemon = _make_daemon(workers=2)
+    a = _client(daemon.endpoint, name='job-a')
+    b = _client(daemon.endpoint, name='job-b')
+    try:
+        a.start(SleepyIdentityWorker)
+        b.start(SleepyIdentityWorker)
+        for i in range(30):
+            a.ventilate(i, sleep_s=0.005)
+        for i in range(100, 130):
+            b.ventilate(i, sleep_s=0.005)
+        # both jobs hold a slice of the fleet while both are live
+        _await(lambda: all(
+            j['workers'] >= 1
+            for j in daemon.dispatcher.health()['jobs']),
+            message='fleet partitioned across jobs')
+        got_a = sorted(_drain(a))
+        got_b = sorted(_drain(b))
+        assert got_a == list(range(30))
+        assert got_b == list(range(100, 130))
+        stats = daemon.dispatcher.stats()
+        assert stats['jobs_active'] == 2
+        assert stats['jobs_seen'] == 2
+    finally:
+        for pool in (a, b):
+            pool.stop()
+            pool.join()
+        # clean goodbyes reclaim both jobs without waiting out a lease
+        _await(lambda: daemon.dispatcher.active_jobs() == 0,
+               message='jobs reclaimed after goodbye')
+        daemon.stop()
+
+
+def test_reader_reads_through_standing_daemon(tmp_path, monkeypatch):
+    """Acceptance: ``make_batch_reader(url, reader_pool_type='service')``
+    with ``PETASTORM_TPU_SERVICE_DAEMON`` set delivers the identical row
+    multiset as a thread-pool read — twice, off one standing daemon (two
+    reader lifetimes, zero fleet restarts)."""
+    from petastorm_tpu.reader import make_batch_reader
+    from tests.test_common import create_test_scalar_dataset
+    url = 'file://' + str(tmp_path / 'dataset')
+    create_test_scalar_dataset(url, num_rows=50, num_files=5)
+
+    def read_ids(pool_type):
+        ids = collections.Counter()
+        with make_batch_reader(url, reader_pool_type=pool_type,
+                               num_epochs=1,
+                               shuffle_row_groups=False) as reader:
+            for batch in reader:
+                ids.update(int(x) for x in batch.id)
+        return ids
+
+    expected = read_ids('thread')
+    assert sum(expected.values()) == 50
+    daemon = _make_daemon(workers=2)
+    try:
+        monkeypatch.setenv('PETASTORM_TPU_SERVICE_DAEMON',
+                           daemon.endpoint)
+        assert read_ids('service') == expected
+        assert read_ids('service') == expected  # second reader lifetime
+        assert daemon.dispatcher.stats()['jobs_seen'] == 2
+    finally:
+        monkeypatch.delenv('PETASTORM_TPU_SERVICE_DAEMON', raising=False)
+        daemon.stop()
+
+
+# -- chaos drill (a): worker SIGKILL → supervisor replacement -----------------
+
+
+def test_worker_sigkill_replaced_within_heartbeat_window():
+    """Chaos (a): SIGKILL a supervised worker mid-job. The supervisor
+    must respawn the seat within one supervision tick of the death, the
+    dispatcher must re-ventilate the dead worker's items, and the job's
+    row multiset must arrive exactly once."""
+    daemon = _make_daemon(workers=2)
+    pool = _client(daemon.endpoint, name='kill-drill')
+    try:
+        pool.start(SleepyIdentityWorker)
+        for i in range(40):
+            pool.ventilate(i, sleep_s=0.05)
+        results = [pool.get_results(timeout=60) for _ in range(5)]
+        victim = daemon.supervisor.status()['slots'][0]['pid']
+        os.kill(victim, signal.SIGKILL)
+        results.extend(_drain(pool))
+        assert sorted(results) == list(range(40))
+        status = daemon.supervisor.status()
+        assert status['spawned_total'] >= 3, 'no replacement spawn'
+        assert daemon.dispatcher.stats()['items_reventilated'] >= 1
+        # the replacement actually serves: fleet back at target strength
+        _await(lambda: daemon.dispatcher.stats()['workers_alive'] >= 2,
+               message='replacement worker registered')
+        actions = [d['action'] for d in daemon.supervisor.decisions()]
+        assert 'worker_death' in actions and 'worker_spawn' in actions
+    finally:
+        pool.stop()
+        pool.join()
+        daemon.stop()
+
+
+# -- chaos drill (b): crash-looping slot trips the breaker --------------------
+
+
+def test_breaker_trips_after_exactly_k_deaths_sparing_cotenants():
+    """Chaos (b): one worker seat crash-loops (a SIGKILL, then every
+    respawn fails via the ``service.spawn`` faultpoint). The breaker
+    must trip after EXACTLY ``breaker_deaths`` deaths — announced once
+    as a ``worker_flapping`` anomaly — while the co-tenant job on the
+    surviving worker keeps its delivery exact and never exhausts a
+    retry budget. Disarming the faultpoint lets the backed-off respawn
+    close the loop and restore the fleet."""
+    daemon = _make_daemon(workers=2)
+    pool = _client(daemon.endpoint, name='cotenant')
+    try:
+        pool.start(SleepyIdentityWorker)
+        # stream enough work that delivery spans the whole drill
+        for i in range(60):
+            pool.ventilate(i, sleep_s=0.02)
+        results = [pool.get_results(timeout=60) for _ in range(3)]
+        victim_slot = daemon.supervisor.status()['slots'][1]
+        os.environ['PETASTORM_TPU_FAULTS'] = \
+            'service.spawn:error:1:match=%d' % victim_slot['slot']
+        faults.refresh_faults()
+        os.kill(victim_slot['pid'], signal.SIGKILL)
+        _await(lambda: any(s['breaker_open']
+                           for s in daemon.supervisor.status()['slots']),
+               message='breaker to open')
+        flapping = [e for e in telemetry.recent_anomalies()
+                    if e['kind'] == 'worker_flapping']
+        assert len(flapping) == 1, 'breaker must announce exactly once'
+        assert flapping[0]['detail']['deaths'] == 3  # the default K
+        # heal the seam: the next backed-off respawn succeeds
+        os.environ.pop('PETASTORM_TPU_FAULTS')
+        faults.refresh_faults()
+        results.extend(_drain(pool))
+        assert sorted(results) == list(range(60)), \
+            'co-tenant delivery must stay exact through the crash loop'
+        assert pool.poisoned_items == [], \
+            'co-tenant retry budgets must survive the crash loop'
+        _await(lambda: daemon.dispatcher.stats()['workers_alive'] >= 2,
+               message='breaker-closed respawn to restore the fleet')
+    finally:
+        pool.stop()
+        pool.join()
+        daemon.stop()
+
+
+# -- chaos drill (c): silent client → lease reclamation -----------------------
+
+
+class _RawJobClient:
+    """A protocol-level client with NO liveness machinery: registers a
+    job, submits items, then can simply go silent — the lease-lapse
+    fixture (and the BUSY/expiry probe)."""
+
+    def __init__(self, endpoint):
+        import zmq
+        self._context = zmq.Context()
+        self.sock = self._context.socket(zmq.DEALER)
+        self.sock.setsockopt(zmq.LINGER, 0)
+        self.sock.connect(endpoint)
+        self.job_id = None
+
+    def register(self, worker_class=SleepyIdentityWorker, lease_s=None,
+                 timeout_s=15):
+        spec = proto.dump_job_spec(worker_class, None, PickleSerializer())
+        params = {'name': 'raw', 'credit': 100}
+        if lease_s is not None:
+            params['lease_s'] = lease_s
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            self.sock.send_multipart([proto.MSG_REGISTER_JOB, spec,
+                                      proto.dump_json_params(params)])
+            if not self.sock.poll(500):
+                continue
+            frames = self.sock.recv_multipart()
+            if frames[0] == proto.MSG_JOB_OK:
+                self.job_id = int(frames[1])
+                return 'ok'
+            if frames[0] == proto.MSG_BUSY:
+                return proto.load_json_params(frames[1])
+        raise AssertionError('no REGISTER_JOB answer within %ss'
+                             % timeout_s)
+
+    def submit(self, cid, *args, **kwargs):
+        self.sock.send_multipart([proto.MSG_SUBMIT, b'%d' % self.job_id,
+                                  b'%d' % cid,
+                                  proto.dump_work_item(args, kwargs)])
+
+    def close(self):
+        self.sock.close(linger=0)
+        self._context.term()
+
+
+def test_lease_lapse_reclaims_job_without_touching_survivor():
+    """Chaos (c): a client registers, submits work, and dies silently
+    (no goodbye, no heartbeat). After its lease the daemon must reclaim
+    the job — pending purged, in-flight reclaimed, workers returned to
+    the pool, ``job_lease_expired`` announced — with zero effect on the
+    surviving job's delivery."""
+    daemon = _make_daemon(workers=2, lease_s=1.0)
+    survivor = _client(daemon.endpoint, name='survivor')
+    silent = _RawJobClient(daemon.endpoint)
+    try:
+        survivor.start(SleepyIdentityWorker)
+        assert silent.register(lease_s=1.0) == 'ok'
+        for cid in range(10):
+            silent.submit(cid, cid, sleep_s=0.05)
+        for i in range(40):
+            survivor.ventilate(i, sleep_s=0.02)
+        _await(lambda: daemon.dispatcher.active_jobs() == 2,
+               message='both jobs registered')
+        # ... and the silent client now dies without a word
+        silent.close()
+        _await(lambda: daemon.dispatcher.active_jobs() == 1,
+               message='lease to reclaim the silent job')
+        expired = [e for e in telemetry.recent_anomalies()
+                   if e['kind'] == 'job_lease_expired']
+        assert len(expired) == 1
+        assert expired[0]['detail']['name'] == 'raw'
+        assert daemon.dispatcher.stats()['jobs_expired'] == 1
+        got = sorted(_drain(survivor))
+        assert got == list(range(40)), \
+            'survivor delivery must be untouched by the reclamation'
+        # the reclaimed job's workers serve the survivor now
+        _await(lambda: daemon.dispatcher.health()['jobs'][0]['workers']
+               >= 2, message='orphaned workers rebound to the survivor')
+    finally:
+        survivor.stop()
+        survivor.join()
+        daemon.stop()
+
+
+# -- daemon SIGKILL + restart: client resubmission, worker re-registration ----
+
+
+def _spawn_daemon_cli(endpoint, extra=()):
+    env = dict(os.environ,
+               PYTHONPATH=os.pathsep.join(
+                   [_REPO_ROOT, os.path.join(_REPO_ROOT, 'tests')]),
+               JAX_PLATFORMS='cpu')
+    return subprocess.Popen(
+        [sys.executable, '-m', 'petastorm_tpu.service',
+         '--endpoint', endpoint, '--no-supervisor',
+         '--heartbeat-interval', str(_HB)] + list(extra),
+        env=env)
+
+
+def _spawn_cli_worker(endpoint):
+    env = dict(os.environ,
+               PYTHONPATH=os.pathsep.join(
+                   [_REPO_ROOT, os.path.join(_REPO_ROOT, 'tests')]),
+               JAX_PLATFORMS='cpu')
+    return subprocess.Popen(
+        [sys.executable, '-m', 'petastorm_tpu.service.worker_server',
+         '--endpoint', endpoint,
+         '--heartbeat-interval', str(_HB),
+         '--ack-timeout', '1.5',
+         '--parent-pid', str(os.getpid())],
+        env=env)
+
+
+def _reap(procs):
+    for p in procs:
+        if p.poll() is None:
+            p.terminate()
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.wait()
+
+
+def test_daemon_sigkill_restart_exact_delivery_with_standing_workers():
+    """THE standing-service drill: SIGKILL the daemon mid-job with
+    standing (externally-started) workers and a live client. On
+    restart, the workers detect the incarnation change through the
+    PR 11 token and re-register; the client re-registers its job and
+    re-submits exactly the unmarkered items. The delivered multiset is
+    exact — the daemon's death cost retries, never rows."""
+    endpoint = 'tcp://127.0.0.1:%d' % free_tcp_port()
+    daemon_proc = _spawn_daemon_cli(endpoint)
+    workers = [_spawn_cli_worker(endpoint) for _ in range(2)]
+    pool = _client(endpoint, name='restart-drill', ack_timeout_s=1.5,
+                   connect_timeout_s=60)
+    try:
+        pool.start(SleepyIdentityWorker)
+        for i in range(30):
+            pool.ventilate(i, sleep_s=0.05)
+        results = [pool.get_results(timeout=60) for _ in range(5)]
+        os.kill(daemon_proc.pid, signal.SIGKILL)
+        daemon_proc.wait()
+        # the control plane is DOWN; the standing workers and the
+        # client both outlive it
+        daemon_proc = _spawn_daemon_cli(endpoint)
+        results.extend(_drain(pool))
+        assert sorted(results) == list(range(30))
+        assert pool.diagnostics['reregistrations'] >= 1
+        assert all(w.poll() is None for w in workers), \
+            'standing workers must survive both daemon incarnations'
+    finally:
+        pool.stop()
+        pool.join()
+        _reap([daemon_proc] + workers)
+
+
+# -- drain / admission control ------------------------------------------------
+
+
+def test_drain_refuses_new_jobs_busy_and_finishes_registered_ones():
+    daemon = _make_daemon(workers=1)
+    pool = _client(daemon.endpoint, name='draining-job')
+    probe = _RawJobClient(daemon.endpoint)
+    try:
+        pool.start(SleepyIdentityWorker)
+        for i in range(10):
+            pool.ventilate(i, sleep_s=0.01)
+        daemon.begin_drain()
+        refusal = probe.register(timeout_s=10)
+        assert refusal != 'ok' and refusal['reason'] == 'draining'
+        # the registered job finishes normally through the drain
+        assert sorted(_drain(pool)) == list(range(10))
+        assert daemon.health()['draining'] is True
+    finally:
+        probe.close()
+        pool.stop()
+        pool.join()
+        _await(lambda: daemon.drained, message='drain to empty')
+        daemon.stop()
+
+
+def test_admission_control_refuses_beyond_max_jobs():
+    daemon = _make_daemon(workers=1, max_jobs=1)
+    first = _client(daemon.endpoint, name='admitted')
+    probe = _RawJobClient(daemon.endpoint)
+    try:
+        first.start(IdentityWorker)
+        refusal = probe.register(timeout_s=10)
+        assert refusal != 'ok' and refusal['reason'] == 'saturated'
+        assert refusal['max_jobs'] == 1
+    finally:
+        probe.close()
+        first.stop()
+        first.join()
+        daemon.stop()
+
+
+# -- protocol backward compatibility ------------------------------------------
+
+
+def test_old_build_worker_serves_new_daemon():
+    """Satellite: a pre-standing-service worker build — bare REGISTER
+    (no pid frame), bare HEARTBEAT (no summary, no token), DONE with an
+    empty metrics frame — must serve a daemon job end to end: the new
+    frames are additive, never required."""
+    import zmq
+    daemon = _make_daemon(workers=0, supervise=False)
+    pool = _client(daemon.endpoint, name='old-worker-job')
+
+    stop = threading.Event()
+    served = []
+
+    def old_worker():
+        context = zmq.Context()
+        sock = context.socket(zmq.DEALER)
+        sock.setsockopt(zmq.LINGER, 0)
+        sock.connect(daemon.endpoint)
+        try:
+            spec = None
+            while spec is None and not stop.is_set():
+                sock.send_multipart([proto.MSG_REGISTER])  # v1: no pid
+                if sock.poll(200):
+                    frames = sock.recv_multipart()
+                    if frames[0] == proto.MSG_SPEC:
+                        spec = frames[1]
+            if spec is None:
+                return
+            worker_class, worker_args, serializer = \
+                proto.load_job_spec(spec)
+            buffer = []
+            worker = worker_class(0, buffer.append, worker_args)
+            worker.initialize()
+            sock.send_multipart([proto.MSG_READY])
+            last_hb = 0.0
+            while not stop.is_set():
+                now = time.monotonic()
+                if now - last_hb > _HB:
+                    last_hb = now
+                    sock.send_multipart([proto.MSG_HEARTBEAT])  # v1: bare
+                if not sock.poll(50):
+                    continue
+                frames = sock.recv_multipart()
+                if frames[0] == proto.MSG_WORK:
+                    del buffer[:]
+                    args, kwargs = proto.load_work_item(frames[2])
+                    kwargs.pop('_trace_ctx', None)
+                    worker.process(*args, **kwargs)
+                    served.append(1)
+                    sock.send_multipart(
+                        [proto.MSG_DONE, frames[1], b'']
+                        + [serializer.serialize(v) for v in buffer])
+                elif frames[0] == proto.MSG_STOP:
+                    break
+        finally:
+            sock.close(linger=0)
+            context.term()
+
+    thread = threading.Thread(target=old_worker, daemon=True)
+    thread.start()
+    try:
+        pool.start(SleepyIdentityWorker)
+        for i in range(12):
+            pool.ventilate(i, sleep_s=0.005)
+        assert sorted(_drain(pool)) == list(range(12))
+        assert served, 'the old-build worker never processed anything'
+    finally:
+        pool.stop()
+        pool.join()
+        stop.set()
+        thread.join(timeout=10)
+        daemon.stop()
+
+
+def test_new_worker_serves_frameless_v1_dispatcher():
+    """Satellite: today's worker server against a dispatcher speaking
+    only the ORIGINAL frame set (SPEC without token, HEARTBEAT_ACK
+    without token, ignoring the new REGISTER pid frame) keeps serving —
+    the compatibility promise runs in both directions."""
+    import zmq
+    endpoint = 'tcp://127.0.0.1:%d' % free_tcp_port()
+    spec = proto.dump_job_spec(IdentityWorker, None, PickleSerializer())
+    results = {}
+    done = threading.Event()
+
+    def v1_dispatcher():
+        context = zmq.Context()
+        sock = context.socket(zmq.ROUTER)
+        sock.bind(endpoint)
+        pending = list(range(8))
+        inflight = {}
+        try:
+            deadline = time.monotonic() + 60
+            while len(results) < 8 and time.monotonic() < deadline:
+                if not sock.poll(50):
+                    continue
+                frames = sock.recv_multipart()
+                identity, msg = frames[0], frames[1]
+                if msg == proto.MSG_REGISTER:
+                    # v1 reply: NO token frame (and frames[2:] — the new
+                    # build's pid frame — deliberately ignored)
+                    sock.send_multipart([identity, proto.MSG_SPEC, spec])
+                elif msg == proto.MSG_READY or msg == proto.MSG_HEARTBEAT:
+                    if msg == proto.MSG_HEARTBEAT:
+                        sock.send_multipart(
+                            [identity, proto.MSG_HEARTBEAT_ACK])
+                    while pending:
+                        item = pending.pop(0)
+                        inflight[item] = True
+                        sock.send_multipart(
+                            [identity, proto.MSG_WORK,
+                             proto.pack_item_id(item),
+                             proto.dump_work_item((item,), {})])
+                elif msg == proto.MSG_DONE:
+                    item = proto.unpack_item_id(frames[2])
+                    payload = frames[3:]
+                    if payload and payload[0] == b'':
+                        payload = payload[1:]
+                    elif payload and proto.load_metrics_delta(payload[0]):
+                        payload = payload[1:]
+                    results[item] = payload
+            for _ in range(3):
+                sock.send_multipart([identity, proto.MSG_STOP])
+                time.sleep(0.05)
+        finally:
+            done.set()
+            sock.close(linger=0)
+            context.term()
+
+    thread = threading.Thread(target=v1_dispatcher, daemon=True)
+    thread.start()
+    env = dict(os.environ,
+               PYTHONPATH=os.pathsep.join(
+                   [_REPO_ROOT, os.path.join(_REPO_ROOT, 'tests')]),
+               JAX_PLATFORMS='cpu')
+    worker = subprocess.Popen(
+        [sys.executable, '-m', 'petastorm_tpu.service.worker_server',
+         '--endpoint', endpoint, '--heartbeat-interval', str(_HB),
+         '--parent-pid', str(os.getpid()), '--once'],
+        env=env)
+    try:
+        assert done.wait(timeout=90), 'v1 dispatcher never finished'
+        assert sorted(results) == list(range(8))
+    finally:
+        thread.join(timeout=10)
+        _reap([worker])
+
+
+def test_completion_from_non_owner_identity_is_dropped():
+    """White-box regression for the daemon-restart duplicate: a restarted
+    daemon's item-id space collides with its predecessor's, and a stale
+    DONE flushed from an old-incarnation worker's socket must NOT
+    complete the colliding new item (it carries some OTHER item's rows —
+    accepting it is a duplicate plus a loss). Only identities this
+    dispatcher actually assigned the item to may complete it."""
+    import threading as _threading
+    from petastorm_tpu.service.dispatcher import Dispatcher, _WorkerState
+
+    delivered = []
+    d = Dispatcher('tcp://127.0.0.1:0', b'spec',
+                   lambda entry: delivered.append(entry) or True,
+                   _threading.Event())
+    now = time.monotonic()
+    owner = _WorkerState(b'OWNER', now)
+    d._workers[b'OWNER'] = owner
+    item = d.submit(b'payload')
+    local = d._jobs[0]
+    local.pending.clear()
+    local.pending_ids.clear()
+    d._inflight[item] = (b'OWNER', b'payload')
+    owner.inflight.add(item)
+    d._item_owners[item] = {b'OWNER'}
+    # the stale frame: same item id, an identity never assigned to it
+    d._complete(b'STALE-GHOST', item, ('result', [b'wrong-rows']), now)
+    assert delivered == [], 'non-owner completion must deliver nothing'
+    assert item in d._inflight, 'the live assignment must stand'
+    # the real owner's completion flows normally
+    d._complete(b'OWNER', item, ('result', [b'rows']), now)
+    assert ('result', b'rows') in delivered
+    assert ('marker', item) in delivered
+
+
+def test_lapsed_worker_rebinds_only_to_its_own_job():
+    """White-box regression: a lapsed-then-resurfacing worker still RUNS
+    the spec of the job it lapsed from — re-admission must restore that
+    binding (never least-loaded rebinding, which would hand job B's
+    items to job A's decode worker), and a worker whose job is gone must
+    be STOPped back through registration instead of idling."""
+    import threading as _threading
+    from petastorm_tpu.service.dispatcher import Dispatcher
+
+    class _SockStub:
+        def __init__(self):
+            self.sent = []
+
+        def send_multipart(self, frames, **kwargs):
+            self.sent.append(frames)
+
+    d = Dispatcher('tcp://127.0.0.1:0', None, None, _threading.Event(),
+                   standing=True)
+    sock = _SockStub()
+    d._sock = sock
+    now = time.monotonic()
+    # two registered jobs; a worker registers and binds (job 1, emptier)
+    d._handle_register_job(sock, b'client-a', [b'', b'', b'spec-a',
+                                               proto.dump_json_params(
+                                                   {'key': 'a'})], now)
+    d._handle_register_job(sock, b'client-b', [b'', b'', b'spec-b',
+                                               proto.dump_json_params(
+                                                   {'key': 'b'})], now)
+    d._handle(sock, [b'w1', proto.MSG_REGISTER])
+    worker = d._workers[b'w1']
+    bound_job = worker.job_id
+    assert bound_job in d._jobs
+    # make the OTHER job the least-loaded one (a naive rebind would pick
+    # it), then lapse the worker and let its heartbeat re-admit it
+    other = [j for j in d._jobs if j != bound_job][0]
+    d._workers[b'w2'] = type(worker)(b'w2', now)
+    d._workers[b'w2'].job_id = bound_job
+    d._jobs[bound_job].workers.add(b'w2')
+    d._deregister(b'w1', 'heartbeat lapsed (test)')
+    d._handle(sock, [b'w1', proto.MSG_HEARTBEAT])
+    assert d._workers[b'w1'].job_id == bound_job, \
+        'resurfaced worker must re-bind to the job whose spec it runs'
+    assert b'w1' not in d._jobs[other].workers
+    # now the worker's job disappears entirely: re-admission must STOP
+    # it back to registration, not leave it idling on a dead spec
+    d._remove_job(d._jobs[bound_job], 'test teardown')
+    d._deregister(b'w1', 'heartbeat lapsed (test)')
+    sock.sent.clear()
+    d._handle(sock, [b'w1', proto.MSG_HEARTBEAT])
+    assert d._workers[b'w1'].job_id is None
+    assert not d._workers[b'w1'].ready
+    assert any(frames[1] == proto.MSG_STOP for frames in sock.sent
+               if frames[0] == b'w1')
+
+
+# -- supervisor unit drills (stub processes, no subprocess cost) --------------
+
+
+class _StubProc:
+    def __init__(self, pid):
+        self.pid = pid
+        self.exit_code = None
+        self.signals = []
+
+    def poll(self):
+        return self.exit_code
+
+    def send_signal(self, sig):
+        self.signals.append(sig)
+
+    def terminate(self):
+        self.signals.append(signal.SIGTERM)
+        self.exit_code = 0
+
+    def kill(self):
+        self.exit_code = -9
+
+    def wait(self, timeout=None):
+        return self.exit_code
+
+
+class _StubDispatcher:
+    def __init__(self):
+        self.stats_value = {'items_pending': 0, 'items_assigned': 0,
+                            'workers_alive': 0}
+        self.alive = set()
+        self.cordoned = []
+
+    def stats(self):
+        return dict(self.stats_value)
+
+    def alive_worker_pids(self):
+        return set(self.alive)
+
+    def cordon_worker_by_pid(self, pid):
+        self.cordoned.append(pid)
+        return True
+
+    def worker_inflight_by_pid(self, pid):
+        return 0
+
+
+def _stub_supervisor(**kwargs):
+    dispatcher = _StubDispatcher()
+    pids = iter(range(1000, 2000))
+    procs = []
+
+    def spawn(worker_id):
+        proc = _StubProc(next(pids))
+        procs.append(proc)
+        return proc
+
+    sup = WorkerSupervisor(dispatcher, 'tcp://stub', spawn=spawn, **kwargs)
+    return sup, dispatcher, procs
+
+
+def test_breaker_opens_after_exactly_k_deaths_and_backs_off():
+    """Unit drill of the breaker state machine: deaths below K respawn
+    immediately; the K-th death inside the window opens the breaker
+    (one ``worker_flapping``), respawns wait out an exponentially
+    growing backoff, and a surviving worker closes the breaker."""
+    sup, dispatcher, procs = _stub_supervisor(
+        initial_workers=1, min_workers=1, max_workers=1,
+        breaker_deaths=3, breaker_window_s=120.0)
+    sup.start()
+    try:
+        assert len(procs) == 1
+        for expected_spawns in (2, 3):
+            procs[-1].exit_code = 13
+            sup.tick()
+            assert len(procs) == expected_spawns, \
+                'deaths under K must respawn immediately'
+        # the K-th death: breaker opens, NO immediate respawn
+        procs[-1].exit_code = 13
+        sup.tick()
+        assert len(procs) == 3
+        slot = sup.status()['slots'][0]
+        assert slot['breaker_open'] is True
+        assert slot['breaker_backoff_level'] == 1
+        flapping = [e for e in telemetry.recent_anomalies()
+                    if e['kind'] == 'worker_flapping']
+        assert len(flapping) == 1
+        assert flapping[0]['detail']['deaths'] == 3
+        # backoff served: the seat respawns again
+        sup._slots[0].open_until = 0.0
+        sup.tick()
+        assert len(procs) == 4
+        # a stable worker closes the breaker once the window passes
+        sup._slots[0].spawned_at -= 121.0
+        dispatcher.alive.add(procs[-1].pid)
+        sup.tick()
+        slot = sup.status()['slots'][0]
+        assert slot['breaker_open'] is False
+        assert slot['breaker_backoff_level'] == 0
+        actions = [d['action'] for d in sup.decisions()]
+        assert 'breaker_open' in actions and 'breaker_close' in actions
+    finally:
+        sup.stop()
+
+
+def test_supervisor_scales_up_on_saturation_and_releases_on_idle():
+    """Unit drill of the scaling policy: sustained saturation recruits
+    one worker per episode up to the ceiling; a sustained idle fleet is
+    released two-phase (cordon → wait idle → SIGTERM) down to the
+    floor, with every decision logged."""
+    sup, dispatcher, procs = _stub_supervisor(
+        initial_workers=1, min_workers=1, max_workers=2)
+    sup.start()
+    try:
+        dispatcher.alive.update(p.pid for p in procs)
+        dispatcher.stats_value = {'items_pending': 5, 'items_assigned': 1,
+                                  'workers_alive': 1}
+        for _ in range(3):
+            sup.tick()
+        assert sup.target == 2
+        assert len(procs) == 2, 'saturation must recruit a worker'
+        dispatcher.alive.update(p.pid for p in procs)
+        # ceiling respected under continued saturation
+        for _ in range(5):
+            sup.tick()
+        assert sup.target == 2
+        # idle: released down to the floor, politely
+        dispatcher.stats_value = {'items_pending': 0, 'items_assigned': 0,
+                                  'workers_alive': 2}
+        for _ in range(10):
+            sup.tick()
+        assert sup.target == 1
+        assert dispatcher.cordoned, 'release must cordon before killing'
+        sup.tick()  # phase two: cordoned + idle -> SIGTERM
+        released = [p for p in procs if signal.SIGTERM in p.signals]
+        assert len(released) == 1
+        released[0].exit_code = 0
+        sup.tick()  # the seat retires with its process
+        assert sup.status()['released_total'] == 1
+        assert len(sup.status()['slots']) == 1
+        actions = [d['action'] for d in sup.decisions()]
+        assert 'scale_up_decision' in actions
+        assert 'worker_release' in actions
+    finally:
+        sup.stop()
+
+
+def test_spawn_faultpoint_is_registered_and_deterministic():
+    """The ``service.spawn`` faultpoint feeds the breaker without any
+    real process: every spawn in the armed window fails, so the seat's
+    deaths are purely injected — the chaos drill the satellite asks
+    for."""
+    os.environ['PETASTORM_TPU_FAULTS'] = 'service.spawn:error'
+    faults.refresh_faults()
+    sup, dispatcher, procs = _stub_supervisor(
+        initial_workers=1, min_workers=1, max_workers=1,
+        breaker_deaths=2, breaker_window_s=60.0)
+    sup.start()
+    try:
+        assert procs == [], 'armed spawn faultpoint must fail the spawn'
+        sup.tick()
+        assert sup.status()['slots'][0]['breaker_open'] is True
+        stats = faults.injection_stats()
+        assert stats['service.spawn']['fired'] >= 2
+        os.environ.pop('PETASTORM_TPU_FAULTS')
+        faults.refresh_faults()
+        sup._slots[0].open_until = 0.0
+        sup.tick()
+        assert len(procs) == 1, 'disarmed seam must spawn again'
+    finally:
+        sup.stop()
