@@ -32,11 +32,14 @@ telemetry back).
 #: construction (footer fetch + prover; petastorm_tpu/pushdown.py) ·
 #: ``late_materialize`` survivor-only decode of heavy columns after the
 #: predicate mask — the late-materialization specialization of
-#: ``decode`` (arrow_worker._load_rowgroup)
+#: ``decode`` (arrow_worker._load_rowgroup) · ``autotune`` one staging
+#: autotuner tick: registry snapshot + rollup window close + policy
+#: (petastorm_tpu/jax/autotune.py; the loop's own overhead is on the
+#: books)
 STAGES = ('ventilate', 'io', 'decode', 'filter', 'transform', 'queue_wait',
           'collate', 'h2d', 'h2d_ready', 'stage_fill', 'h2d_dispatch',
           'cache_hit_read', 'cache_fill', 'decode_fused',
-          'rowgroup_prune', 'late_materialize')
+          'rowgroup_prune', 'late_materialize', 'autotune')
 
 #: every trace-event name the package records outside the canonical stage
 #: spans (docs/telemetry.md, tracing section)
@@ -59,6 +62,10 @@ EVENT_NAMES = frozenset([
     'breaker_close',    # a breaker's respawned worker proved stable
     'job_register',     # daemon admitted a client job into the registry
     'job_gone',         # a job left the registry (goodbye or lease GC)
+    # staging autotuner (jax/autotune.py): one instant per knob
+    # adjustment on the 'autotuner' track, so a Perfetto export shows
+    # WHY throughput changed shape mid-run
+    'autotune_decision',
 ])
 
 #: every metric series name the package exports — the registry namespace
@@ -76,6 +83,8 @@ METRIC_NAMES = frozenset([
     'petastorm_tpu_stall_consumer_wait_seconds_total',
     # staging arena (jax/staging.py)
     'petastorm_tpu_h2d_bytes_total',
+    # staging autotuner (jax/autotune.py)
+    'petastorm_tpu_staging_autotune_decisions_total',
     # row-group cache (cache.py)
     'petastorm_tpu_cache_hits_total',
     'petastorm_tpu_cache_misses_total',
@@ -157,6 +166,10 @@ KNOWN_KNOBS = frozenset([
     'PETASTORM_TPU_TRACE_AUTODUMP_WINDOWS',
     'PETASTORM_TPU_STAGING',
     'PETASTORM_TPU_STAGING_SLOTS',
+    'PETASTORM_TPU_STAGING_AUTOTUNE',
+    'PETASTORM_TPU_STAGING_AUTOTUNE_WINDOW_SEC',
+    'PETASTORM_TPU_STAGING_AUTOTUNE_MAX_SLOTS',
+    'PETASTORM_TPU_STAGING_AUTOTUNE_MAX_PREFETCH',
     'PETASTORM_TPU_DECODED_CACHE',
     'PETASTORM_TPU_DECODED_CACHE_DIR',
     'PETASTORM_TPU_DECODED_CACHE_MEM_MB',

@@ -46,6 +46,24 @@ _IMAGE_POOL_LOCK = threading.Lock()
 # .so predating the argument can raise TypeError there).
 _NATIVE_THREADS_SUPPORT = {}
 
+# In-process override of the PETASTORM_TPU_IMAGE_DECODER_THREADS parse
+# (None = the knob rules). The staging autotuner's adjustment seam
+# (jax/autotune.py): it must retune THIS process without mutating
+# os.environ — child processes inherit the environment, and a mid-run
+# mutation would silently retarget every later reader in this process
+# and poison A/B comparisons against the knob's documented value.
+_DECODER_THREADS_OVERRIDE = None
+
+
+def set_image_decoder_threads_override(value):
+    """Set (int) or clear (None) the in-process decoder-thread override
+    consumed by :func:`image_decoder_threads`. Owned by the staging
+    autotuner; the loader clears it at stop so a tuned-down width never
+    outlives the loader that learned it."""
+    global _DECODER_THREADS_OVERRIDE
+    _DECODER_THREADS_OVERRIDE = (None if value is None
+                                 else max(0, int(value)))
+
 # Calibrated jpeg chroma-upsampling mode (1 fancy / 0 merged), or None until
 # the first sizeable batch decides it; see _jpeg_upsampling_mode.
 _JPEG_FANCY_MODE = None
@@ -198,7 +216,18 @@ def image_decoder_threads():
     never stack on ONE batch (no threads × threads within a decode);
     concurrent reader workers each get their own width, so process-wide
     decode threads scale as workers × knob — sizing guidance in
-    docs/env_knobs.md."""
+    docs/env_knobs.md. The staging autotuner may override the parsed
+    value in-process (:func:`set_image_decoder_threads_override`)."""
+    if _DECODER_THREADS_OVERRIDE is not None:
+        return _DECODER_THREADS_OVERRIDE
+    return image_decoder_threads_from_knob()
+
+
+def image_decoder_threads_from_knob():
+    """The knob's own parsed value, ignoring any in-process override —
+    the autotuner's restore ceiling (a tuner constructed while another
+    loader's override is live must not mistake the tuned-down width for
+    the configured baseline)."""
     raw = knobs.raw('PETASTORM_TPU_IMAGE_DECODER_THREADS')
     if raw is None:
         return min(4, os.cpu_count() or 1)

@@ -228,7 +228,14 @@ class JaxLoader:
         self._min_after_retrieve = min_after_retrieve
         self._extra_capacity = extra_capacity
         self._sharding = self._resolve_sharding(mesh, data_axes, batch_size)
+        # per-batch-length sharded row plans (see _shard_plan_for); None
+        # values pin the make_array_from_process_local_data fallback
+        self._shard_plans = {}
         self._stager = None   # per-pass staging arena (stage thread only)
+        # staging autotuner (jax/autotune.py): created lazily at the
+        # first pass when staging is on and the knob allows, kept across
+        # passes so its learned settings and decision log survive replays
+        self._autotuner = None
         self._stage_thread = None
         self._out_queue = None
         self._stop_event = threading.Event()
@@ -417,6 +424,14 @@ class JaxLoader:
         self._stager = staging.make_stager(
             self._batch_size, self._dtypes, self._last_batch,
             self._put_to_device)
+        if self._stager is not None:
+            from petastorm_tpu.jax import autotune
+            if self._autotuner is None and autotune.autotune_enabled():
+                self._autotuner = autotune.StagingAutotuner(self)
+            if self._autotuner is not None:
+                # the new pass's stager starts at the depth the tuner
+                # already learned (decisions survive epoch replays)
+                self._autotuner.apply_learned(self._stager)
         self._out_queue = queue.Queue(maxsize=self._prefetch)
         self._stage_thread = threading.Thread(target=self._stage_loop,
                                               daemon=True)
@@ -876,6 +891,10 @@ class JaxLoader:
         # provenance rides the queue as a sidecar: rows count as delivered
         # only when the consumer actually receives this item in __next__
         self._put_blocking((device_batch, pull_counts))
+        if self._autotuner is not None:
+            # staging-thread cadence gate: a no-op monotonic compare
+            # until the next autotune window is due
+            self._autotuner.maybe_tick()
 
     def _densify_ragged(self, columns):
         """Apply the ``pad_ragged`` policy to one reader chunk: variable
@@ -979,12 +998,88 @@ class JaxLoader:
         casting already happened upstream)."""
         import jax
         if self._sharding is not None:
-            return {name: jax.make_array_from_process_local_data(
-                        self._sharding, arr)
-                    for name, arr in host_batch.items()}
+            return self._put_sharded(host_batch)
         # one device_put of the whole pytree: a single dispatch covering
         # every field's transfer, instead of one runtime round trip each
         return jax.device_put(host_batch)
+
+    def _put_sharded(self, host_batch):
+        """Sharded (mesh) dispatch. With the staging arena live the
+        engine already wraps this call in ``h2d_dispatch`` and counts
+        ``petastorm_tpu_h2d_bytes_total``; on the legacy path
+        (``PETASTORM_TPU_STAGING=0``) the same instrumentation lives
+        here — shard-slice bytes (what THIS host puts on the wire, not
+        the global batch), so ``h2d_overlap_share`` and the stall
+        attributor work on meshes in both modes."""
+        if self._stager is not None:
+            return self._dispatch_sharded(host_batch)
+        from petastorm_tpu.telemetry import get_registry, metrics_disabled
+        with span('h2d_dispatch'):
+            device_batch = self._dispatch_sharded(host_batch)
+        if not metrics_disabled():
+            get_registry().counter(staging.H2D_BYTES).inc(
+                sum(arr.nbytes for arr in host_batch.values()))
+        return device_batch
+
+    def _dispatch_sharded(self, host_batch):
+        """One dispatch covering the whole pytree: every field's local
+        shard slices ride a single batched ``jax.device_put`` (one
+        runtime round trip) and reassemble into global ``jax.Array``s via
+        ``make_array_from_single_device_arrays`` — instead of one
+        ``make_array_from_process_local_data`` round trip per field.
+        Falls back to the per-field build when the row plan cannot be
+        proven sound for this sharding (always correct, never fast)."""
+        import jax
+        if not host_batch:
+            return {}
+        n_local = len(next(iter(host_batch.values())))
+        plan = self._shard_plan_for(n_local)
+        if plan is None:
+            return {name: jax.make_array_from_process_local_data(
+                        self._sharding, arr)
+                    for name, arr in host_batch.items()}
+        slices = []
+        devices = []
+        for arr in host_batch.values():
+            for device, lo, hi in plan:
+                slices.append(arr[lo:hi])
+                devices.append(device)
+        shards = jax.device_put(slices, devices)
+        out = {}
+        k = len(plan)
+        global_rows = n_local * jax.process_count()
+        for i, (name, arr) in enumerate(host_batch.items()):
+            out[name] = jax.make_array_from_single_device_arrays(
+                (global_rows,) + arr.shape[1:], self._sharding,
+                shards[i * k:(i + 1) * k])
+        return out
+
+    def _shard_plan_for(self, n_local):
+        """Cached per-batch-length row plan (short tails get their own);
+        None pins the ``make_array_from_process_local_data`` fallback for
+        that length."""
+        if n_local in self._shard_plans:
+            return self._shard_plans[n_local]
+        from petastorm_tpu.parallel.sharding import local_shard_plan
+        plan = local_shard_plan(self._sharding, n_local)
+        if plan is None:
+            logger.debug(
+                'sharded staging: no sound row plan for %d local rows on '
+                '%r; using the per-field make_array_from_process_local_'
+                'data fallback', n_local, self._sharding)
+        self._shard_plans[n_local] = plan
+        return plan
+
+    def _set_prefetch(self, depth):
+        """Autotuner seam: deepen the prefetch queue mid-pass. Writing
+        ``queue.Queue.maxsize`` is safe here — the producer retries its
+        bounded put every 0.1s (``_put_blocking``), so a raised bound is
+        observed on the next attempt without waking any waiter."""
+        depth = max(1, int(depth))
+        self._prefetch = depth
+        if self._out_queue is not None:
+            self._out_queue.maxsize = depth
+        return depth
 
     def _put_blocking(self, item):
         start = time.monotonic()
@@ -1077,6 +1172,15 @@ class JaxLoader:
             'fused_decode_mode': self._fused_decode_mode(),
             'fused_decode_rows': (stager.fused_rows
                                   if stager is not None else 0),
+            # staging autotuner (jax/autotune.py): live depth settings +
+            # how many adjustments this loader has made
+            'staging_prefetch': self._prefetch,
+            'staging_slot_depth': (stager.num_slots
+                                   if stager is not None else 0),
+            'staging_autotune': self._autotuner is not None,
+            'staging_autotune_decisions': (self._autotuner.decisions
+                                           if self._autotuner is not None
+                                           else 0),
         })
         if self._fused_fallback is not None:
             diag['fused_decode_fallback'] = self._fused_fallback
@@ -1160,6 +1264,8 @@ class JaxLoader:
             # the cache section is observational, not verdict-derived —
             # a short pass still shows whether the decoded tier served
             self._add_decoded_cache_advice(report)
+            if self._autotuner is not None:
+                report['staging_autotune'] = self._autotuner.summary()
             return report
         frac = consumer / total
         report['input_stall_fraction'] = round(frac, 3)
@@ -1209,6 +1315,10 @@ class JaxLoader:
             report['advice'] = ['producer and consumer are balanced; '
                                 'tune the model step first']
         self._add_decoded_cache_advice(report)
+        if self._autotuner is not None:
+            # the closed loop's own record: current depths + the recent
+            # decision log, so "what changed and why" rides the report
+            report['staging_autotune'] = self._autotuner.summary()
         return report
 
     def _add_decoded_cache_advice(self, report):
@@ -1285,7 +1395,33 @@ class JaxLoader:
             'stage_backpressure_s': round(self._stage_blocked_s, 3),
             'staging_enabled': self._stager is not None,
             'fused_decode_mode': self._fused_decode_mode(),
+            # per-host staging view (the "One host starves the mesh"
+            # runbook reads these across every host's /health endpoint)
+            'h2d_overlap_share': self._h2d_overlap_share(),
+            'staging_prefetch': self._prefetch,
+            'staging_slot_depth': (self._stager.num_slots
+                                   if self._stager is not None else 0),
+            'staging_autotune_decisions': (self._autotuner.decisions
+                                           if self._autotuner is not None
+                                           else 0),
         }
+
+    def _h2d_overlap_share(self):
+        """THIS host's live fill/transfer overlap share (None before the
+        arena has staged anything) — the per-host member of the mesh-wide
+        overlap picture. Computed from the three stage counters directly
+        (sharing the report's formula), never by building a whole
+        pipeline_report: /health is polled, and must stay cheap."""
+        from petastorm_tpu.telemetry import get_registry
+        from petastorm_tpu.telemetry.export import _h2d_overlap_share
+        from petastorm_tpu.telemetry.registry import metric_key
+        from petastorm_tpu.telemetry.spans import STAGE_SECONDS
+        counters = get_registry().counters_with_prefix(STAGE_SECONDS)
+        stages = {
+            stage: {'seconds': counters.get(
+                metric_key(STAGE_SECONDS, {'stage': stage}), 0.0)}
+            for stage in ('stage_fill', 'h2d_dispatch', 'h2d_ready')}
+        return _h2d_overlap_share(stages)
 
     def _obs_report(self):
         """The loader's /report contribution: the live autotune verdict
@@ -1294,6 +1430,10 @@ class JaxLoader:
 
     def stop(self):
         self._obs_mount.close()
+        if self._autotuner is not None:
+            # drops the in-process decoder-thread override so a stopped
+            # loader's learned setting cannot leak into later readers
+            self._autotuner.close()
         self._stop_event.set()
         # Stop the reader FIRST: it is what a staging thread blocked in
         # reader.__next__ is actually waiting on; the stop event alone

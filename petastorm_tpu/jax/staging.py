@@ -293,7 +293,33 @@ class StagingEngine:
                      for _ in range(self._num_slots)]
             self.slabs_allocated += len(slots)
             ring = self._rings[sig] = _Ring(slots)
+        elif len(ring.slots) < self._num_slots:
+            # the autotuner deepened the ring mid-pass (set_num_slots):
+            # grow lazily, at the ring's next use, on the staging thread —
+            # the only thread allowed to touch slot state
+            add = self._num_slots - len(ring.slots)
+            ring.slots.extend(
+                _Slot(self._new_buffers(columns, dtype_map, with_mask),
+                      census=(sanitizer.ViewCensus()
+                              if self._sanitize else None))
+                for _ in range(add))
+            self.slabs_allocated += add
         return ring
+
+    @property
+    def num_slots(self):
+        """Current ring depth target (slots per batch signature)."""
+        return self._num_slots
+
+    def set_num_slots(self, num_slots):
+        """Deepen (never shrink) the per-signature ring depth — the
+        staging autotuner's adjustment seam. Existing rings grow lazily
+        at their next use on the staging thread; shrinking is not
+        supported (a removed slot's in-flight transfer would lose its
+        recycle gate). Returns the effective depth."""
+        self._num_slots = max(self._num_slots,
+                              max(_MIN_SLOTS, int(num_slots)))
+        return self._num_slots
 
     # -- staging -------------------------------------------------------------
 

@@ -46,6 +46,62 @@ def _jax_process_info():
         return None, None
 
 
+def local_shard_plan(sharding, local_rows, process_count=None):
+    """Row-granular dispatch plan for one host's slice of a batch whose
+    leading axis is laid out by ``sharding`` (a
+    ``jax.sharding.NamedSharding`` partitioning axis 0).
+
+    Returns ``[(device, lo, hi), ...]`` — for every *addressable* device,
+    the half-open ``[lo, hi)`` row range of the **process-local** batch
+    that device holds (devices replicated along non-data mesh axes each
+    appear with their own — possibly identical — range). The staging
+    engine slices its slot buffers with these ranges and ships the whole
+    pytree in ONE batched ``jax.device_put`` instead of one
+    ``make_array_from_process_local_data`` round trip per field.
+
+    Returns None when the plan cannot be proven sound — a non-unit-step
+    index, a host whose global rows are not one contiguous block, or a
+    sharding this jax cannot map — and the caller must fall back to
+    ``make_array_from_process_local_data`` (always correct, never fast).
+    """
+    import jax
+    if process_count is None:
+        process_count = jax.process_count()
+    global_rows = local_rows * process_count
+    try:
+        index_map = sharding.addressable_devices_indices_map(
+            (global_rows,))
+    except Exception:  # noqa: BLE001 - jax version / layout drift
+        logger.debug('local_shard_plan: addressable_devices_indices_map '
+                     'failed for %r', sharding, exc_info=True)
+        return None
+    spans = []
+    for device, index in index_map.items():
+        index = index or (slice(None),)
+        lo, hi, step = index[0].indices(global_rows)
+        if step != 1 or hi <= lo:
+            return None
+        spans.append((device, lo, hi))
+    if not spans:
+        return None
+    host_lo = min(lo for _, lo, _ in spans)
+    host_hi = max(hi for _, _, hi in spans)
+    if host_hi - host_lo != local_rows:
+        # this host's devices do not own exactly one local batch of rows
+        return None
+    # the host block must be contiguously covered (no gaps a local row
+    # could fall into): merge the per-device intervals and check
+    covered = host_lo
+    for lo, hi in sorted((lo, hi) for _, lo, hi in spans):
+        if lo > covered:
+            return None
+        covered = max(covered, hi)
+    if covered != host_hi:
+        return None
+    return [(device, lo - host_lo, hi - host_lo)
+            for device, lo, hi in spans]
+
+
 def default_shard_info(cur_shard, shard_count):
     """Resolve (cur_shard, shard_count), filling defaults from JAX.
 

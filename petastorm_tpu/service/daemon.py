@@ -54,9 +54,6 @@ from petastorm_tpu.service import protocol as proto
 from petastorm_tpu.service.dispatcher import Dispatcher
 from petastorm_tpu.service.supervisor import WorkerSupervisor
 from petastorm_tpu.telemetry import count_swallowed, knobs
-from petastorm_tpu.workers import (
-    EmptyResultError, TimeoutWaitingForResultError,
-)
 
 logger = logging.getLogger(__name__)
 
@@ -365,49 +362,16 @@ class DaemonClientPool:
             self._submit_queue.append(cid)
 
     def get_results(self, timeout=None):
-        deadline = None if timeout is None else time.monotonic() + timeout
-        # the wedge clock measures time blocked INSIDE this call: a
-        # consumer pausing between calls (recompile, checkpoint save) is
-        # not service starvation and must not trip the deadline on
-        # re-entry
-        self._last_progress = time.monotonic()
-        while True:
-            if self._error is not None:
-                raise self._error
-            try:
-                kind, payload = self._results_queue.get(
-                    timeout=_POLL_INTERVAL_S)
-            except queue.Empty:
-                if self._stop_event.is_set():
-                    raise EmptyResultError()
-                with self._lock:
-                    all_done = (self._ventilated_items
-                                == self._processed_items)
-                if all_done and (self._ventilator is None
-                                 or self._ventilator.completed()):
-                    raise EmptyResultError()
-                if deadline is not None and time.monotonic() > deadline:
-                    raise TimeoutWaitingForResultError()
-                if not all_done:
-                    self._check_read_deadline()
-                continue
-            self._last_progress = time.monotonic()
-            if kind == 'marker':
-                with self._lock:
-                    self._processed_items += 1
-                    self._acked += 1
-                if self._ventilator is not None:
-                    self._ventilator.processed_item()
-                continue
-            if kind == 'poisoned':
-                self._note_poisoned(payload)
-                continue
-            if kind == 'error':
-                self._error = payload
-                self.stop()
-                self.join()
-                raise self._error
-            return self._serializer.deserialize(payload)
+        from petastorm_tpu.service.service_pool import consume_results
+        return consume_results(self, timeout, self._lock,
+                               on_marker=self._on_marker,
+                               wedge_error=self._wedge_error)
+
+    def _on_marker(self):
+        """Shared-loop hook, runs UNDER ``self._lock`` with the
+        processed-item increment: count the marker into the ack credit
+        the heartbeat reports back to the daemon."""
+        self._acked += 1
 
     def _note_poisoned(self, info):
         """Shared ``poison_policy`` semantics with the embedded pool
@@ -416,24 +380,15 @@ class DaemonClientPool:
         from petastorm_tpu.service.service_pool import apply_poison_policy
         apply_poison_policy(self, info, "the daemon's /health")
 
-    def _check_read_deadline(self):
-        if not self._read_deadline_s:
-            return
-        waited = time.monotonic() - self._last_progress
-        if waited <= self._read_deadline_s:
-            return
-        with self._lock:
-            inflight = self._ventilated_items - self._processed_items
-        error = ServiceWedgedError(
+    def _wedge_error(self, waited, inflight):
+        """The daemon client's wedge diagnosis — carrying the last
+        daemon status this client saw."""
+        return ServiceWedgedError(
             'Daemon-backed service read made no progress for %.1fs with '
             '%d item(s) outstanding (deadline PETASTORM_TPU_SERVICE_READ'
             '_DEADLINE_S=%.1fs). Last daemon status: %r'
             % (waited, inflight, self._read_deadline_s, self._status),
             fleet=dict(self._status))
-        self._error = error
-        self.stop()
-        self.join()
-        raise error
 
     def stop(self):
         if self._ventilator is not None:

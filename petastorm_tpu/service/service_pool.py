@@ -91,6 +91,97 @@ def apply_poison_policy(pool, info, health_owner):
     raise pool._error
 
 
+def consume_results(pool, timeout, lock, check_fatal=None, on_marker=None,
+                    wedge_error=None):
+    """The ONE consumer read loop shared by :class:`ServicePool` and the
+    standing service's :class:`~petastorm_tpu.service.daemon
+    .DaemonClientPool` — factored the way :func:`apply_poison_policy`
+    already is, so the wedge clock, the no-progress deadline and the
+    marker/poison/error handling can never drift between the two
+    topologies (they were deliberate near-copies before).
+
+    ``pool`` provides the shared surface: ``_error``, ``_results_queue``,
+    ``_stop_event``, ``_ventilated_items``/``_processed_items`` (guarded
+    by ``lock``), ``_ventilator``, ``_serializer``, ``_last_progress``,
+    ``_read_deadline_s``, ``_note_poisoned`` and ``stop``/``join``.
+    ``check_fatal()`` (optional) runs on every empty poll and returns an
+    exception to surface, or None — the embedded pool's dispatcher-death
+    / dead-local-fleet probe. ``on_marker()`` (optional) runs UNDER
+    ``lock`` together with the processed-item increment — the daemon
+    client's ack credit. ``wedge_error(waited_s, inflight)`` builds the
+    topology-specific :class:`~petastorm_tpu.errors.ServiceWedgedError`
+    when the no-progress deadline trips.
+    """
+    deadline = None if timeout is None else time.monotonic() + timeout
+    # the wedge clock measures time blocked INSIDE this call: a consumer
+    # pausing between calls (recompile, checkpoint save) is not service
+    # starvation and must not trip the deadline on re-entry
+    pool._last_progress = time.monotonic()
+    while True:
+        if pool._error is not None:
+            raise pool._error
+        try:
+            kind, payload = pool._results_queue.get(
+                timeout=_POLL_INTERVAL_S)
+        except queue.Empty:
+            if pool._stop_event.is_set():
+                raise EmptyResultError()
+            fatal = check_fatal() if check_fatal is not None else None
+            if fatal is not None:
+                pool._error = fatal
+                pool.stop()
+                pool.join()
+                raise pool._error
+            with lock:
+                all_done = (pool._ventilated_items
+                            == pool._processed_items)
+            if all_done and (pool._ventilator is None
+                             or pool._ventilator.completed()):
+                raise EmptyResultError()
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutWaitingForResultError()
+            if not all_done:
+                _check_no_progress(pool, lock, wedge_error)
+            continue
+        pool._last_progress = time.monotonic()
+        if kind == 'marker':
+            with lock:
+                pool._processed_items += 1
+                if on_marker is not None:
+                    on_marker()
+            if pool._ventilator is not None:
+                pool._ventilator.processed_item()
+            continue
+        if kind == 'poisoned':
+            pool._note_poisoned(payload)
+            continue
+        if kind == 'error':
+            pool._error = payload
+            pool.stop()
+            pool.join()
+            raise pool._error
+        return pool._serializer.deserialize(payload)
+
+
+def _check_no_progress(pool, lock, wedge_error):
+    """Raise the diagnosable wedge error when no entry reached this
+    consumer for ``read_deadline_s`` with work outstanding — instead of
+    a silent hang (lost WORK frame, dead-but-undetected workers, network
+    partition, dead daemon)."""
+    if not pool._read_deadline_s or wedge_error is None:
+        return
+    waited = time.monotonic() - pool._last_progress
+    if waited <= pool._read_deadline_s:
+        return
+    with lock:
+        inflight = pool._ventilated_items - pool._processed_items
+    error = wedge_error(waited, inflight)
+    pool._error = error
+    pool.stop()
+    pool.join()
+    raise error
+
+
 class ServicePool:
     """Client pool backed by remote worker servers over ``tcp://``."""
 
@@ -316,99 +407,47 @@ class ServicePool:
             return False
 
     def get_results(self, timeout=None):
-        deadline = None if timeout is None else time.monotonic() + timeout
-        # the wedge clock measures time blocked INSIDE this call: a
-        # consumer pausing between calls (recompile, checkpoint save) is
-        # not service starvation and must not trip the deadline on
-        # re-entry
-        self._last_progress = time.monotonic()
-        while True:
-            if self._error is not None:
-                raise self._error
-            try:
-                kind, payload = self._results_queue.get(
-                    timeout=_POLL_INTERVAL_S)
-            except queue.Empty:
-                if self._stop_event.is_set():
-                    raise EmptyResultError()
-                fatal = (self._dispatcher.fatal_error
-                         if self._dispatcher else None)
-                if fatal is None and self._local_procs and \
-                        all(p.poll() is not None for p in self._local_procs):
-                    with self._counter_lock:
-                        outstanding = (self._ventilated_items
-                                       != self._processed_items)
-                    if outstanding:
-                        fatal = RuntimeError(
-                            'All spawned service worker servers died '
-                            'unexpectedly: %s'
-                            % [p.pid for p in self._local_procs])
-                if fatal is not None:
-                    self._error = fatal
-                    self.stop()
-                    self.join()
-                    raise self._error
-                with self._counter_lock:
-                    all_done = (self._ventilated_items
-                                == self._processed_items)
-                if all_done and (self._ventilator is None
-                                 or self._ventilator.completed()):
-                    raise EmptyResultError()
-                if deadline is not None and time.monotonic() > deadline:
-                    raise TimeoutWaitingForResultError()
-                if not all_done:
-                    self._check_read_deadline()
-                continue
-            self._last_progress = time.monotonic()
-            if kind == 'marker':
-                with self._counter_lock:
-                    self._processed_items += 1
-                if self._ventilator is not None:
-                    self._ventilator.processed_item()
-                continue
-            if kind == 'poisoned':
-                self._note_poisoned(payload)
-                continue
-            if kind == 'error':
-                self._error = payload
-                self.stop()
-                self.join()
-                raise self._error
-            return self._serializer.deserialize(payload)
+        return consume_results(self, timeout, self._counter_lock,
+                               check_fatal=self._check_fatal,
+                               wedge_error=self._wedge_error)
+
+    def _check_fatal(self):
+        """Per-empty-poll fatal probe (shared loop hook): a dispatcher
+        fatal error, or every spawned local worker dead with work still
+        outstanding."""
+        fatal = (self._dispatcher.fatal_error
+                 if self._dispatcher else None)
+        if fatal is None and self._local_procs and \
+                all(p.poll() is not None for p in self._local_procs):
+            with self._counter_lock:
+                outstanding = (self._ventilated_items
+                               != self._processed_items)
+            if outstanding:
+                fatal = RuntimeError(
+                    'All spawned service worker servers died '
+                    'unexpectedly: %s'
+                    % [p.pid for p in self._local_procs])
+        return fatal
 
     def _note_poisoned(self, info):
         """One quarantined item reached this consumer: apply the
         ``poison_policy`` (shared semantics: :func:`apply_poison_policy`)."""
         apply_poison_policy(self, info, "the dispatcher's /health")
 
-    def _check_read_deadline(self):
-        """Raise the diagnosable wedge error when no entry reached this
-        consumer for ``read_deadline_s`` with work outstanding — carrying
-        the live fleet view, so the operator sees WHICH failure domain
-        wedged (lost WORK frame, dead-but-undetected workers, network
-        partition) instead of a silent hang."""
-        if not self._read_deadline_s:
-            return
-        waited = time.monotonic() - self._last_progress
-        if waited <= self._read_deadline_s:
-            return
+    def _wedge_error(self, waited, inflight):
+        """The embedded pool's wedge diagnosis — carrying the live fleet
+        view, so the operator sees WHICH failure domain wedged."""
         fleet = {}
         try:
             fleet = self._dispatcher.fleet_view()
         except Exception:  # noqa: BLE001 - diagnosis must not mask itself
             pass
-        with self._counter_lock:
-            inflight = self._ventilated_items - self._processed_items
-        error = ServiceWedgedError(
+        return ServiceWedgedError(
             'Service read made no progress for %.1fs with %d item(s) '
             'outstanding (deadline PETASTORM_TPU_SERVICE_READ_DEADLINE_S'
             '=%.1fs). Live fleet view: %r'
             % (waited, inflight, self._read_deadline_s, fleet),
             fleet=fleet)
-        self._error = error
-        self.stop()
-        self.join()
-        raise error
 
     def stop(self):
         if self._ventilator is not None:

@@ -159,4 +159,10 @@ def reset_for_tests():
     # lazy: pushdown imports telemetry at its module top
     from petastorm_tpu import pushdown
     pushdown.reset_for_tests()
+    # the staging autotuner's decision ring — only when its module is
+    # already loaded (never force the jax package in for a reset)
+    import sys as _sys
+    autotune = _sys.modules.get('petastorm_tpu.jax.autotune')
+    if autotune is not None:
+        autotune._reset_for_tests()
     refresh_enabled()

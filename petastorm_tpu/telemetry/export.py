@@ -237,6 +237,9 @@ def pipeline_report(registry=None, wall_time_s=None, baseline=None,
     anomalies = _anomalies_section(registry)
     if anomalies is not None:
         report['anomalies'] = anomalies
+    autotune = _staging_autotune_section(registry)
+    if autotune is not None:
+        report['staging_autotune'] = autotune
     return report
 
 
@@ -463,6 +466,35 @@ def _anomalies_section(registry):
     }
 
 
+def _staging_autotune_section(registry):
+    """Staging-autotuner decisions (jax/autotune.py) — present only when
+    the control loop ever adjusted something (counter includes
+    fleet-aggregated remote decisions), so untouched pipelines keep
+    their report shape unchanged. ``recent`` carries this process's
+    last few structured decision entries; the counter is the fleet
+    total by action. Only consulted when the autotune module is already
+    loaded: decisions can only originate in a process running a jax
+    loader, so a lean process (service worker, torch consumer) must
+    never pay the jax-bridge import for a section that would be None."""
+    import sys
+    autotune = sys.modules.get('petastorm_tpu.jax.autotune')
+    if autotune is None:
+        return None
+    by_action = {}
+    for key, value in registry.counters_with_prefix(
+            autotune.AUTOTUNE_DECISIONS).items():
+        action = _label_of(key, 'action') or 'unknown'
+        by_action[action] = by_action.get(action, 0) + int(value)
+    recent = autotune.recent_decisions(10)
+    if not by_action and not recent:
+        return None
+    return {
+        'total': sum(by_action.values()),
+        'by_action': by_action,
+        'recent': recent,
+    }
+
+
 def format_pipeline_report(report):
     """Human-readable rendering of :func:`pipeline_report` (one stage per
     line, canonical pipeline order first, then any extra stages)."""
@@ -548,4 +580,14 @@ def format_pipeline_report(report):
             lines.append('  %s at %.0f — %s'
                          % (event['kind'], event.get('ts') or 0.0,
                             event.get('runbook', '')))
+    if 'staging_autotune' in report:
+        t = report['staging_autotune']
+        actions = ', '.join('%s: %d' % (k, v)
+                            for k, v in sorted(t['by_action'].items()))
+        lines.append('staging autotune: %d decision(s)%s'
+                     % (t['total'], (' (%s)' % actions) if actions else ''))
+        for entry in t['recent'][-3:]:
+            detail = {k: v for k, v in entry.items()
+                      if k not in ('action', 'ts')}
+            lines.append('  %s — %s' % (entry['action'], detail))
     return '\n'.join(lines)

@@ -111,6 +111,18 @@ def obs_enabled():
             and knobs.get_str('PETASTORM_TPU_OBS_PORT') != '')
 
 
+_H2D_READY_KEY = metric_key(STAGE_SECONDS, {'stage': 'h2d_ready'})
+
+
+def h2d_ready_share(window):
+    """Seconds-per-second one closed window spent blocked in the staging
+    arena's ``h2d_ready`` gate — the h2d-starvation signal, defined ONCE
+    here for both consumers: the anomaly detector's ``h2d_starvation``
+    event and the staging autotuner's deepen policy
+    (:mod:`petastorm_tpu.jax.autotune`)."""
+    return window['rates'].get(_H2D_READY_KEY, 0.0)
+
+
 # -- windowed rollup ----------------------------------------------------------
 
 
@@ -379,8 +391,7 @@ class AnomalyDetector:
              'windows': self._sat_streak})
 
     def _check_h2d(self, window, dur):
-        ready_key = metric_key(STAGE_SECONDS, {'stage': 'h2d_ready'})
-        share = window['rates'].get(ready_key, 0.0)  # seconds/sec
+        share = h2d_ready_share(window)  # seconds/sec
         starved = share >= self._saturated_share
         self._h2d_streak = self._h2d_streak + 1 if starved else 0
         return self._fire(

@@ -669,6 +669,57 @@ def test_bench_trend_skips_unparseable_tails(tmp_path):
     assert [n for n, _ in rounds] == [2]
 
 
+def _multichip_round(tmp_path, n, tail, ok=True):
+    record = {'n': n, 'rc': 0 if ok else 1, 'ok': ok, 'tail': tail}
+    (tmp_path / ('MULTICHIP_r%02d.json' % n)).write_text(
+        json.dumps(record))
+
+
+def test_bench_trend_folds_multichip_rounds(tmp_path):
+    """MULTICHIP rounds join the same per-round table: the dryrun's
+    self-counted METRICS line when present, the tail's checkpoint-line
+    count for legacy rounds, and the mesh metrics are regression-gated
+    like every tracked bench metric."""
+    bench_trend = _bench_trend()
+    _bench_round(tmp_path, 1, 1000.0, {})
+    # legacy round: no METRICS line — checks counted from the tail
+    _multichip_round(tmp_path, 1, 'dryrun_multichip: a\n'
+                                  'dryrun_multichip: b\n')
+    # modern round: the trailing self-counted metrics line wins (the
+    # tail's visible line count may be clipped and must not matter)
+    metrics = {'checks': 13, 'sharded_overlap_share': 1.0,
+               'sharded_h2d_mb_per_sec': 120.5}
+    _multichip_round(tmp_path, 2, 'dryrun_multichip: only-one-visible\n'
+                     + 'MULTICHIP_METRICS ' + json.dumps(metrics) + '\n')
+    rounds = bench_trend.load_rounds(str(tmp_path))
+    assert [n for n, _ in rounds] == [1, 2]
+    by_n = dict(rounds)
+    assert by_n[1]['extra']['multichip_checks'] == 2
+    # round 2 has no BENCH record: the MULTICHIP metrics still fold
+    assert by_n[2]['value'] is None
+    assert by_n[2]['extra']['multichip_checks'] == 13
+    assert by_n[2]['extra']['multichip_sharded_overlap_share'] == 1.0
+    report = bench_trend.trend(rounds)
+    assert report['metrics']['multichip_checks']['series'] == [2, 13]
+    assert report['regressions'] == []
+    # a later round LOSING checkpoints is a gated regression
+    _multichip_round(tmp_path, 3, 'MULTICHIP_METRICS '
+                     + json.dumps({'checks': 4}) + '\n')
+    report = bench_trend.trend(bench_trend.load_rounds(str(tmp_path)))
+    assert 'multichip_checks' in report['regressions']
+
+
+def test_bench_trend_failed_legacy_multichip_rounds_skip(tmp_path):
+    """A failed legacy dryrun (ok=false, no metrics line) contributes
+    nothing — absence of evidence is not a regression."""
+    bench_trend = _bench_trend()
+    _bench_round(tmp_path, 1, 1000.0, {'vs_tfdata': 1.0})
+    _multichip_round(tmp_path, 1, 'dryrun_multichip: partial\n'
+                                  'Traceback ...\n', ok=False)
+    rounds = bench_trend.load_rounds(str(tmp_path))
+    assert 'multichip_checks' not in rounds[0][1]['extra']
+
+
 # -- overhead guard ----------------------------------------------------------
 
 

@@ -1,4 +1,4 @@
-"""Fold every BENCH_r*.json round record into a perf trend table.
+"""Fold every BENCH_r*.json and MULTICHIP_r*.json round into one trend.
 
 The driver stores each benchmark round as ``BENCH_r0N.json`` — a wrapper
 ``{"n": N, "rc": ..., "tail": "<last stdout chars>"}`` whose tail ends
@@ -11,6 +11,15 @@ the fold:
 
     python tools/bench_trend.py            # table + one trend JSON line
     python tools/bench_trend.py --fail-on-regression   # CI gate shape
+
+``MULTICHIP_r0N.json`` (the 8-virtual-device dryrun scoreboard) folds
+into the same per-round table: the dryrun's trailing ``MULTICHIP_METRICS
+{...}`` JSON line carries its self-counted checkpoint total and the
+sharded-staging numbers (keys land as ``multichip_<key>``); legacy
+rounds without the line fall back to counting ``dryrun_multichip:``
+lines in the tail (an undercount when the tail clipped — which only
+lowers the bar, never fails it). Before this fold the mesh scoreboard
+had no regression gate at all.
 
 A **regression** is flagged when a tracked higher-is-better metric's
 latest value falls below ``--threshold`` (default 0.9) x the best value
@@ -45,9 +54,18 @@ TRACKED = (
     'lm_train_tuned_mfu',
     'lm_decode_decode_tokens_per_sec',
     'lm_decode_gqa_decode_speedup',
+    # sharded staging (bench sharded_staging section)
+    'sharded_staging_gb_per_sec',
+    'sharded_staging_h2d_efficiency',
+    # the mesh scoreboard (MULTICHIP_r*.json dryrun rounds)
+    'multichip_checks',
+    'multichip_sharded_overlap_share',
+    'multichip_sharded_h2d_mb_per_sec',
 )
 
 _ROUND_RE = re.compile(r'BENCH_r(\d+)\.json$')
+_MULTICHIP_RE = re.compile(r'MULTICHIP_r(\d+)\.json$')
+_MULTICHIP_METRICS_PREFIX = 'MULTICHIP_METRICS '
 
 
 def parse_round(path):
@@ -76,21 +94,70 @@ def parse_round(path):
     return number, headline
 
 
+def parse_multichip_round(path):
+    """``(round_number, metrics_dict)`` from one MULTICHIP_r*.json
+    wrapper (keys prefixed ``multichip_``), or None when the round
+    carries nothing foldable. Prefers the dryrun's self-counted
+    ``MULTICHIP_METRICS`` JSON line (emitted LAST, so it survives tail
+    clipping); legacy rounds fall back to counting the checkpoint lines
+    still visible in the tail."""
+    match = _MULTICHIP_RE.search(os.path.basename(path))
+    if not match:
+        return None
+    with open(path) as f:
+        record = json.load(f)
+    number = int(record.get('n', match.group(1)))
+    tail = record.get('tail', '')
+    metrics = None
+    for line in tail.splitlines():
+        line = line.strip()
+        if not line.startswith(_MULTICHIP_METRICS_PREFIX):
+            continue
+        try:
+            parsed = json.loads(line[len(_MULTICHIP_METRICS_PREFIX):])
+        except ValueError:
+            continue
+        if isinstance(parsed, dict):
+            metrics = parsed  # keep the LAST parseable metrics line
+    if metrics is None:
+        if not record.get('ok'):
+            return None
+        checks = tail.count('dryrun_multichip:')
+        if not checks:
+            return None
+        metrics = {'checks': checks}
+    return number, {'multichip_' + key: value
+                    for key, value in metrics.items()}
+
+
 def load_rounds(directory):
     """Every parseable round in ``directory``, oldest first:
-    ``[(n, headline), ...]``. Unparseable wrappers (clipped tails of the
-    rounds lost to the old single-line format) are skipped, not fatal —
-    the trend is built from whatever rounds survive."""
-    rounds = []
+    ``[(n, headline), ...]`` — BENCH headlines with the same-numbered
+    MULTICHIP round's metrics merged into ``extra`` (a MULTICHIP-only
+    round gets a value-less headline, so the mesh scoreboard is gated
+    even when a bench round was lost). Unparseable wrappers (clipped
+    tails of the rounds lost to the old single-line format) are skipped,
+    not fatal — the trend is built from whatever rounds survive."""
+    by_round = {}
     for path in sorted(glob.glob(os.path.join(directory, 'BENCH_r*.json'))):
         try:
             parsed = parse_round(path)
         except (OSError, ValueError):
             parsed = None
         if parsed is not None:
-            rounds.append(parsed)
-    rounds.sort(key=lambda pair: pair[0])
-    return rounds
+            by_round[parsed[0]] = parsed[1]
+    for path in sorted(glob.glob(os.path.join(directory,
+                                              'MULTICHIP_r*.json'))):
+        try:
+            parsed = parse_multichip_round(path)
+        except (OSError, ValueError):
+            parsed = None
+        if parsed is None:
+            continue
+        number, metrics = parsed
+        headline = by_round.setdefault(number, {'value': None, 'extra': {}})
+        headline.setdefault('extra', {}).update(metrics)
+    return sorted(by_round.items())
 
 
 def metric_value(headline, key):
