@@ -35,11 +35,16 @@ telemetry back).
 #: ``decode`` (arrow_worker._load_rowgroup) · ``autotune`` one staging
 #: autotuner tick: registry snapshot + rollup window close + policy
 #: (petastorm_tpu/jax/autotune.py; the loop's own overhead is on the
-#: books)
+#: books) · ``readahead_fetch`` one coalesced prefetch of an upcoming
+#: row-group's column-chunk ranges on the readahead plane's fetch
+#: threads (petastorm_tpu/readahead.py; wall time overlapped with
+#: decode — a high share here with low ``io`` share is the plane
+#: working)
 STAGES = ('ventilate', 'io', 'decode', 'filter', 'transform', 'queue_wait',
           'collate', 'h2d', 'h2d_ready', 'stage_fill', 'h2d_dispatch',
           'cache_hit_read', 'cache_fill', 'decode_fused',
-          'rowgroup_prune', 'late_materialize', 'autotune')
+          'rowgroup_prune', 'late_materialize', 'autotune',
+          'readahead_fetch')
 
 #: every trace-event name the package records outside the canonical stage
 #: spans (docs/telemetry.md, tracing section)
@@ -141,6 +146,14 @@ METRIC_NAMES = frozenset([
     'petastorm_tpu_rows_pruned_total',
     'petastorm_tpu_late_materialized_rows_total',
     'petastorm_tpu_decoded_cache_skipped_total',
+    # wire-speed I/O plane: coalesced column-chunk readahead
+    # (readahead.py)
+    'petastorm_tpu_readahead_hits_total',
+    'petastorm_tpu_readahead_misses_total',
+    'petastorm_tpu_readahead_bytes_total',
+    'petastorm_tpu_readahead_coalesced_reads_total',
+    'petastorm_tpu_readahead_degraded_total',
+    'petastorm_tpu_readahead_pool_bytes',
 ])
 
 #: prefix of every operator-facing environment knob
@@ -196,6 +209,13 @@ KNOWN_KNOBS = frozenset([
     'PETASTORM_TPU_PUSHDOWN',
     'PETASTORM_TPU_PUSHDOWN_PRUNE',
     'PETASTORM_TPU_PUSHDOWN_WORKERS',
+    'PETASTORM_TPU_READAHEAD',
+    'PETASTORM_TPU_READAHEAD_DEPTH',
+    'PETASTORM_TPU_READAHEAD_MAX_DEPTH',
+    'PETASTORM_TPU_READAHEAD_THREADS',
+    'PETASTORM_TPU_READAHEAD_POOL_MB',
+    'PETASTORM_TPU_READAHEAD_GAP_KB',
+    'PETASTORM_TPU_READAHEAD_MAX_RANGE_MB',
 ])
 
 #: canonical anomaly event kinds the live observability plane's detector
@@ -233,10 +253,14 @@ ANOMALY_KINDS = {
 #: meaningful at the message-send sites; the data-path sites take the
 #: error/oserror/delay modes.
 FAULTPOINTS = {
-    'io.read': 'parquet row-group read (arrow_worker._load_rowgroup) '
-               'and the pushdown planner\'s footer-statistics fetch '
+    'io.read': 'parquet row-group read (arrow_worker._load_rowgroup), '
+               'the pushdown planner\'s footer-statistics fetch '
                '(pushdown.StatsIndex, keys end in #footer — a footer '
-               'fault degrades to unpruned reads, never a wrong answer)',
+               'fault degrades to unpruned reads, never a wrong answer) '
+               'and the readahead plane\'s prefetch reads (readahead.py, '
+               'keys end in #readahead — a fetch fault degrades to the '
+               'worker\'s blocking read, counted in '
+               'petastorm_tpu_readahead_degraded_total)',
     'decode.rowgroup': 'whole row-group decode, incl. the native batch '
                        'decoders (arrow_worker._load_rowgroup)',
     'decode.batch': 'one column batch decode (codecs.'
@@ -302,6 +326,10 @@ BORROW_CALL_KWARGS = {
 BORROW_ATTRS = frozenset([
     'slot.buffers',
     'column.cells',
+    # a readahead fetch entry's pooled range buffers (readahead.py):
+    # recycled when the entry's reference census drains — views over
+    # them are pinned only by a served table's finalizer
+    'entry.ranges',
 ])
 
 #: the ownership-transfer annotation: ``# pipesan: owns`` on (any line of)

@@ -15,11 +15,16 @@ publish a :class:`ColumnBatch`.
 
 import hashlib
 import logging
+from collections import OrderedDict
 
 import numpy as np
 import pyarrow.parquet as pq
 
 from petastorm_tpu import faults
+# the wire-speed I/O plane (docs/telemetry.md "Readahead"): coalesced
+# column-chunk prefetch serving _read_columns zero-copy; a miss IS the
+# blocking read below, so parity never depends on it
+from petastorm_tpu import readahead
 from petastorm_tpu.cache import NullCache
 from petastorm_tpu.codecs import CompressedImageCodec, decode_batch_with_nulls
 from petastorm_tpu.fused import (
@@ -46,6 +51,17 @@ from petastorm_tpu.workers.worker_base import WorkerBase
 logger = logging.getLogger(__name__)
 
 _ALL_ROWS = slice(None)
+
+#: bound on the per-worker open-ParquetFile memo: many-file datasets must
+#: not grow an unbounded open-file/handle map per worker — least-recently
+#: used files are closed and transparently re-opened on the next touch.
+#: The trade-off is explicit: an eviction costs a footer re-read/parse on
+#: the next touch of that file (a remote round trip on object stores,
+#: paid even on readahead hits — serve() needs the parsed metadata), so
+#: the bound is sized to make eviction RARE under shuffled many-file
+#: reads while keeping worst-case handle count (workers × this) well
+#: under default ulimits
+_PARQUET_FILE_CACHE_MAX = 64
 
 
 def _binary_cell_views(arrow_col):
@@ -157,7 +173,13 @@ class RowGroupWorker(WorkerBase):
         self._defer_decode = (bool(args.get('defer_image_decode'))
                               and defer_config_ok(self._transform_spec,
                                                   self._ngram, self._cache))
-        self._parquet_files = {}
+        self._parquet_files = OrderedDict()
+        # per-process readahead manager (petastorm_tpu/readahead.py),
+        # shared by every thread-pool worker in this process and
+        # refcounted across them; None when the plane is off or the
+        # reader shipped no plan (caching readers — a warm epoch must
+        # not prefetch bytes it will never read)
+        self._readahead = readahead.attach(args)
         # PETASTORM_TPU_PUSHDOWN=0: the decode-everything-then-filter
         # oracle shape (exact-parity baseline + the bench's full-scan
         # rung) — resolved once per worker, in the worker's own process
@@ -200,10 +222,13 @@ class RowGroupWorker(WorkerBase):
             batch = self._cache.get(
                 cache_key,
                 lambda: self._load_rowgroup(piece, worker_predicate,
-                                            shuffle_row_drop_partition))
+                                            shuffle_row_drop_partition,
+                                            item_index=item_index,
+                                            epoch=epoch))
         else:
             batch = self._load_rowgroup(piece, worker_predicate,
-                                        shuffle_row_drop_partition)
+                                        shuffle_row_drop_partition,
+                                        item_index=item_index, epoch=epoch)
         if batch is not None:
             batch.item_index = item_index
             batch.epoch = epoch
@@ -222,12 +247,15 @@ class RowGroupWorker(WorkerBase):
                 self.publish_func(batch)
 
     def shutdown(self):
+        if self._readahead is not None:
+            self._readahead = None
+            readahead.release(self.args)
         for f in self._parquet_files.values():
             try:
                 f.close()
             except Exception:  # noqa: BLE001 - best-effort close
                 pass
-        self._parquet_files = {}
+        self._parquet_files = OrderedDict()
 
     # -- internals ----------------------------------------------------------
 
@@ -283,22 +311,45 @@ class RowGroupWorker(WorkerBase):
         return '%s:%s' % (self._decode_fp, file_fp)
 
     def _parquet_file(self, path):
-        if path not in self._parquet_files:
-            self._parquet_files[path] = pq.ParquetFile(self._dataset_info.open(path))
-        return self._parquet_files[path]
+        pf = self._parquet_files.get(path)
+        if pf is None:
+            pf = pq.ParquetFile(self._dataset_info.open(path))
+            self._parquet_files[path] = pf
+            while len(self._parquet_files) > _PARQUET_FILE_CACHE_MAX:
+                _, evicted = self._parquet_files.popitem(last=False)
+                try:
+                    evicted.close()
+                except Exception:  # noqa: BLE001 - best-effort close
+                    pass
+        else:
+            self._parquet_files.move_to_end(path)
+        return pf
 
     def _needed_stored_fields(self):
         """Names of stored fields to read+decode (pre-transform view)."""
         return [f.name for f in self._loaded_schema
                 if f.name in self._stored_schema.fields]
 
-    def _load_rowgroup(self, piece, worker_predicate, drop_partition):
+    def _load_rowgroup(self, piece, worker_predicate, drop_partition,
+                       item_index=None, epoch=None):
         if self._fullscan_oracle and worker_predicate is not None:
             return self._load_rowgroup_fullscan(piece, worker_predicate,
-                                                drop_partition)
+                                                drop_partition,
+                                                item_index=item_index,
+                                                epoch=epoch)
         needed = self._needed_stored_fields()
         partition_keys = [k for k in piece.partition_values if k in needed]
         file_columns = [n for n in needed if n not in piece.partition_values]
+
+        if self._readahead is not None:
+            # advance the readahead clock BEFORE any read of this item.
+            # The prefetchable set respects the late-materialization
+            # two-phase split: under a predicate only the predicate
+            # columns fetch ahead — survivors' heavy columns stay
+            # on-demand (most row-groups never materialize them)
+            prefetch = (sorted(worker_predicate.get_fields())
+                        if worker_predicate is not None else file_columns)
+            self._readahead.observe(item_index, epoch, prefetch)
 
         pf = self._parquet_file(piece.path)
 
@@ -382,11 +433,21 @@ class RowGroupWorker(WorkerBase):
         Faultpoint key: one stable identity per row-group, so chaos
         specs can poison a specific one (match=) or rate-sample reads;
         '#' not ':' as the separator — ':' is the spec grammar's own
-        field separator, so a match= value could never contain it."""
+        field separator, so a match= value could never contain it.
+
+        A readahead hit serves the same columns zero-copy from the
+        prefetched coalesced ranges (pa.BufferReader-backed, zero
+        storage I/O); a miss — or ``PETASTORM_TPU_READAHEAD=0``, the
+        exact-parity oracle — is the blocking read below."""
         if faults.ARMED:
             faults.fault_hit('io.read', key='%s#rg%d'
                              % (piece.path, piece.row_group))
         with span('io'):
+            if self._readahead is not None:
+                table = self._readahead.serve(pf, piece.path,
+                                              piece.row_group, read_columns)
+                if table is not None:
+                    return table
             return pf.read_row_group(piece.row_group, columns=read_columns)
 
     def _finish_batch(self, columns, piece, partition_keys, count):
@@ -406,7 +467,8 @@ class RowGroupWorker(WorkerBase):
         return batch
 
     def _load_rowgroup_fullscan(self, piece, worker_predicate,
-                                drop_partition):
+                                drop_partition, item_index=None,
+                                epoch=None):
         """The decode-everything-then-filter ORACLE
         (``PETASTORM_TPU_PUSHDOWN=0``): one read of every needed +
         predicate column, every row of every column decoded, the
@@ -429,6 +491,10 @@ class RowGroupWorker(WorkerBase):
                             if f not in piece.partition_values]
         read_columns = list(dict.fromkeys(file_columns + pred_file_fields))
 
+        if self._readahead is not None:
+            # the oracle's one read wants everything at once, so the
+            # whole union is the prefetchable set here
+            self._readahead.observe(item_index, epoch, read_columns)
         pf = self._parquet_file(piece.path)
         table = self._read_columns(pf, piece, read_columns)
         num_rows = table.num_rows
@@ -497,8 +563,16 @@ class RowGroupWorker(WorkerBase):
             raise ValueError('Predicate references unknown fields: %s' % missing)
         file_fields = [f for f in pred_fields if f not in piece.partition_values]
         with span('io'):
-            pred_table = pf.read_row_group(piece.row_group,
-                                           columns=file_fields)
+            # phase-1 of the two-phase split: exactly the columns the
+            # readahead plane prefetches under a predicate
+            pred_table = None
+            if self._readahead is not None:
+                pred_table = self._readahead.serve(pf, piece.path,
+                                                   piece.row_group,
+                                                   file_fields)
+            if pred_table is None:
+                pred_table = pf.read_row_group(piece.row_group,
+                                               columns=file_fields)
         with span('decode'):
             decoded = {name: self._decode_column(name,
                                                  pred_table.column(name))

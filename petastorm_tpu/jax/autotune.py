@@ -28,7 +28,19 @@ WINDOW_SEC``, and adjusts three knobs from the window stream:
   this process would silently keep).
 * **producer-bound** verdict for 3 consecutive windows (the consumer
   waits on data) → **restore decode threads** back toward the
-  construction-time baseline, one at a time.
+  construction-time baseline, one at a time — and **raise the
+  ventilator's in-flight bound** (``Reader.set_ventilate_extra``, the
+  remaining knob of the ROADMAP self-tuning item, bounded) so the pool
+  never drains between pulls; a consumer-bound streak lowers it back
+  toward the construction-time baseline.
+* **io-wait saturation** (``io`` stage seconds-per-second at or above
+  the saturation share while producer-bound, 3 consecutive windows) →
+  **deepen readahead**: one more row-group of prefetch depth on the
+  wire-speed I/O plane (:mod:`petastorm_tpu.readahead`; an in-process
+  depth override, bounded by ``PETASTORM_TPU_READAHEAD_MAX_DEPTH``) —
+  storage latency hides behind decode. Sustained **buffer-pool memory
+  pressure** (occupancy ≥ 85% of the pool budget) sheds the depth back
+  one step at a time instead.
 
 Every decision lands three ways, so Perfetto and ``pipeline_report()``
 show *why* throughput changed: a canonical ``autotune_decision`` trace
@@ -55,7 +67,9 @@ from petastorm_tpu.telemetry import (
     get_registry, knobs, metrics_disabled, register_refresh, span, tracing,
 )
 from petastorm_tpu.telemetry.stall import CONSUMER_BOUND, PRODUCER_BOUND
-from petastorm_tpu.telemetry.timeseries import WindowedRollup, h2d_ready_share
+from petastorm_tpu.telemetry.timeseries import (
+    WindowedRollup, h2d_ready_share, io_wait_share,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -75,6 +89,20 @@ _decision_seq = 0
 #: owner clears only its own setting, and a second loader's tuner can
 #: never mistake the tuned-down width for the configured baseline
 _override_owner = None
+
+#: same single-owner discipline for the readahead DEPTH override
+#: (readahead.set_depth_override is one slot per process too)
+_readahead_owner = None
+
+#: pool-occupancy share at which the readahead depth sheds (memory
+#: pressure: the pool is the bound, not the wire)
+_READAHEAD_POOL_PRESSURE = 0.85
+
+#: ceiling on the autotuned ventilator in-flight extra (row-groups kept
+#: in flight beyond the pool's worker count; the knobless satellite of
+#: the self-tuning item — each step costs one more decoded row-group of
+#: consumer-side queue memory at most)
+_MAX_INFLIGHT_EXTRA = 16
 
 # knob caches (refresh_autotune() re-reads); None = not yet resolved
 _enabled = None
@@ -155,10 +183,11 @@ def decision_counts():
 
 
 def _reset_for_tests():
-    global _override_owner
+    global _override_owner, _readahead_owner
     with _decisions_lock:
         _decisions.clear()
     _override_owner = None
+    _readahead_owner = None
 
 
 class StagingAutotuner:
@@ -199,6 +228,19 @@ class StagingAutotuner:
         self._h2d_streak = 0
         self._consumer_streak = 0
         self._producer_streak = 0
+        # readahead-depth control (petastorm_tpu/readahead.py): deepen
+        # while the fleet's io-wait share stays saturated, shed under
+        # buffer-pool memory pressure
+        from petastorm_tpu import readahead
+        self._readahead = readahead
+        self._readahead_max_depth = readahead.readahead_max_depth()
+        self._readahead_override = None
+        self._io_streak = 0
+        self._pool_streak = 0
+        # ventilator in-flight bound (Reader.set_ventilate_extra): the
+        # remaining knob of the ROADMAP self-tuning item — baseline
+        # captured lazily at the first adjustment
+        self._inflight_baseline = None
         #: total adjustments made by THIS tuner (loader diagnostics)
         self.decisions = 0
 
@@ -242,15 +284,40 @@ class StagingAutotuner:
                                  if verdict == CONSUMER_BOUND else 0)
         self._producer_streak = (self._producer_streak + 1
                                  if verdict == PRODUCER_BOUND else 0)
+        # readahead: the io-wait share only argues for more depth while
+        # the consumer actually starves (producer-bound) — an io-heavy
+        # but keeping-up pipeline gains nothing from deeper prefetch.
+        # io_wait_share is fleet-SUMMED seconds-per-second (N parallel
+        # workers can push it past 1.0), so the 0..1 saturation knob is
+        # scaled by the pool's worker count: the trigger means "each
+        # worker spends ≥ the share blocked in io", not "the fleet's
+        # summed io crumbs add up to it"
+        io_share = io_wait_share(window)
+        io_starved = (io_share >= self._saturated_share
+                      * self._io_share_scale()
+                      and verdict == PRODUCER_BOUND)
+        self._io_streak = self._io_streak + 1 if io_starved else 0
+        used, budget = self._readahead.pool_status()
+        pressured = budget > 0 and used / budget >= _READAHEAD_POOL_PRESSURE
+        self._pool_streak = self._pool_streak + 1 if pressured else 0
         if self._h2d_streak >= self._CONSECUTIVE:
             self._h2d_streak = 0
             actions += self._deepen(ready_share)
+        if self._pool_streak >= self._CONSECUTIVE:
+            self._pool_streak = 0
+            self._io_streak = 0
+            actions += self._shed_readahead(used, budget)
+        elif self._io_streak >= self._CONSECUTIVE:
+            self._io_streak = 0
+            actions += self._deepen_readahead(io_share)
         if self._consumer_streak >= self._CONSECUTIVE:
             self._consumer_streak = 0
             actions += self._shed_decode_threads()
+            actions += self._lower_inflight()
         elif self._producer_streak >= self._CONSECUTIVE:
             self._producer_streak = 0
             actions += self._restore_decode_threads()
+            actions += self._raise_inflight()
         self.decisions += len(actions)
         return actions
 
@@ -274,6 +341,113 @@ class StagingAutotuner:
                 'deepen_prefetch', prefetch_from=prefetch,
                 prefetch_to=after, h2d_ready_share=round(ready_share, 4)))
         return actions
+
+    # -- readahead depth (petastorm_tpu/readahead.py) -------------------------
+
+    def _io_share_scale(self):
+        """The io-saturation normalizer: the reader pool's worker count
+        (re-read each window — service fleets grow live), floor 1 for
+        loaders whose reader exposes no pool."""
+        reader = self._tunable_reader()
+        pool = getattr(reader, '_pool', None) if reader is not None \
+            else None
+        workers = getattr(pool, 'workers_count', None)
+        return max(1, workers) if isinstance(workers, int) else 1
+
+    def _owns_readahead(self):
+        """Single-owner guard for the process-wide readahead depth
+        override — same discipline as the decoder-thread slot."""
+        global _readahead_owner
+        if _readahead_owner is None:
+            _readahead_owner = self
+        return _readahead_owner is self
+
+    def _deepen_readahead(self, io_share):
+        """Sustained io-wait while the consumer starves: fetch further
+        ahead so storage latency hides behind decode — bounded by
+        ``PETASTORM_TPU_READAHEAD_MAX_DEPTH``, and only where a live
+        manager can observe the override (thread-pool workers share
+        this process; remote fleets tune from their own windows)."""
+        if self._readahead.live_manager_count() == 0 \
+                or not self._owns_readahead():
+            return []
+        current = self._readahead.current_depth()
+        if current >= self._readahead_max_depth:
+            return []
+        self._readahead_override = current + 1
+        self._readahead.set_depth_override(self._readahead_override)
+        return [record_decision('deepen_readahead', depth_from=current,
+                                depth_to=self._readahead_override,
+                                io_wait_share=round(io_share, 4))]
+
+    def _shed_readahead(self, used, budget):
+        """Sustained buffer-pool pressure: the pool, not the wire, is
+        the bound — back the depth off one step, never below the KNOB's
+        own width (the static configuration is the floor, as with every
+        shed), so fetches stop being declined (``pool-exhausted``) at
+        the budget edge."""
+        if self._readahead.live_manager_count() == 0 \
+                or not self._owns_readahead():
+            return []
+        current = self._readahead.current_depth()
+        if current <= self._readahead.readahead_depth():
+            return []
+        self._readahead_override = current - 1
+        self._readahead.set_depth_override(self._readahead_override)
+        return [record_decision(
+            'shed_readahead', depth_from=current,
+            depth_to=self._readahead_override,
+            pool_share=round(used / budget, 4) if budget else None)]
+
+    def _release_readahead(self):
+        global _readahead_owner
+        if self._readahead_override is not None:
+            self._readahead.set_depth_override(None)
+            self._readahead_override = None
+        if _readahead_owner is self:
+            _readahead_owner = None
+
+    # -- ventilator in-flight bound (Reader.set_ventilate_extra) --------------
+
+    def _tunable_reader(self):
+        reader = getattr(self._loader, 'reader', None)
+        if reader is None or not hasattr(reader, 'set_ventilate_extra'):
+            return None
+        return reader
+
+    def _raise_inflight(self):
+        """Producer-bound: the consumer waits on data — let the
+        ventilator keep more row-groups in flight so the pool never
+        drains between pulls (bounded; each step is at most one more
+        decoded row-group queued consumer-side)."""
+        reader = self._tunable_reader()
+        if reader is None:
+            return []
+        current = reader.ventilate_extra
+        if self._inflight_baseline is None:
+            self._inflight_baseline = current
+        if current >= _MAX_INFLIGHT_EXTRA:
+            return []
+        after = reader.set_ventilate_extra(current + 1)
+        return [record_decision('raise_inflight', inflight_from=current,
+                                inflight_to=after)]
+
+    def _lower_inflight(self):
+        """Consumer-bound: the training step is the wall — give queued
+        row-group memory back, one step at a time toward the
+        construction-time baseline (never below it: the static
+        configuration is the floor, as with every shed)."""
+        reader = self._tunable_reader()
+        if reader is None or self._inflight_baseline is None:
+            return []
+        current = reader.ventilate_extra
+        if current <= self._inflight_baseline:
+            return []
+        after = reader.set_ventilate_extra(current - 1)
+        return [record_decision('lower_inflight', inflight_from=current,
+                                inflight_to=after)]
+
+    # -- decoder threads ------------------------------------------------------
 
     def _owns_override(self):
         """True when THIS tuner may move the process-wide decoder-thread
@@ -330,21 +504,23 @@ class StagingAutotuner:
     # -- lifecycle / reporting ------------------------------------------------
 
     def close(self):
-        """Loader stop: drop the decoder-thread override — only if THIS
-        tuner holds it — so the learned setting dies with the loader
-        instead of leaking into later readers (or wiping another live
-        tuner's setting). The decision log survives in the module ring
-        and the counter."""
+        """Loader stop: drop the decoder-thread and readahead-depth
+        overrides — only those THIS tuner holds — so learned settings
+        die with the loader instead of leaking into later readers (or
+        wiping another live tuner's). The decision log survives in the
+        module ring and the counter."""
         global _override_owner
         if self._thread_override is not None:
             self._release_override()
         elif _override_owner is self:
             _override_owner = None
+        self._release_readahead()
 
     def summary(self):
         """The report-facing view: current depths, bounds, streaks and
         the recent decision log."""
         stager = self._loader._stager
+        reader = self._tunable_reader()
         return {
             'window_s': self.window_s,
             'slots': stager.num_slots if stager is not None else None,
@@ -352,6 +528,10 @@ class StagingAutotuner:
             'prefetch': self._loader._prefetch,
             'max_prefetch': self._max_prefetch,
             'decoder_threads': self._codecs.image_decoder_threads(),
+            'readahead_depth': self._readahead.current_depth(),
+            'readahead_max_depth': self._readahead_max_depth,
+            'ventilate_extra': (reader.ventilate_extra
+                                if reader is not None else None),
             'decisions': self.decisions,
             'recent': recent_decisions(10),
         }

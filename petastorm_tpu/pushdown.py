@@ -162,7 +162,13 @@ class StatsIndex:
     parallel (``PETASTORM_TPU_PUSHDOWN_WORKERS`` threads), memoized
     process-wide by file identity. One footer read per *file*, never per
     row-group; a file whose footer fails to load yields None and every
-    one of its row-groups is conservatively kept."""
+    one of its row-groups is conservatively kept.
+
+    Each memoized row-group entry also carries the exact **byte ranges**
+    of its column chunks (``{root column: [(offset, length), ...]}``) —
+    the readahead plane (:mod:`petastorm_tpu.readahead`) plans its
+    coalesced prefetch reads from the same one-footer-read-per-file memo
+    the pruning planner already pays for."""
 
     def __init__(self, dataset_info):
         self._info = dataset_info
@@ -183,7 +189,17 @@ class StatsIndex:
         stats = self._per_file.get(path)
         if stats is None or row_group >= len(stats):
             return None
-        return stats[row_group]
+        cols, num_rows, _ = stats[row_group]
+        return cols, num_rows
+
+    def get_ranges(self, path, row_group):
+        """``{root column: [(byte offset, length), ...]}`` of one
+        row-group's column chunks, or None when the footer was
+        unreadable — the readahead plane's range planner."""
+        stats = self._per_file.get(path)
+        if stats is None or row_group >= len(stats):
+            return None
+        return stats[row_group][2]
 
     def _load(self, path):
         key = None
@@ -232,16 +248,29 @@ class StatsIndex:
         for rg in range(meta.num_row_groups):
             row_group = meta.row_group(rg)
             cols = {}
+            ranges = {}
             for ci in range(row_group.num_columns):
                 col = row_group.column(ci)
                 name = col.path_in_schema.split('.')[0]
+                # chunk byte range for the readahead plane: the chunk
+                # starts at its first page (the dictionary page when one
+                # exists, else the first data page) and spans its total
+                # compressed size
+                starts = [offset for offset
+                          in (col.dictionary_page_offset,
+                              col.data_page_offset)
+                          if offset is not None]
+                if starts and col.total_compressed_size:
+                    ranges.setdefault(name, []).append(
+                        (int(min(starts)),
+                         int(col.total_compressed_size)))
                 st = col.statistics
                 if st is None or not st.has_min_max:
                     continue
                 null_count = (int(st.null_count) if st.has_null_count
                               else None)
                 cols[name] = (st.min, st.max, null_count)
-            out.append((cols, int(row_group.num_rows)))
+            out.append((cols, int(row_group.num_rows), ranges))
         return out
 
 

@@ -1,0 +1,936 @@
+"""Wire-speed I/O plane: coalesced column-chunk readahead for decode workers.
+
+Every row-group read used to be a blocking ``pf.read_row_group`` inside
+the decode worker, so storage I/O and decode serialized per worker even
+though the ventilator publishes the upcoming item order well in advance —
+on remote/cold storage the whole fleet idles on the wire. tf.data
+(PAPERS.md, arxiv 2101.12127) and the tabular-preprocessing study (arxiv
+2409.14912) both locate the next order of magnitude for input pipelines in
+exactly this overlap: prefetch storage bytes ahead of compute, and read
+them in large coalesced ranges instead of one column-chunk syscall at a
+time. This module is that plane, per decode *process*:
+
+* a :class:`ReadaheadManager` (one per worker process, shared by every
+  thread-pool worker in it) mirrors the ventilator's upcoming-item
+  sequence arithmetically — same per-epoch permutation seed, same
+  ``always_exclude`` filtering (statistics-pruned row-groups never fetch),
+  same reset-stride seed advance — so it knows which row-groups the pool
+  will ask for next without any extra channel;
+* for each upcoming row-group it plans **exact byte ranges** per needed
+  column chunk from the PR 12 memoized footer metadata
+  (:class:`petastorm_tpu.pushdown.StatsIndex` — one footer read per file,
+  process-wide memo) and **coalesces** adjacent ranges into large
+  sequential reads (``PETASTORM_TPU_READAHEAD_GAP_KB`` /
+  ``.._MAX_RANGE_MB``);
+* a small pool of GIL-released fetch threads reads the ranges ``depth``
+  row-groups ahead into a bounded shared buffer pool
+  (``PETASTORM_TPU_READAHEAD_POOL_MB``), under the canonical
+  ``readahead_fetch`` stage;
+* the worker's ``_read_columns`` is then served **zero-copy**: the hit
+  deserializes through :class:`pyarrow.BufferReader`-backed slices of the
+  pooled fetch buffers (a file-like over the fetched ranges, handed to
+  ``pq.ParquetFile(..., metadata=)`` so the footer is never re-read), and
+  the pool accounting holds the buffer until the served table dies.
+
+Failure never changes an answer: a fetch error (which rides the existing
+``io.read`` faultpoint with a ``#readahead`` key suffix), a missing
+footer, pool exhaustion, or a deserialization surprise all degrade to the
+worker's blocking read, counted in
+``petastorm_tpu_readahead_degraded_total{reason=…}``.
+``PETASTORM_TPU_READAHEAD=0`` keeps the blocking read as the exact-parity
+oracle (``tests/test_readahead.py`` holds row multisets identical across
+thread/process/service pools). The late-materialization two-phase split is
+respected: under a predicate only the predicate columns prefetch;
+survivors' heavy columns stay on-demand.
+
+Ownership (pipesan): fetch buffers are owned by the pool; the views a
+served table holds are pinned by the entry's reference count (the
+``weakref.finalize`` census on every served table), and under
+``PETASTORM_TPU_SANITIZE=1`` the buffers carry red-zone canaries checked
+when the pool reclaims them. ``fetch.ranges`` is a registered borrow
+source in ``analysis/contracts.py``.
+"""
+
+import logging
+import os
+import threading
+import weakref
+
+from petastorm_tpu import faults
+from petastorm_tpu.telemetry import (
+    get_registry, knobs, metrics_disabled, register_refresh, span,
+)
+
+logger = logging.getLogger(__name__)
+
+#: registry series (docs/telemetry.md metric reference). Worker-side
+#: increments ride the pool delta channels like every metric, so the
+#: consumer's report sees the whole fleet's readahead activity.
+READAHEAD_HITS = 'petastorm_tpu_readahead_hits_total'
+READAHEAD_MISSES = 'petastorm_tpu_readahead_misses_total'
+READAHEAD_BYTES = 'petastorm_tpu_readahead_bytes_total'
+READAHEAD_COALESCED_READS = 'petastorm_tpu_readahead_coalesced_reads_total'
+READAHEAD_DEGRADED = 'petastorm_tpu_readahead_degraded_total'
+READAHEAD_POOL_BYTES = 'petastorm_tpu_readahead_pool_bytes'
+
+#: how long a serve may wait on an in-flight fetch before degrading to
+#: the blocking read (a dead fetch thread must wedge nothing)
+_SERVE_WAIT_S = 30.0
+
+#: bound on the per-manager order cache (current epoch ± lookahead)
+_ORDER_CACHE_MAX = 4
+#: bound on the sweep-detection seen-sets (epochs per sweep retained)
+_SEEN_EPOCHS_MAX = 4
+
+#: the worker-args key the per-process manager parks under (set by
+#: :func:`attach` AFTER unpickling on the worker side, so it never
+#: travels a job-spec/process-pool wire)
+_ARGS_KEY = '_readahead_manager'
+
+# cached enablement knob (refresh_readahead/telemetry.refresh re-reads)
+_enabled = None
+
+#: autotuner depth override (single slot per process, like the decoder-
+#: thread override in codecs): None = the knob rules
+_depth_override = None
+
+#: live managers in this process (report/health occupancy)
+_live_managers = weakref.WeakSet()
+
+
+def readahead_enabled():
+    """True unless ``PETASTORM_TPU_READAHEAD=0`` pins the blocking-read
+    oracle (on by default: a miss is exactly the blocking read, so
+    enabling it is parity-safe). Resolved in the WORKER's own process —
+    service fleets set it fleet-wide like the pushdown knobs."""
+    global _enabled
+    if _enabled is None:
+        _enabled = not knobs.is_disabled('PETASTORM_TPU_READAHEAD')
+    return _enabled
+
+
+def readahead_depth():
+    """Row-groups fetched ahead of the sequence position (the knob half;
+    :func:`current_depth` folds in the autotuner override)."""
+    return knobs.get_int('PETASTORM_TPU_READAHEAD_DEPTH', 4, floor=1)
+
+
+def readahead_max_depth():
+    """Autotuner deepen ceiling."""
+    return knobs.get_int('PETASTORM_TPU_READAHEAD_MAX_DEPTH', 16, floor=1)
+
+
+def readahead_threads():
+    return knobs.get_int('PETASTORM_TPU_READAHEAD_THREADS', 2, floor=1)
+
+
+def pool_budget_bytes():
+    return knobs.get_int('PETASTORM_TPU_READAHEAD_POOL_MB', 256,
+                         floor=1) * 2 ** 20
+
+
+def gap_bytes():
+    """Coalescing gap: adjacent column-chunk ranges closer than this are
+    merged into one sequential read (the gap bytes are fetched and
+    discarded — cheaper than a second request on real storage)."""
+    return knobs.get_int('PETASTORM_TPU_READAHEAD_GAP_KB', 64,
+                         floor=0) * 1024
+
+
+def max_range_bytes():
+    """Upper bound on one coalesced read (a single larger chunk still
+    gets its own read — never split mid-chunk)."""
+    return knobs.get_int('PETASTORM_TPU_READAHEAD_MAX_RANGE_MB', 16,
+                         floor=1) * 2 ** 20
+
+
+def refresh_readahead():
+    """Re-read the cached enablement knob (part of
+    ``petastorm_tpu.telemetry.refresh()``); the sizing knobs are read at
+    manager construction / per scheduling pass."""
+    global _enabled
+    _enabled = None
+
+
+register_refresh(refresh_readahead)
+
+
+def current_depth():
+    """The live readahead depth: the autotuner's override when one is
+    set, else the knob."""
+    override = _depth_override
+    return override if override is not None else readahead_depth()
+
+
+def set_depth_override(depth):
+    """In-process override of the depth knob (the staging autotuner's
+    seam; never an ``os.environ`` mutation). ``None`` restores the
+    knob."""
+    global _depth_override
+    _depth_override = None if depth is None else max(1, int(depth))
+
+
+def count_degrade(reason):
+    """One degrade-to-blocking event, attributed (``fetch-error`` /
+    ``pool-exhausted`` / ``no-footer`` / ``no-columns`` /
+    ``deserialize`` / ``fetch-timeout`` / ``cache``) — the "Decode is
+    waiting on storage" runbook in docs/troubleshoot.md reads these."""
+    if not metrics_disabled():
+        get_registry().counter(READAHEAD_DEGRADED, reason=reason).inc()
+
+
+def live_manager_count():
+    """Managers currently alive in THIS process (the autotuner only
+    moves the depth override where it can reach a manager)."""
+    return len(_live_managers)
+
+
+def pool_status():
+    """``(bytes_in_use, budget_bytes)`` summed over this process's live
+    managers — the autotuner's memory-pressure signal."""
+    used = 0
+    budget = 0
+    for manager in list(_live_managers):
+        used += manager._pool.used
+        budget += manager._pool.budget
+    return used, budget
+
+
+def health_snapshot():
+    """JSON-safe per-process readahead state for ``/health`` (reader and
+    service worker-server endpoints): live counters + pool occupancy."""
+    used, budget = pool_status()
+    registry = get_registry()
+    return {
+        'enabled': readahead_enabled(),
+        'managers': live_manager_count(),
+        'depth': current_depth(),
+        'hits': int(registry.counter_value(READAHEAD_HITS)),
+        'misses': int(registry.counter_value(READAHEAD_MISSES)),
+        'pool_bytes': int(used),
+        'pool_budget_bytes': int(budget),
+    }
+
+
+def _reset_for_tests():
+    global _enabled, _depth_override
+    _enabled = None
+    _depth_override = None
+    for manager in list(_live_managers):
+        manager.close()
+    # a WeakSet sheds closed managers with their owners; clearing keeps
+    # pool_status from reading engines a test deliberately abandoned
+    _live_managers.clear()
+
+
+# -- the ventilation-sequence plan (built consumer-side, rides worker_args) --
+
+
+def build_plan(items, pieces, randomize, seed, iterations, exclude,
+               workers=None):
+    """The Reader's half: a picklable description of the ventilator's
+    upcoming-item sequence. ``items`` are the ventilator work items
+    (each carrying ``piece_index``), ``pieces`` the row-group list,
+    ``seed`` the ventilator's RESOLVED seed (after its None draw),
+    ``exclude`` the statistics-pruned item indices (skipped every epoch,
+    so they must never fetch), and ``workers`` the pool's worker count —
+    it bounds how many items can sit between observe and serve at once,
+    which sizes the retire slack (see ``_retire_passed_locked``)."""
+    return {
+        'version': 1,
+        # one (path, row_group) per item index; repeated piece paths
+        # pickle as one shared string (drop partitions share pieces)
+        'items': [(pieces[it['piece_index']].path,
+                   pieces[it['piece_index']].row_group) for it in items],
+        'randomize': bool(randomize),
+        'seed': int(seed),
+        'iterations': iterations,
+        'exclude': sorted(exclude or ()),
+        'workers': workers,
+    }
+
+
+def attach(args):
+    """Worker-side entry: the per-process manager for this reader's
+    worker args (created on first call, refcounted across the thread
+    pool's workers, parked on the args dict so it never crosses a
+    pickling boundary). None when the plane is off or the reader shipped
+    no plan (e.g. a caching reader — warm epochs must not fetch)."""
+    plan = args.get('readahead_plan') if isinstance(args, dict) else None
+    if plan is None or not readahead_enabled():
+        return None
+    manager = args.get(_ARGS_KEY)
+    if manager is None:
+        manager = ReadaheadManager(args['dataset_info'], plan)
+        args[_ARGS_KEY] = manager
+    manager.acquire()
+    return manager
+
+
+def release(args):
+    """Worker-side exit: drop one reference; the last worker out closes
+    the manager (fetch threads stopped, pool drained)."""
+    manager = args.get(_ARGS_KEY) if isinstance(args, dict) else None
+    if manager is not None and manager.release() == 0:
+        args.pop(_ARGS_KEY, None)
+
+
+# -- the bounded shared buffer pool ------------------------------------------
+
+
+class _BufferPool:
+    """Byte-budgeted accounting for in-flight + served fetch buffers.
+
+    ``acquire`` is all-or-nothing (a fetch that does not fit degrades to
+    the blocking read rather than evicting someone else's bytes);
+    ``free`` returns capacity when the last holder of an entry — the
+    registry slot or a served table's finalizer — lets go.
+    """
+
+    def __init__(self, budget):
+        self.budget = budget
+        self.used = 0
+        self._lock = threading.Lock()
+
+    def acquire(self, nbytes):
+        with self._lock:
+            if self.used + nbytes > self.budget:
+                return False
+            self.used += nbytes
+        self._note_gauge()
+        return True
+
+    def free(self, nbytes):
+        with self._lock:
+            self.used = max(0, self.used - nbytes)
+        self._note_gauge()
+
+    def _note_gauge(self):
+        if not metrics_disabled():
+            get_registry().gauge(READAHEAD_POOL_BYTES,
+                                 pid=str(os.getpid())).set(self.used)
+
+
+# -- one prefetched row-group -------------------------------------------------
+
+
+_PENDING, _READY, _FAILED = 'pending', 'ready', 'failed'
+
+
+class _Fetch:
+    """One scheduled row-group fetch: its coalesced ranges, pool
+    accounting and lifetime census. ``refs`` starts at 1 (the manager's
+    registry slot); every served table adds one and drops it from a
+    ``weakref.finalize`` when the table dies, so the pooled bytes stay
+    alive exactly as long as something can still read them zero-copy.
+    State transitions and the refcount share one per-entry lock: the
+    fetch thread, serving workers, the retire sweep and GC finalizers
+    all race here."""
+
+    __slots__ = ('rgkey', 'max_gpos', 'state', 'event', 'columns',
+                 'ranges', 'nbytes', 'file_size', 'refs', '_lock',
+                 '_pool', '_guards', '__weakref__')
+
+    def __init__(self, rgkey, max_gpos, pool):
+        self.rgkey = rgkey
+        self.max_gpos = max_gpos
+        self.state = _PENDING
+        self.event = threading.Event()
+        self.columns = frozenset()
+        self.ranges = []
+        self.nbytes = 0
+        self.file_size = None
+        self.refs = 1
+        self._lock = threading.Lock()
+        self._pool = pool
+        self._guards = []
+
+    def complete(self, ranges, guards, nbytes, columns, file_size):
+        """Fetch thread handing over the bytes. False when the entry was
+        retired while the read was in flight — the caller returns the
+        acquired pool bytes itself."""
+        with self._lock:
+            if self.state != _PENDING:
+                return False
+            self.ranges = ranges
+            self._guards = guards
+            self.nbytes = nbytes
+            self.columns = frozenset(columns)
+            self.file_size = file_size
+            self.state = _READY
+        self.event.set()
+        return True
+
+    def fail(self):
+        """A pending fetch failed/was declined; idempotent and a no-op
+        for entries that already completed. Pool bytes the FETCH thread
+        acquired are the fetch thread's to return — it is the only one
+        who knows about them."""
+        with self._lock:
+            if self.state == _PENDING:
+                self.state = _FAILED
+        self.event.set()
+
+    def retire(self):
+        """The manager's registry slot lets go (sequence passed, close):
+        a pending entry is cancelled, a ready one drops the registry
+        reference."""
+        with self._lock:
+            if self.state == _PENDING:
+                self.state = _FAILED
+                drop = False
+            else:
+                drop = self.state == _READY
+        self.event.set()
+        if drop:
+            self.drop_ref()
+
+    def try_add_ref(self):
+        """One more holder — only while the entry is still servable."""
+        with self._lock:
+            if self.state != _READY:
+                return False
+            self.refs += 1
+            return True
+
+    def drop_ref(self):
+        """May run from a GC finalizer on any thread. The last holder
+        out reclaims: canaries checked, buffers dropped, pool bytes
+        returned — exactly once."""
+        with self._lock:
+            self.refs -= 1
+            reclaim = self.refs <= 0 and self.state == _READY
+            if reclaim:
+                self.state = _FAILED  # terminal; nothing may serve now
+                ranges, self.ranges = self.ranges, []
+                guards, self._guards = self._guards, []
+        if reclaim:
+            self._check_guards(guards)
+            del ranges
+            self._pool.free(self.nbytes)
+
+    def _check_guards(self, guards):
+        """Red-zone verification at reclaim time (armed pool buffers are
+        allocated between canaries): a trampled zone means something
+        wrote through a served zero-copy view."""
+        if not guards:
+            return
+        from petastorm_tpu import sanitizer
+        for guard in guards:
+            if not sanitizer.check_canaries(guard):
+                sanitizer.record_violation(
+                    'readahead-canary',
+                    {'path': self.rgkey[0], 'row_group': self.rgkey[1]})
+
+
+# -- zero-copy range-backed file ---------------------------------------------
+
+
+class _OutsideRanges(Exception):
+    """A read fell outside the fetched ranges (metadata surprise — page
+    index, bloom filter): the serve degrades to the blocking read."""
+
+
+class _RangeSource:
+    """Minimal file-like over the fetched byte ranges, for
+    ``pq.ParquetFile(..., metadata=)``: reads inside a fetched range
+    return zero-copy :class:`pyarrow.Buffer` slices (via a per-range
+    ``pa.BufferReader``); anything else raises :class:`_OutsideRanges`
+    so the caller falls back instead of guessing."""
+
+    def __init__(self, ranges, file_size):
+        import pyarrow as pa
+        # Intentional borrow of the entry's pooled buffers: the serving
+        # caller holds an entry reference for the lifetime of this
+        # source and of every buffer slice the deserialization keeps
+        # (weakref.finalize on the served table).  # pipesan: owns
+        self._readers = [(start, len(buf), pa.BufferReader(buf))
+                         for start, buf in ranges]
+        self._size = file_size
+        self._pos = 0
+        self.closed = False
+
+    def seekable(self):
+        return True
+
+    def readable(self):
+        return True
+
+    def writable(self):
+        return False
+
+    def tell(self):
+        return self._pos
+
+    def size(self):
+        if self._size is None:
+            raise _OutsideRanges('file size unknown')
+        return self._size
+
+    def seek(self, offset, whence=0):
+        if whence == 0:
+            self._pos = offset
+        elif whence == 1:
+            self._pos += offset
+        else:
+            self._pos = self.size() + offset
+        return self._pos
+
+    def read(self, nbytes=-1):
+        if nbytes is None or nbytes < 0:
+            raise _OutsideRanges('unbounded read at %d' % self._pos)
+        for start, length, reader in self._readers:
+            if start <= self._pos and self._pos + nbytes <= start + length:
+                reader.seek(self._pos - start)
+                out = reader.read_buffer(nbytes)
+                self._pos += nbytes
+                return out
+        raise _OutsideRanges('read [%d, +%d) outside fetched ranges'
+                             % (self._pos, nbytes))
+
+    def close(self):
+        self.closed = True
+
+
+# -- range planning -----------------------------------------------------------
+
+
+def coalesce_ranges(chunk_ranges, gap, max_range):
+    """Merge sorted ``(start, length)`` column-chunk ranges into large
+    sequential reads: adjacent ranges closer than ``gap`` bytes coalesce
+    (the gap is fetched too — one request beats two on real storage)
+    while no merged read exceeds ``max_range`` — except that a single
+    chunk larger than ``max_range`` keeps its own undivided read."""
+    merged = []
+    for start, length in sorted(chunk_ranges):
+        if merged:
+            last_start, last_len = merged[-1]
+            end = last_start + last_len
+            if (start - end <= gap
+                    and max(end, start + length) - last_start <= max_range):
+                merged[-1] = (last_start,
+                              max(end, start + length) - last_start)
+                continue
+        merged.append((start, length))
+    return merged
+
+
+# -- the per-process manager --------------------------------------------------
+
+
+class ReadaheadManager:
+    """One decode process's readahead scheduler (module docstring).
+
+    Thread-safe: every thread-pool worker in the process calls
+    :meth:`observe`/:meth:`serve`; the fetch threads complete entries.
+    Correctness never depends on prediction — a mispredicted order (a
+    resumed epoch's exclusions, a checkpoint-restored seed) only costs
+    misses, which are exactly the blocking read.
+    """
+
+    def __init__(self, dataset_info, plan):
+        from petastorm_tpu.pushdown import StatsIndex
+        self._info = dataset_info
+        self._items = [tuple(item) for item in plan['items']]
+        self._randomize = plan['randomize']
+        self._seed = plan['seed']
+        self._iterations = plan.get('iterations')
+        self._exclude = frozenset(plan.get('exclude') or ())
+        self._stats = StatsIndex(dataset_info)
+        self._pool = _BufferPool(pool_budget_bytes())
+        self._gap = gap_bytes()
+        self._max_range = max_range_bytes()
+        # retire slack: with N concurrent workers, up to N siblings can
+        # sit between their observe() (which advances the clock) and
+        # their serve() — an entry that far behind the clock may still
+        # be awaited, so only entries beyond the slack retire. Purely an
+        # efficiency bound: a too-small slack costs misses, never rows.
+        self._workers = plan.get('workers') or 1
+        self._retire_slack = max(4, 2 * self._workers)
+        self._lock = threading.Lock()
+        self._footer_lock = threading.Lock()
+        self._columns = None
+        self._entries = {}
+        self._orders = {}     # (sweep, epoch) -> (order, pos_map, base)
+        self._next_base = 0
+        self._clock = -1
+        self._sweep = 0
+        self._seen_by_epoch = {}
+        self._max_epoch = -1
+        self._dup_streak = 0
+        self._refs = 0
+        self._closed = False
+        self._queue = None
+        self._threads = []
+        _live_managers.add(self)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def acquire(self):
+        with self._lock:
+            self._refs += 1
+
+    def release(self):
+        with self._lock:
+            self._refs -= 1
+            refs = self._refs
+        if refs <= 0:
+            self.close()
+        return refs
+
+    def close(self):
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            threads, self._threads = self._threads, []
+            entries, self._entries = dict(self._entries), {}
+            q = self._queue
+        if q is not None:
+            # the fetch threads are daemons; one sentinel each lets them
+            # exit promptly, and a fetch still mid-read completes into a
+            # retired entry and returns its bytes itself
+            for _ in threads:
+                q.put(None)
+        for entry in entries.values():
+            entry.retire()
+
+    # -- worker-facing API --------------------------------------------------
+
+    def observe(self, item_index, epoch, columns):
+        """The worker is loading ``item_index`` of ``epoch``: advance
+        the sequence clock, retire passed entries, and schedule fetches
+        ``depth`` positions ahead. ``columns`` is the prefetchable
+        column set — fixed at the first call (predicate columns under a
+        predicate, the needed file columns otherwise)."""
+        if item_index is None or epoch is None:
+            return
+        tasks = []
+        with self._lock:
+            if self._closed:
+                return
+            if self._columns is None:
+                self._columns = frozenset(columns)
+            sweep = self._advance_sweep_locked(item_index, epoch)
+            placed = self._position_locked(sweep, epoch, item_index)
+            if placed is None:
+                return
+            gpos = placed
+            if gpos > self._clock:
+                self._clock = gpos
+            self._retire_passed_locked()
+            # window depth in GLOBAL sequence positions: a process-pool
+            # worker only handles every ~Nth item, so the window must at
+            # least span the worker stride to ever reach this process's
+            # own next item (thread pools observe every position and are
+            # unaffected when depth >= workers, the defaults)
+            depth = max(current_depth(), self._workers)
+            for offset in range(1, depth + 1):
+                upcoming = self._at_locked(sweep, epoch, gpos + offset)
+                if upcoming is None:
+                    break
+                next_gpos, next_item = upcoming
+                rgkey = self._items[next_item]
+                entry = self._entries.get(rgkey)
+                if entry is not None:
+                    if next_gpos > entry.max_gpos:
+                        entry.max_gpos = next_gpos
+                    continue
+                entry = _Fetch(rgkey, next_gpos, self._pool)
+                self._entries[rgkey] = entry
+                tasks.append(entry)
+        for entry in tasks:
+            self._submit(entry)
+
+    def serve(self, pf, path, row_group, columns):
+        """A prefetched row-group as a :class:`pyarrow.Table`, or None
+        (the caller's blocking read is the fallback — and the oracle).
+        ``pf`` supplies the parsed footer (``metadata=``), so a hit
+        performs zero storage I/O."""
+        wanted = set(columns)
+        with self._lock:
+            entry = self._entries.get((path, row_group))
+            configured = self._columns
+        if configured is None or not wanted <= configured:
+            # on-demand column sets (late-materialized heavy columns)
+            # bypass silently — never waiting on, nor counting against,
+            # a fetch that by design cannot serve them
+            return None
+        if entry is None:
+            self._count(READAHEAD_MISSES)
+            return None
+        if not entry.event.wait(timeout=_SERVE_WAIT_S):
+            count_degrade('fetch-timeout')
+            self._count(READAHEAD_MISSES)
+            return None
+        if entry.state != _READY or not wanted <= entry.columns \
+                or not entry.try_add_ref():
+            self._count(READAHEAD_MISSES)
+            return None
+        table = None
+        try:
+            import pyarrow.parquet as pq
+            source = _RangeSource(entry.ranges, entry.file_size)
+            table = pq.ParquetFile(source, metadata=pf.metadata) \
+                .read_row_group(row_group, columns=sorted(wanted))
+        except Exception:  # noqa: BLE001 - degrade, never a wrong answer
+            logger.debug('readahead: serving %s#rg%d from the pool '
+                         'failed; degrading to the blocking read',
+                         path, row_group, exc_info=True)
+            count_degrade('deserialize')
+            self._count(READAHEAD_MISSES)
+            return None
+        finally:
+            if table is None:
+                entry.drop_ref()
+        # the served table may hold zero-copy slices of the pooled
+        # buffers: the finalizer is the census that keeps the pool
+        # accounting honest for exactly the table's lifetime
+        weakref.finalize(table, entry.drop_ref)
+        self._count(READAHEAD_HITS)
+        return table
+
+    # -- sequence arithmetic (mirrors workers/ventilator.py) ----------------
+
+    def _advance_sweep_locked(self, item_index, epoch):
+        """Detect a ventilator ``reset()`` sweep (epoch numbering
+        restarts at 0, seed advances by the reset stride) from the item
+        stream itself, two complementary ways: TWO CONSECUTIVE repeated
+        (epoch, item) pairs can only be a new sweep — a reset replays
+        the whole epoch, while a lone service re-ventilation/retry
+        redelivers exactly one item and must NOT desync the mirrored
+        seed for the rest of the run — and an epoch regressing by ≥3
+        can only be a restart (covers long runs whose early seen-sets
+        were evicted; pool pipelining straddles at most a couple of
+        adjacent epoch boundaries, never three). A wrong guess costs
+        mispredicted fetches, never wrong data."""
+        restarted = epoch <= self._max_epoch - 3
+        seen = self._seen_by_epoch.get(epoch)
+        duplicate = seen is not None and item_index in seen
+        self._dup_streak = self._dup_streak + 1 if duplicate else 0
+        if restarted or self._dup_streak >= 2:
+            self._sweep += 1
+            self._dup_streak = 0
+            self._seen_by_epoch = {}
+            self._orders = {}
+            self._max_epoch = epoch
+            seen = None
+        elif epoch > self._max_epoch:
+            self._max_epoch = epoch
+        if seen is None:
+            seen = self._seen_by_epoch.setdefault(epoch, set())
+            while len(self._seen_by_epoch) > _SEEN_EPOCHS_MAX:
+                self._seen_by_epoch.pop(min(self._seen_by_epoch))
+        seen.add(item_index)
+        return self._sweep
+
+    def _epoch_order(self, sweep, epoch):
+        """EXACTLY the ventilator's epoch order — the SHARED
+        ``workers.ventilator.epoch_order`` helper (one owner, so the
+        arithmetic cannot drift), at the sweep-advanced seed
+        (``seed + sweep·stride``), with the always-excluded (pruned)
+        items filtered the way the ventilator filters them."""
+        from petastorm_tpu.workers.ventilator import (
+            _RESET_SEED_STRIDE, epoch_order,
+        )
+        seed = (self._seed + sweep * _RESET_SEED_STRIDE) % (2 ** 32)
+        order = epoch_order(len(self._items), seed, epoch,
+                            self._randomize)
+        if self._exclude:
+            order = [i for i in order if i not in self._exclude]
+        return order
+
+    def _order_for_locked(self, sweep, epoch):
+        key = (sweep, epoch)
+        cached = self._orders.get(key)
+        if cached is None:
+            order = self._epoch_order(sweep, epoch)
+            pos_map = {item: i for i, item in enumerate(order)}
+            cached = (order, pos_map, self._next_base)
+            self._next_base += max(1, len(order))
+            self._orders[key] = cached
+            while len(self._orders) > _ORDER_CACHE_MAX:
+                self._orders.pop(min(self._orders))
+        return cached
+
+    def _position_locked(self, sweep, epoch, item_index):
+        order, pos_map, base = self._order_for_locked(sweep, epoch)
+        pos = pos_map.get(item_index)
+        return None if pos is None else base + pos
+
+    def _at_locked(self, sweep, epoch, gpos):
+        """``(gpos, item_index)`` of the sequence position ``gpos``,
+        spilling past the epoch boundary into the next epoch when the
+        iteration count allows — or None past the end of ventilation."""
+        for _ in range(2):
+            order, _, base = self._order_for_locked(sweep, epoch)
+            if base <= gpos < base + len(order):
+                return gpos, order[gpos - base]
+            if gpos < base:
+                return None
+            if self._iterations is not None \
+                    and epoch + 1 >= self._iterations:
+                return None
+            epoch += 1
+        return None
+
+    def _retire_passed_locked(self):
+        """Drop entries whose last sequence position fell behind the
+        clock by more than the retire slack: either served already or
+        consumed by a worker in another process — their pool bytes fund
+        the fetches still ahead. The slack keeps entries alive for
+        concurrent siblings that observed (advancing the clock) but
+        have not served yet."""
+        if not self._entries:
+            return
+        horizon = self._clock - self._retire_slack
+        passed = [key for key, entry in self._entries.items()
+                  if entry.max_gpos < horizon]
+        for key in passed:
+            self._entries.pop(key).retire()
+
+    # -- the fetch side ------------------------------------------------------
+
+    def _submit(self, entry):
+        import queue as queue_mod
+        with self._lock:
+            if self._closed:
+                entry.fail()
+                return
+            if self._queue is None:
+                self._queue = queue_mod.Queue()
+                for i in range(readahead_threads()):
+                    thread = threading.Thread(
+                        target=self._fetch_loop, daemon=True,
+                        name='petastorm-tpu-readahead-%d' % i)
+                    thread.start()
+                    self._threads.append(thread)
+            q = self._queue
+        q.put(entry)
+
+    def _fetch_loop(self):
+        while True:
+            entry = self._queue.get()
+            if entry is None:
+                return
+            if entry.state != _PENDING:
+                continue  # retired while queued
+            try:
+                with span('readahead_fetch'):
+                    self._fetch(entry)
+            except Exception:  # noqa: BLE001 - degrade, never crash
+                logger.warning('readahead: fetch of %s#rg%d failed; the '
+                               'worker will read it blocking',
+                               entry.rgkey[0], entry.rgkey[1],
+                               exc_info=True)
+                count_degrade('fetch-error')
+                entry.fail()
+
+    def _fetch(self, entry):
+        path, row_group = entry.rgkey
+        if faults.ARMED:
+            # the same seam as the worker's blocking read: chaos specs
+            # target fetches alone with match=readahead (a fetch fault
+            # must degrade to the blocking path, never lose a row)
+            faults.fault_hit('io.read',
+                             key='%s#rg%d#readahead' % (path, row_group))
+        planned, decline = self._plan_ranges(path, row_group)
+        if planned is None:
+            # 'no-footer': the footer was unreadable/never memoized;
+            # 'no-columns': the footer is fine but no configured column
+            # has file chunks here (e.g. a partition-only predicate) —
+            # two different runbook steps, never conflated
+            count_degrade(decline)
+            entry.fail()
+            return
+        ranges, colnames = planned
+        nbytes = sum(length for _, length in ranges)
+        if not self._pool.acquire(nbytes):
+            count_degrade('pool-exhausted')
+            entry.fail()
+            return
+        try:
+            buffers, guards, file_size = self._read_ranges(path, ranges)
+        except Exception:
+            entry.fail()
+            self._pool.free(nbytes)
+            raise
+        if not entry.complete(buffers, guards, nbytes, colnames,
+                              file_size):
+            # retired while the bytes were in flight: give them back
+            self._pool.free(nbytes)
+            return
+        if not metrics_disabled():
+            registry = get_registry()
+            registry.counter(READAHEAD_BYTES).inc(nbytes)
+            registry.counter(READAHEAD_COALESCED_READS).inc(len(ranges))
+
+    def _plan_ranges(self, path, row_group):
+        """``((coalesced reads, column names), None)`` covering the
+        configured columns' chunks of one row-group, from the memoized
+        footer metadata — or ``(None, reason)`` when planning declines
+        (``no-footer``: footer unreadable; ``no-columns``: no configured
+        column has file chunks here)."""
+        with self._footer_lock:
+            self._stats.prefetch([path])
+            chunk_ranges = self._stats.get_ranges(path, row_group)
+        if not chunk_ranges:
+            return None, 'no-footer'
+        with self._lock:
+            columns = self._columns or frozenset()
+        chunks = []
+        colnames = []
+        for name in sorted(columns):
+            col_chunks = chunk_ranges.get(name)
+            if col_chunks:
+                chunks.extend(col_chunks)
+                colnames.append(name)
+        if not chunks:
+            return None, 'no-columns'
+        return (coalesce_ranges(chunks, self._gap, self._max_range),
+                colnames), None
+
+    def _read_ranges(self, path, ranges):
+        """The wire reads: one open, one sequential read per coalesced
+        range. Returns pyarrow buffers (zero-copy over the owned bytes),
+        the sanitizer guard arrays (armed only), and the file size."""
+        import pyarrow as pa
+
+        from petastorm_tpu import sanitizer
+        armed = sanitizer.sanitize_enabled()
+        buffers = []
+        guards = []
+        with self._info.open(path) as f:
+            file_size = getattr(f, 'size', None)
+            if callable(file_size):
+                file_size = file_size()
+            for start, length in ranges:
+                f.seek(start)
+                data = f.read(length)
+                if len(data) != length:
+                    raise IOError('short read of %s [%d, +%d): got %d'
+                                  % (path, start, length, len(data)))
+                if armed:
+                    import numpy as np
+                    guarded = sanitizer.allocate_guarded((length,),
+                                                         np.uint8)
+                    guarded[:] = memoryview(data)
+                    guards.append(guarded)
+                    buffers.append((start,
+                                    pa.py_buffer(memoryview(guarded))))
+                else:
+                    buffers.append((start, pa.py_buffer(data)))
+        return buffers, guards, file_size
+
+    # -- internals -----------------------------------------------------------
+
+    @staticmethod
+    def _count(name):
+        if not metrics_disabled():
+            get_registry().counter(name).inc()
+
+
+__all__ = ['ReadaheadManager', 'attach', 'build_plan', 'coalesce_ranges',
+           'count_degrade', 'current_depth', 'health_snapshot',
+           'live_manager_count', 'pool_status', 'readahead_enabled',
+           'release', 'set_depth_override']

@@ -455,14 +455,35 @@ class Reader:
         self._num_epochs = num_epochs
         # The bound is a callable so pools whose fleet grows at runtime
         # (service pool: worker servers can register with a RUNNING job)
-        # get proportionally more row-groups in flight without a restart.
+        # get proportionally more row-groups in flight without a restart
+        # — and so the staging autotuner can raise the in-flight extra
+        # live (set_ventilate_extra).
+        self._ventilate_extra = _VENTILATE_EXTRA_ROWGROUPS
         self._ventilator = ConcurrentVentilator(
             self._pool.ventilate, items, iterations=num_epochs,
             max_ventilation_queue_size=lambda: (
-                self._pool.workers_count + _VENTILATE_EXTRA_ROWGROUPS),
+                self._pool.workers_count + self._ventilate_extra),
             randomize_item_order=shuffle_row_groups, random_seed=seed,
             pass_epoch=True, trace_shard=self.cur_shard,
             always_exclude=self._pruned_items)
+
+        # (4c) readahead plan (petastorm_tpu/readahead.py, docs/telemetry.md
+        # "Readahead"): a picklable description of the ventilator's
+        # upcoming-item sequence — shard- and shuffle-aware, with the
+        # statistics-pruned items excluded so they never fetch — that the
+        # workers' per-process readahead manager mirrors arithmetically.
+        # Caching readers ship no plan: a warm epoch never touches
+        # storage, so prefetching its bytes would be pure waste (counted).
+        from petastorm_tpu import readahead
+        readahead_plan = None
+        if cache is None or isinstance(cache, NullCache):
+            readahead_plan = readahead.build_plan(
+                items, all_pieces, randomize=shuffle_row_groups,
+                seed=self._ventilator.state_dict()['seed'],
+                iterations=num_epochs, exclude=self._pruned_items,
+                workers=self._pool.workers_count)
+        elif readahead.readahead_enabled():
+            readahead.count_degrade('cache')
 
         # (5) start workers; ventilation begins lazily on first read so that
         # load_state_dict can reposition the cursor first.
@@ -485,6 +506,10 @@ class Reader:
                              # fused decode (petastorm_tpu/fused.py): only
                              # batched consumers can host encoded stubs
                              'defer_image_decode': defer,
+                             # the workers resolve PETASTORM_TPU_READAHEAD
+                             # in their OWN process (service fleets set it
+                             # fleet-wide, like the pushdown knobs)
+                             'readahead_plan': readahead_plan,
                          },
                          ventilator=self._ventilator, start_ventilator=False)
 
@@ -717,7 +742,11 @@ class Reader:
             # plan-time pushdown (docs/telemetry.md "Query-shaped
             # reads"): items proven empty and skipped this run
             'pruned_items': len(self._pruned_items),
+            # autotunable in-flight bound (docs/telemetry.md "Readahead")
+            'ventilate_extra': self._ventilate_extra,
         }
+        from petastorm_tpu import readahead
+        health['readahead'] = readahead.health_snapshot()
         try:
             health.update(self._pool.diagnostics)
         except Exception:  # noqa: BLE001 - health must answer regardless
@@ -750,6 +779,19 @@ class Reader:
     def num_epochs(self):
         """Requested epoch count (None = infinite)."""
         return self._num_epochs
+
+    @property
+    def ventilate_extra(self):
+        """Row-groups kept in flight beyond the pool's worker count."""
+        return self._ventilate_extra
+
+    def set_ventilate_extra(self, extra):
+        """Autotuner seam: adjust the ventilator's in-flight bound
+        mid-run (the bound is a callable re-read on every wait cycle, so
+        the new value is observed without waking anyone). Returns the
+        applied value."""
+        self._ventilate_extra = max(1, int(extra))
+        return self._ventilate_extra
 
     @property
     def diagnostics(self):

@@ -334,8 +334,17 @@ def serve(endpoint, worker_id=0, heartbeat_interval_s=1.0,
     # worker's endpoint lives). Unarmed: a shared no-op handle.
     status = {'worker_id': worker_id, 'state': 'registering',
               'jobs_served': 0, 'items_done': 0, 'endpoint': endpoint}
-    obs_mount = obs_server.mount('worker-server',
-                                 health=lambda: dict(status))
+
+    def _health():
+        # per-host readahead visibility in fleet mode: each decode host
+        # runs its own manager (the plan rides the job spec), so the
+        # hit/miss/pool numbers belong on ITS /health, not the client's
+        from petastorm_tpu import readahead
+        out = dict(status)
+        out['readahead'] = readahead.health_snapshot()
+        return out
+
+    obs_mount = obs_server.mount('worker-server', health=_health)
     try:
         while True:
             # Fresh socket (and identity) per job lifetime: a stale

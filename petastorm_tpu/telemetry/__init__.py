@@ -156,9 +156,10 @@ def reset_for_tests():
     reset_attributor()
     reset_recorder()
     tracing._reset_for_tests()
-    # lazy: pushdown imports telemetry at its module top
-    from petastorm_tpu import pushdown
+    # lazy: pushdown/readahead import telemetry at their module tops
+    from petastorm_tpu import pushdown, readahead
     pushdown.reset_for_tests()
+    readahead._reset_for_tests()
     # the staging autotuner's decision ring — only when its module is
     # already loaded (never force the jax package in for a reset)
     import sys as _sys

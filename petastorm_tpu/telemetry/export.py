@@ -231,6 +231,9 @@ def pipeline_report(registry=None, wall_time_s=None, baseline=None,
     pushdown = _pushdown_section(registry)
     if pushdown is not None:
         report['pushdown'] = pushdown
+    readahead = _readahead_section(registry)
+    if readahead is not None:
+        report['readahead'] = readahead
     pipesan = _sanitizer_section(registry)
     if pipesan is not None:
         report['pipesan'] = pipesan
@@ -414,6 +417,43 @@ def _pushdown_section(registry):
     }
 
 
+def _readahead_section(registry):
+    """Wire-speed I/O plane activity (petastorm_tpu/readahead.py) —
+    present only when the plane ever served, missed or degraded (the
+    counters are fleet-merged over the pool delta channels), so
+    readahead-less pipelines keep their report shape unchanged. Pool
+    occupancy/depth come from THIS process's live managers; the "Decode
+    is waiting on storage (io-bound)" runbook in docs/troubleshoot.md
+    reads the hit share, mean coalesced-read size and degrade reasons."""
+    from petastorm_tpu import readahead
+    hits = registry.counter_value(readahead.READAHEAD_HITS)
+    misses = registry.counter_value(readahead.READAHEAD_MISSES)
+    degraded = {}
+    for key, value in registry.counters_with_prefix(
+            readahead.READAHEAD_DEGRADED).items():
+        reason = _label_of(key, 'reason') or 'unknown'
+        degraded[reason] = degraded.get(reason, 0) + int(value)
+    if not hits and not misses and not degraded:
+        return None
+    bytes_fetched = registry.counter_value(readahead.READAHEAD_BYTES)
+    reads = registry.counter_value(readahead.READAHEAD_COALESCED_READS)
+    used, budget = readahead.pool_status()
+    return {
+        'hits': int(hits),
+        'misses': int(misses),
+        'hit_share': (round(hits / (hits + misses), 4)
+                      if hits or misses else None),
+        'bytes_fetched': int(bytes_fetched),
+        'coalesced_reads': int(reads),
+        'mean_coalesced_bytes': (int(bytes_fetched / reads) if reads
+                                 else None),
+        'degraded': degraded,
+        'depth': readahead.current_depth(),
+        'pool_bytes': int(used),
+        'pool_budget_bytes': int(budget),
+    }
+
+
 def _sanitizer_section(registry):
     """pipesan runtime-sanitizer findings — present when the sanitizer is
     armed (``PETASTORM_TPU_SANITIZE=1``) or violations were recorded, so
@@ -560,6 +600,21 @@ def format_pipeline_report(report):
                          if share is not None else ''),
                         p['rows_pruned'], p['late_materialized_rows'],
                         (' — declines: %s' % declines) if declines else ''))
+    if 'readahead' in report:
+        r = report['readahead']
+        reasons = ', '.join('%s: %d' % (k, v)
+                            for k, v in sorted(r['degraded'].items()))
+        lines.append('readahead: %d hit / %d miss%s, %d B over %d '
+                     'coalesced read(s)%s, depth %d, pool %d/%d B%s'
+                     % (r['hits'], r['misses'],
+                        (' (%.1f%%)' % (100 * r['hit_share'])
+                         if r['hit_share'] is not None else ''),
+                        r['bytes_fetched'], r['coalesced_reads'],
+                        (' (mean %d B)' % r['mean_coalesced_bytes']
+                         if r['mean_coalesced_bytes'] is not None else ''),
+                        r['depth'], r['pool_bytes'],
+                        r['pool_budget_bytes'],
+                        (' — degraded: %s' % reasons) if reasons else ''))
     if 'pipesan' in report:
         p = report['pipesan']
         kinds = ', '.join('%s: %d' % (k, v)

@@ -123,6 +123,18 @@ def h2d_ready_share(window):
     return window['rates'].get(_H2D_READY_KEY, 0.0)
 
 
+_IO_SECONDS_KEY = metric_key(STAGE_SECONDS, {'stage': 'io'})
+
+
+def io_wait_share(window):
+    """Seconds-per-second one closed window spent inside the blocking
+    ``io`` stage (fleet-merged: worker increments ride the pool delta
+    channels, so the share can exceed 1.0 across parallel workers) —
+    the io-starvation signal the staging autotuner's readahead-deepen
+    policy reads, defined ONCE here next to its h2d sibling."""
+    return window['rates'].get(_IO_SECONDS_KEY, 0.0)
+
+
 # -- windowed rollup ----------------------------------------------------------
 
 

@@ -43,6 +43,19 @@ _VENTILATION_INTERVAL_S = 0.01
 _RESET_SEED_STRIDE = 0x9E3779B1
 
 
+def epoch_order(n_items, seed, epoch, randomize):
+    """The ONE owner of the per-epoch item order: epoch ``e`` permutes
+    with ``RandomState((seed + e) mod 2^32)`` (identity when not
+    randomized). Shared by :class:`ConcurrentVentilator` and the
+    readahead plane's sequence mirror (:mod:`petastorm_tpu.readahead`) —
+    two private copies would drift silently, and the mirror's failure
+    mode (zero hits, rows still correct) is invisible to parity tests."""
+    if not randomize:
+        return list(range(n_items))
+    rng = np.random.RandomState((seed + epoch) % (2 ** 32))
+    return [int(i) for i in rng.permutation(n_items)]
+
+
 class Ventilator(metaclass=ABCMeta):
     """Base class for ventilators (reference: ``ventilator.py:26-52``)."""
 
@@ -240,10 +253,8 @@ class ConcurrentVentilator(Ventilator):
         return size() if callable(size) else size
 
     def _epoch_order(self, epoch):
-        if not self._randomize:
-            return list(range(len(self._items)))
-        rng = np.random.RandomState((self._seed + epoch) % (2 ** 32))
-        return list(rng.permutation(len(self._items)))
+        return epoch_order(len(self._items), self._seed, epoch,
+                           self._randomize)
 
     def _run(self):
         # A ventilation-thread death must never read as "still running":

@@ -1,0 +1,585 @@
+"""Wire-speed I/O plane (ISSUE 15): coalesced column-chunk readahead.
+
+The load-bearing contract is EXACT PARITY: an epoch served by the
+readahead plane must deliver the identical row multiset (and identical
+heavy-column bytes) as the ``PETASTORM_TPU_READAHEAD=0`` blocking-read
+oracle, across pool flavors, with shuffle, pushdown pruning and late
+materialization active — and every failure (fetch fault, pool
+exhaustion, missing footer) must degrade to the blocking read, counted,
+never to a wrong answer.
+"""
+
+import gc
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from petastorm_tpu import make_batch_reader, make_reader
+from petastorm_tpu import readahead
+from petastorm_tpu import telemetry as T
+from petastorm_tpu.filters import FiltersPredicate
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    T.reset_for_tests()
+    yield
+    T.reset_for_tests()
+
+
+def _with_env(env):
+    """Apply env overrides + refresh the cached knobs; returns a restore
+    callable (which refreshes again)."""
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    T.refresh()
+
+    def restore():
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        T.refresh()
+
+    return restore
+
+
+def _read_ids(url, oracle=False, pool='thread', shuffle=True, **kwargs):
+    restore = _with_env({'PETASTORM_TPU_READAHEAD': '0'} if oracle else {})
+    try:
+        with make_batch_reader(url, reader_pool_type=pool,
+                               shuffle_row_groups=shuffle,
+                               **kwargs) as reader:
+            return sorted(int(i) for batch in reader for i in batch.id)
+    finally:
+        restore()
+
+
+@pytest.fixture(scope='module')
+def scalar_url(tmp_path_factory):
+    """400 scalar rows over 4 files x 5 row-groups of 20 — enough
+    row-groups that the depth-ahead window is exercised end to end."""
+    import pyarrow as pa
+
+    from petastorm_tpu.codecs import ScalarCodec
+    from petastorm_tpu.etl.dataset_metadata import write_dataset
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+    schema = Unischema('ReadaheadSchema', [
+        UnischemaField('id', np.int64, (), ScalarCodec(pa.int64()), False),
+        UnischemaField('value', np.float64, (),
+                       ScalarCodec(pa.float64()), False),
+    ])
+    url = 'file://' + str(tmp_path_factory.mktemp('readahead')) + '/ds'
+    rows = [{'id': i, 'value': i * 0.5} for i in range(400)]
+    write_dataset(url, schema, rows, rowgroup_size_rows=20, num_files=4)
+    return url
+
+
+# ---------------------------------------------------------------------------
+# Units: coalescing, buffer pool, sequence arithmetic
+# ---------------------------------------------------------------------------
+
+
+class TestCoalesce:
+    def test_adjacent_ranges_merge_through_the_gap(self):
+        merged = readahead.coalesce_ranges(
+            [(0, 100), (150, 100), (1000, 50)], gap=64, max_range=10000)
+        # 100..150 gap (50 <= 64) merges; 250..1000 (750) does not
+        assert merged == [(0, 250), (1000, 50)]
+
+    def test_max_range_caps_a_merge(self):
+        merged = readahead.coalesce_ranges(
+            [(0, 100), (110, 100), (220, 100)], gap=64, max_range=250)
+        assert merged == [(0, 210), (220, 100)]
+
+    def test_single_oversized_chunk_is_never_split(self):
+        merged = readahead.coalesce_ranges([(0, 5000)], gap=0,
+                                           max_range=100)
+        assert merged == [(0, 5000)]
+
+    def test_unsorted_input_is_sorted_first(self):
+        merged = readahead.coalesce_ranges([(500, 10), (0, 10)], gap=1000,
+                                           max_range=10000)
+        assert merged == [(0, 510)]
+
+
+class TestBufferPool:
+    def test_acquire_free_and_exhaustion(self):
+        pool = readahead._BufferPool(100)
+        assert pool.acquire(60)
+        assert not pool.acquire(50)  # all-or-nothing, never evicts
+        assert pool.acquire(40)
+        pool.free(60)
+        assert pool.used == 40
+        pool.free(40)
+        assert pool.used == 0
+
+
+class TestSequenceMirror:
+    """The manager's predicted order must be EXACTLY the ventilator's:
+    same permutation arithmetic, same exclusions, same reset stride."""
+
+    def _manager(self, n=10, randomize=True, seed=7, exclude=(),
+                 iterations=None):
+        plan = {'version': 1,
+                'items': [('f%d' % (i % 2), i) for i in range(n)],
+                'randomize': randomize, 'seed': seed,
+                'iterations': iterations, 'exclude': sorted(exclude)}
+        manager = readahead.ReadaheadManager(object(), plan)
+        manager.close()  # arithmetic only; no fetch threads wanted
+        return manager
+
+    def _ventilator_order(self, n, seed, epoch, exclude=(), sweeps=0):
+        from petastorm_tpu.workers.ventilator import (
+            ConcurrentVentilator, _RESET_SEED_STRIDE,
+        )
+        vent = ConcurrentVentilator(lambda **kw: None,
+                                    [{'i': i} for i in range(n)],
+                                    randomize_item_order=True,
+                                    random_seed=seed,
+                                    always_exclude=frozenset(exclude))
+        vent._seed = (seed + sweeps * _RESET_SEED_STRIDE) % (2 ** 32)
+        order = vent._epoch_order(epoch)
+        if exclude:
+            order = [i for i in order if i not in frozenset(exclude)]
+        return order
+
+    @pytest.mark.parametrize('epoch', [0, 1, 5])
+    def test_epoch_orders_match(self, epoch):
+        manager = self._manager(n=17, seed=123)
+        assert manager._epoch_order(0, epoch) == \
+            self._ventilator_order(17, 123, epoch)
+
+    def test_excluded_items_never_appear(self):
+        manager = self._manager(n=12, seed=3, exclude={2, 7})
+        order = manager._epoch_order(0, 0)
+        assert 2 not in order and 7 not in order
+        assert order == self._ventilator_order(12, 3, 0, exclude={2, 7})
+
+    def test_sweep_advances_by_the_reset_stride(self):
+        manager = self._manager(n=9, seed=55)
+        assert manager._epoch_order(1, 0) == \
+            self._ventilator_order(9, 55, 0, sweeps=1)
+
+    def test_sweep_detected_from_repeated_epoch_items(self):
+        manager = self._manager(n=4, randomize=False)
+        assert manager._advance_sweep_locked(0, 0) == 0
+        assert manager._advance_sweep_locked(1, 0) == 0
+        # a reset REPLAYS the epoch: two consecutive repeats flip the
+        # sweep (the first repeat alone is ambiguous — see below)
+        assert manager._advance_sweep_locked(0, 0) == 0
+        assert manager._advance_sweep_locked(1, 0) == 1
+
+    def test_lone_retry_redelivery_does_not_desync(self):
+        """A service re-ventilation/retry redelivers exactly ONE
+        duplicate item; that must not read as a reset (it would advance
+        the mirrored seed and kill the hit rate for the rest of the
+        run)."""
+        manager = self._manager(n=6, randomize=False)
+        for item in (0, 1, 2):
+            assert manager._advance_sweep_locked(item, 0) == 0
+        assert manager._advance_sweep_locked(1, 0) == 0  # the retry
+        for item in (3, 4, 5):
+            assert manager._advance_sweep_locked(item, 0) == 0
+
+    def test_sweep_detected_after_long_runs_by_epoch_regression(self):
+        """A reset after MORE epochs than the bounded seen-sets retain
+        (epoch 0's set evicted) must still be detected — via the
+        epoch-regression rule — or a shuffled reader would mispredict
+        forever after reset."""
+        manager = self._manager(n=4, randomize=True)
+        for epoch in range(8):  # > _SEEN_EPOCHS_MAX: epoch 0 set evicted
+            for item in range(4):
+                assert manager._advance_sweep_locked(item, epoch) == 0
+        assert manager._advance_sweep_locked(0, 0) == 1
+        # ...while ordinary cross-boundary pipelining (a late item from
+        # the PREVIOUS epoch) never reads as a restart
+        assert manager._advance_sweep_locked(1, 0) == 1
+        manager._advance_sweep_locked(0, 1)
+        assert manager._advance_sweep_locked(3, 0) == 1
+
+
+# ---------------------------------------------------------------------------
+# Exact parity vs the blocking-read oracle
+# ---------------------------------------------------------------------------
+
+
+class TestExactParity:
+    @pytest.mark.parametrize('pool', ['thread', 'dummy', 'process',
+                                      'service'])
+    def test_row_multiset_parity_across_pools(self, scalar_url, pool):
+        got = _read_ids(scalar_url, pool=pool, workers_count=2)
+        oracle = _read_ids(scalar_url, oracle=True, pool=pool,
+                           workers_count=2)
+        assert got == oracle == list(range(400))
+
+    def test_hits_recorded_and_pool_drains(self, scalar_url):
+        got = _read_ids(scalar_url, num_epochs=2)
+        assert got == sorted(list(range(400)) * 2)
+        registry = T.get_registry()
+        hits = registry.counter_value(readahead.READAHEAD_HITS)
+        assert hits > 20  # 40 reads total; only cold-start misses allowed
+        assert registry.counter_value(readahead.READAHEAD_BYTES) > 0
+        assert registry.counter_value(
+            readahead.READAHEAD_COALESCED_READS) > 0
+        assert registry.counter_value('petastorm_tpu_stage_calls_total',
+                                      stage='readahead_fetch') > 0
+        gc.collect()
+        used, _ = readahead.pool_status()
+        assert used == 0
+
+    def test_parity_with_pushdown_and_late_materialization(
+            self, synthetic_dataset):
+        """Shuffle + statistics pruning + the two-phase late-materialized
+        read, served by the plane: row multiset AND heavy-column bytes
+        must match the blocking oracle."""
+        pred = FiltersPredicate([('id', 'in', (3, 31, 47, 99))])
+
+        def rows(oracle):
+            restore = _with_env({'PETASTORM_TPU_READAHEAD': '0'}
+                                if oracle else {})
+            try:
+                with make_reader(synthetic_dataset.url,
+                                 shuffle_row_groups=True,
+                                 predicate=pred) as reader:
+                    return sorted((r.id, r.image_png.tobytes(),
+                                   r.matrix.tobytes()) for r in reader)
+            finally:
+                restore()
+
+        got = rows(oracle=False)
+        assert [g[0] for g in got] == [3, 31, 47, 99]
+        assert got == rows(oracle=True)
+
+    def test_reset_sweep_keeps_hitting(self, scalar_url):
+        """reader.reset() advances the ventilator seed by the reset
+        stride; the manager must detect the new sweep from the item
+        stream and keep predicting (≥ one extra miss at the boundary is
+        fine, going cold for the whole sweep is not)."""
+        with make_batch_reader(scalar_url, reader_pool_type='thread',
+                               shuffle_row_groups=True,
+                               num_epochs=1) as reader:
+            first = sorted(int(i) for b in reader for i in b.id)
+            reader.reset()
+            second = sorted(int(i) for b in reader for i in b.id)
+        assert first == second == list(range(400))
+        registry = T.get_registry()
+        hits = registry.counter_value(readahead.READAHEAD_HITS)
+        misses = registry.counter_value(readahead.READAHEAD_MISSES)
+        assert hits + misses == 40
+        assert hits >= 30
+
+
+# ---------------------------------------------------------------------------
+# Degrade: counted, never a wrong answer
+# ---------------------------------------------------------------------------
+
+
+class TestDegrade:
+    def test_faulted_fetch_degrades_to_blocking(self, scalar_url):
+        """Every prefetch read faulted (the io.read faultpoint's
+        #readahead keys): the epoch must still deliver the exact
+        multiset through the blocking path, with the degrade counted."""
+        # match on the '#readahead' KEY SUFFIX, not the bare word — the
+        # pytest tmp dir itself contains 'readahead', and a path match
+        # would fault the worker's blocking reads too
+        restore = _with_env(
+            {'PETASTORM_TPU_FAULTS': 'io.read:error:1:match=#readahead'})
+        try:
+            got = _read_ids(scalar_url)
+        finally:
+            restore()
+        assert got == list(range(400))
+        registry = T.get_registry()
+        assert registry.counter_value(readahead.READAHEAD_DEGRADED,
+                                      reason='fetch-error') > 0
+        assert registry.counter_value(readahead.READAHEAD_HITS) == 0
+
+    def test_pool_exhaustion_degrades(self, scalar_url, monkeypatch):
+        monkeypatch.setattr(readahead, 'pool_budget_bytes', lambda: 16)
+        got = _read_ids(scalar_url)
+        assert got == list(range(400))
+        registry = T.get_registry()
+        assert registry.counter_value(readahead.READAHEAD_DEGRADED,
+                                      reason='pool-exhausted') > 0
+        assert registry.counter_value(readahead.READAHEAD_HITS) == 0
+
+    def test_caching_reader_ships_no_plan(self, scalar_url, tmp_path):
+        """A caching reader must never prefetch (warm epochs read no
+        storage); the decline is counted once, reader-side."""
+        with make_batch_reader(scalar_url, reader_pool_type='thread',
+                               shuffle_row_groups=False,
+                               cache_type='decoded',
+                               cache_location=str(tmp_path / 'cache'),
+                               cache_size_limit=10 ** 8) as reader:
+            delivered = sorted(int(i) for b in reader for i in b.id)
+        assert delivered == list(range(400))
+        registry = T.get_registry()
+        assert registry.counter_value(readahead.READAHEAD_HITS) == 0
+        assert registry.counter_value(readahead.READAHEAD_MISSES) == 0
+        assert registry.counter_value(readahead.READAHEAD_DEGRADED,
+                                      reason='cache') == 1
+
+    def test_plan_decline_reasons_are_distinct(self, scalar_url):
+        """A healthy footer with no prefetchable file columns (e.g. a
+        partition-only predicate) must not read as 'no-footer' — the
+        runbook sends those two cases down different paths."""
+        from petastorm_tpu.etl.dataset_metadata import ParquetDatasetInfo
+        info = ParquetDatasetInfo(scalar_url)
+        plan = {'version': 1, 'items': [(info.file_paths[0], 0)],
+                'randomize': False, 'seed': 0, 'iterations': 1,
+                'exclude': [], 'workers': 1}
+        manager = readahead.ReadaheadManager(info, plan)
+        try:
+            manager._columns = frozenset(['not_a_stored_column'])
+            assert manager._plan_ranges(info.file_paths[0], 0) == \
+                (None, 'no-columns')
+            assert manager._plan_ranges('/nonexistent.parquet', 0) == \
+                (None, 'no-footer')
+            manager._columns = frozenset(['id'])
+            planned, decline = manager._plan_ranges(info.file_paths[0], 0)
+            assert decline is None and planned[1] == ['id']
+        finally:
+            manager.close()
+
+    def test_oracle_knob_runs_zero_plane_state(self, scalar_url):
+        got = _read_ids(scalar_url, oracle=True)
+        assert got == list(range(400))
+        registry = T.get_registry()
+        assert registry.counter_value(readahead.READAHEAD_HITS) == 0
+        assert registry.counter_value(readahead.READAHEAD_BYTES) == 0
+        assert readahead.live_manager_count() == 0
+
+
+# ---------------------------------------------------------------------------
+# Satellites: parquet-file LRU, report section, health, ventilate seam
+# ---------------------------------------------------------------------------
+
+
+class TestParquetFileLru:
+    def test_memo_is_bounded_and_reads_stay_exact(self, scalar_url,
+                                                  monkeypatch):
+        from petastorm_tpu import arrow_worker
+        monkeypatch.setattr(arrow_worker, '_PARQUET_FILE_CACHE_MAX', 2)
+        with make_batch_reader(scalar_url, reader_pool_type='thread',
+                               workers_count=1,
+                               shuffle_row_groups=True) as reader:
+            delivered = sorted(int(i) for b in reader for i in b.id)
+            workers = reader._pool._workers
+            assert workers
+            for worker in workers:
+                assert len(worker._parquet_files) <= 2
+        assert delivered == list(range(400))
+
+
+class TestReportAndHealth:
+    def test_report_section_and_rendering(self, scalar_url):
+        _read_ids(scalar_url)
+        report = T.pipeline_report()
+        section = report['readahead']
+        assert section['hits'] + section['misses'] > 0
+        assert section['hit_share'] is not None
+        assert section['coalesced_reads'] > 0
+        assert section['mean_coalesced_bytes'] > 0
+        assert section['pool_bytes'] == 0  # everything reclaimed
+        text = T.format_pipeline_report(report)
+        assert 'readahead:' in text
+
+    def test_section_absent_without_activity(self):
+        assert 'readahead' not in T.pipeline_report()
+
+    def test_health_snapshot_shape(self, scalar_url):
+        with make_batch_reader(scalar_url, reader_pool_type='thread',
+                               shuffle_row_groups=False) as reader:
+            next(iter(reader))
+            health = reader._obs_health()
+            assert health['ventilate_extra'] == 2
+            snap = health['readahead']
+            assert snap['enabled'] is True
+            assert snap['managers'] == 1
+            assert snap['depth'] >= 1
+
+
+class TestVentilateExtraSeam:
+    def test_live_bound_adjustment(self, scalar_url):
+        with make_batch_reader(scalar_url, reader_pool_type='thread',
+                               workers_count=2,
+                               shuffle_row_groups=False) as reader:
+            vent = reader._ventilator
+            assert vent._current_max_queue_size() == 4
+            assert reader.set_ventilate_extra(7) == 7
+            assert reader.ventilate_extra == 7
+            assert vent._current_max_queue_size() == 9
+            # floor 1: the tuner can never strangle ventilation entirely
+            assert reader.set_ventilate_extra(0) == 1
+
+
+# ---------------------------------------------------------------------------
+# The autotuner policies (readahead depth + ventilator in-flight)
+# ---------------------------------------------------------------------------
+
+
+class _FakeReader:
+    def __init__(self, extra=2):
+        self._extra = extra
+
+    @property
+    def ventilate_extra(self):
+        return self._extra
+
+    def set_ventilate_extra(self, extra):
+        self._extra = max(1, int(extra))
+        return self._extra
+
+
+class _FakeLoader:
+    def __init__(self, reader=None):
+        self._stager = None
+        self._prefetch = 2
+        self._reader = reader or _FakeReader()
+
+    @property
+    def reader(self):
+        return self._reader
+
+    def _set_prefetch(self, depth):
+        self._prefetch = depth
+        return depth
+
+
+def _window(verdict=None, io_rate=0.0):
+    from petastorm_tpu.telemetry.timeseries import _IO_SECONDS_KEY
+    return {'rates': {_IO_SECONDS_KEY: io_rate}, 'quantiles': {},
+            'gauges': {}, 'producer_wait_s': 0.0, 'consumer_wait_s': 0.0,
+            'verdict': verdict, 'dur_s': 1.0, 'throughput': None,
+            'start': 0.0}
+
+
+@pytest.fixture()
+def live_manager(scalar_url):
+    """One live manager so the depth policies have something to tune."""
+    from petastorm_tpu.etl.dataset_metadata import ParquetDatasetInfo
+    plan = {'version': 1, 'items': [('f', 0)], 'randomize': False,
+            'seed': 0, 'iterations': 1, 'exclude': []}
+    manager = readahead.ReadaheadManager(
+        ParquetDatasetInfo(scalar_url), plan)
+    yield manager
+    manager.close()
+
+
+class TestAutotunePolicies:
+    def _tuner(self, loader=None):
+        from petastorm_tpu.jax.autotune import StagingAutotuner
+        return StagingAutotuner(loader or _FakeLoader(), window_s=10.0)
+
+    def test_sustained_io_wait_deepens_readahead(self, live_manager):
+        from petastorm_tpu.telemetry.stall import PRODUCER_BOUND
+        tuner = self._tuner()
+        base = readahead.current_depth()
+        for _ in range(3):
+            actions = tuner.observe(_window(verdict=PRODUCER_BOUND,
+                                            io_rate=1.5))
+        deepens = [a for a in actions
+                   if a['action'] == 'deepen_readahead']
+        assert deepens and deepens[0]['depth_to'] == base + 1
+        assert readahead.current_depth() == base + 1
+        tuner.close()
+        assert readahead.current_depth() == base  # override died with it
+
+    def test_io_wait_without_starving_consumer_does_nothing(
+            self, live_manager):
+        tuner = self._tuner()
+        for _ in range(6):
+            actions = tuner.observe(_window(verdict=None, io_rate=1.5))
+        assert not any(a['action'] == 'deepen_readahead'
+                       for a in actions)
+        tuner.close()
+
+    def test_pool_pressure_sheds_depth_to_the_knob_floor(
+            self, live_manager, monkeypatch):
+        from petastorm_tpu.telemetry.stall import PRODUCER_BOUND
+        tuner = self._tuner()
+        base = readahead.current_depth()
+        for _ in range(3):  # deepen first: the knob width is the floor
+            tuner.observe(_window(verdict=PRODUCER_BOUND, io_rate=1.5))
+        assert readahead.current_depth() == base + 1
+        monkeypatch.setattr(readahead, 'pool_status',
+                            lambda: (95, 100))
+        for _ in range(3):
+            actions = tuner.observe(_window())
+        sheds = [a for a in actions if a['action'] == 'shed_readahead']
+        assert sheds and sheds[0]['depth_to'] == base
+        # at the knob's own width the shed stops: the static
+        # configuration is the floor, never tuned below
+        for _ in range(6):
+            actions = tuner.observe(_window())
+        assert not any(a['action'] == 'shed_readahead' for a in actions)
+        assert readahead.current_depth() == base
+        tuner.close()
+
+    def test_no_live_manager_means_no_depth_decisions(self):
+        from petastorm_tpu.telemetry.stall import PRODUCER_BOUND
+        tuner = self._tuner()
+        for _ in range(3):
+            actions = tuner.observe(_window(verdict=PRODUCER_BOUND,
+                                            io_rate=1.5))
+        assert not any(a['action'] == 'deepen_readahead'
+                       for a in actions)
+        tuner.close()
+
+    def test_inflight_raises_and_lowers_with_the_verdict(self):
+        from petastorm_tpu.telemetry.stall import (
+            CONSUMER_BOUND, PRODUCER_BOUND,
+        )
+        reader = _FakeReader(extra=2)
+        tuner = self._tuner(_FakeLoader(reader))
+        for _ in range(3):
+            actions = tuner.observe(_window(verdict=PRODUCER_BOUND))
+        raises = [a for a in actions if a['action'] == 'raise_inflight']
+        assert raises and reader.ventilate_extra == 3
+        for _ in range(3):
+            actions = tuner.observe(_window(verdict=CONSUMER_BOUND))
+        lowers = [a for a in actions if a['action'] == 'lower_inflight']
+        assert lowers and reader.ventilate_extra == 2
+        # never below the construction-time baseline
+        for _ in range(6):
+            tuner.observe(_window(verdict=CONSUMER_BOUND))
+        assert reader.ventilate_extra == 2
+        tuner.close()
+
+    def test_decisions_land_in_report_ring(self, live_manager):
+        from petastorm_tpu.jax import autotune
+        from petastorm_tpu.telemetry.stall import PRODUCER_BOUND
+        tuner = self._tuner()
+        for _ in range(3):
+            tuner.observe(_window(verdict=PRODUCER_BOUND, io_rate=1.5))
+        actions = {d['action'] for d in autotune.recent_decisions()}
+        assert 'deepen_readahead' in actions
+        assert 'raise_inflight' in actions
+        report = T.pipeline_report()
+        assert report['staging_autotune']['total'] >= 2
+        tuner.close()
+
+
+# ---------------------------------------------------------------------------
+# Sanitizer compatibility
+# ---------------------------------------------------------------------------
+
+
+class TestSanitize:
+    def test_parity_and_canaries_under_sanitize(self, scalar_url):
+        restore = _with_env({'PETASTORM_TPU_SANITIZE': '1'})
+        try:
+            got = _read_ids(scalar_url)
+        finally:
+            restore()
+        assert got == list(range(400))
+        from petastorm_tpu import sanitizer
+        assert not [v for v in sanitizer.violations()
+                    if v['kind'] == 'readahead-canary']
+        gc.collect()
+        used, _ = readahead.pool_status()
+        assert used == 0

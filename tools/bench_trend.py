@@ -43,6 +43,9 @@ TRACKED = (
     'hello_world_warm_epoch_rows_per_sec',
     'cache_hit_share',
     'selective_read_1pct_rows_per_sec',
+    # wire-speed I/O plane (bench io_overlap section)
+    'io_overlap_speedup',
+    'io_overlap_readahead_rows_per_sec',
     'native_decode_speedup',
     'imagenet_batch_rows_per_sec',
     'imagenet_jax_rows_per_sec',
