@@ -39,15 +39,16 @@ trend:
 	  --allow lm_train_steps_per_sec --allow imagenet_jax_rows_per_sec
 
 # seeded chaos suite (docs/service.md "Failure semantics" + "Standing
-# service"): deterministic fault injection, poison quarantine, dispatcher
-# restart, daemon SIGKILL/restart, lease lapse, breaker trips. The fast
+# service" + "High availability"): deterministic fault injection, poison
+# quarantine, dispatcher restart, daemon SIGKILL/restart, lease lapse,
+# breaker trips, standby failover/promotion, QoS preemption. The fast
 # subset is tier-1; the soak variant runs the slow-marked full-epoch
 # drills on top.
 chaos:
-	$(PYTHON) -m pytest tests/test_chaos.py tests/test_daemon.py -q -m "not slow"
+	$(PYTHON) -m pytest tests/test_chaos.py tests/test_daemon.py tests/test_failover.py -q -m "not slow"
 
 chaos-soak:
-	$(PYTHON) -m pytest tests/test_chaos.py tests/test_daemon.py -q
+	$(PYTHON) -m pytest tests/test_chaos.py tests/test_daemon.py tests/test_failover.py -q
 
 # the CI gate sequence: static contracts, perf trend, the seeded chaos
 # drills (fast subset — also inside test-fast, but a named early gate

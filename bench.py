@@ -119,10 +119,10 @@ _START = time.monotonic()
 # asserted under _HEADLINE_MAX_CHARS. Ordered by importance: if the line
 # ever approaches the cap, the least important tail keys drop first.
 # raised 1500 → 1600 for the selective_read headline key, → 1700 for
-# the two sharded_staging keys; the io_overlap_speedup key brings the
-# worst case to 1664, still under the cap — the driver tail is 2,000
-# chars and the emit loop still drops tail keys at the cap
-_HEADLINE_MAX_CHARS = 1700
+# the two sharded_staging keys, → 1800 for the two service HA keys
+# (worst case ~1740) — the driver tail is 2,000 chars and the emit
+# loop still drops tail keys at the cap
+_HEADLINE_MAX_CHARS = 1800
 _HEADLINE_EXTRA_KEYS = (
     'vs_tfdata',
     'hello_world_warm_epoch_rows_per_sec',
@@ -135,6 +135,11 @@ _HEADLINE_EXTRA_KEYS = (
     # blocking oracle under injected storage latency (rates, hit share
     # and coalesced-size attribution stay in the full cumulative dict)
     'io_overlap_speedup',
+    # standing-service HA (bench service section): kill-to-first-row
+    # blackout through a warm-standby promotion, and the share of
+    # bindings that landed on a fingerprint-warm host
+    'service_failover_blackout_s',
+    'service_placement_hit_share',
     'lm_train_mfu',
     'lm_train_input_bound_util',
     'lm_train_tuned_mfu',
@@ -1954,6 +1959,110 @@ def main():
             delta[readahead.READAHEAD_BYTES] / reads / 1024, 2) if reads \
             else 0.0
 
+    def sec_service():
+        # Standing-service HA record (docs/service.md, "High
+        # availability"): SIGKILL a subprocess primary mid-job with a
+        # warm in-process standby attached and measure the delivery
+        # blackout — kill to first post-promotion row at the client.
+        # Then a second job with the identical decode fingerprint binds
+        # against the promoted daemon's warm fleet for the
+        # placement-hit share.
+        import signal as _signal
+
+        from petastorm_tpu.service.daemon import DaemonClientPool
+        from petastorm_tpu.service.protocol import free_tcp_port
+        from petastorm_tpu.service.standby import StandbyDaemon
+        from petastorm_tpu.workers.worker_base import WorkerBase
+
+        class _Echo(WorkerBase):  # shipped to the workers via dill
+            def process(self, value):
+                self.publish_func(value)
+
+        repo = os.path.dirname(os.path.abspath(__file__))
+        env = dict(os.environ, PYTHONPATH=repo, JAX_PLATFORMS='cpu')
+        endpoint = 'tcp://127.0.0.1:%d' % free_tcp_port()
+        procs = [subprocess.Popen(
+            [sys.executable, '-m', 'petastorm_tpu.service',
+             '--endpoint', endpoint, '--no-supervisor',
+             '--heartbeat-interval', '0.2'], env=env)]
+        procs += [subprocess.Popen(
+            [sys.executable, '-m', 'petastorm_tpu.service.worker_server',
+             '--endpoint', endpoint, '--heartbeat-interval', '0.2',
+             '--ack-timeout', '2', '--parent-pid', str(os.getpid())],
+            env=env) for _ in range(2)]
+        standby = None
+        pools = []
+        try:
+            pool = DaemonClientPool(endpoint, name='bench-ha',
+                                    heartbeat_interval_s=0.2,
+                                    ack_timeout_s=1.5,
+                                    connect_timeout_s=60)
+            pools.append(pool)
+            pool.start(_Echo, worker_args={'placement_group': 'bench-ha'})
+            standby = StandbyDaemon(endpoint, sync_interval_s=0.2,
+                                    lapse_s=1.0, supervise=False,
+                                    heartbeat_interval_s=0.2)
+            standby.start()
+            n = 50 if SMOKE else 200
+            for i in range(n):
+                pool.ventilate(i)
+            got = [pool.get_results(timeout=60) for _ in range(n // 4)]
+            t_kill = time.monotonic()
+            os.kill(procs[0].pid, _signal.SIGKILL)
+            procs[0].wait()
+            # blackout at the SERVICE plane: kill → the first row
+            # delivered through the promoted incarnation (client
+            # re-registration and re-submission included) — the local
+            # results buffer can't fake this number
+            standby.wait_promoted(60)
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                promoted = standby.daemon
+                if promoted is not None and promoted.dispatcher.health()[
+                        'items_completed'] > 0:
+                    break
+                time.sleep(0.02)
+            extra['service_failover_blackout_s'] = round(
+                time.monotonic() - t_kill, 2)
+            while len(got) < n:
+                got.append(pool.get_results(timeout=60))
+            extra['service_failover_exact'] = sorted(got) == list(range(n))
+            pools.remove(pool)
+            pool.stop()
+            pool.join()
+            second = DaemonClientPool(endpoint, name='bench-warm',
+                                      heartbeat_interval_s=0.2,
+                                      ack_timeout_s=1.5,
+                                      connect_timeout_s=60)
+            pools.append(second)
+            second.start(_Echo,
+                         worker_args={'placement_group': 'bench-ha'})
+            for i in range(50):
+                second.ventilate(i)
+            for _ in range(50):
+                second.get_results(timeout=60)
+            health = standby.health()
+            placed = (health.get('placement_hits', 0)
+                      + health.get('placement_misses', 0))
+            if placed:
+                extra['service_placement_hit_share'] = round(
+                    health['placement_hits'] / placed, 3)
+        finally:
+            for p in pools:
+                p.stop()
+                p.join()
+            if standby is not None:
+                standby.stop()
+            for p in procs:
+                if p.poll() is None:
+                    p.terminate()
+            for p in procs:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait()
+
     def sec_lm_tokens():
         _build_c4_like(c4_url)
         extra['lm_packed_tokens_per_sec'] = round(_measure_lm_tokens(c4_url),
@@ -2256,6 +2365,7 @@ def main():
         section('decoded_cache', 10, sec_decoded_cache)
         section('selective_read', 15, sec_selective_read)
         section('io_overlap', 10, sec_io_overlap)
+        section('service', 20, sec_service)
         section('lm_tokens', 10, sec_lm_tokens)
         section('imagenet', 20, sec_imagenet)
         section('probe', 20, lambda: _probe_tpu(extra))

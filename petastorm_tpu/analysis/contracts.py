@@ -67,6 +67,10 @@ EVENT_NAMES = frozenset([
     'breaker_close',    # a breaker's respawned worker proved stable
     'job_register',     # daemon admitted a client job into the registry
     'job_gone',         # a job left the registry (goodbye or lease GC)
+    # high availability + QoS (service/standby.py + dispatcher.py):
+    'standby_promote',  # standby detected a primary lapse; promoting
+    'endpoint_takeover',  # promoted standby bound the primary's endpoint
+    'job_preempt',      # priority preemption cordoned a worker for a job
     # staging autotuner (jax/autotune.py): one instant per knob
     # adjustment on the 'autotuner' track, so a Perfetto export shows
     # WHY throughput changed shape mid-run
@@ -123,6 +127,14 @@ METRIC_NAMES = frozenset([
     'petastorm_tpu_service_workers_spawned_total',
     'petastorm_tpu_service_workers_released_total',
     'petastorm_tpu_service_breaker_open',
+    # highly-available decode service: warm-standby failover, QoS
+    # preemption, cache-aware placement (service/standby.py +
+    # dispatcher.py)
+    'petastorm_tpu_service_failovers_total',
+    'petastorm_tpu_service_replication_lag_seconds',
+    'petastorm_tpu_service_preemptions_total',
+    'petastorm_tpu_service_placement_hits_total',
+    'petastorm_tpu_service_placement_misses_total',
     'petastorm_tpu_swallowed_errors_total',
     'petastorm_tpu_faults_injected_total',
     # decoded-cache failure domain (materialized_cache.py)
@@ -206,6 +218,12 @@ KNOWN_KNOBS = frozenset([
     'PETASTORM_TPU_SERVICE_MAX_WORKERS',
     'PETASTORM_TPU_SERVICE_BREAKER_DEATHS',
     'PETASTORM_TPU_SERVICE_BREAKER_WINDOW_S',
+    'PETASTORM_TPU_SERVICE_SCALE_WINDOW_S',
+    'PETASTORM_TPU_SERVICE_STANDBY_SYNC_S',
+    'PETASTORM_TPU_SERVICE_STANDBY_LAPSE_S',
+    'PETASTORM_TPU_SERVICE_JOB_WEIGHT',
+    'PETASTORM_TPU_SERVICE_JOB_PRIORITY',
+    'PETASTORM_TPU_SERVICE_PLACEMENT',
     'PETASTORM_TPU_PUSHDOWN',
     'PETASTORM_TPU_PUSHDOWN_PRUNE',
     'PETASTORM_TPU_PUSHDOWN_WORKERS',
@@ -242,6 +260,8 @@ ANOMALY_KINDS = {
     'worker_flapping': 'A worker slot is crash-looping (worker_flapping)',
     'job_lease_expired': 'A job lease expired and was reclaimed '
                          '(job_lease_expired)',
+    'dispatcher_failover': 'The dispatcher failed over to its standby '
+                           '(dispatcher_failover)',
 }
 
 #: every registered fault-injection site (:mod:`petastorm_tpu.faults`),
@@ -280,6 +300,17 @@ FAULTPOINTS = {
                      '(service/supervisor.py; error = the spawn fails, '
                      'feeding the crash-loop circuit breaker — the '
                      'breaker drill without burning real processes)',
+    'zmq.replicate': 'the standby replication stream, checked at BOTH '
+                     'ends (dispatcher SSTATE send, standby receive; '
+                     'drop = the snapshot is lost in flight — sustained, '
+                     'the standby\'s mirror goes stale and a later '
+                     'promotion is COLD: clients re-register from '
+                     'scratch, still exactly-once)',
+    'service.promote': 'a standby\'s promotion attempt (service/'
+                       'standby.py; error = the attempt fails and is '
+                       'retried with backoff inside the promote window '
+                       '— the failover drill\'s knob for prolonging the '
+                       'blackout deterministically)',
 }
 
 #: the one knob-truthiness rule for "disable"/"enable" env spellings —

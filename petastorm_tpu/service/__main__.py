@@ -7,6 +7,14 @@ Runs the daemonized dispatcher (job registry, leases, admission
 control) plus the self-healing worker supervisor until SIGTERM/SIGINT
 drains the registry empty (a second signal stops hard). See
 docs/service.md, "Standing service".
+
+With ``--standby`` the process is a WARM STANDBY instead: it mirrors
+the primary daemon already serving ``--endpoint`` and promotes itself
+onto that same endpoint when the primary goes silent past the lapse
+window (docs/service.md, "High availability")::
+
+    python -m petastorm_tpu.service --standby \\
+        --endpoint tcp://127.0.0.1:7777 --workers 2
 """
 
 import argparse
@@ -51,6 +59,18 @@ def main(argv=None):
                         help='serve /metrics /report /health /trace on '
                              'this port (0 = ephemeral; same as setting '
                              'PETASTORM_TPU_OBS_PORT)')
+    parser.add_argument('--standby', action='store_true',
+                        help='run as a warm standby for the PRIMARY '
+                             'daemon at --endpoint: mirror its registry '
+                             'and promote onto that endpoint when it '
+                             'lapses (requires a concrete port)')
+    parser.add_argument('--standby-sync-interval', type=float,
+                        default=None,
+                        help='seconds between replication pulls (default '
+                             'PETASTORM_TPU_SERVICE_STANDBY_SYNC_S)')
+    parser.add_argument('--standby-lapse', type=float, default=None,
+                        help='primary silence before promotion (default '
+                             'PETASTORM_TPU_SERVICE_STANDBY_LAPSE_S)')
     parser.add_argument('-v', '--verbose', action='store_true')
     args = parser.parse_args(argv)
     if args.obs_port is not None:
@@ -61,13 +81,21 @@ def main(argv=None):
     # the daemon itself must never touch an accelerator; its supervised
     # workers re-pin themselves the same way (exec_in_new_process)
     os.environ['JAX_PLATFORMS'] = 'cpu'
-    daemon = ServiceDaemon(
-        args.endpoint, initial_workers=args.workers,
+    daemon_kwargs = dict(
+        initial_workers=args.workers,
         min_workers=args.min_workers, max_workers=args.max_workers,
         heartbeat_interval_s=args.heartbeat_interval,
         liveness_timeout_s=args.liveness_timeout,
         max_jobs=args.max_jobs, lease_s=args.lease,
         supervise=not args.no_supervisor)
+    if args.standby:
+        from petastorm_tpu.service.standby import StandbyDaemon
+        standby = StandbyDaemon(
+            args.endpoint, sync_interval_s=args.standby_sync_interval,
+            lapse_s=args.standby_lapse, **daemon_kwargs)
+        standby.run_forever()
+        return 0
+    daemon = ServiceDaemon(args.endpoint, **daemon_kwargs)
     daemon.start()
     daemon.run_forever()
     return 0

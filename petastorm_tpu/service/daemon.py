@@ -86,19 +86,23 @@ class ServiceDaemon:
                  liveness_timeout_s=None, max_inflight_per_worker=2,
                  max_retries=None, retry_backoff_s=None, max_jobs=None,
                  lease_s=None, supervise=True, supervisor_tick_s=None,
-                 spawn=None):
+                 spawn=None, seed_state=None):
         self._stop_event = threading.Event()
         self._heartbeat_interval_s = heartbeat_interval_s
         self._liveness_timeout_s = (liveness_timeout_s
                                     if liveness_timeout_s is not None
                                     else 4.0 * heartbeat_interval_s)
+        # seed_state: a promoted standby's replicated registry snapshot
+        # (docs/service.md, "High availability") — job identities and
+        # QoS params survive the failover; items re-ventilate
         self.dispatcher = Dispatcher(
             endpoint, None, None, self._stop_event,
             heartbeat_interval_s=heartbeat_interval_s,
             liveness_timeout_s=self._liveness_timeout_s,
             max_inflight_per_worker=max_inflight_per_worker,
             max_retries=max_retries, retry_backoff_s=retry_backoff_s,
-            standing=True, max_jobs=max_jobs, default_lease_s=lease_s)
+            standing=True, max_jobs=max_jobs, default_lease_s=lease_s,
+            seed_state=seed_state)
         self._initial_workers = initial_workers
         self._min_workers = min_workers
         self._max_workers = max_workers
@@ -144,12 +148,17 @@ class ServiceDaemon:
 
     def health(self):
         doc = self.dispatcher.health()
+        # HA role: a ServiceDaemon is always the serving head; the warm
+        # mirror is a StandbyDaemon whose /health says 'standby' (then
+        # 'promoting' / 'primary' as it takes over)
+        doc['role'] = 'primary'
         if self.supervisor is not None:
             doc['supervisor'] = self.supervisor.status()
         return doc
 
     def report(self):
-        doc = {'fleet': self.dispatcher.fleet_view()}
+        doc = {'fleet': self.dispatcher.fleet_view(),
+               'role': 'primary'}
         if self.supervisor is not None:
             doc['scaling_decisions'] = self.supervisor.decisions()
         return doc
@@ -218,7 +227,8 @@ class DaemonClientPool:
                  serializer=None, heartbeat_interval_s=1.0,
                  lease_s=None, connect_timeout_s=30.0,
                  ack_timeout_s=None, poison_policy='raise',
-                 read_deadline_s=None, name=None):
+                 read_deadline_s=None, name=None, weight=None,
+                 priority=None):
         """
         :param endpoint: the daemon's ``tcp://`` address (default: the
             ``PETASTORM_TPU_SERVICE_DAEMON`` knob).
@@ -232,6 +242,14 @@ class DaemonClientPool:
         :param ack_timeout_s: heartbeat-ack silence after which the
             daemon is presumed dead and re-registration begins
             (default ``max(10 × heartbeat_interval, 10s)``).
+        :param weight: QoS fair-share weight the job registers with (a
+            weight-3 job targets 3x the workers of a weight-1
+            co-tenant); default: the ``PETASTORM_TPU_SERVICE_JOB_WEIGHT``
+            knob, else the daemon's default of 1.
+        :param priority: QoS priority tier (strict admission: a higher
+            tier with pending work preempts workers from lower tiers);
+            default: the ``PETASTORM_TPU_SERVICE_JOB_PRIORITY`` knob,
+            else 0.
         """
         if poison_policy not in ('raise', 'skip'):
             raise ValueError("poison_policy must be 'raise' or 'skip'; "
@@ -255,6 +273,18 @@ class DaemonClientPool:
                                      'PETASTORM_TPU_SERVICE_READ'
                                      '_DEADLINE_S', 300.0, floor=0.0))
         self._name = name or 'client-%d' % os.getpid()
+        # QoS params ride the REGISTER_JOB params dict; knob defaults so
+        # a Reader-embedded client is governable without code changes
+        self._weight = (float(weight) if weight is not None
+                        else knobs.get_float(
+                            'PETASTORM_TPU_SERVICE_JOB_WEIGHT', 0.0,
+                            floor=0.0)) or None
+        self._priority = (int(priority) if priority is not None
+                          else knobs.get_int(
+                              'PETASTORM_TPU_SERVICE_JOB_PRIORITY', 0))
+        #: decode fingerprint for cache-aware placement, derived from
+        #: the job's worker args at start()
+        self._fingerprint = None
         #: idempotency key: a re-sent REGISTER_JOB (lost JOB_OK, socket
         #: reset) answers with the SAME job instead of a duplicate
         self._client_key = uuid.uuid4().hex
@@ -319,6 +349,12 @@ class DaemonClientPool:
             raise RuntimeError('DaemonClientPool already started')
         self._spec_payload = proto.dump_job_spec(worker_class, worker_args,
                                                  self._serializer)
+        # cache-aware placement: stamp the registration with the SAME
+        # fingerprint the worker servers advertise for their decoded
+        # caches (one helper on both sides — placement.py), so the
+        # dispatcher can bind this job to a warm host first
+        from petastorm_tpu.service.placement import placement_fingerprint
+        self._fingerprint = placement_fingerprint(worker_args)
         self._net_thread = threading.Thread(
             target=self._net_loop, daemon=True, name='service-daemon-client')
         self._net_thread.start()
@@ -466,6 +502,12 @@ class DaemonClientPool:
                   'credit': self._results_queue_size}
         if self._lease_s:
             params['lease_s'] = self._lease_s
+        if self._weight:
+            params['weight'] = self._weight
+        if self._priority:
+            params['priority'] = self._priority
+        if self._fingerprint:
+            params['fingerprint'] = self._fingerprint
         deadline = time.monotonic() + self._connect_timeout_s
         busy_backoff = _BUSY_BACKOFF_BASE_S
         next_send = 0.0

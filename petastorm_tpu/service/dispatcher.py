@@ -139,11 +139,19 @@ SERVICE_ITEMS_ASSIGNED = 'petastorm_tpu_service_items_assigned'
 SERVICE_RETRIES = 'petastorm_tpu_service_retries_total'
 SERVICE_POISONED = 'petastorm_tpu_service_items_poisoned_total'
 SERVICE_JOBS = 'petastorm_tpu_service_jobs_active'
+# cache-aware placement + QoS (docs/service.md, "High availability"):
+# a placement hit is a job bound to a worker already advertising the
+# job's decode fingerprint (its host holds the warm cache); a
+# preemption is a worker cordoned away from a lower-priority job for a
+# higher-priority one at row-group granularity
+SERVICE_PLACEMENT_HITS = 'petastorm_tpu_service_placement_hits_total'
+SERVICE_PLACEMENT_MISSES = 'petastorm_tpu_service_placement_misses_total'
+SERVICE_PREEMPTIONS = 'petastorm_tpu_service_preemptions_total'
 
 
 class _WorkerState:
     __slots__ = ('identity', 'last_heartbeat', 'ready', 'inflight',
-                 'job_id', 'cordoned', 'pid')
+                 'job_id', 'cordoned', 'pid', 'cache_fps', 'preempted_to')
 
     def __init__(self, identity, now):
         self.identity = identity
@@ -160,6 +168,15 @@ class _WorkerState:
         #: the heartbeat summaries; None on old builds until the first
         #: summary arrives
         self.pid = None
+        #: decode fingerprints the worker's host advertises (REGISTER
+        #: advert frame / heartbeat summaries) — the dispatcher's slice
+        #: of the fleet cache directory (docs/service.md)
+        self.cache_fps = set()
+        #: job id a pending preemption is cordoning this worker toward:
+        #: no new assignments, STOPped once its in-flight drains (never
+        #: mid-item), then re-bound by priority. Distinct from
+        #: ``cordoned``, which is the supervisor's TERMINATE path.
+        self.preempted_to = None
 
 
 class _Job:
@@ -171,10 +188,12 @@ class _Job:
                  'client_key', 'lease_s', 'last_client_seen', 'credit',
                  'markers_sent', 'markers_acked', 'pending', 'pending_ids',
                  'client_item_ids', 'live_cids', 'out', 'workers',
-                 'submitted', 'completed', 'created_at')
+                 'submitted', 'completed', 'created_at', 'weight',
+                 'priority', 'fingerprint')
 
     def __init__(self, job_id, spec_payload, deliver=None, client=None,
-                 client_key=None, lease_s=None, credit=None, name=None):
+                 client_key=None, lease_s=None, credit=None, name=None,
+                 weight=None, priority=None, fingerprint=None):
         self.job_id = job_id
         self.name = name or 'job-%d' % job_id
         self.spec_payload = spec_payload
@@ -184,6 +203,16 @@ class _Job:
         self.lease_s = lease_s
         self.last_client_seen = time.monotonic()
         self.credit = credit
+        # QoS (docs/service.md, "High availability"): weight scales the
+        # job's fair share of the worker fleet (weight 3 ≈ 3× the
+        # workers of a weight-1 co-tenant); priority is strict admission
+        # — a higher tier with pending work takes workers from lower
+        # tiers (preemption), never the reverse. Defaults reproduce the
+        # pre-QoS equal-share scheduler exactly.
+        self.weight = max(float(weight), 1e-6) if weight else 1.0
+        self.priority = int(priority) if priority else 0
+        #: decode fingerprint for cache-aware placement; None opts out
+        self.fingerprint = fingerprint or None
         # delivery-credit clock for client jobs: markers sent vs markers
         # the client reports consumed; the gap bounds everything buffered
         # between the two processes, so a stalled consumer quiesces ITS
@@ -231,6 +260,9 @@ class _Job:
             'credit': self.credit,
             'lease_s': self.lease_s,
             'out_backlog': len(self.out),
+            'weight': self.weight,
+            'priority': self.priority,
+            'fingerprint': self.fingerprint,
         }
 
 
@@ -277,7 +309,7 @@ class Dispatcher:
                  heartbeat_interval_s=1.0, liveness_timeout_s=4.0,
                  max_inflight_per_worker=2, no_workers_timeout_s=30.0,
                  max_retries=None, retry_backoff_s=None, standing=False,
-                 max_jobs=None, default_lease_s=None):
+                 max_jobs=None, default_lease_s=None, seed_state=None):
         self._requested_endpoint = endpoint
         self._deliver = deliver
         self._stop_event = stop_event
@@ -308,6 +340,10 @@ class Dispatcher:
                                  else knobs.get_float(
                                      'PETASTORM_TPU_SERVICE_LEASE_S',
                                      30.0, floor=1.0))
+        # cache-aware placement toggle: on by default, a kill switch for
+        # fleets where fingerprint adverts misbehave
+        self._placement_enabled = not knobs.is_disabled(
+            'PETASTORM_TPU_SERVICE_PLACEMENT')
         #: this dispatcher incarnation's identity, riding every SPEC and
         #: HEARTBEAT_ACK: a worker that sees the token change knows its
         #: dispatcher was replaced and must re-register for the new job
@@ -369,6 +405,14 @@ class Dispatcher:
         self._jobs_seen = 1 if job_spec_payload is not None else 0
         self._jobs_expired = 0
         self._metrics_deltas_merged = 0
+        # QoS / placement / HA accounting (docs/service.md, "High
+        # availability"): binding placement hits/misses, preemptions,
+        # and the replication pulls served to a warm standby
+        self._placement_hits = 0
+        self._placement_misses = 0
+        self._preemptions = 0
+        self._standby_syncs_served = 0
+        self._last_standby_sync = None
         # identity -> latest heartbeat-piggybacked observability summary
         # (JSON dict); the per-worker breakdown of the fleet view. Kept
         # alongside _workers and pruned on deregister, so it is bounded
@@ -397,6 +441,71 @@ class Dispatcher:
         # sweep, so the map stays bounded by in-flight work, never by
         # stream length or failure churn.
         self._trace_ctx = {}
+        if seed_state:
+            self._adopt_seed_state(seed_state)
+
+    def _adopt_seed_state(self, state):
+        """Adopt a promoted standby's replicated registry snapshot
+        (:meth:`standby_snapshot` of the dead primary). Jobs come back
+        with their identity (job id, client key), lease, credit and QoS
+        params but with ``client=None`` and a zeroed credit window: the
+        clients' re-registration (triggered by this incarnation's fresh
+        token) re-binds them by key and re-submits every un-markered
+        item, which is also why no in-flight items replicate — they
+        re-ventilate from the client side. ``next_item_id`` seeds ABOVE
+        the dead primary's watermark so late cross-incarnation frames
+        can never collide with this incarnation's id space (they are
+        dropped by the ``_item_owners`` gate regardless)."""
+        try:
+            for desc in state.get('jobs', ()):
+                job = _Job(int(desc['job_id']), desc['spec_payload'],
+                           client=None, client_key=desc.get('client_key'),
+                           lease_s=desc.get('lease_s'),
+                           credit=desc.get('credit'),
+                           name=desc.get('name'),
+                           weight=desc.get('weight'),
+                           priority=desc.get('priority'),
+                           fingerprint=desc.get('fingerprint'))
+                self._jobs[job.job_id] = job
+                self._jobs_seen += 1
+            self._job_seq = max([self._job_seq,
+                                 int(state.get('job_seq', 0))]
+                                + [j.job_id for j in self._jobs.values()])
+            self._next_item_id = max(self._next_item_id,
+                                     int(state.get('next_item_id', 0)))
+        except Exception:  # noqa: BLE001 - degrade to a cold promote
+            count_swallowed('dispatcher-seed-state')
+            logger.warning('Unusable standby seed state; promoting cold '
+                           '(clients re-register)', exc_info=True)
+
+    def standby_snapshot(self):
+        """The replication snapshot a warm standby pulls (SSYNC): client
+        job identities and QoS/lease/credit params plus the id
+        watermarks. Deliberately NOT replicated: in-flight items and
+        delivery buffers (they re-ventilate via client re-submission),
+        the worker roster beyond its cache adverts (workers re-register
+        with the new incarnation within a heartbeat), and the local
+        embedded job (it dies with its process)."""
+        with self._lock:
+            jobs = [{
+                'job_id': job.job_id,
+                'name': job.name,
+                'spec_payload': job.spec_payload,
+                'client_key': job.client_key,
+                'lease_s': job.lease_s,
+                'credit': job.credit,
+                'weight': job.weight,
+                'priority': job.priority,
+                'fingerprint': job.fingerprint,
+            } for job in self._jobs.values() if not job.is_local]
+            next_item_id = self._next_item_id
+        fleet_fps = set()
+        for worker in list(self._workers.values()):
+            fleet_fps.update(worker.cache_fps)
+        return {'next_item_id': next_item_id,
+                'job_seq': self._job_seq,
+                'jobs': jobs,
+                'fleet_cache_fps': sorted(fleet_fps)}
 
     # -- thread-safe surface (called from pool / ventilator threads) ---------
 
@@ -504,6 +613,26 @@ class Dispatcher:
         stats['max_jobs'] = self._max_jobs
         stats['jobs'] = [job.descriptor() for job in jobs]
         stats['poisoned'] = list(self._poisoned.values())
+        # QoS / placement / HA surface (docs/service.md, "High
+        # availability"): per-job delivery shares (worker fraction,
+        # weight-normalized target), placement hit/miss counters, and
+        # how recently a warm standby pulled a replication snapshot
+        bound = sum(len(job.workers) for job in jobs) or 1
+        weight_total = sum(job.weight for job in jobs) or 1.0
+        stats['qos'] = [{
+            'job_id': job.job_id, 'name': job.name,
+            'weight': job.weight, 'priority': job.priority,
+            'worker_share': round(len(job.workers) / bound, 4),
+            'target_share': round(job.weight / weight_total, 4),
+        } for job in jobs]
+        stats['placement_enabled'] = self._placement_enabled
+        stats['placement_hits'] = self._placement_hits
+        stats['placement_misses'] = self._placement_misses
+        stats['preemptions'] = self._preemptions
+        stats['standby_syncs_served'] = self._standby_syncs_served
+        stats['last_standby_sync_age_s'] = (
+            round(time.monotonic() - self._last_standby_sync, 3)
+            if self._last_standby_sync is not None else None)
         return stats
 
     def fleet_view(self):
@@ -529,6 +658,10 @@ class Dispatcher:
             }
             if worker.cordoned:
                 entry['cordoned'] = True
+            if worker.preempted_to is not None:
+                entry['preempted_to'] = worker.preempted_to
+            if worker.cache_fps:
+                entry['cache_fps'] = sorted(worker.cache_fps)
             summary = self._worker_obs.get(identity)
             if summary is not None:
                 entry['summary'] = summary
@@ -724,6 +857,14 @@ class Dispatcher:
                     worker.pid = int(frames[2])
                 except ValueError:
                     pass  # old/foreign build: pid arrives via summaries
+            if len(frames) > 3:
+                # optional cache-fingerprint advert (JSON list): the
+                # worker's host already holds these decoded caches, so
+                # binding MUST see them before its first heartbeat
+                # summary arrives — placement at registration time is
+                # the whole point (docs/service.md). Absent from older
+                # builds; a bad frame degrades to no advert.
+                self._note_cache_advert(worker, frames[3])
             if worker.job_id is None:
                 self._bind_worker(worker)
             job = self._jobs.get(worker.job_id)
@@ -736,7 +877,8 @@ class Dispatcher:
         elif msg == proto.MSG_READY:
             worker = self._workers.get(identity)
             if worker is not None:
-                worker.ready = not worker.cordoned
+                worker.ready = (not worker.cordoned
+                                and worker.preempted_to is None)
                 worker.last_heartbeat = now
         elif msg == proto.MSG_HEARTBEAT:
             summary = None
@@ -789,6 +931,9 @@ class Dispatcher:
                     worker.ready = False
             if summary is not None:
                 self._worker_obs[identity] = summary
+                fps = summary.get('cache_fp')
+                if isinstance(fps, list):
+                    worker.cache_fps.update(str(fp) for fp in fps if fp)
             sock.send_multipart([identity, proto.MSG_HEARTBEAT_ACK,
                                  self.token])
         elif msg == proto.MSG_DONE:
@@ -815,6 +960,21 @@ class Dispatcher:
             self._fail(identity, item_id, exc, now)
         elif msg == proto.MSG_BYE:
             self._deregister(identity, 'said goodbye')
+        elif msg == proto.MSG_STANDBY_SYNC:
+            # a warm standby pulling its replication snapshot
+            # (docs/service.md, "High availability"). The drop faultpoint
+            # models a severed replication stream: the standby's snapshot
+            # goes stale (or stays empty) and a later promotion degrades
+            # to a cold promote — which the chaos suite proves is still
+            # multiset-exact, just slower to re-admit.
+            if faults.ARMED and faults.fault_hit(
+                    'zmq.replicate', key=identity) == 'drop':
+                return  # injected: snapshot lost in flight
+            self._standby_syncs_served += 1
+            self._last_standby_sync = now
+            sock.send_multipart(
+                [identity, proto.MSG_STANDBY_STATE, self.token,
+                 proto.dump_standby_state(self.standby_snapshot())])
         elif msg in (proto.MSG_REGISTER_JOB, proto.MSG_SUBMIT,
                      proto.MSG_CLIENT_HB, proto.MSG_JOB_GONE):
             # client frames are OTHER PROCESSES' input: a malformed one
@@ -871,9 +1031,14 @@ class Dispatcher:
         # so requiring it to match would defeat exactly the reconnect
         # case; the rebind below points the job's results at the
         # client's live identity.
+        # key-alone matching also covers a job SEEDED from a promoted
+        # standby's snapshot (client=None until its owner re-registers
+        # with this incarnation): the reconnecting client re-binds to
+        # the job identity the dead primary leased it — same id, same
+        # key — instead of double-registering
         if client_key:
             for job in self._jobs.values():
-                if job.client is not None and job.client_key == client_key:
+                if job.client_key == client_key:
                     job.client = identity
                     # reconcile the delivery-credit clock: markers sent
                     # toward the OLD identity during the blip were
@@ -921,7 +1086,10 @@ class Dispatcher:
             self._job_seq += 1
             job = _Job(self._job_seq, frames[2], client=identity,
                        client_key=client_key, lease_s=lease_s,
-                       credit=credit, name=params.get('name'))
+                       credit=credit, name=params.get('name'),
+                       weight=params.get('weight'),
+                       priority=params.get('priority'),
+                       fingerprint=params.get('fingerprint'))
             job.last_client_seen = now
             self._jobs[job.job_id] = job
         self._jobs_seen += 1
@@ -1047,13 +1215,53 @@ class Dispatcher:
 
     # -- worker <-> job binding ----------------------------------------------
 
+    def _note_cache_advert(self, worker, frame):
+        """Fold a worker's REGISTER-time cache advert (JSON list of
+        decode fingerprints) into its fleet cache-directory entry. A
+        bad frame degrades to no advert — placement is advisory."""
+        import json
+        try:
+            fps = json.loads(bytes(frame).decode('utf-8'))
+        except Exception:  # noqa: BLE001 - placement is advisory
+            count_swallowed('dispatcher-cache-advert')
+            return
+        if isinstance(fps, list):
+            worker.cache_fps.update(str(fp) for fp in fps if fp)
+
     def _bind_worker(self, worker):
-        """Bind a fresh/unbound worker to the job that needs it most
-        (fewest bound workers; ties to the oldest job)."""
+        """Bind a fresh/unbound worker to the job that needs it most:
+        jobs with pending work before idle ones (a drained tier —
+        however senior — must not hoard fresh workers while a co-tenant
+        has rows waiting; priority gates SERVICE, not possession), then
+        highest priority tier, then lowest weight-normalized load, then
+        cache-aware placement (the job whose decode fingerprint the
+        worker's host already advertises wins the tie — its cache is
+        warm there), ties to the oldest job. With default QoS params and
+        no fingerprints this reduces exactly to the original
+        least-loaded-first binding."""
         candidates = [job for job in self._jobs.values()]
         if not candidates:
             return None
-        job = min(candidates, key=lambda j: (len(j.workers), j.job_id))
+
+        def warmth(job):
+            if not (self._placement_enabled and job.fingerprint):
+                return 1
+            return 0 if job.fingerprint in worker.cache_fps else 1
+
+        job = min(candidates,
+                  key=lambda j: (0 if j.pending else 1,
+                                 -j.priority if j.pending else 0,
+                                 len(j.workers) / j.weight,
+                                 warmth(j), j.job_id))
+        if self._placement_enabled and job.fingerprint:
+            if job.fingerprint in worker.cache_fps:
+                self._placement_hits += 1
+                if not metrics_disabled():
+                    get_registry().counter(SERVICE_PLACEMENT_HITS).inc()
+            else:
+                self._placement_misses += 1
+                if not metrics_disabled():
+                    get_registry().counter(SERVICE_PLACEMENT_MISSES).inc()
         worker.job_id = job.job_id
         job.workers.add(worker.identity)
         return job
@@ -1070,28 +1278,54 @@ class Dispatcher:
 
     def _rebalance_step(self):
         """At most one worker moves per call: find the most-served and
-        least-served jobs; when the gap exceeds one worker (or the
-        least-served has none), STOP one IDLE worker of the donor. Idle
-        only: STOPping a busy worker would re-ventilate its items and
-        charge their retry budgets for a scheduling decision."""
+        least-served jobs by WEIGHT-NORMALIZED load; when the move
+        narrows the normalized gap (at equal weights: the raw gap
+        exceeds one worker) or the least-served has none, STOP one IDLE
+        worker of the donor. Idle only: STOPping a busy worker would
+        re-ventilate its items and charge their retry budgets for a
+        scheduling decision. Priority preemption runs first — it is the
+        one path allowed to cordon a BUSY worker (drained at row-group
+        granularity, never mid-item)."""
+        self._preempt_step()
         jobs = list(self._jobs.values())
         if len(jobs) < 2:
             return
-        donor = max(jobs, key=lambda j: len(j.workers))
-        needy = min(jobs, key=lambda j: len(j.workers))
-        starved = len(needy.workers) == 0 and (len(donor.workers) >= 2
-                                               or bool(needy.pending))
-        if len(donor.workers) - len(needy.workers) < 2 and not starved:
-            # a zero-worker job WITH pending work may steal an idle
-            # worker even from a one-worker donor: with more jobs than
-            # workers that degenerates to time-multiplexing at sweep
-            # cadence (the donor steals back when ITS queue is the
-            # starved one) — crude, but strictly better than the 9th
-            # job wedging against a fully-partitioned fleet
+        # demand classes before load: a job with NO pending work is the
+        # preferred donor (its workers are idle capital) and is never
+        # needy, whatever its weight-normalized load — without this, an
+        # idle high-priority job and a pending-first _bind_worker churn
+        # a STOP/rebind loop while a busy co-tenant starves
+        donor = max(jobs, key=lambda j: (0 if j.pending else 1,
+                                         len(j.workers) / j.weight,
+                                         -j.job_id))
+        needy = min(jobs, key=lambda j: (0 if j.pending else 1,
+                                         len(j.workers) / j.weight,
+                                         j.job_id))
+        if donor is needy or not donor.workers:
+            return
+        if needy.priority < donor.priority and donor.pending:
+            # strict priority: a busy higher tier keeps its fleet — the
+            # lower tier waits (the documented starvation semantics,
+            # docs/troubleshoot.md). An IDLE higher tier still donates.
+            return
+        starved = len(needy.workers) == 0 and bool(needy.pending)
+        idle_donor = not donor.pending and bool(needy.pending)
+        donor_after = (len(donor.workers) - 1) / donor.weight
+        needy_after = (len(needy.workers) + 1) / needy.weight
+        if donor_after < needy_after and not starved and not idle_donor:
+            # starved: a zero-worker job WITH pending work may steal an
+            # idle worker even from a one-worker donor — with more jobs
+            # than workers that degenerates to time-multiplexing at
+            # sweep cadence (the donor steals back when ITS queue is
+            # the starved one): crude, but strictly better than the 9th
+            # job wedging against a fully-partitioned fleet.
+            # idle_donor: a drained job's workers all flow to a pending
+            # co-tenant, one per sweep, whatever the load gap says.
             return
         for identity in list(donor.workers):
             worker = self._workers.get(identity)
-            if worker is None or worker.inflight or worker.cordoned:
+            if worker is None or worker.inflight or worker.cordoned \
+                    or worker.preempted_to is not None:
                 continue
             worker.job_id = None
             worker.ready = False
@@ -1100,6 +1334,78 @@ class Dispatcher:
             logger.info('Rebalancing: moved worker %s off job %d toward '
                         'job %d', identity, donor.job_id, needy.job_id)
             return
+
+    def _preempt_step(self):
+        """Priority admission: the highest-priority job with pending
+        work and no workers' worth of service takes ONE worker per sweep
+        from a lower tier. An idle victim moves immediately (same STOP →
+        re-register → priority-first rebind path as rebalancing); a busy
+        one is marked ``preempted_to`` — no new assignments, drained at
+        row-group granularity, moved once its in-flight empties — so
+        exactly-once accounting is untouched and the preempted job is
+        never charged a retry or a quarantine for the scheduling
+        decision."""
+        # release drained preempted workers first: their in-flight hit
+        # zero since the mark, so the move completes this sweep
+        for worker in list(self._workers.values()):
+            if worker.preempted_to is None or worker.inflight:
+                continue
+            old_job = self._jobs.get(worker.job_id)
+            if old_job is not None:
+                old_job.workers.discard(worker.identity)
+            worker.job_id = None
+            worker.ready = False
+            worker.preempted_to = None
+            self._send_worker(worker.identity, [proto.MSG_STOP])
+        jobs = [j for j in self._jobs.values()]
+        if len(jobs) < 2:
+            return
+        contenders = [j for j in jobs
+                      if j.pending and not j.gated() and not j.workers]
+        if not contenders:
+            return
+        high = max(contenders, key=lambda j: (j.priority, -j.job_id))
+        victims = [j for j in jobs if j.priority < high.priority
+                   and j.workers]
+        if not victims:
+            return
+        victim = max(victims, key=lambda j: (len(j.workers) / j.weight,
+                                             -j.priority))
+        # prefer an idle victim worker (moves this sweep); else cordon
+        # one busy worker to drain — skip ones already marked
+        chosen = None
+        for identity in sorted(victim.workers):
+            worker = self._workers.get(identity)
+            if worker is None or worker.cordoned \
+                    or worker.preempted_to is not None:
+                continue
+            if chosen is None or (chosen.inflight and not worker.inflight):
+                chosen = worker
+            if not worker.inflight:
+                break
+        if chosen is None:
+            return
+        self._preemptions += 1
+        if not metrics_disabled():
+            get_registry().counter(SERVICE_PREEMPTIONS).inc()
+        tracing.record_instant(
+            'job_preempt', tracing.mint(high.job_id), 'daemon',
+            job=high.job_id, victim_job=victim.job_id,
+            worker=chosen.identity.decode('utf-8', 'replace'),
+            draining=bool(chosen.inflight))
+        logger.warning('Preempting worker %s from job %d (priority %d) '
+                       'toward job %d (priority %d)%s', chosen.identity,
+                       victim.job_id, victim.priority, high.job_id,
+                       high.priority,
+                       ' after drain' if chosen.inflight else '')
+        if chosen.inflight:
+            chosen.preempted_to = high.job_id
+            chosen.ready = False
+            return
+        victim.workers.discard(chosen.identity)
+        chosen.job_id = None
+        chosen.ready = False
+        self._send_worker(chosen.identity, [proto.MSG_STOP])
 
     def _merge_metrics(self, frame):
         """Fold one worker server's piggybacked telemetry delta into this

@@ -1,0 +1,100 @@
+"""Cache-aware placement: decode fingerprints for the service plane.
+
+The standing service schedules many jobs onto one worker fleet. Two
+jobs reading the same dataset with the same decode pipeline produce the
+SAME materialized row-group cache (docs/materialized_cache.md) — so the
+second job should land on the host that already decoded it, not redo
+the work cold on another. The currency of that decision is the decode
+fingerprint (:func:`petastorm_tpu.materialized_cache.decode_fingerprint`):
+
+* the **client** stamps its job registration with the fingerprint of
+  the job's worker args (``DaemonClientPool._register_job``);
+* each **worker server** advertises the fingerprints of the caches its
+  host already holds — on REGISTER (a trailing JSON frame) and in every
+  heartbeat obs summary (``cache_fp``), kept fresh via marker files in
+  the decoded-cache directory;
+* the **dispatcher** folds the adverts into a fleet cache directory and
+  prefers fingerprint-matching workers when binding (``_bind_worker``),
+  counting hits and misses in telemetry.
+
+Both sides compute the fingerprint with :func:`placement_fingerprint`
+below — one function, identical inputs, identical value — so a
+placement hit is a real cache hit, not a naming coincidence. Jobs whose
+worker args carry no schema (stub workers, non-reader jobs) can opt in
+with an explicit ``placement_group`` string in ``worker_args``; it
+bypasses the schema derivation entirely and is matched verbatim.
+
+Everything here is advisory: a wrong or missing fingerprint costs warm
+starts, never correctness — so every helper swallows its own failures
+(:func:`petastorm_tpu.telemetry.count_swallowed`) and degrades to
+"no fingerprint".
+"""
+
+import os
+
+from petastorm_tpu.telemetry import count_swallowed
+
+#: cap on fingerprints a worker advertises (REGISTER frame / heartbeat
+#: summary) and on marker files scanned — adverts ride the hot
+#: heartbeat path and a host rarely holds more than a handful of warm
+#: datasets at once
+MAX_ADVERTISED = 8
+
+_MARKER_PREFIX = '.fp_'
+
+
+def placement_fingerprint(worker_args):
+    """The placement identity of a job, or None when it has none.
+
+    An explicit ``placement_group`` string in ``worker_args`` wins
+    unconditionally (the user-facing escape hatch, and how schema-less
+    stub jobs participate); otherwise the fingerprint derives from the
+    decode-relevant args exactly like the materialized cache's own
+    layout key (``loaded_schema`` + ``transform_spec`` + ``ngram``).
+    """
+    if not isinstance(worker_args, dict):
+        return None
+    try:
+        group = worker_args.get('placement_group')
+        if group:
+            return str(group)
+        loaded_schema = worker_args.get('loaded_schema')
+        if loaded_schema is None:
+            return None
+        from petastorm_tpu.materialized_cache import decode_fingerprint
+        return decode_fingerprint(loaded_schema,
+                                  worker_args.get('transform_spec'),
+                                  ngram=worker_args.get('ngram'))
+    except Exception:  # noqa: BLE001 - placement is advisory
+        count_swallowed('placement-fingerprint')
+        return None
+
+
+def note_fingerprint(cache_dir, fingerprint):
+    """Drop a marker file so FUTURE worker servers on this host advertise
+    ``fingerprint`` from their first REGISTER (the in-process set covers
+    the current server's lifetime; the marker survives it)."""
+    if not cache_dir or not fingerprint:
+        return
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        path = os.path.join(cache_dir, _MARKER_PREFIX + str(fingerprint))
+        with open(path, 'a'):
+            pass
+    except Exception:  # noqa: BLE001 - placement is advisory
+        count_swallowed('placement-marker')
+
+
+def advertised_fingerprints(cache_dir, extra=()):
+    """The fingerprints a worker server should advertise: marker files
+    under ``cache_dir`` plus the in-process ``extra`` set, sorted and
+    capped at :data:`MAX_ADVERTISED`."""
+    found = set(str(fp) for fp in extra if fp)
+    try:
+        if cache_dir and os.path.isdir(cache_dir):
+            for name in os.listdir(cache_dir):
+                if name.startswith(_MARKER_PREFIX):
+                    found.add(name[len(_MARKER_PREFIX):])
+    except Exception:  # noqa: BLE001 - placement is advisory
+        count_swallowed('placement-scan')
+    return sorted(found)[:MAX_ADVERTISED]

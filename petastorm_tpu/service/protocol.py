@@ -107,6 +107,17 @@ MSG_RESULT = b'RES'              # [RES, <kind>, <client item id>, <payload>*]
 # MSG_RESULT's kind frame carries b'result' / b'error' / b'marker' /
 # b'poisoned' — the wire form of the dispatcher's local delivery tuples
 
+# warm-standby replication (docs/service.md, "High availability"). The
+# standby daemon is one more DEALER peer on the primary's ROUTER socket
+# — told apart by message type exactly like clients — that periodically
+# pulls a registry snapshot. Pull, not push: the primary stays ignorant
+# of how many standbys watch it, and a lapsed standby costs nothing.
+# These frames are ADDITIVE like the client vocabulary: an old
+# dispatcher logs an unknown message type and the standby degrades to a
+# cold promote (re-registration only).
+MSG_STANDBY_SYNC = b'SSYNC'      # [SSYNC] — standby pulls a snapshot
+MSG_STANDBY_STATE = b'SSTATE'    # [SSTATE, <token>, <state payload>]
+
 
 def pack_item_id(item_id):
     return b'%d' % item_id
@@ -255,6 +266,37 @@ def load_json_params(frame):
     except Exception:  # noqa: BLE001 - advisory metadata
         return {}
     return params if isinstance(params, dict) else {}
+
+
+def dump_standby_state(state):
+    """Frame the dispatcher's replication snapshot (job specs, leases,
+    credit watermarks, QoS params, fleet cache directory — see
+    ``Dispatcher.standby_snapshot``) for the SSTATE reply. dill, not
+    JSON: the snapshot embeds the jobs' spec payloads verbatim, which
+    are dill by design (the job spec IS code — same trust model as the
+    rest of the wire). Errors degrade to ``b''`` (a lost snapshot costs
+    one sync round, never the primary)."""
+    try:
+        return dill.dumps(state)
+    except Exception:  # noqa: BLE001 - replication is advisory
+        from petastorm_tpu.telemetry import count_swallowed
+        count_swallowed('standby-state-encode')
+        return b''
+
+
+def load_standby_state(payload):
+    """Inverse of :func:`dump_standby_state`; None for empty or
+    undecodable frames (the standby keeps its previous snapshot and the
+    lag gauge shows the staleness)."""
+    if not payload:
+        return None
+    try:
+        state = dill.loads(payload)
+    except Exception:  # noqa: BLE001 - replication is advisory
+        from petastorm_tpu.telemetry import count_swallowed
+        count_swallowed('standby-state-decode')
+        return None
+    return state if isinstance(state, dict) else None
 
 
 def free_tcp_port(host='127.0.0.1'):

@@ -11,11 +11,14 @@ for a daemonized fleet (docs/service.md, "Standing service"):
   replaced within one supervision tick, so a SIGKILL costs the fleet one
   heartbeat window, not a worker.
 * **Recruitment**: sustained saturation — the dispatcher's queue holding
-  pending work while every live worker is loaded, or ``queue_saturated``
-  anomaly events from the rollup detector — recruits workers one per
-  episode up to ``PETASTORM_TPU_SERVICE_MAX_WORKERS``.
+  pending work while every live worker is loaded, judged over rollup
+  WINDOWS of ``PETASTORM_TPU_SERVICE_SCALE_WINDOW_S`` seconds rather
+  than raw ticks (:class:`_ScaleRollup`, the autotuner's windowed-
+  verdict discipline) — recruits workers one per episode up to
+  ``PETASTORM_TPU_SERVICE_MAX_WORKERS``.
 * **Release**: a sustained idle fleet (nothing pending, nothing
-  assigned — the consumer-bound regime) releases workers down to
+  assigned over the same windows — the consumer-bound regime) releases
+  workers down to
   ``PETASTORM_TPU_SERVICE_MIN_WORKERS``, two-phase so no work is ever
   re-ventilated for a scaling decision: *cordon* (the dispatcher stops
   assigning to that worker), wait idle, then SIGTERM (the worker server
@@ -64,9 +67,9 @@ SERVICE_SPAWNED = 'petastorm_tpu_service_workers_spawned_total'
 SERVICE_RELEASED = 'petastorm_tpu_service_workers_released_total'
 SERVICE_BREAKER_OPEN = 'petastorm_tpu_service_breaker_open'
 
-#: consecutive saturated ticks before one worker is recruited
+#: consecutive saturated windows before one worker is recruited
 _SCALE_UP_TICKS = 3
-#: consecutive idle ticks before one worker is released
+#: consecutive idle windows before one worker is released
 _SCALE_DOWN_TICKS = 10
 #: wall-clock grace for a spawned worker's FIRST registration (a fresh
 #: interpreter pays import time before it can heartbeat at all)
@@ -122,6 +125,58 @@ class _Slot:
         }
 
 
+class _ScaleRollup:
+    """Windowed scaling verdicts — the autotuner's discipline applied to
+    recruit/release (docs/service.md). Raw per-tick streaks made every
+    transient spike a vote: one tick of backlog while a worker was
+    between row-groups counted toward recruitment exactly like a tick of
+    real saturation. Instead, ticks accumulate into a rollup window of
+    ``PETASTORM_TPU_SERVICE_SCALE_WINDOW_S`` seconds and each CLOSED
+    window casts one verdict from its MEANS — saturated, idle, or
+    neither — so a decision needs sustained evidence, not a lucky
+    sample. A window of 0 (the default) closes one window per tick:
+    verdicts degenerate to the original per-tick readings and the
+    scaling cadence is unchanged."""
+
+    __slots__ = ('window_s', 'sat_windows', 'idle_windows',
+                 '_samples', '_window_start')
+
+    def __init__(self, window_s):
+        self.window_s = window_s
+        self.sat_windows = 0
+        self.idle_windows = 0
+        self._samples = []
+        self._window_start = None
+
+    def add(self, now, pending, assigned, alive):
+        """Fold one tick's dispatcher sample. Returns the closed
+        window's stats (the decision log's evidence) when this sample
+        completed a window, else None."""
+        if self._window_start is None:
+            self._window_start = now
+        self._samples.append((pending, assigned, alive))
+        if now - self._window_start < self.window_s:
+            return None
+        n = len(self._samples)
+        mean_pending = sum(s[0] for s in self._samples) / n
+        mean_assigned = sum(s[1] for s in self._samples) / n
+        mean_alive = sum(s[2] for s in self._samples) / n
+        self._samples = []
+        self._window_start = now
+        # the same conditions the per-tick reading used, over the
+        # window means: queued work while every live worker carries
+        # load, vs. a fleet with nothing queued and nothing assigned
+        saturated = mean_pending > 0 and (mean_alive == 0
+                                          or mean_assigned >= mean_alive)
+        idle = mean_pending == 0 and mean_assigned == 0
+        self.sat_windows = self.sat_windows + 1 if saturated else 0
+        self.idle_windows = self.idle_windows + 1 if idle else 0
+        return {'ticks': n, 'mean_pending': round(mean_pending, 2),
+                'mean_assigned': round(mean_assigned, 2),
+                'mean_alive': round(mean_alive, 2),
+                'saturated': saturated, 'idle': idle}
+
+
 class WorkerSupervisor:
     """Process-spawning supervision loop for a daemon's worker fleet.
 
@@ -173,8 +228,8 @@ class WorkerSupervisor:
                           min(initial_workers, self._max_workers))
         self._slots = []
         self._slot_seq = 0
-        self._sat_streak = 0
-        self._idle_streak = 0
+        self._scale = _ScaleRollup(knobs.get_float(
+            'PETASTORM_TPU_SERVICE_SCALE_WINDOW_S', 0.0, floor=0.0))
         self._wedge_streaks = {}            # pid -> lapsed-since timestamp
         self._decision_seq = 0
         self._decisions = collections.deque(maxlen=_DECISION_KEEP)
@@ -343,28 +398,27 @@ class WorkerSupervisor:
         pending = stats.get('items_pending', 0)
         assigned = stats.get('items_assigned', 0)
         alive = stats.get('workers_alive', 0)
-        # saturation: work is queued while every live worker already
-        # carries load — the dispatcher-side reading of the rollup
-        # detector's queue_saturated condition (and the same condition
-        # that emits the event when the observability plane is armed)
-        saturated = pending > 0 and (alive == 0 or assigned >= alive)
-        idle = pending == 0 and assigned == 0
-        self._sat_streak = self._sat_streak + 1 if saturated else 0
-        self._idle_streak = self._idle_streak + 1 if idle else 0
-        if self._sat_streak >= _SCALE_UP_TICKS \
+        # every tick feeds the rollup; only a CLOSED window casts a
+        # saturated/idle verdict (window 0 = one window per tick, the
+        # original cadence) — see _ScaleRollup
+        window = self._scale.add(now, pending, assigned, alive)
+        if window is None:
+            return
+        if self._scale.sat_windows >= _SCALE_UP_TICKS \
                 and self.target < self._max_workers:
             self.target += 1
-            self._sat_streak = 0
+            self._scale.sat_windows = 0
             # decision-log only: _add_slot's spawn records the canonical
             # worker_spawn trace instant (one instant per actual spawn)
             self._record('scale_up_decision', target=self.target,
-                         pending=pending, workers_alive=alive)
+                         pending=pending, workers_alive=alive,
+                         window=window)
             self._add_slot(now)
-        elif self._idle_streak >= _SCALE_DOWN_TICKS \
+        elif self._scale.idle_windows >= _SCALE_DOWN_TICKS \
                 and self.target > self._min_workers \
                 and len(self._slots) > self._min_workers:
             self.target -= 1
-            self._idle_streak = 0
+            self._scale.idle_windows = 0
             self._begin_release(now)
 
     def _advance_releases(self, now):
@@ -535,6 +589,7 @@ class WorkerSupervisor:
             'max_workers': self._max_workers,
             'breaker_deaths': self._breaker_deaths,
             'breaker_window_s': self._breaker_window_s,
+            'scale_window_s': self._scale.window_s,
             'spawned_total': self._spawned_total,
             'released_total': self._released_total,
             'slots': slots,

@@ -69,7 +69,8 @@ def _parent_died(parent_pid):
     return not psutil.pid_exists(parent_pid)
 
 
-def _register(sock, parent_pid, register_timeout_s, term_event=None):
+def _register(sock, parent_pid, register_timeout_s, term_event=None,
+              cache_fps=()):
     """REGISTER with exponential backoff until the SPEC arrives.
 
     Returns ``(spec payload, dispatcher token)`` — token None from a
@@ -77,16 +78,30 @@ def _register(sock, parent_pid, register_timeout_s, term_event=None):
     should exit (orphaned, SIGTERMed, or the registration window
     closed).
     """
+    import json
+
     backoff_s = 0.1
     deadline = (None if register_timeout_s is None
                 else time.monotonic() + register_timeout_s)
     last_parent_check = 0.0
+    # cache-fingerprint advert (JSON list, additive frame like the pid):
+    # the dispatcher must see which decoded caches this HOST already
+    # holds BEFORE it binds us to a job — placement happens at
+    # registration time (docs/service.md, "High availability")
+    try:
+        advert = json.dumps(list(cache_fps)).encode() if cache_fps else b''
+    except Exception:  # noqa: BLE001 - placement is advisory
+        count_swallowed('worker-cache-advert')
+        advert = b''
+    frames_out = [proto.MSG_REGISTER, b'%d' % os.getpid()]
+    if advert:
+        frames_out.append(advert)
     while True:
         # the trailing pid frame is ADVISORY and additive (an old
         # dispatcher ignores extra REGISTER frames): it lets a standing
         # daemon's supervisor tell a worker that is merely between jobs
         # (re-registering, not yet heartbeating) from a wedged one
-        sock.send_multipart([proto.MSG_REGISTER, b'%d' % os.getpid()])
+        sock.send_multipart(frames_out)
         poll_deadline = time.monotonic() + backoff_s
         while time.monotonic() < poll_deadline:
             if term_event is not None and term_event.is_set():
@@ -137,13 +152,25 @@ def _reroot_decoded_cache(worker_args):
 
 def _run_job(sock, spec_payload, worker_id, heartbeat_interval_s,
              ack_timeout_s, parent_pid, status=None, token=None,
-             term_event=None):
+             term_event=None, known_fps=None):
     """One job lifetime: build the worker, stream items until STOP, the
     dispatcher vanishes (ack timeout), or a DIFFERENT dispatcher
     incarnation takes the endpoint (heartbeat-ack token mismatch).
     Returns True if the server should serve again."""
     worker_class, worker_args, serializer = proto.load_job_spec(spec_payload)
     _reroot_decoded_cache(worker_args)
+    # cache-aware placement: this job's decode fingerprint becomes part
+    # of the host's advert set (heartbeat summaries now; a marker file
+    # so future server processes advertise it from their first REGISTER)
+    from petastorm_tpu.service import placement
+    fingerprint = placement.placement_fingerprint(worker_args)
+    if fingerprint:
+        known_fps = known_fps if known_fps is not None else set()
+        known_fps.add(fingerprint)
+        placement.note_fingerprint(
+            knobs.get_str('PETASTORM_TPU_DECODED_CACHE_DIR'), fingerprint)
+    advertised = sorted(known_fps)[:placement.MAX_ADVERTISED] \
+        if known_fps else None
     # per-heartbeat observability summary (docs/telemetry.md fleet view):
     # thread-free rates since the previous heartbeat, piggybacked on the
     # HEARTBEAT frame so the dispatcher's endpoint can break the fleet
@@ -217,6 +244,8 @@ def _run_job(sock, spec_payload, worker_id, heartbeat_interval_s,
                         summary = summarizer.summary(
                             obs_port=obs_server.server_port())
                         summary['items_done'] = status.get('items_done', 0)
+                        if advertised:
+                            summary['cache_fp'] = advertised
                         frame = proto.dump_obs_summary(summary)
                     except Exception:  # noqa: BLE001 - advisory telemetry
                         count_swallowed('worker-obs-summary')
@@ -345,6 +374,12 @@ def serve(endpoint, worker_id=0, heartbeat_interval_s=1.0,
         return out
 
     obs_mount = obs_server.mount('worker-server', health=_health)
+    # fingerprints of decoded caches this host holds, advertised on
+    # REGISTER and heartbeats: warm markers on disk plus every job this
+    # process served (cache-aware placement, docs/service.md)
+    from petastorm_tpu.service import placement
+    known_fps = set(placement.advertised_fingerprints(
+        knobs.get_str('PETASTORM_TPU_DECODED_CACHE_DIR')))
     try:
         while True:
             # Fresh socket (and identity) per job lifetime: a stale
@@ -359,16 +394,18 @@ def serve(endpoint, worker_id=0, heartbeat_interval_s=1.0,
             sock.connect(endpoint)
             try:
                 status['state'] = 'registering'
-                spec_payload, token = _register(sock, parent_pid,
-                                                register_timeout_s,
-                                                term_event=term_event)
+                spec_payload, token = _register(
+                    sock, parent_pid, register_timeout_s,
+                    term_event=term_event,
+                    cache_fps=sorted(known_fps)[:placement.MAX_ADVERTISED])
                 if spec_payload is None:
                     return
                 status['state'] = 'serving'
                 serve_again = _run_job(sock, spec_payload, worker_id,
                                        heartbeat_interval_s, ack_timeout_s,
                                        parent_pid, status=status,
-                                       token=token, term_event=term_event)
+                                       token=token, term_event=term_event,
+                                       known_fps=known_fps)
                 status['jobs_served'] += 1
                 try:
                     sock.send_multipart([proto.MSG_BYE])

@@ -364,13 +364,20 @@ def _service_section(registry):
     machinery's activity visible without reading dispatcher logs."""
     from petastorm_tpu.service.dispatcher import (
         SERVICE_DUPLICATE_DONE, SERVICE_ITEMS_ASSIGNED,
-        SERVICE_ITEMS_PENDING, SERVICE_POISONED, SERVICE_RETRIES,
-        SERVICE_REVENTILATED, SERVICE_WORKERS_ALIVE,
+        SERVICE_ITEMS_PENDING, SERVICE_PLACEMENT_HITS,
+        SERVICE_PLACEMENT_MISSES, SERVICE_POISONED, SERVICE_PREEMPTIONS,
+        SERVICE_RETRIES, SERVICE_REVENTILATED, SERVICE_WORKERS_ALIVE,
         SERVICE_WORKERS_REGISTERED,
+    )
+    from petastorm_tpu.service.standby import (
+        SERVICE_FAILOVERS, SERVICE_REPLICATION_LAG,
     )
     gauges = registry.gauges_with_prefix('petastorm_tpu_service_')
     if not gauges:
         return None
+    placement_hits = registry.counter_value(SERVICE_PLACEMENT_HITS)
+    placement_misses = registry.counter_value(SERVICE_PLACEMENT_MISSES)
+    placed = placement_hits + placement_misses
     return {
         'workers_alive': int(registry.gauge_value(SERVICE_WORKERS_ALIVE)),
         'workers_registered': int(
@@ -382,6 +389,17 @@ def _service_section(registry):
             registry.counter_value(SERVICE_DUPLICATE_DONE)),
         'retried': int(registry.counter_value(SERVICE_RETRIES)),
         'poisoned': int(registry.counter_value(SERVICE_POISONED)),
+        # high availability + QoS (docs/service.md): how many times THIS
+        # process promoted a standby, how stale its mirror is, and what
+        # the scheduler did about priorities and warm caches
+        'failovers': int(registry.counter_value(SERVICE_FAILOVERS)),
+        'replication_lag_s': round(
+            registry.gauge_value(SERVICE_REPLICATION_LAG), 3),
+        'preemptions': int(registry.counter_value(SERVICE_PREEMPTIONS)),
+        'placement_hits': int(placement_hits),
+        'placement_misses': int(placement_misses),
+        'placement_hit_share': (round(placement_hits / placed, 4)
+                                if placed else None),
     }
 
 
@@ -588,6 +606,19 @@ def format_pipeline_report(report):
                         s['items_pending'], s['items_assigned'],
                         s['reventilated'], s['duplicate_done'],
                         s.get('retried', 0), s.get('poisoned', 0)))
+        ha_bits = []
+        if s.get('failovers'):
+            ha_bits.append('%d failover(s), replication lag %.3fs'
+                           % (s['failovers'],
+                              s.get('replication_lag_s') or 0.0))
+        if s.get('preemptions'):
+            ha_bits.append('%d preemption(s)' % s['preemptions'])
+        if s.get('placement_hit_share') is not None:
+            ha_bits.append('placement %d hit / %d miss (%.1f%%)'
+                           % (s['placement_hits'], s['placement_misses'],
+                              100 * s['placement_hit_share']))
+        if ha_bits:
+            lines.append('service HA/QoS: %s' % ', '.join(ha_bits))
     if 'pushdown' in report:
         p = report['pushdown']
         share = p['prune_share']

@@ -1,0 +1,497 @@
+"""High-availability chaos drills: warm-standby failover, per-job QoS
+(weights, priority preemption), and cache-aware placement
+(docs/service.md, "High availability" / "Per-job QoS" /
+"Cache-aware placement").
+
+Topology mirrors tests/test_daemon.py's SIGKILL drill: the PRIMARY
+daemon and its standing workers run as subprocesses (so a SIGKILL is a
+real control-plane death), while the standby under test runs in-process
+— its anomalies, trace instants, and fault injections land in THIS
+process's telemetry where the assertions can see them."""
+
+import collections
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from petastorm_tpu import faults, telemetry
+from petastorm_tpu.service.daemon import DaemonClientPool, ServiceDaemon
+from petastorm_tpu.service.protocol import free_tcp_port
+from petastorm_tpu.service.standby import StandbyDaemon
+from petastorm_tpu.workers import EmptyResultError
+from tests.stub_workers import IdentityWorker, SleepyIdentityWorker
+
+pytestmark = pytest.mark.service
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# tight-but-safe (the test_daemon.py convention): sub-second detection,
+# generous outer deadlines so shared-box scheduling noise cannot flake
+_HB = 0.15
+_SYNC = 0.2
+_LAPSE = 1.2
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry_and_faults():
+    telemetry.reset_for_tests()
+    yield
+    os.environ.pop('PETASTORM_TPU_FAULTS', None)
+    faults.refresh_faults()
+    assert faults.ARMED is None
+    telemetry.reset_for_tests()
+
+
+def _drain(pool, per_result_timeout_s=60):
+    out = []
+    while True:
+        try:
+            out.append(pool.get_results(timeout=per_result_timeout_s))
+        except EmptyResultError:
+            return out
+
+
+def _client(endpoint, **kwargs):
+    kwargs.setdefault('heartbeat_interval_s', _HB)
+    return DaemonClientPool(endpoint, **kwargs)
+
+
+def _await(predicate, deadline_s=30, interval_s=0.05, message='condition'):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(interval_s)
+    raise AssertionError('timed out waiting for %s' % message)
+
+
+def _subprocess_env():
+    return dict(os.environ,
+                PYTHONPATH=os.pathsep.join(
+                    [_REPO_ROOT, os.path.join(_REPO_ROOT, 'tests')]),
+                JAX_PLATFORMS='cpu')
+
+
+def _spawn_daemon_cli(endpoint, extra=()):
+    return subprocess.Popen(
+        [sys.executable, '-m', 'petastorm_tpu.service',
+         '--endpoint', endpoint, '--no-supervisor',
+         '--heartbeat-interval', str(_HB)] + list(extra),
+        env=_subprocess_env())
+
+
+def _spawn_cli_worker(endpoint):
+    return subprocess.Popen(
+        [sys.executable, '-m', 'petastorm_tpu.service.worker_server',
+         '--endpoint', endpoint,
+         '--heartbeat-interval', str(_HB),
+         '--ack-timeout', '1.5',
+         '--parent-pid', str(os.getpid())],
+        env=_subprocess_env())
+
+
+def _reap(procs):
+    for p in procs:
+        if p.poll() is None:
+            p.terminate()
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.wait()
+
+
+def _standby(endpoint, **kwargs):
+    """Start an in-process standby. Callers must only do this once the
+    primary is KNOWN to be bound (a registered client proves it, or
+    :func:`_await_primary_up`): a standby pointed at a not-yet-bound
+    endpoint lapses during the primary's startup and burns its whole
+    promotion window losing the bind race — correct behavior for the
+    daemon, a 30-second stall for a test."""
+    kwargs.setdefault('sync_interval_s', _SYNC)
+    kwargs.setdefault('lapse_s', _LAPSE)
+    kwargs.setdefault('heartbeat_interval_s', _HB)
+    kwargs.setdefault('supervise', False)
+    standby = StandbyDaemon(endpoint, **kwargs)
+    standby.start()
+    return standby
+
+
+def _await_primary_up(endpoint, deadline_s=30):
+    """Block until the daemon at ``endpoint`` answers a replication
+    probe (bound AND serving)."""
+    import zmq
+
+    from petastorm_tpu.service import protocol as proto
+    context = zmq.Context()
+    sock = context.socket(zmq.DEALER)
+    sock.setsockopt(zmq.LINGER, 0)
+    sock.connect(endpoint)
+    try:
+        deadline = time.monotonic() + deadline_s
+        while time.monotonic() < deadline:
+            sock.send_multipart([proto.MSG_STANDBY_SYNC])
+            if sock.poll(200):
+                return
+        raise AssertionError('primary at %s never answered' % endpoint)
+    finally:
+        sock.close(linger=0)
+        context.term()
+
+
+def _failover_events():
+    return [e for e in telemetry.recent_anomalies()
+            if e['kind'] == 'dispatcher_failover']
+
+
+# -- warm-standby promotion ---------------------------------------------------
+
+
+def test_warm_failover_sigkill_two_priority_jobs_exact():
+    """THE HA drill: SIGKILL the primary daemon mid-epoch with two
+    unequal-priority jobs registered. The warm standby (which has been
+    mirroring the registry) promotes onto the same endpoint within a
+    lapse window; both clients re-register against the new incarnation
+    and re-submit their unmarkered items; each job's delivered row
+    multiset is exact — the failover cost retries, never rows."""
+    endpoint = 'tcp://127.0.0.1:%d' % free_tcp_port()
+    primary = _spawn_daemon_cli(endpoint)
+    workers = [_spawn_cli_worker(endpoint) for _ in range(3)]
+    hi = _client(endpoint, name='job-hi', priority=2,
+                 ack_timeout_s=1.5, connect_timeout_s=60)
+    lo = _client(endpoint, name='job-lo',
+                 ack_timeout_s=1.5, connect_timeout_s=60)
+    standby = None
+    try:
+        hi.start(SleepyIdentityWorker)
+        lo.start(SleepyIdentityWorker)
+        # both registrations answered: the primary is up — safe to
+        # point a standby at it (see _standby)
+        standby = _standby(endpoint)
+        for i in range(30):
+            hi.ventilate(i, sleep_s=0.05)
+        for i in range(100, 130):
+            lo.ventilate(i, sleep_s=0.05)
+        got_hi = [hi.get_results(timeout=60) for _ in range(5)]
+        got_lo = [lo.get_results(timeout=60) for _ in range(5)]
+        # the standby must hold a WARM snapshot (both jobs) before the
+        # kill — otherwise this drill degrades to the cold-promote one
+        _await(lambda: standby.health()['snapshot_jobs'] == 2,
+               message='warm replication of both jobs')
+        t_kill = time.monotonic()
+        os.kill(primary.pid, signal.SIGKILL)
+        primary.wait()
+        assert standby.wait_promoted(30), 'standby must take over'
+        blackout_s = time.monotonic() - t_kill
+        got_hi.extend(_drain(hi))
+        got_lo.extend(_drain(lo))
+        assert sorted(got_hi) == list(range(30))
+        assert sorted(got_lo) == list(range(100, 130))
+        assert standby.role == 'primary'
+        # detection is one lapse window; the bind retry adds a little
+        assert blackout_s < _LAPSE + 8.0, \
+            'promotion took %.1fs — outside the lapse window' % blackout_s
+        events = _failover_events()
+        assert len(events) == 1, 'exactly one failover announcement'
+        assert events[0]['detail']['warm'] is True
+        assert events[0]['detail']['snapshot_jobs'] == 2
+        health = standby.health()
+        assert health['role'] == 'primary'
+        assert health['promotions'] == 1
+        # QoS params survived the failover through the snapshot
+        qos = {q['name']: q for q in health['qos']}
+        assert qos['job-hi']['priority'] == 2
+        assert qos['job-lo']['priority'] == 0
+        assert hi.diagnostics['reregistrations'] >= 1
+        assert lo.diagnostics['reregistrations'] >= 1
+        assert all(w.poll() is None for w in workers), \
+            'standing workers must survive the failover'
+    finally:
+        for pool in (hi, lo):
+            pool.stop()
+            pool.join()
+        if standby is not None:
+            standby.stop()
+        _reap([primary] + workers)
+
+
+def test_reader_completes_through_failover(tmp_path):
+    """The same drill through the reader stack: a
+    ``make_batch_reader`` job reading through the standing daemon
+    delivers the identical row multiset as a thread-pool read even when
+    the primary is SIGKILLed mid-read and the standby promotes."""
+    from petastorm_tpu.reader import make_batch_reader
+    from tests.test_common import create_test_scalar_dataset
+    url = 'file://' + str(tmp_path / 'dataset')
+    create_test_scalar_dataset(url, num_rows=50, num_files=5)
+
+    def read_ids(pool, kill=None):
+        ids = collections.Counter()
+        killed = False
+        with make_batch_reader(url, reader_pool_type=pool,
+                               num_epochs=1,
+                               shuffle_row_groups=False) as reader:
+            for batch in reader:
+                ids.update(int(x) for x in batch.id)
+                if kill is not None and not killed \
+                        and kill.poll() is None:
+                    killed = True
+                    os.kill(kill.pid, signal.SIGKILL)
+        return ids
+
+    expected = read_ids('thread')
+    assert sum(expected.values()) == 50
+    endpoint = 'tcp://127.0.0.1:%d' % free_tcp_port()
+    primary = _spawn_daemon_cli(endpoint)
+    workers = [_spawn_cli_worker(endpoint) for _ in range(2)]
+    _await_primary_up(endpoint)
+    standby = _standby(endpoint)
+    pool = _client(endpoint, name='reader-ha', ack_timeout_s=1.5,
+                   connect_timeout_s=60)
+    try:
+        assert read_ids(pool, kill=primary) == expected
+        assert standby.wait_promoted(30), \
+            'the kill mid-read must have promoted the standby'
+        assert standby.role == 'primary'
+    finally:
+        standby.stop()
+        _reap([primary] + workers)
+
+
+def test_standby_death_is_harmless():
+    """Losing the MIRROR must cost nothing: a job runs to exact
+    completion while the standby watching the primary is SIGKILLed
+    mid-replication, and the primary's health stays clean."""
+    daemon = ServiceDaemon('tcp://127.0.0.1:0', initial_workers=2,
+                           heartbeat_interval_s=_HB,
+                           supervisor_tick_s=_HB)
+    daemon.start()
+    standby_proc = _spawn_daemon_cli(
+        daemon.endpoint,
+        extra=['--standby', '--standby-sync-interval', str(_SYNC),
+               '--standby-lapse', '30'])
+    pool = _client(daemon.endpoint, name='survivor')
+    try:
+        pool.start(SleepyIdentityWorker)
+        for i in range(30):
+            pool.ventilate(i, sleep_s=0.02)
+        # replication is live (the primary answered sync pulls) ...
+        _await(lambda: daemon.dispatcher.health()[
+            'standby_syncs_served'] >= 2,
+            message='standby replication stream')
+        # ... and now the mirror dies hard
+        os.kill(standby_proc.pid, signal.SIGKILL)
+        standby_proc.wait()
+        assert sorted(_drain(pool)) == list(range(30))
+        health = daemon.health()
+        assert health['role'] == 'primary'
+        assert health['poisoned'] == []
+        assert not _failover_events()
+    finally:
+        pool.stop()
+        pool.join()
+        _reap([standby_proc])
+        daemon.stop()
+
+
+def test_replication_drop_cold_promote_still_exact():
+    """Chaos seam ``zmq.replicate:drop``: every replication snapshot
+    the standby pulls is dropped on receive, so it promotes COLD (no
+    registry snapshot). Correctness must not depend on the snapshot:
+    the client's job expires on the new incarnation, it re-registers
+    and re-submits, and the delivered multiset is still exact."""
+    endpoint = 'tcp://127.0.0.1:%d' % free_tcp_port()
+    primary = _spawn_daemon_cli(endpoint)
+    workers = [_spawn_cli_worker(endpoint) for _ in range(2)]
+    pool = _client(endpoint, name='cold-drill', ack_timeout_s=1.5,
+                   connect_timeout_s=60)
+    standby = None
+    try:
+        pool.start(SleepyIdentityWorker)
+        for i in range(30):
+            pool.ventilate(i, sleep_s=0.05)
+        got = [pool.get_results(timeout=60) for _ in range(5)]
+        # arm AFTER the subprocesses spawned: the drop must hit the
+        # in-process standby's receive side only
+        os.environ['PETASTORM_TPU_FAULTS'] = 'zmq.replicate:drop'
+        faults.refresh_faults()
+        standby = _standby(endpoint, lapse_s=1.0)
+        _await(lambda: faults.injection_stats().get(
+            'zmq.replicate', {}).get('fired', 0) >= 1,
+            message='replication frames to be dropped')
+        assert standby.health()['snapshot_jobs'] == 0
+        os.kill(primary.pid, signal.SIGKILL)
+        primary.wait()
+        assert standby.wait_promoted(45), 'cold standby must still promote'
+        got.extend(_drain(pool))
+        assert sorted(got) == list(range(30))
+        assert standby.role == 'primary'
+        events = _failover_events()
+        assert events, 'cold promotion still announces the failover'
+        assert events[-1]['detail']['warm'] is False
+        assert pool.diagnostics['reregistrations'] >= 1
+    finally:
+        pool.stop()
+        pool.join()
+        if standby is not None:
+            standby.stop()
+        _reap([primary] + workers)
+
+
+def test_promote_faultpoint_retries_until_success():
+    """Chaos seam ``service.promote``: the first promote attempts fail
+    (injected), the standby backs off and retries inside its promotion
+    window, and the takeover still lands."""
+    daemon = ServiceDaemon('tcp://127.0.0.1:0', initial_workers=1,
+                           heartbeat_interval_s=_HB,
+                           supervisor_tick_s=_HB)
+    daemon.start()
+    standby = _standby(daemon.endpoint, sync_interval_s=0.1, lapse_s=0.6)
+    try:
+        _await(lambda: standby.health()['syncs_ok'] >= 1,
+               message='replication before the takeover drill')
+        os.environ['PETASTORM_TPU_FAULTS'] = 'service.promote:error:1:times=2'
+        faults.refresh_faults()
+        daemon.stop()  # frees the endpoint; the standby lapses and takes it
+        assert standby.wait_promoted(30), \
+            'promotion must survive injected attempt failures'
+        stats = faults.injection_stats()
+        assert stats['service.promote']['fired'] == 2
+        health = standby.health()
+        assert health['role'] == 'primary'
+        assert health['promotions'] == 1
+    finally:
+        standby.stop()
+        daemon.stop()
+
+
+# -- per-job QoS --------------------------------------------------------------
+
+
+def test_priority_preemption_drains_never_strands():
+    """A higher-priority job with pending work and no workers preempts
+    a lower tier at row-group granularity: the victim worker finishes
+    its in-flight items before moving (nothing re-ventilated, nothing
+    quarantined, no retry budget charged) and both jobs deliver their
+    exact multisets."""
+    daemon = ServiceDaemon('tcp://127.0.0.1:0', initial_workers=2,
+                           heartbeat_interval_s=_HB,
+                           supervisor_tick_s=_HB)
+    daemon.start()
+    lo = _client(daemon.endpoint, name='batch-lo')
+    hi = _client(daemon.endpoint, name='online-hi', priority=3)
+    try:
+        lo.start(SleepyIdentityWorker)
+        for i in range(100):
+            lo.ventilate(i, sleep_s=0.05)
+        # the whole fleet belongs to the low job before the contender
+        _await(lambda: sum(j['workers'] for j in
+                           daemon.dispatcher.health()['jobs']) == 2,
+               message='fleet bound to the low-priority job')
+        hi.start(SleepyIdentityWorker)
+        for i in range(1000, 1010):
+            hi.ventilate(i, sleep_s=0.01)
+        _await(lambda: daemon.dispatcher.health()['preemptions'] >= 1,
+               message='a preemption decision')
+        assert sorted(_drain(hi)) == list(range(1000, 1010))
+        assert sorted(_drain(lo)) == list(range(100))
+        stats = daemon.dispatcher.stats()
+        assert stats['items_poisoned'] == 0, \
+            'preemption must never quarantine'
+        assert stats['items_retried'] == 0, \
+            'a drained preemption charges no retry budget'
+        assert hi.poisoned_items == [] and lo.poisoned_items == []
+        assert daemon.dispatcher.health()['preemptions'] >= 1
+    finally:
+        for pool in (hi, lo):
+            pool.stop()
+            pool.join()
+        daemon.stop()
+
+
+def test_weighted_fair_share_three_to_one():
+    """A weight-3 job targets (and gets) three times the workers of a
+    weight-1 co-tenant on a 4-worker fleet, and both still deliver
+    exactly."""
+    daemon = ServiceDaemon('tcp://127.0.0.1:0', initial_workers=4,
+                           heartbeat_interval_s=_HB,
+                           supervisor_tick_s=_HB)
+    daemon.start()
+    heavy = _client(daemon.endpoint, name='heavy', weight=3)
+    light = _client(daemon.endpoint, name='light')
+    try:
+        heavy.start(SleepyIdentityWorker)
+        light.start(SleepyIdentityWorker)
+        for i in range(200):
+            heavy.ventilate(i, sleep_s=0.03)
+        for i in range(1000, 1100):
+            light.ventilate(i, sleep_s=0.03)
+
+        def shares():
+            return {q['name']: q for q in
+                    daemon.dispatcher.health()['qos']}
+
+        _await(lambda: shares()['heavy']['worker_share'] == 0.75
+               and shares()['light']['worker_share'] == 0.25,
+               message='the 3:1 weighted split')
+        snap = shares()
+        assert snap['heavy']['target_share'] == 0.75
+        assert snap['light']['target_share'] == 0.25
+        assert sorted(_drain(heavy)) == list(range(200))
+        assert sorted(_drain(light)) == list(range(1000, 1100))
+    finally:
+        for pool in (heavy, light):
+            pool.stop()
+            pool.join()
+        daemon.stop()
+
+
+# -- cache-aware placement ----------------------------------------------------
+
+
+def test_placement_binds_second_job_to_warm_host():
+    """A second job with the identical decode fingerprint
+    (``placement_group``) lands on workers that already ran it, and the
+    dispatcher's telemetry counts the warm binding as a hit."""
+    daemon = ServiceDaemon('tcp://127.0.0.1:0', initial_workers=2,
+                           heartbeat_interval_s=_HB,
+                           supervisor_tick_s=_HB)
+    daemon.start()
+    pools = []
+    try:
+        first = _client(daemon.endpoint, name='cold-pass')
+        pools.append(first)
+        first.start(IdentityWorker,
+                    worker_args={'placement_group': 'grp-warm'})
+        for i in range(10):
+            first.ventilate(i)
+        assert sorted(_drain(first)) == list(range(10))
+        # the first binding of this fingerprint found no warm host
+        assert daemon.dispatcher.health()['placement_misses'] >= 1
+        pools.remove(first)
+        first.stop()
+        first.join()
+        _await(lambda: daemon.dispatcher.active_jobs() == 0,
+               message='first job reclaimed')
+        second = _client(daemon.endpoint, name='warm-pass')
+        pools.append(second)
+        second.start(IdentityWorker,
+                     worker_args={'placement_group': 'grp-warm'})
+        for i in range(100, 110):
+            second.ventilate(i)
+        assert sorted(_drain(second)) == list(range(100, 110))
+        health = daemon.dispatcher.health()
+        assert health['placement_enabled'] is True
+        assert health['placement_hits'] >= 1, \
+            'the identical fingerprint must bind warm'
+    finally:
+        for pool in pools:
+            pool.stop()
+            pool.join()
+        daemon.stop()

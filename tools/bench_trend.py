@@ -60,6 +60,9 @@ TRACKED = (
     # sharded staging (bench sharded_staging section)
     'sharded_staging_gb_per_sec',
     'sharded_staging_h2d_efficiency',
+    # standing-service HA (bench service section): warm-placement share
+    # (the blackout is lower-is-better and stays out of this gate)
+    'service_placement_hit_share',
     # the mesh scoreboard (MULTICHIP_r*.json dryrun rounds)
     'multichip_checks',
     'multichip_sharded_overlap_share',
