@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: test test-fast analyze lint trend chaos chaos-soak ci typecheck bench dryrun docker clean
+.PHONY: test test-fast analyze lint trend chaos chaos-soak mixture ci typecheck bench dryrun docker clean
 
 # full suite (~10 min: includes the compile-heavy model/attention tests)
 test:
@@ -50,10 +50,18 @@ chaos:
 chaos-soak:
 	$(PYTHON) -m pytest tests/test_chaos.py tests/test_daemon.py tests/test_failover.py -q
 
+# streaming mixture engine (docs/mixture.md): determinism/resume/reshard
+# oracles plus the weighted-sampling regressions. Fast subset is tier-1
+# (also inside test-fast); the named gate fails the determinism story
+# first, like chaos does for the failure domain.
+mixture:
+	$(PYTHON) -m pytest tests/test_mixture.py tests/test_weighted_sampling.py -q -m "not slow"
+
 # the CI gate sequence: static contracts, perf trend, the seeded chaos
 # drills (fast subset — also inside test-fast, but a named early gate
-# fails the failure-domain story first and fast), then tier-1 tests
-ci: analyze trend chaos test-fast
+# fails the failure-domain story first and fast), the mixture
+# determinism oracles, then tier-1 tests
+ci: analyze trend chaos mixture test-fast
 
 typecheck:
 	$(PYTHON) -m mypy petastorm_tpu

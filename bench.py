@@ -90,6 +90,13 @@ IO_OVERLAP_ROWS = 768 if SMOKE else 4096
 IO_OVERLAP_ROWGROUP_ROWS = 32
 IO_OVERLAP_READ_DELAY_S = 0.004 if SMOKE else 0.005
 
+# streaming mixture engine (mixture_stream section): two token corpora
+# mixed 3:1 and packed to fixed rows; sized so a full pass exercises the
+# readahead plane across many row-groups without dominating the budget
+MIXTURE_DOCS_A = 384 if SMOKE else 3072
+MIXTURE_DOCS_B = 128 if SMOKE else 1024
+MIXTURE_SEQ_LEN = 512
+
 # ONE owner of the staged-batch size shared by the real imagenet H2D
 # section and its dummy-source decomposition (the share math divides by
 # it — two hardcoded 64s would drift apart silently)
@@ -119,10 +126,11 @@ _START = time.monotonic()
 # asserted under _HEADLINE_MAX_CHARS. Ordered by importance: if the line
 # ever approaches the cap, the least important tail keys drop first.
 # raised 1500 → 1600 for the selective_read headline key, → 1700 for
-# the two sharded_staging keys, → 1800 for the two service HA keys
-# (worst case ~1740) — the driver tail is 2,000 chars and the emit
-# loop still drops tail keys at the cap
-_HEADLINE_MAX_CHARS = 1800
+# the two sharded_staging keys, → 1800 for the two service HA keys,
+# → 1900 for the two mixture_stream keys (worst case ~1845) — the
+# driver tail is 2,000 chars and the emit loop still drops tail keys
+# at the cap
+_HEADLINE_MAX_CHARS = 1900
 _HEADLINE_EXTRA_KEYS = (
     'vs_tfdata',
     'hello_world_warm_epoch_rows_per_sec',
@@ -135,6 +143,11 @@ _HEADLINE_EXTRA_KEYS = (
     # blocking oracle under injected storage latency (rates, hit share
     # and coalesced-size attribution stay in the full cumulative dict)
     'io_overlap_speedup',
+    # streaming mixture engine (bench mixture_stream section): packed
+    # throughput and fill ratio; deviation, hit share and the oracle
+    # rate stay in the full cumulative dict
+    'mixture_packed_tokens_per_sec',
+    'mixture_fill_ratio',
     # standing-service HA (bench service section): kill-to-first-row
     # blackout through a warm-standby promotion, and the share of
     # bindings that landed on a fingerprint-warm host
@@ -302,6 +315,30 @@ def _build_io_overlap(url):
     # any real store) while multi-file path handling still exercises
     write_dataset(url, schema, rows,
                   rowgroup_size_rows=IO_OVERLAP_ROWGROUP_ROWS, num_files=2)
+
+
+def _build_mixture_source(url, num_docs, seed):
+    """Plain-parquet token corpus (list<int64> ``tokens``) across many
+    row-groups — the mixture engine's input shape."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    path = url[len('file://'):]
+    os.makedirs(path, exist_ok=True)
+    rng = np.random.RandomState(seed)
+    per_file = (num_docs + 1) // 2
+    doc_id = 0
+    for file_idx in range(2):
+        n = min(per_file, num_docs - doc_id)
+        tokens = [rng.randint(2, 1000, size=int(rng.randint(20, 400)))
+                  .tolist() for _ in range(n)]
+        table = pa.table({'doc_id': np.arange(doc_id, doc_id + n),
+                          'tokens': tokens})
+        pq.write_table(table, os.path.join(path,
+                                           'part-%d.parquet' % file_idx),
+                       row_group_size=64)
+        doc_id += n
 
 
 class _SlowFile:
@@ -1662,6 +1699,8 @@ def main():
     c4_url = 'file://' + tmp + '/c4_like'
     selective_url = 'file://' + tmp + '/selective'
     io_overlap_url = 'file://' + tmp + '/io_overlap'
+    mix_a_url = 'file://' + tmp + '/mixture_web'
+    mix_b_url = 'file://' + tmp + '/mixture_code'
     extra = {}
     state = {
         'metric': 'hello_world_read_rate',
@@ -1958,6 +1997,97 @@ def main():
         extra['io_overlap_mean_coalesced_kb'] = round(
             delta[readahead.READAHEAD_BYTES] / reads / 1024, 2) if reads \
             else 0.0
+
+    def sec_mixture_stream():
+        """Streaming mixture engine (ISSUE 17): two token corpora mixed
+        3:1 by the arithmetic interleave and packed to fixed
+        MIXTURE_SEQ_LEN rows — packed-token throughput, fill ratio, the
+        interleave's realized-ratio deviation against an RNG-draw
+        baseline, and the readahead hit share on the mixture path, with
+        the PETASTORM_TPU_READAHEAD=0 pass as the exact-parity oracle
+        (identical packed rows, bit for bit)."""
+        from petastorm_tpu import readahead, telemetry
+        from petastorm_tpu.mixture import (InterleaveSchedule,
+                                           MixtureSource, MixtureSpec,
+                                           MixtureStream,
+                                           realized_deviation)
+        from petastorm_tpu.telemetry import get_registry
+
+        _build_mixture_source(mix_a_url, MIXTURE_DOCS_A, seed=1)
+        _build_mixture_source(mix_b_url, MIXTURE_DOCS_B, seed=2)
+        weights = [3, 1]
+
+        def spec():
+            return MixtureSpec(
+                [MixtureSource('web', weights[0], url=mix_a_url),
+                 MixtureSource('code', weights[1], url=mix_b_url)],
+                seed=0, seq_len=MIXTURE_SEQ_LEN)
+
+        def one_pass(oracle):
+            env = {'PETASTORM_TPU_READAHEAD': '0' if oracle else '1',
+                   'PETASTORM_TPU_READAHEAD_DEPTH': '8',
+                   'PETASTORM_TPU_READAHEAD_THREADS': '4'}
+            saved = {k: os.environ.get(k) for k in env}
+            os.environ.update(env)
+            telemetry.refresh()
+            try:
+                stream = MixtureStream(spec(), reader_pool_type='thread',
+                                       workers_count=2)
+                try:
+                    start = time.monotonic()
+                    rows = list(stream)
+                    elapsed = time.monotonic() - start
+                    return elapsed, rows, stream.pack_stats
+                finally:
+                    stream.stop()
+                    stream.join()
+            finally:
+                for k, v in saved.items():
+                    if v is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = v
+                telemetry.refresh()
+
+        registry = get_registry()
+        before = {name: registry.counter_value(name) for name in
+                  (readahead.READAHEAD_HITS, readahead.READAHEAD_MISSES)}
+        ahead_s, ahead_rows, stats = one_pass(oracle=False)
+        delta = {name: registry.counter_value(name) - before[name]
+                 for name in before}
+        oracle_s, oracle_rows, _ = one_pass(oracle=True)
+        assert len(ahead_rows) == len(oracle_rows) and all(
+            np.array_equal(a[k], b[k])
+            for a, b in zip(ahead_rows, oracle_rows)
+            for k in ('tokens', 'loss_mask', 'segment_ids')), \
+            'mixture_stream readahead parity broke'
+        extra['mixture_parity'] = True
+        extra['mixture_packed_tokens_per_sec'] = round(
+            stats['tokens'] / ahead_s, 1)
+        extra['mixture_oracle_packed_tokens_per_sec'] = round(
+            stats['tokens'] / oracle_s, 1)
+        extra['mixture_fill_ratio'] = round(stats['fill_ratio'], 4)
+        extra['mixture_rows'] = stats['rows']
+        extra['mixture_split_doc_share'] = round(
+            stats['split_docs'] / max(1, stats['docs']), 4)
+        served = (delta[readahead.READAHEAD_HITS]
+                  + delta[readahead.READAHEAD_MISSES])
+        if served:
+            extra['mixture_readahead_hit_share'] = round(
+                delta[readahead.READAHEAD_HITS] / served, 4)
+        # interleave-vs-RNG divergence: worst realized-ratio deviation
+        # over 2k positions — the arithmetic schedule holds a hard O(1)
+        # bound where RNG draws wander O(sqrt(n))
+        k = 2000
+        order = InterleaveSchedule.order(weights, seed=0, start=0, k=k)
+        extra['mixture_interleave_deviation'] = round(
+            realized_deviation(order, weights), 3)
+        rng = np.random.RandomState(0)
+        share = weights[0] / float(sum(weights))
+        rng_order = [0 if draw < share else 1
+                     for draw in rng.random_sample(k)]
+        extra['mixture_rng_deviation'] = round(
+            realized_deviation(rng_order, weights), 3)
 
     def sec_service():
         # Standing-service HA record (docs/service.md, "High
@@ -2365,6 +2495,7 @@ def main():
         section('decoded_cache', 10, sec_decoded_cache)
         section('selective_read', 15, sec_selective_read)
         section('io_overlap', 10, sec_io_overlap)
+        section('mixture_stream', 15, sec_mixture_stream)
         section('service', 20, sec_service)
         section('lm_tokens', 10, sec_lm_tokens)
         section('imagenet', 20, sec_imagenet)

@@ -39,12 +39,14 @@ telemetry back).
 #: row-group's column-chunk ranges on the readahead plane's fetch
 #: threads (petastorm_tpu/readahead.py; wall time overlapped with
 #: decode — a high share here with low ``io`` share is the plane
-#: working)
+#: working) · ``pack`` token-budget sequence packing: variable-length
+#: documents folded into fixed ``(seq_len,)`` rows with loss masks and
+#: segment ids (petastorm_tpu/mixture/packing.py)
 STAGES = ('ventilate', 'io', 'decode', 'filter', 'transform', 'queue_wait',
           'collate', 'h2d', 'h2d_ready', 'stage_fill', 'h2d_dispatch',
           'cache_hit_read', 'cache_fill', 'decode_fused',
           'rowgroup_prune', 'late_materialize', 'autotune',
-          'readahead_fetch')
+          'readahead_fetch', 'pack')
 
 #: every trace-event name the package records outside the canonical stage
 #: spans (docs/telemetry.md, tracing section)
@@ -166,6 +168,13 @@ METRIC_NAMES = frozenset([
     'petastorm_tpu_readahead_coalesced_reads_total',
     'petastorm_tpu_readahead_degraded_total',
     'petastorm_tpu_readahead_pool_bytes',
+    # streaming mixture engine: deterministic mixing + sequence packing
+    # (mixture/engine.py, mixture/packing.py)
+    'petastorm_tpu_mixture_docs_total',
+    'petastorm_tpu_pack_rows_total',
+    'petastorm_tpu_pack_tokens_total',
+    'petastorm_tpu_pack_padding_tokens_total',
+    'petastorm_tpu_pack_split_docs_total',
 ])
 
 #: prefix of every operator-facing environment knob
@@ -234,6 +243,8 @@ KNOWN_KNOBS = frozenset([
     'PETASTORM_TPU_READAHEAD_POOL_MB',
     'PETASTORM_TPU_READAHEAD_GAP_KB',
     'PETASTORM_TPU_READAHEAD_MAX_RANGE_MB',
+    'PETASTORM_TPU_MIXTURE_OPEN_BINS',
+    'PETASTORM_TPU_MIXTURE_RESEQ_MAX',
 ])
 
 #: canonical anomaly event kinds the live observability plane's detector

@@ -277,13 +277,23 @@ class TrainCheckpointer:
             # checkpoints (no items_global) keep the documented
             # starts-fresh fallback.
             try:
-                merged = merge_loader_states(payload.values())
+                values = list(payload.values())
+                if all(isinstance(s, dict) and 'mixture' in s
+                       for s in values):
+                    # Mixture checkpoints re-shard at interleave-position
+                    # granularity, not row-group granularity: the packed
+                    # row ordinal is the unit (docs/mixture.md).
+                    from petastorm_tpu.mixture import merge_mixture_states
+                    merged = merge_mixture_states(values)
+                    position = 'ordinal %s' % merged.get('resume_ordinal')
+                else:
+                    merged = merge_loader_states(values)
+                    position = 'epoch %s' % merged['epoch']
                 loader.load_state_dict(merged)
                 logger.info(
                     'checkpoint step %s: loader state merged from %d '
-                    'processes onto %d (elastic resume, epoch %s)',
-                    step, len(payload), jax.process_count(),
-                    merged['epoch'])
+                    'processes onto %d (elastic resume, %s)',
+                    step, len(payload), jax.process_count(), position)
                 return step
             except ValueError as e:
                 logger.warning('checkpoint step %s: cannot merge resized '

@@ -57,7 +57,7 @@ def make_jax_loader(dataset_url_or_urls, batch_size, mesh=None, data_axes=None,
                     dtypes=None, prefetch=2, num_epochs=1,
                     inmemory_cache_all=False, pad_ragged=None,
                     bucket_boundaries=None,
-                    reader_factory=None, **reader_kwargs):
+                    reader_factory=None, mixture=None, **reader_kwargs):
     """Create a :class:`JaxLoader` over a Parquet dataset.
 
     :param batch_size: rows per emitted batch **per host**. With a mesh, must
@@ -113,6 +113,15 @@ def make_jax_loader(dataset_url_or_urls, batch_size, mesh=None, data_axes=None,
         the actual length distribution over a uniform grid.
     :param reader_factory: reader constructor (defaults to
         :func:`petastorm_tpu.reader.make_batch_reader`).
+    :param mixture: a :class:`petastorm_tpu.mixture.MixtureSpec` — feed
+        the loader a deterministic weighted multi-dataset mixture of
+        packed token rows (``tokens`` / ``loss_mask`` / ``segment_ids``
+        columns; the spec needs ``seq_len``) instead of one dataset
+        (``dataset_url_or_urls`` must then be None; the sources carry
+        their own URLs). ``reader_kwargs`` flow to every source's
+        reader; with ``reader_pool_type='service'`` and a standing
+        daemon configured, each source registers as its own
+        QoS-weighted job on the shared fleet (docs/mixture.md).
     :param reader_kwargs: forwarded to the reader factory (predicates,
         sharding overrides, pool type, ...).
 
@@ -132,6 +141,37 @@ def make_jax_loader(dataset_url_or_urls, batch_size, mesh=None, data_axes=None,
     """
     from petastorm_tpu.reader import make_batch_reader
     factory = reader_factory or make_batch_reader
+    if mixture is not None:
+        if dataset_url_or_urls is not None:
+            raise ValueError('mixture= and dataset_url_or_urls are mutually '
+                             'exclusive: the MixtureSpec sources carry their '
+                             'own URLs')
+        if reader_factory is not None:
+            raise ValueError('mixture= builds its own source readers; give '
+                             'per-source factories on the MixtureSource '
+                             'entries instead of reader_factory=')
+        if fields is not None:
+            raise ValueError('mixture= emits fixed packed columns (tokens/'
+                             'loss_mask/segment_ids); fields= does not apply')
+        if inmemory_cache_all:
+            raise ValueError('mixture= does not support inmemory_cache_all')
+        from petastorm_tpu.mixture import MixtureBatchReader, MixtureStream
+        stream = MixtureStream(mixture, num_epochs=num_epochs,
+                               **reader_kwargs)
+        reader = MixtureBatchReader(stream, rows_per_pull=batch_size)
+        try:
+            return JaxLoader(reader, batch_size, mesh=mesh,
+                             data_axes=data_axes, shuffle_rows=shuffle_rows,
+                             shuffling_queue_capacity=shuffling_queue_capacity,
+                             min_after_retrieve=min_after_retrieve,
+                             extra_capacity=extra_capacity, seed=seed,
+                             last_batch=last_batch, dtypes=dtypes,
+                             prefetch=prefetch, pad_ragged=pad_ragged,
+                             bucket_boundaries=bucket_boundaries)
+        except Exception:
+            reader.stop()
+            reader.join()
+            raise
     if inmemory_cache_all and num_epochs not in (1, None):
         raise ValueError(
             'inmemory_cache_all caches exactly one epoch and replays it; '

@@ -1,0 +1,26 @@
+"""Streaming mixture engine: deterministic multi-dataset mixing +
+token-budget sequence packing (docs/mixture.md).
+
+Public surface::
+
+    from petastorm_tpu.mixture import (
+        MixtureSpec, MixtureSource,      # declarative mixture description
+        MixtureStream,                   # the packed-row iterator
+        MixtureBatchReader,              # Reader-shaped adapter (JaxLoader)
+        InterleaveSchedule,              # arithmetic source order
+        SequencePacker,                  # doc -> fixed-row packing
+        merge_mixture_states,            # N -> M elastic resume
+    )
+"""
+
+from petastorm_tpu.mixture.adapter import MixtureBatchReader  # noqa: F401
+from petastorm_tpu.mixture.engine import (  # noqa: F401
+    MixtureStream, build_source_readers, merge_mixture_states,
+)
+from petastorm_tpu.mixture.interleave import (  # noqa: F401
+    InterleaveSchedule, realized_deviation,
+)
+from petastorm_tpu.mixture.packing import SequencePacker  # noqa: F401
+from petastorm_tpu.mixture.spec import (  # noqa: F401
+    MixtureSource, MixtureSpec,
+)

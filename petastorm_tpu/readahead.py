@@ -52,6 +52,7 @@ source in ``analysis/contracts.py``.
 """
 
 import logging
+import math
 import os
 import threading
 import weakref
@@ -227,7 +228,7 @@ def _reset_for_tests():
 
 
 def build_plan(items, pieces, randomize, seed, iterations, exclude,
-               workers=None):
+               workers=None, interleave=None):
     """The Reader's half: a picklable description of the ventilator's
     upcoming-item sequence. ``items`` are the ventilator work items
     (each carrying ``piece_index``), ``pieces`` the row-group list,
@@ -235,7 +236,23 @@ def build_plan(items, pieces, randomize, seed, iterations, exclude,
     ``exclude`` the statistics-pruned item indices (skipped every epoch,
     so they must never fetch), and ``workers`` the pool's worker count —
     it bounds how many items can sit between observe and serve at once,
-    which sizes the retire slack (see ``_retire_passed_locked``)."""
+    which sizes the retire slack (see ``_retire_passed_locked``).
+
+    ``interleave`` (optional) marks this reader as ONE SOURCE of a
+    deterministic weighted mixture (:mod:`petastorm_tpu.mixture`): a
+    dict carrying the source's exact normalized ``share`` of the
+    interleave. The source's ventilation order IS its mixture-local
+    upcoming order (the mixture consumes each source strictly in
+    ventilation order), so the mirror arithmetic is unchanged — the
+    share only scales the prefetch depth, keeping the fleet-wide
+    readahead budget split in mixing proportion instead of every
+    source greedily prefetching as if it owned the whole consumer."""
+    if interleave is not None:
+        share = float(interleave.get('share', 1.0))
+        if not 0.0 < share <= 1.0:
+            raise ValueError('interleave share must be in (0, 1], got %r'
+                             % (share,))
+        interleave = dict(interleave, share=share)
     return {
         'version': 1,
         # one (path, row_group) per item index; repeated piece paths
@@ -247,6 +264,7 @@ def build_plan(items, pieces, randomize, seed, iterations, exclude,
         'iterations': iterations,
         'exclude': sorted(exclude or ()),
         'workers': workers,
+        'interleave': interleave,
     }
 
 
@@ -547,6 +565,9 @@ class ReadaheadManager:
         # efficiency bound: a too-small slack costs misses, never rows.
         self._workers = plan.get('workers') or 1
         self._retire_slack = max(4, 2 * self._workers)
+        # mixture source share (build_plan interleave=): scales this
+        # source's prefetch depth to its exact mixing proportion
+        self._mix_share = (plan.get('interleave') or {}).get('share')
         self._lock = threading.Lock()
         self._footer_lock = threading.Lock()
         self._columns = None
@@ -624,7 +645,12 @@ class ReadaheadManager:
             # least span the worker stride to ever reach this process's
             # own next item (thread pools observe every position and are
             # unaffected when depth >= workers, the defaults)
-            depth = max(current_depth(), self._workers)
+            depth = current_depth()
+            if self._mix_share:
+                # one mixture source: its fair slice of the window,
+                # rounded up (floor 1 keeps every source prefetching)
+                depth = max(1, int(math.ceil(depth * self._mix_share)))
+            depth = max(depth, self._workers)
             for offset in range(1, depth + 1):
                 upcoming = self._at_locked(sweep, epoch, gpos + offset)
                 if upcoming is None:

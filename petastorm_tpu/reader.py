@@ -119,7 +119,8 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None,
                       cache_location=None, cache_size_limit=None,
                       cache_row_size_estimate=None, transform_spec=None,
                       filters=None, storage_options=None, filesystem=None,
-                      defer_image_decode=False, poison_policy=None):
+                      defer_image_decode=False, poison_policy=None,
+                      mixture_interleave=None):
     """Reader yielding whole row-groups as namedtuples of column arrays.
 
     Works on any Parquet store, petastorm metadata or not
@@ -134,6 +135,11 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None,
         that decodes them straight into its destination buffers. Plain
         batch consumers should leave this off — namedtuple batches would
         carry encoded stubs.
+    :param mixture_interleave: set by the mixture engine
+        (:mod:`petastorm_tpu.mixture`) when this reader serves one source
+        of a weighted mixture: a dict with the source's exact interleave
+        ``share``, annotated into the readahead plan so per-worker
+        prefetch depth follows the mixing ratio.
     """
     info = ParquetDatasetInfo(dataset_url_or_urls, storage_options,
                               filesystem=filesystem)
@@ -151,7 +157,8 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None,
                   transform_spec=transform_spec, ngram=None, filters=filters,
                   batched_output=True,
                   defer_image_decode=defer_image_decode,
-                  poison_policy=poison_policy)
+                  poison_policy=poison_policy,
+                  mixture_interleave=mixture_interleave)
 
 
 def _make_cache(cache_type, location, size_limit, row_size_estimate,
@@ -313,7 +320,8 @@ class Reader:
                  rowgroup_selector=None, num_epochs=1, cur_shard=None,
                  shard_count=None, seed=0, cache=None, transform_spec=None,
                  ngram=None, filters=None, batched_output=True,
-                 defer_image_decode=False, poison_policy=None):
+                 defer_image_decode=False, poison_policy=None,
+                 mixture_interleave=None):
         self.dataset_info = dataset_info
         self.batched_output = batched_output and ngram is None
         self.ngram = ngram
@@ -459,6 +467,8 @@ class Reader:
         # — and so the staging autotuner can raise the in-flight extra
         # live (set_ventilate_extra).
         self._ventilate_extra = _VENTILATE_EXTRA_ROWGROUPS
+        self._shuffle_row_groups = shuffle_row_groups
+        self._resume_excluded = {}
         self._ventilator = ConcurrentVentilator(
             self._pool.ventilate, items, iterations=num_epochs,
             max_ventilation_queue_size=lambda: (
@@ -481,7 +491,8 @@ class Reader:
                 items, all_pieces, randomize=shuffle_row_groups,
                 seed=self._ventilator.state_dict()['seed'],
                 iterations=num_epochs, exclude=self._pruned_items,
-                workers=self._pool.workers_count)
+                workers=self._pool.workers_count,
+                interleave=mixture_interleave)
         elif readahead.readahead_enabled():
             readahead.count_degrade('cache')
 
@@ -727,6 +738,27 @@ class Reader:
         # The new sweep restarts epoch numbering from 0; stale consumption
         # records would otherwise corrupt state_dict()'s resume math.
         self._consumed_by_epoch = {}
+        self._resume_excluded = {}
+
+    def ventilation_order(self, epoch):
+        """Item indices the ventilator will emit for ``epoch``, in order.
+
+        The public face of the ventilator's arithmetic order (shared
+        with the readahead mirror): the per-epoch permutation from
+        :func:`petastorm_tpu.workers.ventilator.epoch_order` under the
+        ventilator's LIVE seed, minus the statistics-pruned items and —
+        for a restored reader's resume epoch — the items excluded as
+        already consumed. Downstream resequencers (the mixture engine's
+        ordered sources) use this to turn the pool's completion-order
+        deliveries back into a deterministic stream.
+        """
+        from petastorm_tpu.workers.ventilator import epoch_order
+        order = epoch_order(self._num_items,
+                            self._ventilator.state_dict()['seed'],
+                            epoch, self._shuffle_row_groups)
+        skip = set(self._pruned_items)
+        skip.update(self._resume_excluded.get(epoch, ()))
+        return [int(i) for i in order if i not in skip]
 
     def _obs_health(self):
         """This reader's /health contribution: iteration state + the
@@ -945,6 +977,10 @@ class Reader:
             'iterations_remaining': state['iterations_remaining'],
         })
         self._ventilator.exclude_from_next_epoch(state['consumed_items'])
+        # ventilation_order must mirror the exclusion: the resume epoch's
+        # already-consumed items never ventilate again
+        self._resume_excluded = {
+            int(state['epoch']): frozenset(state['consumed_items'])}
         # Seed the consumption record to match the restored position: a
         # LATER checkpoint must see epochs before the resume epoch as
         # complete and the resume epoch's pre-restore items as consumed —

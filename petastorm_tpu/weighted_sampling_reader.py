@@ -4,6 +4,14 @@ Parity with ``petastorm/weighted_sampling_reader.py:20-115``: each ``next()``
 draws one underlying reader with the given probability and returns its next
 item. Readers must agree on output schema/mode; exhaustion of ANY reader ends
 the mix (so relative mixing ratios hold throughout).
+
+``deterministic=True`` swaps the RNG draw for the mixture engine's
+arithmetic interleave (:class:`petastorm_tpu.mixture.InterleaveSchedule`):
+the same surface and the same weights, but the source at position ``p``
+becomes a pure function of ``(seed, weights, p)`` — replayable by any
+rank and by the readahead plan, with a hard realized-ratio deviation
+bound instead of an in-expectation one. Callers who need the full
+packed-row mixture should use :mod:`petastorm_tpu.mixture` directly.
 """
 
 import numpy as np
@@ -15,9 +23,13 @@ class WeightedSamplingReader:
     :param probabilities: relative weights, one per reader (normalized
         internally).
     :param seed: RNG seed for reproducible mixing.
+    :param deterministic: mix by the arithmetic interleave schedule
+        instead of RNG draws (``seed`` then defaults to 0 — there is no
+        nondeterministic flavor of an arithmetic schedule).
     """
 
-    def __init__(self, readers, probabilities, seed=None):
+    def __init__(self, readers, probabilities, seed=None,
+                 deterministic=False):
         if len(readers) != len(probabilities):
             raise ValueError('readers and probabilities must have equal '
                              'lengths (%d != %d)'
@@ -45,7 +57,12 @@ class WeightedSamplingReader:
         self._cum /= self._cum[-1]
         self._seed = seed
         self._rng = np.random.RandomState(seed)
-        self._draws = 0  # mux RNG cursor (for checkpoint/resume)
+        self._draws = 0  # mux cursor: DELIVERED draws only
+        self._schedule = None
+        if deterministic:
+            from petastorm_tpu.mixture import InterleaveSchedule
+            self._schedule = InterleaveSchedule(
+                list(probabilities), seed=0 if seed is None else seed)
 
     # The mix exposes the shared reader surface.
     @property
@@ -70,10 +87,29 @@ class WeightedSamplingReader:
         return self
 
     def __next__(self):
+        # _draws must count only DELIVERED draws: charging before the
+        # source's next() means a StopIteration (any source drying ends
+        # the mix) leaves an undelivered draw counted, and a checkpoint
+        # taken at mix end replays a choice sequence shifted by one on
+        # restore. The generator state rewinds on the failure path so
+        # BOTH restore flavors (rng_state and legacy seed+draws replay)
+        # reflect delivered draws only.
+        if self._schedule is not None:
+            choice = self._schedule.peek(1)[0]
+            item = next(self._readers[choice])
+            self._schedule.next()
+            self._draws += 1
+            return item
+        pre = self._rng.get_state()
         choice = int(np.searchsorted(self._cum, self._rng.random_sample(),
                                      side='right'))
+        try:
+            item = next(self._readers[min(choice, len(self._readers) - 1)])
+        except StopIteration:
+            self._rng.set_state(pre)
+            raise
         self._draws += 1
-        return next(self._readers[min(choice, len(self._readers) - 1)])
+        return item
 
     def next(self):
         return self.__next__()
@@ -92,10 +128,13 @@ class WeightedSamplingReader:
         # O(1); 'draws' stays as a diagnostic and as the replay cursor
         # for checkpoints written before rng_state existed
         kind, keys, pos, has_gauss, cached = self._rng.get_state()
-        return {'version': 1, 'seed': self._seed, 'draws': self._draws,
-                'rng_state': [kind, [int(k) for k in keys], int(pos),
-                              int(has_gauss), float(cached)],
-                'readers': [r.state_dict() for r in self._readers]}
+        state = {'version': 1, 'seed': self._seed, 'draws': self._draws,
+                 'rng_state': [kind, [int(k) for k in keys], int(pos),
+                               int(has_gauss), float(cached)],
+                 'readers': [r.state_dict() for r in self._readers]}
+        if self._schedule is not None:
+            state['interleave'] = self._schedule.state_dict()
+        return state
 
     def load_state_dict(self, state):
         """Reposition every source and the mux cursor (call before
@@ -111,6 +150,18 @@ class WeightedSamplingReader:
         # actually on, or a second-generation restore would replay a
         # different choice sequence than the real run took.
         self._seed = state.get('seed', self._seed)
+        if self._schedule is not None:
+            if 'interleave' in state:
+                self._schedule.load_state_dict(state['interleave'])
+            else:
+                # RNG-mode checkpoint into a deterministic mix: the
+                # arithmetic order is a pure function of position, so
+                # the delivered-draw count IS the full cursor
+                self._schedule.reset()
+                for _ in range(int(state['draws'])):
+                    self._schedule.next()
+            self._draws = state['draws']
+            return
         self._rng = np.random.RandomState(self._seed)
         if 'rng_state' in state:
             # O(1) restore: adopt the saved Mersenne-Twister state

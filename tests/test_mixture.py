@@ -1,0 +1,484 @@
+"""Streaming mixture engine: deterministic multi-dataset interleave +
+token-budget sequence packing (``petastorm_tpu/mixture/``).
+
+Covers the four subsystem layers plus the acceptance oracles:
+
+* arithmetic interleave — source at position ``p`` is a pure function of
+  ``(seed, weights, p)``, with a hard realized-ratio deviation bound;
+* ``SequencePacker`` — token conservation, loss masks, segment ids,
+  bounded open-bin set, split-tail carry, JSON checkpoint state;
+* elastic checkpoint/resume — mid-stream resume parity across pool
+  flavors, plus the N→M reshard oracle: N shard states merged and
+  restored onto M consumers reproduce the uninterrupted global packed
+  stream bit-identically;
+* plane integration — identical streams with the readahead plane on and
+  off (``PETASTORM_TPU_READAHEAD=0`` is the exact-parity oracle), and
+  through the daemonized decode service.
+"""
+
+import json
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from petastorm_tpu import telemetry as T
+from petastorm_tpu.mixture import (InterleaveSchedule, MixtureBatchReader,
+                                   MixtureSource, MixtureSpec, MixtureStream,
+                                   SequencePacker, build_source_readers,
+                                   merge_mixture_states, realized_deviation)
+
+ROW_COLS = ('tokens', 'loss_mask', 'segment_ids')
+
+
+@pytest.fixture(scope='session')
+def mix_datasets(tmp_path_factory):
+    """Three plain-parquet token corpora of different sizes/lengths."""
+    root = tmp_path_factory.mktemp('mixture')
+    urls = {}
+    for name, num_files, seed in [('a', 3, 1), ('b', 2, 2), ('c', 2, 3)]:
+        d = root / name
+        d.mkdir()
+        rng = np.random.RandomState(seed)
+        row = 0
+        for f in range(num_files):
+            tokens = [rng.randint(1, 1000, size=rng.randint(1, 50)).tolist()
+                      for _ in range(10)]
+            table = pa.table({'row_id': np.arange(row, row + 10),
+                              'tokens': tokens})
+            pq.write_table(table, str(d / ('part-%d.parquet' % f)),
+                           row_group_size=5)
+            row += 10
+        urls[name] = 'file://' + str(d)
+    return urls
+
+
+def _spec(urls, sources=('a', 'b'), weights=(3, 1), seed=11, seq_len=64,
+          **kw):
+    return MixtureSpec([MixtureSource(n, w, url=urls[n])
+                        for n, w in zip(sources, weights)],
+                       seed=seed, seq_len=seq_len, **kw)
+
+
+def _drain(stream):
+    try:
+        return list(stream)
+    finally:
+        stream.stop()
+        stream.join()
+
+
+def _rows_equal(a, b):
+    return all(np.array_equal(a[k], b[k]) for k in ROW_COLS)
+
+
+def _streams_equal(xs, ys):
+    return len(xs) == len(ys) and all(_rows_equal(a, b)
+                                      for a, b in zip(xs, ys))
+
+
+# -- layer 1: arithmetic interleave ------------------------------------------
+
+
+class TestInterleave:
+    def test_position_is_pure_function(self):
+        sched = InterleaveSchedule([3, 1, 1], seed=7)
+        live = [sched.next() for _ in range(100)]
+        assert live == InterleaveSchedule.order([3, 1, 1], seed=7, start=0,
+                                                k=100)
+        fresh = InterleaveSchedule([3, 1, 1], seed=7)
+        assert [fresh.source_at(p) for p in (0, 5, 42, 99)] == \
+            [live[p] for p in (0, 5, 42, 99)]
+
+    def test_peek_does_not_advance(self):
+        sched = InterleaveSchedule([2, 1], seed=0)
+        ahead = sched.peek(5)
+        assert [sched.next() for _ in range(5)] == ahead
+
+    def test_windowed_order_matches_full_order(self):
+        full = InterleaveSchedule.order([5, 2, 3], seed=3, start=0, k=60)
+        assert InterleaveSchedule.order([5, 2, 3], seed=3, start=20,
+                                        k=25) == full[20:45]
+
+    @pytest.mark.parametrize('weights', [[3, 1], [1, 1, 1], [5, 2, 3],
+                                         [0.7, 0.2, 0.1]])
+    def test_realized_ratio_deviation_bound(self, weights):
+        order = InterleaveSchedule.order(weights, seed=13, start=0, k=400)
+        # the smooth round-robin guarantee: per-source realized counts
+        # never stray more than one credit from the exact share
+        assert realized_deviation(order, weights) <= 1.0 + 1e-9
+
+    def test_seed_permutes_schedule(self):
+        a = InterleaveSchedule.order([2, 1, 1], seed=0, start=0, k=50)
+        others = [InterleaveSchedule.order([2, 1, 1], seed=s, start=0, k=50)
+                  for s in range(1, 8)]
+        assert any(o != a for o in others)
+        # whatever the seed permutes, the smoothness bound still holds
+        assert all(realized_deviation(o, [2, 1, 1]) <= 1.0 + 1e-9
+                   for o in others)
+
+    def test_state_json_roundtrip_continues_exactly(self):
+        sched = InterleaveSchedule([3, 1, 2], seed=5)
+        for _ in range(17):
+            sched.next()
+        state = json.loads(json.dumps(sched.state_dict()))
+        restored = InterleaveSchedule.from_state([3, 1, 2], 5, state)
+        assert [sched.next() for _ in range(40)] == \
+            [restored.next() for _ in range(40)]
+
+    def test_bad_weights_rejected(self):
+        with pytest.raises(ValueError):
+            InterleaveSchedule([])
+        with pytest.raises(ValueError):
+            InterleaveSchedule([1, -1])
+        with pytest.raises(ValueError):
+            InterleaveSchedule([0, 0])
+
+
+# -- layer 2: token-budget packer --------------------------------------------
+
+
+def _docs(n, seed=0, lo=1, hi=50):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(1, 1000, size=rng.randint(lo, hi)).tolist()
+            for _ in range(n)]
+
+
+class TestSequencePacker:
+    def test_rows_are_fixed_shape_with_masks_and_segments(self):
+        packer = SequencePacker(seq_len=32)
+        rows = []
+        for doc in _docs(20, seed=1, hi=20):
+            rows.extend(packer.feed(doc))
+        rows.extend(packer.flush())
+        for row in rows:
+            assert row['tokens'].shape == (32,)
+            assert row['loss_mask'].shape == (32,)
+            assert row['segment_ids'].shape == (32,)
+            # padding carries mask 0 / segment 0, real tokens mask 1
+            pad = row['loss_mask'] == 0
+            assert np.all(row['segment_ids'][pad] == 0)
+            assert np.all(row['tokens'][pad] == 0)
+            assert np.all(row['segment_ids'][~pad] >= 1)
+            # segments are 1-based and non-decreasing within a row
+            seg = row['segment_ids'][~pad]
+            assert np.all(np.diff(seg) >= 0)
+
+    def test_token_conservation(self):
+        docs = _docs(30, seed=2)
+        packer = SequencePacker(seq_len=48)
+        rows = [r for d in docs for r in packer.feed(d)]
+        rows.extend(packer.flush())
+        total = sum(len(d) for d in docs)
+        assert sum(int(r['loss_mask'].sum()) for r in rows) == total
+        assert packer.stats['tokens'] == total
+        assert packer.stats['rows'] == len(rows)
+        assert packer.stats['docs'] == len(docs)
+
+    def test_overlong_doc_splits_across_rows(self):
+        packer = SequencePacker(seq_len=16)
+        doc = list(range(1, 41))  # 40 tokens -> 2 full rows + carry of 8
+        rows = packer.feed(doc)
+        assert len(rows) == 2
+        assert packer.stats['carried_tokens'] == 8
+        rows.extend(packer.flush())
+        got = np.concatenate([r['tokens'][r['loss_mask'] == 1]
+                              for r in rows])
+        assert got.tolist() == doc
+        assert packer.stats['split_docs'] == 1
+
+    def test_open_bin_bound_and_first_fit(self):
+        packer = SequencePacker(seq_len=10, open_bins=2)
+        for doc in _docs(50, seed=3, hi=10):
+            packer.feed(doc)
+            assert packer.stats['open_bins'] <= 2
+        packer.flush()
+        assert packer.stats['open_bins'] == 0
+
+    def test_open_bins_knob_default(self, monkeypatch):
+        monkeypatch.setenv('PETASTORM_TPU_MIXTURE_OPEN_BINS', '7')
+        T.refresh()
+        try:
+            assert SequencePacker(seq_len=8)._open_bins == 7
+        finally:
+            monkeypatch.delenv('PETASTORM_TPU_MIXTURE_OPEN_BINS')
+            T.refresh()
+
+    def test_state_json_roundtrip_mid_stream(self):
+        docs = _docs(40, seed=4)
+        a = SequencePacker(seq_len=32)
+        for d in docs[:25]:
+            a.feed(d)
+        state = json.loads(json.dumps(a.state_dict()))
+        b = SequencePacker(seq_len=32)
+        b.load_state_dict(state)
+        rows_a = [r for d in docs[25:] for r in a.feed(d)] + a.flush()
+        rows_b = [r for d in docs[25:] for r in b.feed(d)] + b.flush()
+        assert _streams_equal(rows_a, rows_b)
+        assert a.stats == b.stats
+
+    def test_fill_ratio_reported(self):
+        packer = SequencePacker(seq_len=64)
+        for d in _docs(60, seed=5):
+            packer.feed(d)
+        packer.flush()
+        stats = packer.stats
+        assert 0.5 < stats['fill_ratio'] <= 1.0
+        assert stats['padding_tokens'] == \
+            stats['rows'] * 64 - stats['tokens']
+
+
+# -- spec --------------------------------------------------------------------
+
+
+class TestMixtureSpec:
+    def test_source_requires_exactly_one_of_url_or_factory(self):
+        with pytest.raises(ValueError):
+            MixtureSource('x', 1)
+        with pytest.raises(ValueError):
+            MixtureSource('x', 1, url='file:///d',
+                          reader_factory=lambda: None)
+
+    def test_fingerprint_tracks_identity(self, mix_datasets):
+        assert _spec(mix_datasets).fingerprint() == \
+            _spec(mix_datasets).fingerprint()
+        assert _spec(mix_datasets).fingerprint() != \
+            _spec(mix_datasets, weights=(1, 1)).fingerprint()
+        assert _spec(mix_datasets).fingerprint() != \
+            _spec(mix_datasets, seed=12).fingerprint()
+
+
+# -- layers 3+4: stream determinism, resume, reshard, plane parity -----------
+
+
+class TestStreamDeterminism:
+    def test_cross_pool_identical_streams(self, mix_datasets):
+        oracle = _drain(MixtureStream(_spec(mix_datasets),
+                                      reader_pool_type='dummy'))
+        assert oracle, 'mixture produced no packed rows'
+        for workers in (2, 4):
+            got = _drain(MixtureStream(_spec(mix_datasets),
+                                       reader_pool_type='thread',
+                                       workers_count=workers))
+            assert _streams_equal(oracle, got)
+
+    def test_three_source_stream_and_ratio(self, mix_datasets):
+        spec = _spec(mix_datasets, sources=('a', 'b', 'c'),
+                     weights=(3, 1, 1), seed=2)
+        stream = MixtureStream(spec, reader_pool_type='thread',
+                               workers_count=3)
+        rows = _drain(stream)
+        assert rows
+        docs = stream.source_doc_counts
+        assert sum(docs) > 0
+        # source a holds a 0.6 share; the interleave keeps the realized
+        # ratio within one credit of exact until a source drains
+        assert docs[0] > docs[1] and docs[0] > docs[2]
+
+    def test_readahead_on_off_parity(self, mix_datasets):
+        from tests.test_readahead import _with_env
+        restore = _with_env({'PETASTORM_TPU_READAHEAD': '0'})
+        try:
+            oracle = _drain(MixtureStream(_spec(mix_datasets, seed=21),
+                                          reader_pool_type='thread',
+                                          workers_count=3))
+        finally:
+            restore()
+        restore = _with_env({'PETASTORM_TPU_READAHEAD': '1'})
+        try:
+            live = _drain(MixtureStream(_spec(mix_datasets, seed=21),
+                                        reader_pool_type='thread',
+                                        workers_count=3))
+        finally:
+            restore()
+        assert _streams_equal(oracle, live)
+
+    @pytest.mark.slow
+    def test_process_pool_identical_stream(self, mix_datasets):
+        oracle = _drain(MixtureStream(_spec(mix_datasets),
+                                      reader_pool_type='dummy'))
+        got = _drain(MixtureStream(_spec(mix_datasets),
+                                   reader_pool_type='process',
+                                   workers_count=2))
+        assert _streams_equal(oracle, got)
+
+
+class TestResume:
+    def test_resume_parity_across_pool_shapes(self, mix_datasets):
+        oracle = _drain(MixtureStream(_spec(mix_datasets),
+                                      reader_pool_type='dummy'))
+        for cut in (1, len(oracle) // 2, len(oracle) - 2):
+            first = MixtureStream(_spec(mix_datasets),
+                                  reader_pool_type='thread',
+                                  workers_count=4)
+            head = [next(first) for _ in range(cut)]
+            state = json.loads(json.dumps(first.state_dict()))
+            first.stop()
+            first.join()
+            second = MixtureStream(_spec(mix_datasets),
+                                   reader_pool_type='thread',
+                                   workers_count=3)
+            second.load_state_dict(state)
+            tail = _drain(second)
+            assert _streams_equal(head + tail, oracle), 'cut=%d' % cut
+
+    @pytest.mark.parametrize('n_from,n_to,steps', [(2, 3, 3), (3, 2, 2),
+                                                   (1, 2, 4)])
+    def test_reshard_oracle_bit_identical(self, mix_datasets, n_from, n_to,
+                                          steps):
+        """The acceptance oracle: N shard states merged and restored on
+        M consumers stitch back into the uninterrupted global stream."""
+        spec_kw = dict(sources=('a', 'b'), weights=(3, 1), seed=11)
+        oracle = _drain(MixtureStream(_spec(mix_datasets, **spec_kw),
+                                      reader_pool_type='dummy'))
+        states, pre = [], {}
+        for r in range(n_from):
+            s = MixtureStream(_spec(mix_datasets, **spec_kw),
+                              reader_pool_type='thread', workers_count=2,
+                              cur_shard=r, shard_count=n_from)
+            pre[r] = [next(s) for _ in range(steps)]
+            states.append(json.loads(json.dumps(s.state_dict())))
+            s.stop()
+            s.join()
+        merged = merge_mixture_states(states)
+        resume = merged['resume_ordinal']
+        stitched = [None] * len(oracle)
+        for r in range(n_from):
+            for i, row in enumerate(pre[r]):
+                stitched[r + i * n_from] = row
+        for r in range(n_to):
+            s = MixtureStream(_spec(mix_datasets, **spec_kw),
+                              reader_pool_type='thread', workers_count=2,
+                              cur_shard=r, shard_count=n_to)
+            s.load_state_dict(json.loads(json.dumps(merged)))
+            post = _drain(s)
+            ordinals = [o for o in range(resume, len(oracle))
+                        if o % n_to == r]
+            assert len(ordinals) == len(post)
+            for o, row in zip(ordinals, post):
+                stitched[o] = row
+        assert all(x is not None for x in stitched)
+        assert _streams_equal(oracle, stitched)
+
+    def test_merge_rejects_mismatched_families(self, mix_datasets):
+        s = MixtureStream(_spec(mix_datasets), reader_pool_type='dummy',
+                          cur_shard=0, shard_count=2)
+        next(s)
+        state = s.state_dict()
+        s.stop()
+        s.join()
+        with pytest.raises(ValueError, match='mixture states'):
+            merge_mixture_states([])
+        other = dict(state, mixture='0' * 16)
+        with pytest.raises(ValueError, match='different mixtures'):
+            merge_mixture_states([state, other])
+        with pytest.raises(ValueError, match='shard'):
+            merge_mixture_states([state, dict(state, shard_count=3)])
+
+    def test_fingerprint_guard_on_restore(self, mix_datasets):
+        s = MixtureStream(_spec(mix_datasets), reader_pool_type='dummy')
+        next(s)
+        state = s.state_dict()
+        s.stop()
+        s.join()
+        t = MixtureStream(_spec(mix_datasets, weights=(1, 1)),
+                          reader_pool_type='dummy')
+        try:
+            with pytest.raises(ValueError, match='fingerprint'):
+                t.load_state_dict(state)
+        finally:
+            t.stop()
+            t.join()
+
+
+# -- plane integration: jax loader + daemonized service ----------------------
+
+
+class TestLoaderIntegration:
+    def test_make_jax_loader_mixture_batches(self, mix_datasets):
+        from petastorm_tpu.jax import make_jax_loader
+        spec = _spec(mix_datasets, seq_len=48)
+        loader = make_jax_loader(None, mixture=spec, batch_size=4,
+                                 reader_pool_type='thread',
+                                 workers_count=2)
+        try:
+            batch = next(iter(loader))
+            for col in ROW_COLS:
+                assert np.asarray(batch[col]).shape == (4, 48)
+        finally:
+            loader.stop()
+
+    def test_mixture_rejects_conflicting_loader_args(self, mix_datasets):
+        from petastorm_tpu.jax import make_jax_loader
+        spec = _spec(mix_datasets)
+        with pytest.raises(ValueError):
+            make_jax_loader(mix_datasets['a'], mixture=spec, batch_size=2)
+        with pytest.raises(ValueError):
+            make_jax_loader(None, mixture=spec, batch_size=2,
+                            inmemory_cache_all=True)
+
+    def test_adapter_requires_seq_len(self, mix_datasets):
+        spec = _spec(mix_datasets, seq_len=None)
+        stream = MixtureStream(spec, reader_pool_type='dummy')
+        try:
+            with pytest.raises(ValueError, match='seq_len'):
+                MixtureBatchReader(stream)
+        finally:
+            stream.stop()
+            stream.join()
+
+
+@pytest.mark.service
+def test_daemon_service_path_bit_identical(mix_datasets, monkeypatch):
+    """Acceptance: the mixture routed through a standing decode daemon
+    (per-source QoS jobs) delivers the identical packed stream as the
+    local thread pool — including across a mid-stream N→M reshard."""
+    from petastorm_tpu.service.daemon import ServiceDaemon
+    spec_kw = dict(sources=('a', 'b'), weights=(3, 1), seed=11)
+    oracle = _drain(MixtureStream(_spec(mix_datasets, **spec_kw),
+                                  reader_pool_type='thread',
+                                  workers_count=2))
+    daemon = ServiceDaemon('tcp://127.0.0.1:0', initial_workers=2)
+    daemon.start()
+
+    def daemon_stream(**stream_kw):
+        readers = build_source_readers(_spec(mix_datasets, **spec_kw),
+                                       reader_pool_type='service')
+        return MixtureStream(_spec(mix_datasets, **spec_kw),
+                             readers=readers, **stream_kw)
+
+    try:
+        monkeypatch.setenv('PETASTORM_TPU_SERVICE_DAEMON', daemon.endpoint)
+        got = _drain(daemon_stream())
+        assert _streams_equal(oracle, got)
+        jobs = daemon.dispatcher.stats()['jobs_seen']
+        assert jobs >= 2, 'each source should register its own QoS job'
+
+        # N→M reshard through the daemon: 2 shard states cut at the same
+        # step count, merged, restored onto 1 consumer — bit-identical
+        steps = 3
+        states, pre = [], {}
+        for r in range(2):
+            s = daemon_stream(cur_shard=r, shard_count=2)
+            pre[r] = [next(s) for _ in range(steps)]
+            states.append(json.loads(json.dumps(s.state_dict())))
+            s.stop()
+            s.join()
+        merged = merge_mixture_states(states)
+        resume = merged['resume_ordinal']
+        assert resume == 2 * steps
+        s = daemon_stream(cur_shard=0, shard_count=1)
+        s.load_state_dict(json.loads(json.dumps(merged)))
+        post = _drain(s)
+        stitched = [None] * len(oracle)
+        for r in range(2):
+            for i, row in enumerate(pre[r]):
+                stitched[r + i * 2] = row
+        for o, row in zip(range(resume, len(oracle)), post):
+            stitched[o] = row
+        assert all(x is not None for x in stitched)
+        assert _streams_equal(oracle, stitched)
+    finally:
+        monkeypatch.delenv('PETASTORM_TPU_SERVICE_DAEMON', raising=False)
+        daemon.stop()

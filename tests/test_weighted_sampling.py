@@ -349,3 +349,118 @@ def test_mix_legacy_checkpoint_without_rng_state_replays(scalar_dataset):
         mix2.load_state_dict(state)
         got = [float(mix2._rng.random_sample()) for _ in range(7)]
     np.testing.assert_allclose(got, want_stream[5:], rtol=0, atol=0)
+
+
+# -- delivered-draw accounting + deterministic interleave mode ---------------
+
+
+class _DryReader:
+    """Schema-compatible source that is already exhausted."""
+
+    def __init__(self, like):
+        self._like = like
+
+    def __getattr__(self, name):
+        return getattr(self._like, name)
+
+    def __next__(self):
+        raise StopIteration
+
+
+def test_stop_iteration_does_not_charge_draw(synthetic_dataset):
+    # regression: __next__ used to charge _draws BEFORE the source's
+    # next(), so the draw that ended the mix (StopIteration) was counted
+    # and a checkpoint at mix end replayed a choice sequence shifted by
+    # one on restore
+    with _reader(synthetic_dataset.url) as a:
+        mix = WeightedSamplingReader([a, _DryReader(a)], [0.5, 0.5], seed=3)
+        delivered = 0
+        try:
+            while True:
+                next(mix)
+                delivered += 1
+        except StopIteration:
+            pass
+        state = mix.state_dict()
+    assert state['draws'] == delivered
+    # the mux RNG rewound the failed draw: its state equals a reference
+    # generator advanced by exactly the DELIVERED draws
+    ref = np.random.RandomState(3)
+    ref.random_sample(delivered)
+    _, ref_keys, ref_pos, _, _ = ref.get_state()
+    assert state['rng_state'][1] == [int(k) for k in ref_keys]
+    assert state['rng_state'][2] == int(ref_pos)
+
+
+def test_deterministic_mode_follows_interleave(synthetic_dataset):
+    from petastorm_tpu.mixture import InterleaveSchedule
+    choices = []
+
+    def record(bucket):
+        return lambda: choices.append(bucket)
+
+    with _reader(synthetic_dataset.url) as a, _reader(synthetic_dataset.url) as b:
+        mix = WeightedSamplingReader(
+            [_SpyReader(a, record(0)), _SpyReader(b, record(1))],
+            [3, 1], seed=5, deterministic=True)
+        for _ in range(100):
+            next(mix)
+    assert choices == InterleaveSchedule.order([3, 1], seed=5, start=0,
+                                               k=100)
+
+
+def test_deterministic_mode_checkpoint_roundtrip(scalar_dataset):
+    from petastorm_tpu.reader import make_batch_reader
+
+    def build():
+        readers = [make_batch_reader(scalar_dataset.url,
+                                     schema_fields=['^id$'],
+                                     num_epochs=None,
+                                     shuffle_row_groups=False,
+                                     reader_pool_type='dummy')
+                   for _ in range(2)]
+        return WeightedSamplingReader(readers, [2, 1], seed=9,
+                                      deterministic=True)
+
+    with build() as oracle:
+        want = [np.asarray(next(oracle).id).tolist() for _ in range(20)]
+
+    with build() as mix:
+        head = [np.asarray(next(mix).id).tolist() for _ in range(7)]
+        state = mix.state_dict()
+    assert 'interleave' in state
+
+    with build() as mix2:
+        mix2.load_state_dict(state)
+        tail = [np.asarray(next(mix2).id).tolist() for _ in range(13)]
+    assert head + tail == want
+
+
+def test_deterministic_mode_accepts_legacy_draws_state(scalar_dataset):
+    # an RNG-era checkpoint (no 'interleave' leg) restores by replaying
+    # the pure schedule to the delivered-draw cursor
+    from petastorm_tpu.reader import make_batch_reader
+
+    def build():
+        readers = [make_batch_reader(scalar_dataset.url,
+                                     schema_fields=['^id$'],
+                                     num_epochs=None,
+                                     shuffle_row_groups=False,
+                                     reader_pool_type='dummy')
+                   for _ in range(2)]
+        return WeightedSamplingReader(readers, [2, 1], seed=9,
+                                      deterministic=True)
+
+    with build() as oracle:
+        want = [np.asarray(next(oracle).id).tolist() for _ in range(20)]
+
+    with build() as mix:
+        for _ in range(7):
+            next(mix)
+        state = mix.state_dict()
+    del state['interleave']
+
+    with build() as mix2:
+        mix2.load_state_dict(state)
+        tail = [np.asarray(next(mix2).id).tolist() for _ in range(13)]
+    assert tail == want[7:]

@@ -46,6 +46,9 @@ TRACKED = (
     # wire-speed I/O plane (bench io_overlap section)
     'io_overlap_speedup',
     'io_overlap_readahead_rows_per_sec',
+    # streaming mixture engine (bench mixture_stream section)
+    'mixture_packed_tokens_per_sec',
+    'mixture_fill_ratio',
     'native_decode_speedup',
     'imagenet_batch_rows_per_sec',
     'imagenet_jax_rows_per_sec',
