@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: test test-fast analyze lint trend chaos chaos-soak mixture ci typecheck bench dryrun docker clean
+.PHONY: test test-fast analyze lint trend chaos chaos-soak mixture write ci typecheck bench dryrun docker clean
 
 # full suite (~10 min: includes the compile-heavy model/attention tests)
 test:
@@ -57,11 +57,19 @@ chaos-soak:
 mixture:
 	$(PYTHON) -m pytest tests/test_mixture.py tests/test_weighted_sampling.py -q -m "not slow"
 
+# distributed write plane (docs/write.md): backend byte-parity, the
+# crash-safety chaos drill (injected io.write faults → zero partial
+# files, byte-identical retried manifest), compaction under concurrent
+# reads, append-follower staleness, and the write→read property test.
+# Fast subset is tier-1; the named gate fails the write story first.
+write:
+	$(PYTHON) -m pytest tests/test_write.py -q -m "not slow"
+
 # the CI gate sequence: static contracts, perf trend, the seeded chaos
 # drills (fast subset — also inside test-fast, but a named early gate
 # fails the failure-domain story first and fast), the mixture
-# determinism oracles, then tier-1 tests
-ci: analyze trend chaos mixture test-fast
+# determinism oracles, the write-plane gate, then tier-1 tests
+ci: analyze trend chaos mixture write test-fast
 
 typecheck:
 	$(PYTHON) -m mypy petastorm_tpu

@@ -97,6 +97,16 @@ MIXTURE_DOCS_A = 384 if SMOKE else 3072
 MIXTURE_DOCS_B = 128 if SMOKE else 1024
 MIXTURE_SEQ_LEN = 512
 
+# distributed write plane (write_throughput section, ISSUE 18): enough
+# rows that encode+flush dominates pool/commit fixed costs, sharded so
+# both backends exercise multi-shard dispatch; the compaction probe
+# stacks small appended generations so the before/after read contrast
+# is file-count-driven, not noise
+WRITE_BENCH_ROWS = 4000 if SMOKE else 20000
+WRITE_BENCH_SHARD_ROWS = 500 if SMOKE else 2500
+WRITE_COMPACT_GENS = 6
+WRITE_COMPACT_GEN_ROWS = 250 if SMOKE else 1000
+
 # ONE owner of the staged-batch size shared by the real imagenet H2D
 # section and its dummy-source decomposition (the share math divides by
 # it — two hardcoded 64s would drift apart silently)
@@ -127,10 +137,10 @@ _START = time.monotonic()
 # ever approaches the cap, the least important tail keys drop first.
 # raised 1500 → 1600 for the selective_read headline key, → 1700 for
 # the two sharded_staging keys, → 1800 for the two service HA keys,
-# → 1900 for the two mixture_stream keys (worst case ~1845) — the
-# driver tail is 2,000 chars and the emit loop still drops tail keys
-# at the cap
-_HEADLINE_MAX_CHARS = 1900
+# → 1900 for the two mixture_stream keys (worst case ~1845), → 1950
+# for the write_throughput headline key — the driver tail is 2,000
+# chars and the emit loop still drops tail keys at the cap
+_HEADLINE_MAX_CHARS = 1950
 _HEADLINE_EXTRA_KEYS = (
     'vs_tfdata',
     'hello_world_warm_epoch_rows_per_sec',
@@ -148,6 +158,10 @@ _HEADLINE_EXTRA_KEYS = (
     # rate stay in the full cumulative dict
     'mixture_packed_tokens_per_sec',
     'mixture_fill_ratio',
+    # distributed write plane (bench write_throughput section): local
+    # backend commit-to-commit write rate; MB/s, the fleet backend and
+    # the compaction read delta stay in the full cumulative dict
+    'write_rows_per_sec',
     # standing-service HA (bench service section): kill-to-first-row
     # blackout through a warm-standby promotion, and the share of
     # bindings that landed on a fingerprint-warm host
@@ -315,6 +329,29 @@ def _build_io_overlap(url):
     # any real store) while multi-file path handling still exercises
     write_dataset(url, schema, rows,
                   rowgroup_size_rows=IO_OVERLAP_ROWGROUP_ROWS, num_files=2)
+
+
+def _write_bench_schema():
+    """Scalar id + a repeated string payload: enough bytes per row that
+    the MB/s number reflects flush/serialization work, while encode
+    stays cheap enough that the local-vs-fleet contrast is about
+    dispatch, not codec time."""
+    import numpy as np
+    import pyarrow as pa
+
+    from petastorm_tpu.codecs import ScalarCodec
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    return Unischema('WriteBenchSchema', [
+        UnischemaField('id', np.int64, (), ScalarCodec(pa.int64()), False),
+        UnischemaField('payload', np.str_, (), ScalarCodec(pa.string()),
+                       False),
+    ])
+
+
+def _write_bench_fs(url):
+    from petastorm_tpu.fs import get_filesystem_and_path_or_paths
+    return get_filesystem_and_path_or_paths(url)
 
 
 def _build_mixture_source(url, num_docs, seed):
@@ -1701,6 +1738,7 @@ def main():
     io_overlap_url = 'file://' + tmp + '/io_overlap'
     mix_a_url = 'file://' + tmp + '/mixture_web'
     mix_b_url = 'file://' + tmp + '/mixture_code'
+    write_bench_dir = tmp + '/write_plane'
     extra = {}
     state = {
         'metric': 'hello_world_read_rate',
@@ -2088,6 +2126,92 @@ def main():
                      for draw in rng.random_sample(k)]
         extra['mixture_rng_deviation'] = round(
             realized_deviation(rng_order, weights), 3)
+
+    def sec_write_throughput():
+        """Distributed write plane (ISSUE 18): commit-to-commit rows/s
+        and MB/s for the local (pool=None, shards run inline) and fleet
+        (ServicePool subprocess workers) backends over the same row
+        stream — backend byte-parity asserted via the committed
+        manifests, which carry no timestamps. Then the compaction
+        story: small appended generations read before and after
+        compact_dataset folds them, for the read-speed delta the
+        re-shard service exists to buy."""
+        from petastorm_tpu.reader import make_batch_reader
+        from petastorm_tpu.service.service_pool import ServicePool
+        from petastorm_tpu.write import compact_dataset
+        from petastorm_tpu.write import manifest as wmanifest
+        from petastorm_tpu.write import write_dataset_distributed
+
+        schema = _write_bench_schema()
+        rows = [{'id': i, 'payload': 'payload-%06d|' % i * 8}
+                for i in range(WRITE_BENCH_ROWS)]
+
+        def one_write(name, pool):
+            url = 'file://' + write_bench_dir + '/' + name
+            start = time.monotonic()
+            writer = write_dataset_distributed(
+                url, schema, rows, sort_by='id',
+                shard_rows=WRITE_BENCH_SHARD_ROWS, pool=pool)
+            elapsed = time.monotonic() - start
+            nbytes = sum(e['bytes'] for e in writer.manifest['files'])
+            return elapsed, nbytes, writer
+
+        local_s, local_bytes, w_local = one_write('local', None)
+        fleet_s, fleet_bytes, w_fleet = one_write(
+            'fleet', ServicePool(spawn_local_workers=4,
+                                 heartbeat_interval_s=0.2,
+                                 liveness_timeout_s=2.0,
+                                 connect_timeout_s=60,
+                                 no_workers_timeout_s=30))
+        assert wmanifest.dumps(w_local.manifest) == \
+            wmanifest.dumps(w_fleet.manifest), 'write backend parity broke'
+        extra['write_parity'] = True
+        extra['write_rows_per_sec'] = round(WRITE_BENCH_ROWS / local_s, 1)
+        extra['write_mb_per_sec'] = round(
+            local_bytes / local_s / (1024 * 1024), 2)
+        extra['write_fleet_rows_per_sec'] = round(
+            WRITE_BENCH_ROWS / fleet_s, 1)
+        extra['write_fleet_mb_per_sec'] = round(
+            fleet_bytes / fleet_s / (1024 * 1024), 2)
+        check = w_local.last_self_check
+        if check:
+            extra['write_selfcheck_prune_share'] = round(
+                check['predicted_prune_share'], 4)
+            extra['write_selfcheck_fits_window_share'] = round(
+                check['coalesce']['fits_window_share'], 4)
+
+        # compaction before/after: many small generations vs the fold
+        compact_url = 'file://' + write_bench_dir + '/compact'
+        for gen in range(WRITE_COMPACT_GENS):
+            write_dataset_distributed(
+                compact_url, schema,
+                [{'id': i, 'payload': 'payload-%06d|' % i * 8}
+                 for i in range(gen * WRITE_COMPACT_GEN_ROWS,
+                                (gen + 1) * WRITE_COMPACT_GEN_ROWS)],
+                sort_by='id', shard_rows=WRITE_COMPACT_GEN_ROWS // 4,
+                append=(gen > 0))
+
+        def read_s():
+            best = None
+            for _ in range(3):
+                start = time.monotonic()
+                with make_batch_reader(compact_url,
+                                       shuffle_row_groups=False) as r:
+                    total = sum(len(b.id) for b in r)
+                elapsed = time.monotonic() - start
+                assert total == WRITE_COMPACT_GENS * WRITE_COMPACT_GEN_ROWS
+                best = elapsed if best is None else min(best, elapsed)
+            return best
+
+        before_files = len(wmanifest.load(
+            *_write_bench_fs(compact_url))['files'])
+        before_s = read_s()
+        compacted = compact_dataset(compact_url, minimum=2)
+        assert compacted is not None, 'write bench compaction planned nothing'
+        after_s = read_s()
+        extra['write_compact_files_before'] = before_files
+        extra['write_compact_files_after'] = len(compacted['files'])
+        extra['write_compact_read_speedup'] = round(before_s / after_s, 3)
 
     def sec_service():
         # Standing-service HA record (docs/service.md, "High
@@ -2496,6 +2620,7 @@ def main():
         section('selective_read', 15, sec_selective_read)
         section('io_overlap', 10, sec_io_overlap)
         section('mixture_stream', 15, sec_mixture_stream)
+        section('write_throughput', 15, sec_write_throughput)
         section('service', 20, sec_service)
         section('lm_tokens', 10, sec_lm_tokens)
         section('imagenet', 20, sec_imagenet)

@@ -41,12 +41,19 @@ telemetry back).
 #: decode — a high share here with low ``io`` share is the plane
 #: working) · ``pack`` token-budget sequence packing: variable-length
 #: documents folded into fixed ``(seq_len,)`` rows with loss masks and
-#: segment ids (petastorm_tpu/mixture/packing.py)
+#: segment ids (petastorm_tpu/mixture/packing.py) · ``encode``
+#: write-path codec encode of row dicts into parquet-storable values
+#: (etl/dataset_metadata.DatasetWriter, write/writer.py) ·
+#: ``write_flush`` one buffered row-group flushed as an arrow table
+#: into a parquet part file (etl/dataset_metadata.DatasetWriter._flush)
+#: · ``compact`` one compaction group folded: source part files read at
+#: the arrow level, re-chunked to readahead-friendly row-groups and
+#: rewritten (write/compact.py)
 STAGES = ('ventilate', 'io', 'decode', 'filter', 'transform', 'queue_wait',
           'collate', 'h2d', 'h2d_ready', 'stage_fill', 'h2d_dispatch',
           'cache_hit_read', 'cache_fill', 'decode_fused',
           'rowgroup_prune', 'late_materialize', 'autotune',
-          'readahead_fetch', 'pack')
+          'readahead_fetch', 'pack', 'encode', 'write_flush', 'compact')
 
 #: every trace-event name the package records outside the canonical stage
 #: spans (docs/telemetry.md, tracing section)
@@ -175,6 +182,15 @@ METRIC_NAMES = frozenset([
     'petastorm_tpu_pack_tokens_total',
     'petastorm_tpu_pack_padding_tokens_total',
     'petastorm_tpu_pack_split_docs_total',
+    # distributed write plane: fleet-ETL writer, compaction, append
+    # (write/writer.py, write/compact.py, write/manifest.py)
+    'petastorm_tpu_write_rows_total',
+    'petastorm_tpu_write_bytes_total',
+    'petastorm_tpu_write_files_total',
+    'petastorm_tpu_write_commits_total',
+    'petastorm_tpu_write_manifest_generation',
+    'petastorm_tpu_compact_runs_total',
+    'petastorm_tpu_compact_files_folded_total',
 ])
 
 #: prefix of every operator-facing environment knob
@@ -245,6 +261,12 @@ KNOWN_KNOBS = frozenset([
     'PETASTORM_TPU_READAHEAD_MAX_RANGE_MB',
     'PETASTORM_TPU_MIXTURE_OPEN_BINS',
     'PETASTORM_TPU_MIXTURE_RESEQ_MAX',
+    'PETASTORM_TPU_WRITE_ROWGROUP_MB',
+    'PETASTORM_TPU_WRITE_WORKERS',
+    'PETASTORM_TPU_WRITE_SHARD_ROWS',
+    'PETASTORM_TPU_WRITE_SELF_CHECK',
+    'PETASTORM_TPU_COMPACT_TARGET_MB',
+    'PETASTORM_TPU_COMPACT_MIN_FILES',
 ])
 
 #: canonical anomaly event kinds the live observability plane's detector
@@ -322,6 +344,18 @@ FAULTPOINTS = {
                        'retried with backoff inside the promote window '
                        '— the failover drill\'s knob for prolonging the '
                        'blackout deterministically)',
+    'io.write': 'the distributed write plane\'s publication seams '
+                '(write/writer.py, write/compact.py, write/manifest.py):'
+                ' part-file data write/close (keys end in #part), the '
+                'tmp->final rename that publishes a part file (keys end '
+                'in #rename) and the atomic manifest swap that commits '
+                'a generation (keys end in #manifest). A fault before '
+                'the rename leaves only an invisible .tmp file; a fault '
+                'before the manifest swap leaves the previous generation'
+                ' committed — either way readers never see a torn '
+                'dataset, and a retried shard republishes byte-identical'
+                ' output (the crash-safety chaos drill in '
+                'tests/test_write.py)',
 }
 
 #: the one knob-truthiness rule for "disable"/"enable" env spellings —

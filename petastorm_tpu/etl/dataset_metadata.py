@@ -32,6 +32,7 @@ import pyarrow.parquet as pq
 
 from petastorm_tpu.errors import MetadataError
 from petastorm_tpu.fs import get_filesystem_and_path_or_paths, normalize_dir_url
+from petastorm_tpu.telemetry import span
 from petastorm_tpu.unischema import Unischema, dict_to_encoded_row
 
 logger = logging.getLogger(__name__)
@@ -137,6 +138,19 @@ class ParquetDatasetInfo:
     def _discover_files(fs, root):
         if fs.isfile(root):
             return [root]
+        # A committed manifest (written by petastorm_tpu.write) is the
+        # dataset truth: its file list is a single atomic snapshot, so a
+        # reader racing a concurrent writer/compaction never sees a torn
+        # mix of old and new part files the directory walk would.
+        from petastorm_tpu.write import manifest as write_manifest
+        try:
+            committed = write_manifest.load(fs, root.rstrip('/'))
+        except write_manifest.ManifestError as e:
+            logger.warning('Ignoring unreadable dataset manifest: %s', e)
+            committed = None
+        if committed is not None:
+            return sorted(write_manifest.committed_paths(
+                committed, root.rstrip('/')))
         files = []
         root_norm = root.rstrip('/')
         for path in fs.find(root):
@@ -496,13 +510,20 @@ class DatasetWriter:
     def __init__(self, dataset_url, schema, rowgroup_size_rows=1000,
                  partition_by=(), file_prefix='part', storage_options=None,
                  rowgroup_size_mb=None, compression='auto',
-                 workers_count=None):
+                 workers_count=None, sort_by=None, filesystem=None):
         """``workers_count``: >1 encodes :meth:`write_row_dicts` batches in
         a thread pool (codec encode — jpeg/png via cv2, ``np.save`` — is
         the write path's CPU cost and releases the GIL), the first-party
         stand-in for the reference's Spark-executor-parallel write
         (``etl/dataset_metadata.py:52``). Row order is preserved.
-        ``None``/0/1 encode serially."""
+        ``None``/0/1 encode serially.
+
+        ``sort_by``: name of a column the caller promises to feed in
+        non-decreasing order. The promise is stamped into each file's
+        footer as parquet sorted-column metadata and (with the footer
+        statistics this writer always emits) is what lets pushdown prune
+        row-groups on range predicates over that column. Order is the
+        caller's contract — the writer does not re-sort."""
         self.schema = schema
         self._compression = compression
         self._workers_count = int(workers_count or 0)
@@ -511,10 +532,13 @@ class DatasetWriter:
         self.rowgroup_size_bytes = (rowgroup_size_mb * 1024 * 1024
                                     if rowgroup_size_mb else None)
         self.partition_by = tuple(partition_by)
+        self.sort_by = sort_by
+        if sort_by is not None and sort_by not in {f.name for f in schema}:
+            raise ValueError('sort_by column %r is not in the schema' % sort_by)
         self._url = normalize_dir_url(dataset_url)
         self._file_prefix = file_prefix
         self.fs, self.root_path = get_filesystem_and_path_or_paths(
-            self._url, storage_options)
+            self._url, storage_options, filesystem=filesystem)
         self.fs.makedirs(self.root_path, exist_ok=True)
         self._arrow_schema = self._storage_schema()
         self._writers = {}
@@ -522,6 +546,10 @@ class DatasetWriter:
         self._buffer_bytes = {}
         self._file_seq = 0
         self._files_written = 0
+        self._rows_written = 0
+        #: paths of every parquet file this writer has CLOSED (fully
+        #: written) — the distributed plane renames these into place
+        self.paths_written = []
 
     def _storage_schema(self):
         fields = [pa.field(f.name, f.arrow_storage_type(), nullable=True)
@@ -563,6 +591,17 @@ class DatasetWriter:
             segments.append('%s=%s' % (key, quote(str(row[key]), safe='')))
         return '/'.join(segments)
 
+    def _sorting_columns(self):
+        """Parquet sorted-column metadata for the declared sort key, or
+        None. Ascending nulls-last: the ordering :func:`dict_to_encoded_row`
+        output naturally satisfies when the caller feeds sorted rows."""
+        if self.sort_by is None:
+            return None
+        index = self._arrow_schema.get_field_index(self.sort_by)
+        if index < 0:  # sort key is a partition column — not in-file
+            return None
+        return [pq.SortingColumn(index)]
+
     def _writer_for(self, part_dir):
         if part_dir not in self._writers:
             directory = posixpath.join(self.root_path, part_dir) if part_dir else self.root_path
@@ -570,10 +609,15 @@ class DatasetWriter:
             path = posixpath.join(directory, '%s-%05d.parquet' % (self._file_prefix, self._file_seq))
             self._file_seq += 1
             sink = self.fs.open(path, 'wb')
+            # Footer statistics are ALWAYS on: a dataset written without
+            # them reads full-scan-priced — every pushdown plan declines
+            # with 'no-statistics' (docs/troubleshoot.md).
             self._writers[part_dir] = (
                 pq.ParquetWriter(sink, self._arrow_schema,
-                                 compression=self._resolve_compression()),
-                sink)
+                                 compression=self._resolve_compression(),
+                                 write_statistics=True,
+                                 sorting_columns=self._sorting_columns()),
+                sink, path)
             self._buffers[part_dir] = []
         return self._writers[part_dir][0]
 
@@ -590,7 +634,9 @@ class DatasetWriter:
         return total
 
     def write_row_dict(self, row_dict):
-        self._append_encoded(dict_to_encoded_row(self.schema, row_dict))
+        with span('encode'):
+            encoded = dict_to_encoded_row(self.schema, row_dict)
+        self._append_encoded(encoded)
 
     def _append_encoded(self, encoded):
         part_dir = self._partition_dir(encoded)
@@ -628,7 +674,8 @@ class DatasetWriter:
                 thread_name_prefix='pt-encode')
 
         def encode_chunk(part):
-            return [dict_to_encoded_row(self.schema, r) for r in part]
+            with span('encode'):
+                return [dict_to_encoded_row(self.schema, r) for r in part]
 
         rows_iter = iter(row_dicts)
         pending = collections.deque()
@@ -654,22 +701,25 @@ class DatasetWriter:
         self._buffer_bytes[part_dir] = 0
         if not rows:
             return
-        columns = {}
-        for field in self._arrow_schema:
-            values = [r[field.name] for r in rows]
-            columns[field.name] = pa.array(values, type=field.type)
-        table = pa.table(columns, schema=self._arrow_schema)
-        self._writers[part_dir][0].write_table(table)
+        with span('write_flush'):
+            columns = {}
+            for field in self._arrow_schema:
+                values = [r[field.name] for r in rows]
+                columns[field.name] = pa.array(values, type=field.type)
+            table = pa.table(columns, schema=self._arrow_schema)
+            self._writers[part_dir][0].write_table(table)
+        self._rows_written += len(rows)
         self._buffers[part_dir] = []
 
     def _close_writers(self):
         for part_dir in list(self._writers):
             self._flush(part_dir)
-            writer, sink = self._writers.pop(part_dir)
+            writer, sink, path = self._writers.pop(part_dir)
             writer.close()
             sink.close()
             self._buffers.pop(part_dir, None)
             self._files_written += 1
+            self.paths_written.append(path)
 
     def close(self):
         if self._encode_pool is not None:
@@ -681,11 +731,49 @@ class DatasetWriter:
             self._writer_for('')
         self._close_writers()
 
+    def abort(self):
+        """Tear down without publishing buffered rows: drop unflushed
+        buffers, close the underlying sinks, and delete every file this
+        writer opened (including already-closed ones). The exception-path
+        counterpart of :meth:`close` — after an abort the directory holds
+        no half-written output from this writer."""
+        if self._encode_pool is not None:
+            self._encode_pool.shutdown(wait=True, cancel_futures=True)
+            self._encode_pool = None
+        opened = []
+        for part_dir in list(self._writers):
+            writer, sink, path = self._writers.pop(part_dir)
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001 - best-effort teardown
+                logger.debug('abort: parquet writer close failed for %s', path)
+            try:
+                sink.close()
+            except OSError:
+                pass
+            opened.append(path)
+            self._buffers.pop(part_dir, None)
+        for path in opened + self.paths_written:
+            try:
+                if self.fs.exists(path):
+                    self.fs.rm(path)
+            except (OSError, ValueError):
+                pass
+        self.paths_written = []
+        self._buffers = {}
+        self._buffer_bytes = {}
+
     def __enter__(self):
         return self
 
     def __exit__(self, exc_type, exc_val, exc_tb):
-        self.close()
+        # Success path publishes; an exception path must NOT flush the
+        # partial buffers as if the write finished — it aborts, removing
+        # this writer's files, so a crashed ETL job can simply rerun.
+        if exc_type is None:
+            self.close()
+        else:
+            self.abort()
 
 
 def write_dataset(dataset_url, schema, rows, rowgroup_size_rows=1000,

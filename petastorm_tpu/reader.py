@@ -120,7 +120,7 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None,
                       cache_row_size_estimate=None, transform_spec=None,
                       filters=None, storage_options=None, filesystem=None,
                       defer_image_decode=False, poison_policy=None,
-                      mixture_interleave=None):
+                      mixture_interleave=None, max_staleness_s=None):
     """Reader yielding whole row-groups as namedtuples of column arrays.
 
     Works on any Parquet store, petastorm metadata or not
@@ -140,9 +140,27 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None,
         of a weighted mixture: a dict with the source's exact interleave
         ``share``, annotated into the readahead plan so per-worker
         prefetch depth follows the mixing ratio.
+    :param max_staleness_s: bounded-staleness opt-in for growing
+        (append-mode) datasets: requires a committed manifest
+        (:mod:`petastorm_tpu.write`) and resolves the file set from a
+        manifest snapshot taken at open — so the reader sees every row
+        committed before the open, and rows written seconds ago are
+        picked up by simply reopening (or by
+        :class:`petastorm_tpu.write.AppendFollower`, which tails
+        continuously within this bound). Raises ``ValueError`` on a
+        manifest-less dataset — there is no commit point to bound
+        staleness against.
     """
     info = ParquetDatasetInfo(dataset_url_or_urls, storage_options,
                               filesystem=filesystem)
+    if max_staleness_s is not None:
+        from petastorm_tpu.write import manifest as write_manifest
+        if isinstance(dataset_url_or_urls, (list, tuple)) or \
+                write_manifest.load(info.fs, info.root_path) is None:
+            raise ValueError(
+                'max_staleness_s requires a single dataset URL with a '
+                'committed manifest (written by petastorm_tpu.write); '
+                '%r has none' % (dataset_url_or_urls,))
     return Reader(info, schema_fields=schema_fields,
                   reader_pool_type=reader_pool_type, workers_count=workers_count,
                   results_queue_size=results_queue_size,

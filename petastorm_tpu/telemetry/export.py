@@ -234,6 +234,9 @@ def pipeline_report(registry=None, wall_time_s=None, baseline=None,
     readahead = _readahead_section(registry)
     if readahead is not None:
         report['readahead'] = readahead
+    write = _write_section(registry)
+    if write is not None:
+        report['write'] = write
     pipesan = _sanitizer_section(registry)
     if pipesan is not None:
         report['pipesan'] = pipesan
@@ -472,6 +475,32 @@ def _readahead_section(registry):
     }
 
 
+def _write_section(registry):
+    """Distributed write plane activity (petastorm_tpu/write/) — present
+    only when this process (or its fleet, via the pool delta channels)
+    wrote, committed or compacted, so read-only pipelines keep their
+    report shape unchanged. The committed generation is a gauge: the
+    latest manifest swap this process published."""
+    from petastorm_tpu.write import compact, writer
+    from petastorm_tpu.write import manifest as write_manifest
+    rows = registry.counter_value(writer.WRITE_ROWS)
+    commits = registry.counter_value(write_manifest.WRITE_COMMITS)
+    compact_runs = registry.counter_value(compact.COMPACT_RUNS)
+    if not rows and not commits and not compact_runs:
+        return None
+    return {
+        'rows_written': int(rows),
+        'bytes_written': int(registry.counter_value(writer.WRITE_BYTES)),
+        'files_written': int(registry.counter_value(writer.WRITE_FILES)),
+        'commits': int(commits),
+        'generation': int(registry.gauge_value(
+            write_manifest.MANIFEST_GENERATION) or 0),
+        'compact_runs': int(compact_runs),
+        'files_folded': int(registry.counter_value(
+            compact.COMPACT_FILES_FOLDED)),
+    }
+
+
 def _sanitizer_section(registry):
     """pipesan runtime-sanitizer findings — present when the sanitizer is
     armed (``PETASTORM_TPU_SANITIZE=1``) or violations were recorded, so
@@ -646,6 +675,17 @@ def format_pipeline_report(report):
                         r['depth'], r['pool_bytes'],
                         r['pool_budget_bytes'],
                         (' — degraded: %s' % reasons) if reasons else ''))
+    if 'write' in report:
+        w = report['write']
+        compact_bit = ''
+        if w['compact_runs']:
+            compact_bit = (', %d compaction run(s) folding %d file(s)'
+                           % (w['compact_runs'], w['files_folded']))
+        lines.append('write plane: %d row(s) / %d B in %d part file(s), '
+                     '%d commit(s), generation %d%s'
+                     % (w['rows_written'], w['bytes_written'],
+                        w['files_written'], w['commits'], w['generation'],
+                        compact_bit))
     if 'pipesan' in report:
         p = report['pipesan']
         kinds = ', '.join('%s: %d' % (k, v)

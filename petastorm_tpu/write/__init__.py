@@ -1,0 +1,37 @@
+"""petastorm_tpu.write — the Spark-free distributed write plane.
+
+Four layers (docs/write.md):
+
+1. **Fleet-ETL writer** (:mod:`.writer`): ``DistributedDatasetWriter``
+   shards encode+write across any worker pool (thread/process/service
+   fleet) with exactly-once tmp+rename publication and a commit
+   manifest; ``pool=None`` is the degenerate local backend.
+2. **Read-optimized layout** (:mod:`.layout`): row-groups sized to the
+   readahead window, statistics-rich footers, and the post-write
+   ``self_check`` that reads the output back through the pushdown /
+   readahead planners.
+3. **Compaction** (:mod:`.compact`): fold small-file ingest into
+   readahead-friendly parts under an atomic manifest swap.
+4. **Bounded-staleness append** (:mod:`.append`): monotonic manifest
+   generations; followers pick up rows written seconds ago.
+"""
+
+from petastorm_tpu.write.append import AppendFollower, follow_dataset
+from petastorm_tpu.write.compact import (
+    CompactionDaemon, compact_dataset, plan_compaction,
+)
+from petastorm_tpu.write.layout import self_check, target_rowgroup_bytes
+from petastorm_tpu.write.manifest import (
+    ManifestError, gc_superseded, load as load_manifest, staleness_s,
+)
+from petastorm_tpu.write.writer import (
+    DistributedDatasetWriter, WriteShardWorker, write_dataset_distributed,
+)
+
+__all__ = [
+    'AppendFollower', 'CompactionDaemon', 'DistributedDatasetWriter',
+    'ManifestError', 'WriteShardWorker', 'compact_dataset',
+    'follow_dataset', 'gc_superseded', 'load_manifest', 'plan_compaction',
+    'self_check', 'staleness_s', 'target_rowgroup_bytes',
+    'write_dataset_distributed',
+]

@@ -1,0 +1,231 @@
+"""Compaction / re-shard: fold small-file ingest into readahead-friendly
+part files, atomically.
+
+Streaming ingest (the bounded-staleness append path) lands many small
+part files — each a full footer read, a tiny coalesce window and a
+request-per-file on real storage. :func:`compact_dataset` folds them:
+
+* **Arrow-level fold** — source parts are read as arrow tables
+  (``pq.read_table``), concatenated and rewritten with the layout
+  target's row-group size. Cells stay codec-encoded bytes throughout, so
+  Unischema fidelity is structural, not re-encoded; the footer schema
+  JSON is untouched.
+* **Atomic swap** — folded files are published tmp+rename like every
+  write, then ONE manifest swap replaces the source entries with the
+  folded ones. A reader that resolved the previous generation keeps
+  reading the old files (left on disk until
+  :func:`~petastorm_tpu.write.manifest.gc_superseded`); a reader that
+  resolves after the swap sees only folded files. No interleaving —
+  concurrent reads stay multiset-exact.
+* **Standing service** — :class:`CompactionDaemon` rides the PR 13
+  daemon pattern: a background thread re-plans on an interval and folds
+  whenever at least ``PETASTORM_TPU_COMPACT_MIN_FILES`` parts undershoot
+  the ``PETASTORM_TPU_COMPACT_TARGET_MB`` target.
+"""
+
+import logging
+import posixpath
+import threading
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from petastorm_tpu import faults
+from petastorm_tpu.fs import get_filesystem_and_path_or_paths, normalize_dir_url
+from petastorm_tpu.telemetry import get_registry, knobs, metrics_disabled, span
+from petastorm_tpu.write import layout, manifest
+from petastorm_tpu.write.manifest import TMP_PREFIX
+
+logger = logging.getLogger(__name__)
+
+COMPACT_RUNS = 'petastorm_tpu_compact_runs_total'
+COMPACT_FILES_FOLDED = 'petastorm_tpu_compact_files_folded_total'
+
+_MB = 1024 * 1024
+
+
+def target_file_bytes():
+    """Compaction fold target: ``PETASTORM_TPU_COMPACT_TARGET_MB``
+    (default 64 MB — a few readahead-window-sized row-groups per file,
+    so footer count drops without single-file hotspotting)."""
+    return knobs.get_int('PETASTORM_TPU_COMPACT_TARGET_MB', 64, floor=1) * _MB
+
+
+def min_files():
+    return knobs.get_int('PETASTORM_TPU_COMPACT_MIN_FILES', 4, floor=2)
+
+
+def plan_compaction(committed, target_bytes=None, minimum=None):
+    """Groups of manifest entries worth folding: runs of sub-target
+    files (manifest path order — adjacent in sort-key order when the
+    dataset declares one) packed greedily up to ``target_bytes`` per
+    folded output. Returns ``[[entry, ...], ...]``; empty when the
+    dataset is already readahead-friendly."""
+    target = target_bytes or target_file_bytes()
+    floor_count = minimum or min_files()
+    small = [e for e in committed['files'] if e['bytes'] < target]
+    if len(small) < floor_count:
+        return []
+    groups = []
+    group = []
+    group_bytes = 0
+    for entry in small:
+        if group and group_bytes + entry['bytes'] > target:
+            groups.append(group)
+            group, group_bytes = [], 0
+        group.append(entry)
+        group_bytes += entry['bytes']
+    if len(group) >= 2:
+        groups.append(group)
+    return [g for g in groups if len(g) >= 2]
+
+
+def _fold_group(fs, root_path, group, generation, group_id, rowgroup_bytes,
+                sort_key=None):
+    """Rewrite one group's rows into a single tmp part, rename it, and
+    return its manifest entry (with ``replaces`` naming the sources)."""
+    with span('compact'):
+        tables = []
+        for entry in group:
+            with fs.open(posixpath.join(root_path, entry['path']), 'rb') as f:
+                tables.append(pq.read_table(f))
+        folded = pa.concat_tables(tables)
+        sorting = None
+        if sort_key is not None and sort_key in folded.schema.names:
+            # fold preserves (and re-establishes, when appends interleaved
+            # key ranges) the declared order, and restamps the
+            # sorted-column footer metadata the writer promised
+            folded = folded.sort_by(sort_key)
+            sorting = [pq.SortingColumn(
+                folded.schema.get_field_index(sort_key))]
+        final_name = 'part-g%04d-c%05d-00000.parquet' % (generation, group_id)
+        final_path = posixpath.join(root_path, final_name)
+        tmp_path = posixpath.join(root_path, TMP_PREFIX + final_name)
+        if faults.ARMED:
+            faults.fault_hit('io.write', key='%s#part' % final_path)
+        # row-group re-chunk: rows sized so each row-group lands near the
+        # layout byte target (readahead-window aligned)
+        bytes_per_row = max(1, folded.nbytes // max(1, folded.num_rows))
+        rows_per_group = max(1, rowgroup_bytes // bytes_per_row)
+        with fs.open(tmp_path, 'wb') as sink:
+            pq.write_table(folded, sink, row_group_size=rows_per_group,
+                           write_statistics=True, sorting_columns=sorting)
+        if faults.ARMED:
+            faults.fault_hit('io.write', key='%s#rename' % final_path)
+        try:
+            fs.mv(tmp_path, final_path)
+        except FileExistsError:
+            fs.rm(final_path)
+            fs.mv(tmp_path, final_path)
+    with fs.open(final_path, 'rb') as f:
+        meta = pq.read_metadata(f)
+    return manifest.file_entry(
+        final_name, meta.num_rows, meta.num_row_groups,
+        int(fs.info(final_path)['size']), source='compact',
+        replaces=[e['path'] for e in group])
+
+
+def compact_dataset(dataset_url, storage_options=None, target_bytes=None,
+                    minimum=None, gc_grace_s=None):
+    """One compaction pass. Returns the new committed manifest, or None
+    when there was nothing to fold (or no manifest to fold under).
+
+    Source files are NOT deleted here — they back any reader that
+    resolved the previous generation. Pass ``gc_grace_s`` to also sweep
+    superseded files older than the grace window (a standing daemon's
+    second pass does this)."""
+    url = normalize_dir_url(dataset_url)
+    fs, root_path = get_filesystem_and_path_or_paths(url, storage_options)
+    committed = manifest.load(fs, root_path)
+    if committed is None:
+        return None
+    groups = plan_compaction(committed, target_bytes, minimum)
+    if not groups:
+        return None
+    generation = committed['generation'] + 1
+    rowgroup_bytes = layout.target_rowgroup_bytes()
+    folded_entries = []
+    for group_id, group in enumerate(groups):
+        folded_entries.append(_fold_group(
+            fs, root_path, group, generation, group_id, rowgroup_bytes,
+            sort_key=committed.get('sort_key')))
+    replaced = {path for e in folded_entries for path in e['replaces']}
+    survivors = [e for e in committed['files'] if e['path'] not in replaced]
+    new_manifest = manifest.build_manifest(
+        survivors + folded_entries, generation=generation,
+        sort_key=committed.get('sort_key'))
+    _restamp_footer(url, fs, root_path, new_manifest, storage_options)
+    published = manifest.publish(fs, root_path, new_manifest)
+    if not metrics_disabled():
+        registry = get_registry()
+        registry.counter(COMPACT_RUNS).inc()
+        registry.counter(COMPACT_FILES_FOLDED).inc(len(replaced))
+    logger.info('compact: folded %d file(s) into %d under %s '
+                '(generation %d)', len(replaced), len(folded_entries),
+                root_path, generation)
+    if gc_grace_s is not None:
+        manifest.gc_superseded(fs, root_path, grace_s=gc_grace_s)
+    return published
+
+
+def _restamp_footer(url, fs, root_path, new_manifest, storage_options):
+    """Refresh the row-group counts in ``_common_metadata`` for the new
+    file set. The schema entries are preserved as-is (fold is
+    arrow-level: Unischema fidelity is untouched)."""
+    import json
+
+    from petastorm_tpu.etl.dataset_metadata import (
+        LEGACY_ROW_GROUPS_PER_FILE_KEY, ROW_GROUPS_PER_FILE_KEY,
+        ParquetDatasetInfo, update_dataset_metadata,
+    )
+    info = ParquetDatasetInfo(url, storage_options, validate=False)
+    info.file_paths = sorted(manifest.committed_paths(new_manifest,
+                                                      root_path))
+    counts_json = json.dumps(manifest.row_group_counts(new_manifest),
+                             sort_keys=True).encode('utf-8')
+    entries = {ROW_GROUPS_PER_FILE_KEY: counts_json}
+    if info.common_metadata is not None and info.common_metadata.metadata \
+            and LEGACY_ROW_GROUPS_PER_FILE_KEY in info.common_metadata.metadata:
+        entries[LEGACY_ROW_GROUPS_PER_FILE_KEY] = counts_json
+    update_dataset_metadata(info, entries)
+
+
+class CompactionDaemon:
+    """Standing compaction job: re-plans on an interval, folds when the
+    small-file count crosses the floor, gc-sweeps superseded files after
+    a grace window. One daemon per dataset; idempotent start/stop."""
+
+    def __init__(self, dataset_url, interval_s=30.0, gc_grace_s=300.0,
+                 storage_options=None):
+        self._url = dataset_url
+        self._interval_s = interval_s
+        self._gc_grace_s = gc_grace_s
+        self._storage_options = storage_options
+        self._stop = threading.Event()
+        self._thread = None
+        self.runs = 0
+
+    def start(self):
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run,
+                                        name='pt-compactd', daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.wait(self._interval_s):
+            try:
+                if compact_dataset(self._url,
+                                   storage_options=self._storage_options,
+                                   gc_grace_s=self._gc_grace_s) is not None:
+                    self.runs += 1
+            except Exception:  # noqa: BLE001 - a standing job never dies
+                logger.exception('compaction daemon: pass failed for %s',
+                                 self._url)
+
+    def stop(self):
+        self._stop.set()
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=30)

@@ -1,0 +1,232 @@
+"""The commit manifest: atomic, monotonic dataset generations.
+
+A manifest-bearing dataset has ONE commit point: ``_manifest.json`` at
+the dataset root, swapped atomically (tmp + rename) by every writer
+commit, compaction fold and append. The manifest is the committed truth
+about which part files ARE the dataset:
+
+* **Exactly-once publication** — part files are written under invisible
+  ``.tmp.`` names and renamed into place before the manifest swap; a
+  writer SIGKILL mid-write leaves only tmp litter (purged by the next
+  commit) and the previous manifest generation committed. Readers
+  (:class:`petastorm_tpu.etl.dataset_metadata.ParquetDatasetInfo`
+  consults the manifest before falling back to a directory walk) can
+  never observe a torn dataset.
+* **Determinism** — the manifest carries NO wall-clock state: the same
+  rows committed through any retry/failover path serialize to
+  byte-identical manifest JSON (the crash-safety contract the chaos
+  drill asserts). Staleness questions are answered from the manifest
+  *file's* mtime, not embedded timestamps.
+* **Monotonic generations** — every commit bumps ``generation`` by one;
+  an append adds file entries, a compaction replaces entries
+  (``replaces`` names the folded files, left on disk for in-flight
+  readers until :func:`gc_superseded`). Bounded-staleness followers
+  (:mod:`petastorm_tpu.write.append`) diff generations to deliver only
+  new rows.
+"""
+
+import json
+import logging
+import posixpath
+import time
+
+from petastorm_tpu import faults
+from petastorm_tpu.telemetry import get_registry, metrics_disabled
+
+logger = logging.getLogger(__name__)
+
+MANIFEST_NAME = '_manifest.json'
+MANIFEST_VERSION = 1
+
+#: committed-generation gauge (docs/telemetry.md): the latest generation
+#: this process published (writer commits, compaction folds)
+MANIFEST_GENERATION = 'petastorm_tpu_write_manifest_generation'
+WRITE_COMMITS = 'petastorm_tpu_write_commits_total'
+
+#: invisible-name prefix of in-flight part files and manifest swaps;
+#: leading '.' keeps them out of every discovery walk
+TMP_PREFIX = '.tmp.'
+
+#: tmp litter older than this is presumed orphaned by a dead writer and
+#: purged at the next commit (the decoded cache's age rule)
+_TMP_PURGE_AGE_S = 3600.0
+
+
+class ManifestError(RuntimeError):
+    """A manifest that exists but cannot be trusted (unparseable,
+    wrong version, non-monotonic swap attempt)."""
+
+
+def manifest_path(root_path):
+    return posixpath.join(root_path, MANIFEST_NAME)
+
+
+def file_entry(path, rows, row_groups, nbytes, source='append',
+               replaces=()):
+    """One committed part file. ``path`` is dataset-root-relative."""
+    return {'path': path, 'rows': int(rows), 'row_groups': int(row_groups),
+            'bytes': int(nbytes), 'source': source,
+            'replaces': sorted(replaces)}
+
+
+def build_manifest(files, generation=0, sort_key=None):
+    """A manifest dict, entries sorted by path (deterministic bytes)."""
+    return {
+        'version': MANIFEST_VERSION,
+        'generation': int(generation),
+        'sort_key': sort_key,
+        'files': sorted(files, key=lambda e: e['path']),
+    }
+
+
+def dumps(manifest):
+    """Canonical manifest bytes: sorted keys, fixed separators — the
+    byte-identical-across-retries contract."""
+    return json.dumps(manifest, sort_keys=True,
+                      separators=(',', ':')).encode('utf-8')
+
+
+def load(fs, root_path):
+    """The committed manifest at ``root_path``, or None when the dataset
+    carries none (plain parquet store)."""
+    path = manifest_path(root_path)
+    try:
+        if not fs.exists(path):
+            return None
+        with fs.open(path, 'rb') as f:
+            raw = f.read()
+    except (OSError, ValueError):
+        return None
+    try:
+        manifest = json.loads(raw.decode('utf-8'))
+    except (ValueError, UnicodeDecodeError) as e:
+        raise ManifestError('Unparseable manifest at %r: %s' % (path, e))
+    if manifest.get('version') != MANIFEST_VERSION:
+        raise ManifestError('Unsupported manifest version %r at %r'
+                            % (manifest.get('version'), path))
+    return manifest
+
+
+def staleness_s(fs, root_path):
+    """Age in seconds of the committed manifest (None without one) —
+    the reader-side ``max_staleness_s`` evidence. Filesystem mtime, not
+    embedded time: the manifest bytes stay deterministic."""
+    path = manifest_path(root_path)
+    try:
+        info = fs.info(path)
+    except (OSError, FileNotFoundError, ValueError):
+        return None
+    mtime = info.get('mtime')
+    if mtime is None:
+        return None
+    if hasattr(mtime, 'timestamp'):
+        mtime = mtime.timestamp()
+    return max(0.0, time.time() - float(mtime))
+
+
+def publish(fs, root_path, manifest):
+    """Atomically swap the committed manifest (tmp + rename) after
+    proving the swap monotonic against the generation on storage."""
+    current = load(fs, root_path)
+    if current is not None and manifest['generation'] <= current['generation']:
+        raise ManifestError(
+            'Manifest swap is not monotonic: committed generation %d, '
+            'attempted %d' % (current['generation'], manifest['generation']))
+    path = manifest_path(root_path)
+    tmp = posixpath.join(root_path, TMP_PREFIX + MANIFEST_NAME)
+    if faults.ARMED:
+        faults.fault_hit('io.write', key='%s#manifest' % path)
+    with fs.open(tmp, 'wb') as f:
+        f.write(dumps(manifest))
+    fs.mv(tmp, path)
+    if not metrics_disabled():
+        registry = get_registry()
+        registry.counter(WRITE_COMMITS).inc()
+        registry.gauge(MANIFEST_GENERATION).set(manifest['generation'])
+    logger.debug('write: committed manifest generation %d (%d files)',
+                 manifest['generation'], len(manifest['files']))
+    return manifest
+
+
+def committed_paths(manifest, root_path):
+    """Absolute paths of the manifest's committed part files."""
+    return [posixpath.join(root_path, e['path']) for e in manifest['files']]
+
+
+def row_group_counts(manifest):
+    """``{relative path: row-group count}`` for the metadata footer —
+    the commit already knows every count, so the footer write pays zero
+    re-scans."""
+    return {e['path']: e['row_groups'] for e in manifest['files']}
+
+
+def purge_stale_tmp(fs, root_path, max_age_s=_TMP_PURGE_AGE_S):
+    """Remove ``.tmp.`` litter orphaned by dead writers. Age-gated so a
+    concurrent live writer's in-flight tmp is never yanked; purged count
+    returned (best-effort: a racing delete is not an error)."""
+    purged = 0
+    try:
+        listing = fs.ls(root_path, detail=True)
+    except (OSError, FileNotFoundError, ValueError):
+        return 0
+    now = time.time()
+    for entry in listing:
+        name = posixpath.basename(entry.get('name', ''))
+        if not name.startswith(TMP_PREFIX):
+            continue
+        mtime = entry.get('mtime')
+        if hasattr(mtime, 'timestamp'):
+            mtime = mtime.timestamp()
+        if mtime is not None and now - float(mtime) < max_age_s:
+            continue
+        try:
+            fs.rm(entry['name'])
+            purged += 1
+        except (OSError, FileNotFoundError, ValueError):
+            pass
+    if purged:
+        logger.info('write: purged %d stale tmp file(s) under %s',
+                    purged, root_path)
+    return purged
+
+
+def gc_superseded(fs, root_path, grace_s=0.0):
+    """Delete data files on disk that the committed manifest no longer
+    references (compaction leftovers), once they are at least
+    ``grace_s`` seconds older than the manifest — in-flight readers
+    that opened the previous generation keep their files until the
+    grace window passes. Returns the removed paths."""
+    manifest = load(fs, root_path)
+    if manifest is None:
+        return []
+    committed = {e['path'] for e in manifest['files']}
+    manifest_age = staleness_s(fs, root_path)
+    removed = []
+    try:
+        listing = fs.find(root_path, detail=True)
+    except TypeError:
+        listing = {p: fs.info(p) for p in fs.find(root_path)}
+    for path, entry in sorted(listing.items()):
+        rel = posixpath.relpath(path, root_path.rstrip('/'))
+        segments = rel.split('/')
+        if any(seg.startswith(('.', '_')) for seg in segments):
+            continue
+        if rel in committed:
+            continue
+        if grace_s > 0:
+            mtime = entry.get('mtime')
+            if hasattr(mtime, 'timestamp'):
+                mtime = mtime.timestamp()
+            age_past_swap = (None if mtime is None or manifest_age is None
+                             else (time.time() - float(mtime)) - manifest_age)
+            if age_past_swap is None or age_past_swap < grace_s:
+                continue
+        try:
+            fs.rm(path)
+            removed.append(rel)
+        except (OSError, FileNotFoundError, ValueError):
+            pass
+    if removed:
+        logger.info('write: garbage-collected %d superseded file(s) '
+                    'under %s', len(removed), root_path)
+    return removed

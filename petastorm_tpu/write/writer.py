@@ -1,0 +1,355 @@
+"""Fleet-ETL writer: sharded encode+write over any worker pool.
+
+:class:`DistributedDatasetWriter` shards row batches across the uniform
+pool contract (``start/ventilate/get_results/stop`` — thread, process,
+or the PR 13/16 service fleet, which brings job registration, QoS
+weights, retries and chaos faultpoints for free), with the
+single-process :class:`~petastorm_tpu.etl.dataset_metadata.DatasetWriter`
+as the degenerate local backend: pass ``pool=None`` and shards run
+inline through the *same* :class:`WriteShardWorker` code path, so
+local and fleet writes are byte-equivalent.
+
+Exactly-once publication (the crash-safety contract):
+
+1. a shard worker writes its part files under invisible ``.tmp.`` names
+   (discovery skips dotted segments), then renames each into a
+   **deterministic** final name ``part-g<gen>-s<shard>-<seq>.parquet``;
+2. a SIGKILLed / faulted attempt leaves only tmp litter — the pool
+   re-ventilates the shard and the retry republishes byte-identical
+   files onto the same names (rename-over-rename is a safe replace);
+3. the coordinator commits by swapping ``_manifest.json``
+   (:mod:`petastorm_tpu.write.manifest`) *after* the metadata footer —
+   readers either see the previous generation or the complete new one,
+   never a torn mix.
+"""
+
+import json
+import logging
+import posixpath
+
+import pyarrow.parquet as pq
+
+from petastorm_tpu import faults
+from petastorm_tpu.etl.dataset_metadata import (
+    LEGACY_ROW_GROUPS_PER_FILE_KEY, LEGACY_UNISCHEMA_KEY,
+    ROW_GROUPS_PER_FILE_KEY, UNISCHEMA_KEY, DatasetWriter,
+    ParquetDatasetInfo, update_dataset_metadata,
+)
+from petastorm_tpu.errors import MetadataError
+from petastorm_tpu.fs import get_filesystem_and_path_or_paths, normalize_dir_url
+from petastorm_tpu.telemetry import get_registry, knobs, metrics_disabled
+from petastorm_tpu.unischema import Unischema
+from petastorm_tpu.workers.worker_base import WorkerBase
+from petastorm_tpu.write import layout, manifest
+from petastorm_tpu.write.manifest import TMP_PREFIX
+
+logger = logging.getLogger(__name__)
+
+WRITE_ROWS = 'petastorm_tpu_write_rows_total'
+WRITE_BYTES = 'petastorm_tpu_write_bytes_total'
+WRITE_FILES = 'petastorm_tpu_write_files_total'
+
+_MB = 1024 * 1024
+
+
+def _default_shard_rows():
+    return knobs.get_int('PETASTORM_TPU_WRITE_SHARD_ROWS', 4096, floor=1)
+
+
+def _default_encode_workers():
+    return knobs.get_int('PETASTORM_TPU_WRITE_WORKERS', 0, floor=0)
+
+
+class WriteShardWorker(WorkerBase):
+    """Writes ONE ventilated shard of rows as tmp part files and renames
+    them into their deterministic final names.
+
+    ``worker_args``: ``{'dataset_url', 'schema_json', 'generation',
+    'rowgroup_size_rows', 'rowgroup_size_mb', 'compression', 'sort_by',
+    'encode_workers', 'storage_options'}`` — everything picklable, so
+    the same spec ships to thread, process and service-fleet workers.
+    Publishes ``{'shard': id, 'entries': [manifest file entries]}``.
+    """
+
+    def initialize(self):
+        self._schema = Unischema.from_json_dict(self.args['schema_json'])
+        self.fs, self.root_path = get_filesystem_and_path_or_paths(
+            self.args['dataset_url'], self.args.get('storage_options'))
+
+    def process(self, shard_id, rows):
+        a = self.args
+        final_prefix = 'part-g%04d-s%05d' % (a['generation'], shard_id)
+        if faults.ARMED:
+            faults.fault_hit('io.write', key='%s/%s#part'
+                             % (self.root_path, final_prefix))
+        writer = DatasetWriter(
+            a['dataset_url'], self._schema,
+            rowgroup_size_rows=a['rowgroup_size_rows'],
+            rowgroup_size_mb=a['rowgroup_size_mb'],
+            compression=a['compression'],
+            file_prefix=TMP_PREFIX + final_prefix,
+            sort_by=a['sort_by'],
+            workers_count=a['encode_workers'],
+            storage_options=a.get('storage_options'))
+        try:
+            writer.write_row_dicts(rows)
+            writer.close()
+        except BaseException:
+            writer.abort()
+            raise
+        entries = []
+        total_rows = 0
+        total_bytes = 0
+        for tmp_path in writer.paths_written:
+            directory, tmp_name = posixpath.split(tmp_path)
+            assert tmp_name.startswith(TMP_PREFIX), tmp_name
+            final_path = posixpath.join(directory, tmp_name[len(TMP_PREFIX):])
+            if faults.ARMED:
+                faults.fault_hit('io.write', key='%s#rename' % final_path)
+            try:
+                self.fs.mv(tmp_path, final_path)
+            except FileExistsError:
+                # retry of a shard whose earlier attempt already renamed
+                # this file: the rewrite is byte-identical, replace it
+                self.fs.rm(final_path)
+                self.fs.mv(tmp_path, final_path)
+            with self.fs.open(final_path, 'rb') as f:
+                meta = pq.read_metadata(f)
+            nbytes = int(self.fs.info(final_path)['size'])
+            rel = posixpath.relpath(final_path, self.root_path.rstrip('/'))
+            entries.append(manifest.file_entry(
+                rel, meta.num_rows, meta.num_row_groups, nbytes,
+                source='write'))
+            total_rows += int(meta.num_rows)
+            total_bytes += nbytes
+        if not metrics_disabled():
+            registry = get_registry()
+            registry.counter(WRITE_ROWS).inc(total_rows)
+            registry.counter(WRITE_BYTES).inc(total_bytes)
+            registry.counter(WRITE_FILES).inc(len(entries))
+        self.publish_func({'shard': shard_id, 'entries': entries})
+
+
+class DistributedDatasetWriter:
+    """Distributed (or degenerate-local) dataset writer with manifest
+    commit. Usage::
+
+        with DistributedDatasetWriter(url, schema, pool=ServicePool(...),
+                                      sort_by='id') as w:
+            w.write_row_dicts(rows)
+        # exit publishes: part files, metadata footer, manifest commit
+
+    ``pool=None`` runs every shard inline through the same
+    :class:`WriteShardWorker` (the local backend); any object honoring
+    the pool contract distributes them. The pool must be constructed but
+    NOT started — this writer owns its start/stop lifecycle.
+
+    ``append=True`` stacks a new manifest generation on top of the
+    committed one (rows become visible to bounded-staleness readers at
+    the commit); ``append=False`` requires a manifest-free target.
+    Hive partitioning stays a :class:`DatasetWriter`-only feature — the
+    deterministic shard naming the exactly-once contract rests on does
+    not compose with row-value-dependent directories.
+    """
+
+    def __init__(self, dataset_url, schema, pool=None, shard_rows=None,
+                 rowgroup_size_rows=100000, rowgroup_size_mb=None,
+                 compression='auto', sort_by=None, append=False,
+                 storage_options=None):
+        self.schema = schema
+        self.sort_by = sort_by
+        self._url = normalize_dir_url(dataset_url)
+        self._storage_options = storage_options
+        self.fs, self.root_path = get_filesystem_and_path_or_paths(
+            self._url, storage_options)
+        self.fs.makedirs(self.root_path, exist_ok=True)
+        committed = manifest.load(self.fs, self.root_path)
+        if committed is not None and not append:
+            raise ValueError(
+                'Dataset %r already carries a committed manifest '
+                '(generation %d); pass append=True to stack a new '
+                'generation' % (dataset_url, committed['generation']))
+        self._base_entries = list(committed['files']) if committed else []
+        self.generation = (committed['generation'] if committed else 0) + 1
+        if committed and sort_by is None:
+            self.sort_by = committed.get('sort_key')
+        self._pool = pool
+        self._pool_started = False
+        self._shard_rows = shard_rows or _default_shard_rows()
+        if rowgroup_size_mb is None:
+            rowgroup_size_mb = max(1, layout.target_rowgroup_bytes() // _MB)
+        self._worker_args = {
+            'dataset_url': self._url,
+            'schema_json': schema.to_json_dict(),
+            'generation': self.generation,
+            'rowgroup_size_rows': rowgroup_size_rows,
+            'rowgroup_size_mb': rowgroup_size_mb,
+            'compression': compression,
+            'sort_by': self.sort_by,
+            'encode_workers': _default_encode_workers(),
+            'storage_options': storage_options,
+        }
+        self._buffer = []
+        self._shards_dispatched = 0
+        self._inline_results = []
+        self._inline_worker = None
+        self.manifest = None  #: the committed manifest, set by close()
+        self.last_self_check = None
+
+    # -- dispatch ----------------------------------------------------------
+
+    def write_row_dict(self, row_dict):
+        self._buffer.append(row_dict)
+        if len(self._buffer) >= self._shard_rows:
+            self._dispatch_shard()
+
+    def write_row_dicts(self, row_dicts):
+        for row in row_dicts:
+            self.write_row_dict(row)
+
+    def _dispatch_shard(self):
+        rows, self._buffer = self._buffer, []
+        if not rows:
+            return
+        shard_id = self._shards_dispatched
+        self._shards_dispatched += 1
+        if self._pool is None:
+            if self._inline_worker is None:
+                self._inline_worker = WriteShardWorker(
+                    0, self._inline_results.append, self._worker_args)
+                self._inline_worker.initialize()
+            self._inline_worker.process(shard_id, rows)
+            return
+        if not self._pool_started:
+            self._pool.start(WriteShardWorker, self._worker_args)
+            self._pool_started = True
+        self._pool.ventilate(shard_id=shard_id, rows=rows)
+
+    def _drain_pool(self):
+        if self._pool is None:
+            return list(self._inline_results)
+        results = []
+        while len(results) < self._shards_dispatched:
+            results.append(self._pool.get_results())
+        return results
+
+    # -- commit ------------------------------------------------------------
+
+    def close(self):
+        """Flush, drain every shard, write the metadata footer, commit
+        the manifest, then (unless ``PETASTORM_TPU_WRITE_SELF_CHECK`` is
+        disabled) run the layout self-check on the committed dataset."""
+        self._dispatch_shard()
+        try:
+            results = self._drain_pool()
+        finally:
+            self._stop_pool()
+        new_entries = [e for r in results for e in r['entries']]
+        entries = self._base_entries + new_entries
+        if not entries:
+            # zero-row dataset: one empty part keeps the store readable
+            with DatasetWriter(self._url, self.schema,
+                               file_prefix='part-g%04d-s00000' % self.generation,
+                               sort_by=self.sort_by,
+                               storage_options=self._storage_options) as w:
+                pass
+            path = w.paths_written[0]
+            rel = posixpath.relpath(path, self.root_path.rstrip('/'))
+            with self.fs.open(path, 'rb') as f:
+                meta = pq.read_metadata(f)
+            entries = [manifest.file_entry(
+                rel, meta.num_rows, meta.num_row_groups,
+                int(self.fs.info(path)['size']), source='write')]
+        built = manifest.build_manifest(entries, generation=self.generation,
+                                        sort_key=self.sort_by)
+        self._write_footer(built)
+        self.manifest = manifest.publish(self.fs, self.root_path, built)
+        manifest.purge_stale_tmp(self.fs, self.root_path)
+        if not knobs.is_disabled('PETASTORM_TPU_WRITE_SELF_CHECK'):
+            info = ParquetDatasetInfo(self._url, self._storage_options)
+            self.last_self_check = layout.self_check(info,
+                                                     sort_key=self.sort_by)
+
+    def _write_footer(self, built):
+        """Stamp ``_common_metadata`` (schema JSON + row-group counts)
+        from the manifest's already-known counts — zero footer re-scans,
+        and written BEFORE the manifest swap so a committed generation
+        always has its footer."""
+        info = ParquetDatasetInfo(self._url, self._storage_options,
+                                  validate=False)
+        # the footer must describe the NEW generation even though the
+        # committed manifest (append mode) still lists the previous one
+        info.file_paths = sorted(manifest.committed_paths(built,
+                                                          self.root_path))
+        counts_json = json.dumps(manifest.row_group_counts(built),
+                                 sort_keys=True).encode('utf-8')
+        entries = {
+            ROW_GROUPS_PER_FILE_KEY: counts_json,
+            UNISCHEMA_KEY: json.dumps(
+                self.schema.to_json_dict()).encode('utf-8'),
+        }
+        try:
+            from petastorm_tpu.etl.legacy import pickle_unischema_for_reference
+            entries[LEGACY_UNISCHEMA_KEY] = pickle_unischema_for_reference(
+                self.schema)
+            entries[LEGACY_ROW_GROUPS_PER_FILE_KEY] = counts_json
+        except MetadataError as e:
+            logger.debug('Not writing reference-compatible schema pickle: %s',
+                         e)
+        update_dataset_metadata(info, entries)
+
+    def _stop_pool(self):
+        if self._pool is not None and self._pool_started:
+            self._pool_started = False
+            self._pool.stop()
+            self._pool.join()
+
+    def abort(self):
+        """Exception-path teardown: stop the pool and sweep THIS
+        generation's litter (tmp files and any already-renamed parts of
+        the uncommitted generation). The committed manifest is untouched
+        — readers never knew this write happened."""
+        self._buffer = []
+        try:
+            self._stop_pool()
+        except Exception:  # noqa: BLE001 - teardown must reach the sweep
+            logger.exception('write abort: pool stop failed')
+        marker = 'part-g%04d-' % self.generation
+        try:
+            listing = self.fs.ls(self.root_path, detail=False)
+        except (OSError, FileNotFoundError, ValueError):
+            return
+        for path in listing:
+            name = posixpath.basename(path)
+            if name == marker or name.startswith(marker) \
+                    or name.startswith(TMP_PREFIX + marker):
+                try:
+                    self.fs.rm(path)
+                except (OSError, FileNotFoundError, ValueError):
+                    pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        if exc_type is None:
+            self.close()
+        else:
+            self.abort()
+
+
+def write_dataset_distributed(dataset_url, schema, rows, pool=None,
+                              sort_by=None, append=False, shard_rows=None,
+                              rowgroup_size_rows=100000,
+                              rowgroup_size_mb=None,
+                              storage_options=None):
+    """One-call distributed materialization; returns the committed
+    :class:`DistributedDatasetWriter` (manifest + self-check report)."""
+    writer = DistributedDatasetWriter(
+        dataset_url, schema, pool=pool, shard_rows=shard_rows,
+        rowgroup_size_rows=rowgroup_size_rows,
+        rowgroup_size_mb=rowgroup_size_mb, sort_by=sort_by, append=append,
+        storage_options=storage_options)
+    with writer:
+        writer.write_row_dicts(rows)
+    return writer

@@ -49,6 +49,9 @@ TRACKED = (
     # streaming mixture engine (bench mixture_stream section)
     'mixture_packed_tokens_per_sec',
     'mixture_fill_ratio',
+    # distributed write plane (bench write_throughput section)
+    'write_rows_per_sec',
+    'write_compact_read_speedup',
     'native_decode_speedup',
     'imagenet_batch_rows_per_sec',
     'imagenet_jax_rows_per_sec',
