@@ -13,12 +13,16 @@ replay buffers) consumes:
 * Compaction-aware: a ``source='compact'`` entry whose ``replaces`` were
   all already delivered is *skipped* — its rows already flowed through
   the old files, and redelivering them would break exactly-once. A
-  folded entry covering never-seen sources is delivered (minus nothing:
-  folds replace whole files, so delivery stays file-granular and
-  multiset-exact).
+  folded entry covering never-seen sources is delivered whole. A fold
+  that MIXES delivered and undelivered sources (compaction groups small
+  files across generations) is not delivered either way: the follower
+  reads the still-on-disk undelivered source files directly (superseded
+  files survive until ``gc_superseded``'s grace window passes), so
+  delivery stays file-granular and multiset-exact.
 """
 
 import logging
+import posixpath
 import threading
 import time
 
@@ -68,7 +72,11 @@ class AppendFollower:
 
     def _fresh_entries(self):
         """Undelivered manifest entries of the latest committed
-        generation, compact-fold redelivery filtered out."""
+        generation, compact-fold redelivery filtered out. A fold that
+        mixes delivered and undelivered sources comes back as pseudo
+        entries for the undelivered SOURCE files (read directly off
+        disk), never the fold itself — delivering the fold would
+        redeliver the consumed part and break exactly-once."""
         committed = manifest.load(self.fs, self.root_path)
         if committed is None or committed['generation'] <= self.generation:
             return None
@@ -77,13 +85,47 @@ class AppendFollower:
             if entry['path'] in self._delivered:
                 continue
             replaces = entry.get('replaces') or []
-            if replaces and all(p in self._delivered for p in replaces):
+            undelivered = [p for p in replaces if p not in self._delivered]
+            if replaces and not undelivered:
                 # fold of fully-delivered sources: rows already flowed
                 self._delivered.add(entry['path'])
                 continue
+            if replaces and len(undelivered) < len(replaces):
+                on_disk = [p for p in undelivered if self._on_disk(p)]
+                if len(on_disk) == len(undelivered):
+                    # ``settles`` marks the fold delivered once its last
+                    # undelivered source has been read
+                    fresh.extend({'path': p, 'settles': entry['path']}
+                                 for p in undelivered)
+                    continue
+                logger.warning(
+                    'append follower: fold %r mixes delivered and '
+                    'undelivered sources but %d source file(s) are already '
+                    'garbage-collected; delivering the whole fold (bounded '
+                    'redelivery — keep the gc grace window above the '
+                    'follower poll interval to avoid this)',
+                    entry['path'], len(undelivered) - len(on_disk))
             fresh.append(entry)
         self.generation = committed['generation']
         return fresh
+
+    def _on_disk(self, rel_path):
+        try:
+            return self.fs.exists(posixpath.join(self.root_path, rel_path))
+        except (OSError, ValueError):
+            return False
+
+    def _mark_delivered(self, fresh):
+        """Record a read batch of entries as delivered — the entries
+        themselves, every source file they folded (those rows flowed
+        through the fold), and any fold a direct source read settles."""
+        for entry in fresh:
+            self._delivered.add(entry['path'])
+            for p in entry.get('replaces') or []:
+                self._delivered.add(p)
+            settles = entry.get('settles')
+            if settles is not None:
+                self._delivered.add(settles)
 
     def __iter__(self):
         idle_since = time.monotonic()
@@ -99,8 +141,7 @@ class AppendFollower:
                 # delivery marked AFTER the read: a crash mid-read means
                 # redelivery next iteration (at-least-once within one
                 # follower restart; exactly-once within a live follower)
-                for entry in fresh:
-                    self._delivered.add(entry['path'])
+                self._mark_delivered(fresh)
                 continue
             if (self._stop_after_idle_s is not None
                     and time.monotonic() - idle_since

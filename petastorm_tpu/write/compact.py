@@ -16,7 +16,9 @@ request-per-file on real storage. :func:`compact_dataset` folds them:
   reading the old files (left on disk until
   :func:`~petastorm_tpu.write.manifest.gc_superseded`); a reader that
   resolves after the swap sees only folded files. No interleaving —
-  concurrent reads stay multiset-exact.
+  concurrent reads stay multiset-exact. The commit section holds the
+  manifest lease, so a racing append commit rebases instead of being
+  lost.
 * **Standing service** — :class:`CompactionDaemon` rides the PR 13
   daemon pattern: a background thread re-plans on an interval and folds
   whenever at least ``PETASTORM_TPU_COMPACT_MIN_FILES`` parts undershoot
@@ -130,10 +132,16 @@ def compact_dataset(dataset_url, storage_options=None, target_bytes=None,
     """One compaction pass. Returns the new committed manifest, or None
     when there was nothing to fold (or no manifest to fold under).
 
+    The fold (the data rewrite) runs lock-free; the commit section —
+    rebase onto the latest committed manifest, footer restamp, manifest
+    swap — holds the commit lease, so an append commit that landed
+    mid-fold keeps its files and a fold whose sources were concurrently
+    replaced is dropped instead of resurrecting folded-away rows.
+
     Source files are NOT deleted here — they back any reader that
     resolved the previous generation. Pass ``gc_grace_s`` to also sweep
-    superseded files older than the grace window (a standing daemon's
-    second pass does this)."""
+    superseded files once the swap outlives the grace window (a
+    standing daemon's second pass does this)."""
     url = normalize_dir_url(dataset_url)
     fs, root_path = get_filesystem_and_path_or_paths(url, storage_options)
     committed = manifest.load(fs, root_path)
@@ -149,19 +157,37 @@ def compact_dataset(dataset_url, storage_options=None, target_bytes=None,
         folded_entries.append(_fold_group(
             fs, root_path, group, generation, group_id, rowgroup_bytes,
             sort_key=committed.get('sort_key')))
-    replaced = {path for e in folded_entries for path in e['replaces']}
-    survivors = [e for e in committed['files'] if e['path'] not in replaced]
-    new_manifest = manifest.build_manifest(
-        survivors + folded_entries, generation=generation,
-        sort_key=committed.get('sort_key'))
-    _restamp_footer(url, fs, root_path, new_manifest, storage_options)
-    published = manifest.publish(fs, root_path, new_manifest)
+    with manifest.commit_lock(fs, root_path):
+        latest = manifest.load(fs, root_path) or committed
+        latest_paths = {e['path'] for e in latest['files']}
+        surviving_folds = []
+        for entry in folded_entries:
+            if all(p in latest_paths for p in entry['replaces']):
+                surviving_folds.append(entry)
+                continue
+            # a concurrent committer already replaced some source of
+            # this fold: publishing it would resurrect folded-away rows
+            try:
+                fs.rm(posixpath.join(root_path, entry['path']))
+            except (OSError, FileNotFoundError, ValueError):
+                pass
+        if not surviving_folds:
+            return None
+        generation = latest['generation'] + 1
+        replaced = {p for e in surviving_folds for p in e['replaces']}
+        survivors = [e for e in latest['files'] if e['path'] not in replaced]
+        new_manifest = manifest.build_manifest(
+            survivors + surviving_folds, generation=generation,
+            sort_key=latest.get('sort_key'))
+        _restamp_footer(url, fs, root_path, new_manifest, storage_options)
+        published = manifest.publish(fs, root_path, new_manifest,
+                                     locked=True)
     if not metrics_disabled():
         registry = get_registry()
         registry.counter(COMPACT_RUNS).inc()
         registry.counter(COMPACT_FILES_FOLDED).inc(len(replaced))
     logger.info('compact: folded %d file(s) into %d under %s '
-                '(generation %d)', len(replaced), len(folded_entries),
+                '(generation %d)', len(replaced), len(surviving_folds),
                 root_path, generation)
     if gc_grace_s is not None:
         manifest.gc_superseded(fs, root_path, grace_s=gc_grace_s)
@@ -170,19 +196,28 @@ def compact_dataset(dataset_url, storage_options=None, target_bytes=None,
 
 def _restamp_footer(url, fs, root_path, new_manifest, storage_options):
     """Refresh the row-group counts in ``_common_metadata`` for the new
-    file set. The schema entries are preserved as-is (fold is
+    file set, merged over the previously stamped counts: a reader
+    holding the pre-swap generation's file list still finds counts for
+    the superseded files it resolves (they stay on disk until
+    ``gc_superseded``). The schema entries are preserved as-is (fold is
     arrow-level: Unischema fidelity is untouched)."""
     import json
 
     from petastorm_tpu.etl.dataset_metadata import (
         LEGACY_ROW_GROUPS_PER_FILE_KEY, ROW_GROUPS_PER_FILE_KEY,
         ParquetDatasetInfo, update_dataset_metadata,
+        _row_group_counts_from_common_metadata,
     )
     info = ParquetDatasetInfo(url, storage_options, validate=False)
     info.file_paths = sorted(manifest.committed_paths(new_manifest,
                                                       root_path))
-    counts_json = json.dumps(manifest.row_group_counts(new_manifest),
-                             sort_keys=True).encode('utf-8')
+    try:
+        previous = _row_group_counts_from_common_metadata(info)
+    except (OSError, ValueError):
+        previous = None
+    counts = manifest.merge_footer_counts(
+        fs, root_path, manifest.row_group_counts(new_manifest), previous)
+    counts_json = json.dumps(counts, sort_keys=True).encode('utf-8')
     entries = {ROW_GROUPS_PER_FILE_KEY: counts_json}
     if info.common_metadata is not None and info.common_metadata.metadata \
             and LEGACY_ROW_GROUPS_PER_FILE_KEY in info.common_metadata.metadata:

@@ -23,6 +23,10 @@ about which part files ARE the dataset:
   readers until :func:`gc_superseded`). Bounded-staleness followers
   (:mod:`petastorm_tpu.write.append`) diff generations to deliver only
   new rows.
+* **Serialized commits** — every load→swap critical section holds the
+  ``_manifest.lock`` lease (:class:`CommitLock`), so concurrent
+  committers (append writer vs. compaction daemon) rebase onto each
+  other instead of the last rename silently dropping the loser's files.
 """
 
 import json
@@ -88,14 +92,17 @@ def dumps(manifest):
 
 def load(fs, root_path):
     """The committed manifest at ``root_path``, or None when the dataset
-    carries none (plain parquet store)."""
+    carries none (plain parquet store). Only a *missing* manifest maps
+    to None — a transient IO error propagates, so callers never silently
+    degrade to the torn directory-walk view (or restart at generation 1)
+    just because storage hiccuped."""
     path = manifest_path(root_path)
     try:
         if not fs.exists(path):
             return None
         with fs.open(path, 'rb') as f:
             raw = f.read()
-    except (OSError, ValueError):
+    except FileNotFoundError:
         return None
     try:
         manifest = json.loads(raw.decode('utf-8'))
@@ -124,9 +131,121 @@ def staleness_s(fs, root_path):
     return max(0.0, time.time() - float(mtime))
 
 
-def publish(fs, root_path, manifest):
+#: lease file serializing manifest commits; underscore prefix keeps it
+#: out of every discovery walk (and the gc sweep)
+LOCK_NAME = '_manifest.lock'
+_LOCK_STALE_S = 60.0
+_LOCK_TIMEOUT_S = 120.0
+_LOCK_POLL_S = 0.05
+
+
+class CommitLock:
+    """Lease file serializing manifest commits under one dataset root.
+
+    Without it, two concurrent committers (an append writer racing the
+    compaction daemon, or two appenders) can both load generation G,
+    both pass the monotonic check and both swap G+1 — the last rename
+    wins and the loser's files silently leave the manifest, to be
+    deleted by :func:`gc_superseded` (durable loss of acknowledged
+    writes). :func:`publish` and the read-modify-write commit sections
+    in the writer and compactor hold this lease across load→swap, so
+    racers serialize and rebase instead.
+
+    The lease is taken with exclusive create (``xb``); a lease older
+    than ``stale_s`` is presumed orphaned by a dead committer and
+    broken. Acquisition past ``timeout_s`` raises :class:`ManifestError`
+    rather than waiting forever.
+    """
+
+    def __init__(self, fs, root_path, timeout_s=_LOCK_TIMEOUT_S,
+                 stale_s=_LOCK_STALE_S):
+        self._fs = fs
+        self._path = posixpath.join(root_path, LOCK_NAME)
+        self._timeout_s = timeout_s
+        self._stale_s = stale_s
+        self._held = False
+
+    def _try_create(self):
+        try:
+            with self._fs.open(self._path, 'xb') as f:
+                f.write(b'petastorm_tpu commit lease')
+            return True
+        except FileExistsError:
+            return False
+        except (ValueError, NotImplementedError):
+            # no exclusive-create on this filesystem: degrade to
+            # check-then-create (window shrinks to one fs call)
+            if self._fs.exists(self._path):
+                return False
+            with self._fs.open(self._path, 'wb') as f:
+                f.write(b'petastorm_tpu commit lease')
+            return True
+
+    def _break_if_stale(self):
+        try:
+            info = self._fs.info(self._path)
+        except (OSError, ValueError):
+            return
+        mtime = info.get('mtime')
+        if hasattr(mtime, 'timestamp'):
+            mtime = mtime.timestamp()
+        if mtime is None or time.time() - float(mtime) < self._stale_s:
+            return
+        logger.warning('write: breaking stale commit lease %s (older than '
+                       '%.0fs)', self._path, self._stale_s)
+        try:
+            self._fs.rm(self._path)
+        except (OSError, FileNotFoundError, ValueError):
+            pass
+
+    def acquire(self):
+        deadline = time.monotonic() + self._timeout_s
+        while True:
+            if self._try_create():
+                self._held = True
+                return self
+            self._break_if_stale()
+            if time.monotonic() >= deadline:
+                raise ManifestError(
+                    'Commit lease %r held past the %.1fs timeout — another '
+                    'committer is live (or died inside the stale window)'
+                    % (self._path, self._timeout_s))
+            time.sleep(_LOCK_POLL_S)
+
+    def release(self):
+        if not self._held:
+            return
+        self._held = False
+        try:
+            self._fs.rm(self._path)
+        except (OSError, FileNotFoundError, ValueError):
+            pass
+
+    def __enter__(self):
+        return self.acquire()
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.release()
+
+
+def commit_lock(fs, root_path, **kwargs):
+    """The commit lease for ``root_path`` (see :class:`CommitLock`)."""
+    return CommitLock(fs, root_path, **kwargs)
+
+
+def publish(fs, root_path, manifest, locked=False, lock_timeout_s=None):
     """Atomically swap the committed manifest (tmp + rename) after
-    proving the swap monotonic against the generation on storage."""
+    proving the swap monotonic against the generation on storage.
+
+    Load, check and swap run under the commit lease — pass
+    ``locked=True`` only when the caller already holds it (the writer's
+    and compactor's read-modify-write commit sections do, so their
+    rebase and the swap are one critical section)."""
+    if not locked:
+        kwargs = ({} if lock_timeout_s is None
+                  else {'timeout_s': lock_timeout_s})
+        with CommitLock(fs, root_path, **kwargs):
+            return publish(fs, root_path, manifest, locked=True)
     current = load(fs, root_path)
     if current is not None and manifest['generation'] <= current['generation']:
         raise ManifestError(
@@ -192,20 +311,32 @@ def purge_stale_tmp(fs, root_path, max_age_s=_TMP_PURGE_AGE_S):
 
 def gc_superseded(fs, root_path, grace_s=0.0):
     """Delete data files on disk that the committed manifest no longer
-    references (compaction leftovers), once they are at least
-    ``grace_s`` seconds older than the manifest — in-flight readers
-    that opened the previous generation keep their files until the
-    grace window passes. Returns the removed paths."""
-    manifest = load(fs, root_path)
-    if manifest is None:
+    references (compaction leftovers), once the grace window has passed.
+
+    The window is measured from the **manifest swap** — the manifest
+    file's mtime IS the moment the files became superseded — so nothing
+    is deleted until the swap itself is at least ``grace_s`` old: a
+    reader that resolved the previous generation seconds before the
+    swap keeps every file it may hold, no matter how long ago those
+    files were *written*. Each candidate must additionally be
+    ``grace_s`` old itself, which protects parts an in-flight writer
+    has renamed but not yet committed. Returns the removed paths."""
+    committed_manifest = load(fs, root_path)
+    if committed_manifest is None:
         return []
-    committed = {e['path'] for e in manifest['files']}
-    manifest_age = staleness_s(fs, root_path)
+    committed = {e['path'] for e in committed_manifest['files']}
+    if grace_s > 0:
+        swap_age = staleness_s(fs, root_path)
+        if swap_age is None or swap_age < grace_s:
+            # the swap that superseded these files is younger than the
+            # grace window: in-flight readers may still hold them
+            return []
     removed = []
     try:
         listing = fs.find(root_path, detail=True)
     except TypeError:
         listing = {p: fs.info(p) for p in fs.find(root_path)}
+    now = time.time()
     for path, entry in sorted(listing.items()):
         rel = posixpath.relpath(path, root_path.rstrip('/'))
         segments = rel.split('/')
@@ -217,9 +348,7 @@ def gc_superseded(fs, root_path, grace_s=0.0):
             mtime = entry.get('mtime')
             if hasattr(mtime, 'timestamp'):
                 mtime = mtime.timestamp()
-            age_past_swap = (None if mtime is None or manifest_age is None
-                             else (time.time() - float(mtime)) - manifest_age)
-            if age_past_swap is None or age_past_swap < grace_s:
+            if mtime is None or now - float(mtime) < grace_s:
                 continue
         try:
             fs.rm(path)
@@ -230,3 +359,26 @@ def gc_superseded(fs, root_path, grace_s=0.0):
         logger.info('write: garbage-collected %d superseded file(s) '
                     'under %s', len(removed), root_path)
     return removed
+
+
+def merge_footer_counts(fs, root_path, counts, previous):
+    """Row-group counts for the ``_common_metadata`` restamp: the new
+    generation's ``counts`` merged over the ``previous`` stamped map.
+
+    A reader holding the previous generation's file list (or opening
+    between the footer restamp and the manifest swap) resolves
+    superseded files — dropping their counts would fail its
+    ``load_row_groups`` with a missing-count error. Stale keys are
+    pruned once their backing file leaves the disk (``gc_superseded``),
+    keeping the map bounded."""
+    merged = dict(previous or {})
+    merged.update(counts)
+    for rel in list(merged):
+        if rel in counts:
+            continue
+        try:
+            if not fs.exists(posixpath.join(root_path, rel)):
+                del merged[rel]
+        except (OSError, ValueError):
+            pass
+    return merged

@@ -106,13 +106,7 @@ class WriteShardWorker(WorkerBase):
             final_path = posixpath.join(directory, tmp_name[len(TMP_PREFIX):])
             if faults.ARMED:
                 faults.fault_hit('io.write', key='%s#rename' % final_path)
-            try:
-                self.fs.mv(tmp_path, final_path)
-            except FileExistsError:
-                # retry of a shard whose earlier attempt already renamed
-                # this file: the rewrite is byte-identical, replace it
-                self.fs.rm(final_path)
-                self.fs.mv(tmp_path, final_path)
+            self._publish_part(tmp_path, final_path)
             with self.fs.open(final_path, 'rb') as f:
                 meta = pq.read_metadata(f)
             nbytes = int(self.fs.info(final_path)['size'])
@@ -128,6 +122,33 @@ class WriteShardWorker(WorkerBase):
             registry.counter(WRITE_BYTES).inc(total_bytes)
             registry.counter(WRITE_FILES).inc(len(entries))
         self.publish_func({'shard': shard_id, 'entries': entries})
+
+    def _publish_part(self, tmp_path, final_path):
+        """Rename one tmp part onto its deterministic final name. An
+        occupied name is byte-compared: a retry of this shard
+        republishes identical bytes (keep the committed copy),
+        different bytes mean a CONCURRENT writer took the same
+        generation — fail loudly instead of silently replacing another
+        commit's data."""
+        try:
+            occupied = self.fs.exists(final_path)
+        except (OSError, ValueError):
+            occupied = False
+        if not occupied:
+            self.fs.mv(tmp_path, final_path)
+            return
+        with self.fs.open(final_path, 'rb') as f:
+            committed_bytes = f.read()
+        with self.fs.open(tmp_path, 'rb') as f:
+            our_bytes = f.read()
+        if committed_bytes != our_bytes:
+            raise RuntimeError(
+                'write: part name collision at %r — a concurrent writer '
+                'committed different bytes under this generation\'s '
+                'deterministic name; re-open the writer (append=True) to '
+                'take a fresh generation' % final_path)
+        # byte-identical retry leftover: the committed copy stands
+        self.fs.rm(tmp_path)
 
 
 class DistributedDatasetWriter:
@@ -169,6 +190,7 @@ class DistributedDatasetWriter:
                 'Dataset %r already carries a committed manifest '
                 '(generation %d); pass append=True to stack a new '
                 'generation' % (dataset_url, committed['generation']))
+        self._append = bool(append)
         self._base_entries = list(committed['files']) if committed else []
         self.generation = (committed['generation'] if committed else 0) + 1
         if committed and sort_by is None:
@@ -238,15 +260,20 @@ class DistributedDatasetWriter:
     def close(self):
         """Flush, drain every shard, write the metadata footer, commit
         the manifest, then (unless ``PETASTORM_TPU_WRITE_SELF_CHECK`` is
-        disabled) run the layout self-check on the committed dataset."""
+        disabled) run the layout self-check on the committed dataset.
+
+        The commit section — rebase onto the latest committed manifest,
+        footer, swap — holds the commit lease: an append commit that
+        raced this writer (another appender on a different generation, a
+        compaction fold) keeps its files, and this commit stacks on top
+        instead of silently dropping it."""
         self._dispatch_shard()
         try:
             results = self._drain_pool()
         finally:
             self._stop_pool()
         new_entries = [e for r in results for e in r['entries']]
-        entries = self._base_entries + new_entries
-        if not entries:
+        if not (self._base_entries or new_entries):
             # zero-row dataset: one empty part keeps the store readable
             with DatasetWriter(self._url, self.schema,
                                file_prefix='part-g%04d-s00000' % self.generation,
@@ -257,13 +284,31 @@ class DistributedDatasetWriter:
             rel = posixpath.relpath(path, self.root_path.rstrip('/'))
             with self.fs.open(path, 'rb') as f:
                 meta = pq.read_metadata(f)
-            entries = [manifest.file_entry(
+            new_entries = [manifest.file_entry(
                 rel, meta.num_rows, meta.num_row_groups,
                 int(self.fs.info(path)['size']), source='write')]
-        built = manifest.build_manifest(entries, generation=self.generation,
-                                        sort_key=self.sort_by)
-        self._write_footer(built)
-        self.manifest = manifest.publish(self.fs, self.root_path, built)
+        with manifest.commit_lock(self.fs, self.root_path):
+            latest = manifest.load(self.fs, self.root_path)
+            if latest is not None:
+                if not self._append:
+                    raise manifest.ManifestError(
+                        'Dataset %r gained a committed manifest (generation '
+                        '%d) while this non-append write ran — refusing to '
+                        'clobber it' % (self._url, latest['generation']))
+                # rebase: commits that landed since __init__ (another
+                # generation's appender, a compaction fold) keep their
+                # files; ours stack on top
+                self._base_entries = list(latest['files'])
+                self.generation = latest['generation'] + 1
+                if self.sort_by is None:
+                    self.sort_by = latest.get('sort_key')
+            entries = self._base_entries + new_entries
+            built = manifest.build_manifest(entries,
+                                            generation=self.generation,
+                                            sort_key=self.sort_by)
+            self._write_footer(built)
+            self.manifest = manifest.publish(self.fs, self.root_path, built,
+                                             locked=True)
         manifest.purge_stale_tmp(self.fs, self.root_path)
         if not knobs.is_disabled('PETASTORM_TPU_WRITE_SELF_CHECK'):
             info = ParquetDatasetInfo(self._url, self._storage_options)
@@ -274,15 +319,26 @@ class DistributedDatasetWriter:
         """Stamp ``_common_metadata`` (schema JSON + row-group counts)
         from the manifest's already-known counts — zero footer re-scans,
         and written BEFORE the manifest swap so a committed generation
-        always has its footer."""
+        always has its footer. Counts merge over the previously stamped
+        map so a reader holding an older generation (whose superseded
+        files are still on disk) keeps resolving."""
+        from petastorm_tpu.etl.dataset_metadata import (
+            _row_group_counts_from_common_metadata,
+        )
         info = ParquetDatasetInfo(self._url, self._storage_options,
                                   validate=False)
         # the footer must describe the NEW generation even though the
         # committed manifest (append mode) still lists the previous one
         info.file_paths = sorted(manifest.committed_paths(built,
                                                           self.root_path))
-        counts_json = json.dumps(manifest.row_group_counts(built),
-                                 sort_keys=True).encode('utf-8')
+        try:
+            previous = _row_group_counts_from_common_metadata(info)
+        except (OSError, ValueError):
+            previous = None
+        counts = manifest.merge_footer_counts(
+            self.fs, self.root_path, manifest.row_group_counts(built),
+            previous)
+        counts_json = json.dumps(counts, sort_keys=True).encode('utf-8')
         entries = {
             ROW_GROUPS_PER_FILE_KEY: counts_json,
             UNISCHEMA_KEY: json.dumps(

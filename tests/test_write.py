@@ -123,6 +123,43 @@ class TestManifest:
         age = wmanifest.staleness_s(fs, root)
         assert age is not None and age < 30.0
 
+    def test_publish_serializes_via_commit_lease(self, tmp_path):
+        """A held commit lease blocks a second committer loudly; a lease
+        orphaned by a dead committer is broken once stale."""
+        fs, root = get_filesystem_and_path_or_paths('file://' + str(tmp_path))
+        lock_file = tmp_path / '_manifest.lock'
+        lock_file.write_bytes(b'held by a live committer')
+        with pytest.raises(ManifestError, match='lease'):
+            wmanifest.publish(fs, root,
+                              wmanifest.build_manifest([], generation=1),
+                              lock_timeout_s=0.3)
+        old = time.time() - 3600
+        os.utime(lock_file, (old, old))
+        with wmanifest.CommitLock(fs, root, timeout_s=5.0, stale_s=60.0):
+            pass  # stale lease broken, fresh one taken and released
+        assert not lock_file.exists()
+        wmanifest.publish(fs, root, wmanifest.build_manifest([], generation=1))
+        assert load_manifest(fs, root)['generation'] == 1
+        assert not lock_file.exists()  # publish releases its own lease
+
+    def test_load_propagates_transient_io_errors(self, tmp_path):
+        """A transiently unreadable manifest must NOT read as
+        'manifest-less dataset' — that silently degrades discovery to
+        the torn directory walk."""
+        fs, root = get_filesystem_and_path_or_paths('file://' + str(tmp_path))
+        wmanifest.publish(fs, root, wmanifest.build_manifest([], generation=1))
+
+        class FlakyFS:
+            def exists(self, path):
+                return fs.exists(path)
+
+            def open(self, *args, **kwargs):
+                raise OSError('transient storage hiccup')
+
+        with pytest.raises(OSError, match='transient'):
+            wmanifest.load(FlakyFS(), root)
+        assert wmanifest.load(fs, root)['generation'] == 1
+
     def test_purge_respects_age_gate(self, tmp_path):
         fs, root = get_filesystem_and_path_or_paths('file://' + str(tmp_path))
         fresh = tmp_path / '.tmp.part-live.parquet'
@@ -425,6 +462,35 @@ class TestCompaction:
         assert removed
         assert _read_ids(url) == list(range(total))
 
+    def test_gc_grace_measured_from_swap_not_file_age(self, tmp_path):
+        """High-severity regression: hour-old source files must NOT be
+        GC'd the instant a compaction supersedes them — the grace
+        window runs from the manifest swap, so a reader that resolved
+        the previous generation seconds before the swap keeps its
+        files."""
+        url, total, _ = _small_file_dataset(tmp_path)
+        old = time.time() - 7200
+        for p in glob.glob(str(tmp_path / 'part-*')):
+            os.utime(p, (old, old))
+        assert compact_dataset(url, minimum=2) is not None
+        fs, root = get_filesystem_and_path_or_paths(url)
+        assert gc_superseded(fs, root, grace_s=5.0) == []
+        assert _read_ids(url) == list(range(total))
+
+    def test_reader_holding_old_file_list_survives_restamp(self, tmp_path):
+        """The footer restamp merges the previous generation's
+        row-group counts: a reader that resolved the pre-swap file list
+        (or opens between restamp and swap) still loads row-groups for
+        the superseded files it holds."""
+        from petastorm_tpu.etl.dataset_metadata import load_row_groups
+        url, total, _ = _small_file_dataset(tmp_path)
+        old_paths = list(ParquetDatasetInfo(url).file_paths)
+        assert compact_dataset(url, minimum=2) is not None
+        stale = ParquetDatasetInfo(url, validate=False)
+        stale.file_paths = old_paths
+        pieces = load_row_groups(stale)  # no MetadataError
+        assert len(pieces) >= len(old_paths)
+
     def test_plan_respects_min_files_floor(self):
         committed = wmanifest.build_manifest(
             [wmanifest.file_entry('a.parquet', 10, 1, 100),
@@ -528,6 +594,75 @@ class TestAppend:
         # the fold's rows already flowed through the source files:
         # exactly-once, no redelivery
         assert sorted(seen) == list(range(total))
+
+
+    def test_partial_fold_delivers_only_undelivered_sources(self, tmp_path):
+        """A fold that mixes delivered and undelivered sources must not
+        be delivered whole (that redelivers consumed rows): the
+        follower reads the still-on-disk undelivered source files
+        directly, and the fold is settled afterwards."""
+        url = 'file://' + str(tmp_path)
+        write_dataset_distributed(url, SCHEMA, _rows(40), shard_rows=20,
+                                  sort_by='id')
+        follower = AppendFollower(url)
+        first = follower._fresh_entries()
+        assert len(first) == 2
+        follower._mark_delivered(first)
+        # a new generation lands, then compaction folds it together
+        # with the already-delivered files
+        write_dataset_distributed(url, SCHEMA, _rows(40, start=40),
+                                  shard_rows=40, append=True)
+        assert compact_dataset(url, minimum=2) is not None
+        fresh = follower._fresh_entries()
+        assert fresh and all(e.get('settles') for e in fresh)
+        urls = [url.rstrip('/') + '/' + e['path'] for e in fresh]
+        with make_batch_reader(urls, shuffle_row_groups=False) as reader:
+            got = sorted(int(i) for b in reader for i in b.id)
+        assert got == list(range(40, 80))  # ONLY the undelivered rows
+        follower._mark_delivered(fresh)
+        # the fold is settled: the next generation delivers only its
+        # own new file, nothing from the fold
+        write_dataset_distributed(url, SCHEMA, _rows(10, start=80),
+                                  shard_rows=10, append=True)
+        nxt = follower._fresh_entries()
+        assert len(nxt) == 1 and not nxt[0].get('replaces')
+
+
+# ---------------------------------------------------------------------------
+# Concurrent committers: the commit lease
+# ---------------------------------------------------------------------------
+
+
+class TestCommitConcurrency:
+    def test_append_commit_rebases_over_concurrent_compaction(self,
+                                                              tmp_path):
+        """Lost-update regression: an append writer whose base
+        generation is compacted away mid-write rebases onto the latest
+        manifest at commit — the fold keeps its files, the append
+        stacks on top, nothing is dropped or resurrected."""
+        url, total, _ = _small_file_dataset(tmp_path, files=4, rows_per=20)
+        w = DistributedDatasetWriter(url, SCHEMA, shard_rows=40, append=True)
+        w.write_row_dicts(_rows(40, start=total))
+        compacted = compact_dataset(url, minimum=2)
+        assert compacted is not None  # swapped a generation mid-write
+        w.close()
+        assert w.manifest['generation'] == compacted['generation'] + 1
+        assert any(e['source'] == 'compact' for e in w.manifest['files'])
+        assert _read_ids(url) == list(range(total + 40))
+
+    def test_same_generation_part_collision_fails_loudly(self, tmp_path):
+        """Two appenders racing the same generation collide on the
+        deterministic part names: the second must fail loudly instead
+        of silently replacing the first's committed bytes."""
+        url = 'file://' + str(tmp_path)
+        write_dataset_distributed(url, SCHEMA, _rows(50), shard_rows=50)
+        wa = DistributedDatasetWriter(url, SCHEMA, shard_rows=50, append=True)
+        wb = DistributedDatasetWriter(url, SCHEMA, shard_rows=50, append=True)
+        wa.write_row_dicts(_rows(50, start=50))  # renamed into place inline
+        with pytest.raises(RuntimeError, match='collision'):
+            wb.write_row_dicts(_rows(50, start=100))
+        wa.close()
+        assert _read_ids(url) == list(range(100))
 
 
 # ---------------------------------------------------------------------------
