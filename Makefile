@@ -34,9 +34,15 @@ lint: analyze
 #                              bench round rebaselines and this drops.
 #   imagenet_jax_rows_per_sec — r05 ran pre-PR7/9 (no decoded cache, no
 #                              fused decode); superseded next round.
+#   critpath_overhead_share  — lower-is-better (analysis share of a
+#                              traced epoch): an IMPROVEMENT reads as a
+#                              drop to this gate, so the column is
+#                              display-only; the perf-marked test gates
+#                              the real <2% budget. Standing allowance.
 trend:
 	$(PYTHON) tools/bench_trend.py --fail-on-regression \
-	  --allow lm_train_steps_per_sec --allow imagenet_jax_rows_per_sec
+	  --allow lm_train_steps_per_sec --allow imagenet_jax_rows_per_sec \
+	  --allow critpath_overhead_share
 
 # seeded chaos suite (docs/service.md "Failure semantics" + "Standing
 # service" + "High availability"): deterministic fault injection, poison

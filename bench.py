@@ -138,9 +138,10 @@ _START = time.monotonic()
 # raised 1500 → 1600 for the selective_read headline key, → 1700 for
 # the two sharded_staging keys, → 1800 for the two service HA keys,
 # → 1900 for the two mixture_stream keys (worst case ~1845), → 1950
-# for the write_throughput headline key — the driver tail is 2,000
-# chars and the emit loop still drops tail keys at the cap
-_HEADLINE_MAX_CHARS = 1950
+# for the write_throughput headline key, → 1980 for the two critpath
+# keys (worst case 1965; +newline still ≤ the 2,000-char driver tail)
+# — the emit loop still drops tail keys at the cap
+_HEADLINE_MAX_CHARS = 1980
 _HEADLINE_EXTRA_KEYS = (
     'vs_tfdata',
     'hello_world_warm_epoch_rows_per_sec',
@@ -162,6 +163,12 @@ _HEADLINE_EXTRA_KEYS = (
     # backend commit-to-commit write rate; MB/s, the fleet backend and
     # the compaction read delta stay in the full cumulative dict
     'write_rows_per_sec',
+    # critical-path engine (bench critpath section): the sweep-line
+    # analysis' share of a traced epoch (budget <2%) and its best
+    # what-if projection; bottleneck and event count stay in the full
+    # cumulative dict
+    'critpath_overhead_share',
+    'critpath_top_whatif',
     # standing-service HA (bench service section): kill-to-first-row
     # blackout through a warm-standby promotion, and the share of
     # bindings that landed on a fingerprint-warm host
@@ -2213,6 +2220,51 @@ def main():
         extra['write_compact_files_after'] = len(compacted['files'])
         extra['write_compact_read_speedup'] = round(before_s / after_s, 3)
 
+    def sec_critpath():
+        """Critical-path engine (ISSUE 19): a fully-traced hello-world
+        read, then the sweep-line analysis over its flight recorder —
+        the analysis' wall-clock share of the traced read (the <2%
+        overhead budget the perf-marked test also gates), the
+        critical-path bottleneck stage and the top what-if projection."""
+        from petastorm_tpu import telemetry
+        from petastorm_tpu.reader import make_reader
+        from petastorm_tpu.telemetry import critpath, recorder
+
+        if not os.path.isdir(tmp + '/hello_world'):
+            _build_hello_world(hello_url)
+        env = {'PETASTORM_TPU_TRACE': '1',
+               'PETASTORM_TPU_TRACE_SAMPLE': '1'}
+        saved = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        telemetry.refresh()
+        try:
+            start = time.monotonic()
+            with make_reader(hello_url, reader_pool_type='thread',
+                             workers_count=2, num_epochs=1,
+                             shuffle_row_groups=False) as reader:
+                rows = sum(1 for _ in reader)
+            traced_s = time.monotonic() - start
+            assert rows == HELLO_ROWS, rows
+            start = time.monotonic()
+            report = critpath.analyze()
+            analyze_s = time.monotonic() - start
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+            telemetry.refresh()
+            recorder.reset_recorder()
+        assert report is not None, 'traced read recorded no stage events'
+        extra['critpath_overhead_share'] = round(analyze_s / traced_s, 4)
+        extra['critpath_bottleneck'] = report['bottleneck']
+        extra['critpath_events'] = report['events']
+        if report['what_if']:
+            top = report['what_if'][0]
+            extra['critpath_top_whatif'] = '%s => %+.1f%%' % (
+                top['scenario'], top['epoch_delta_pct'])
+
     def sec_service():
         # Standing-service HA record (docs/service.md, "High
         # availability"): SIGKILL a subprocess primary mid-job with a
@@ -2621,6 +2673,7 @@ def main():
         section('io_overlap', 10, sec_io_overlap)
         section('mixture_stream', 15, sec_mixture_stream)
         section('write_throughput', 15, sec_write_throughput)
+        section('critpath', 10, sec_critpath)
         section('service', 20, sec_service)
         section('lm_tokens', 10, sec_lm_tokens)
         section('imagenet', 20, sec_imagenet)

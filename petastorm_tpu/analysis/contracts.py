@@ -84,6 +84,10 @@ EVENT_NAMES = frozenset([
     # adjustment on the 'autotuner' track, so a Perfetto export shows
     # WHY throughput changed shape mid-run
     'autotune_decision',
+    # streaming mixture engine (mixture/engine.py): one complete event
+    # per source-reader batch pull on that source's track, so a traced
+    # mixture run shows which source each document lifeline came from
+    'mixture_pull',
 ])
 
 #: every metric series name the package exports — the registry namespace
@@ -191,6 +195,16 @@ METRIC_NAMES = frozenset([
     'petastorm_tpu_write_manifest_generation',
     'petastorm_tpu_compact_runs_total',
     'petastorm_tpu_compact_files_folded_total',
+    # bounded-staleness append reads (write/append.py): observed lag
+    # between the latest committed manifest and the follower's delivery
+    'petastorm_tpu_append_staleness_s',
+    # SLO plane (telemetry/slo.py): per-target breach windows + the
+    # error budget left in the long burn window (1.0 = untouched)
+    'petastorm_tpu_slo_breach_windows_total',
+    'petastorm_tpu_slo_budget_remaining',
+    # critical-path engine (telemetry/critpath.py): decision-quality
+    # cross-check against the staging autotuner (verdict=agree|disagree)
+    'petastorm_tpu_critpath_agreement_total',
 ])
 
 #: prefix of every operator-facing environment knob
@@ -267,6 +281,9 @@ KNOWN_KNOBS = frozenset([
     'PETASTORM_TPU_WRITE_SELF_CHECK',
     'PETASTORM_TPU_COMPACT_TARGET_MB',
     'PETASTORM_TPU_COMPACT_MIN_FILES',
+    'PETASTORM_TPU_SLO',
+    'PETASTORM_TPU_OBS_LOG_DIR',
+    'PETASTORM_TPU_OBS_LOG_MB',
 ])
 
 #: canonical anomaly event kinds the live observability plane's detector
@@ -295,6 +312,7 @@ ANOMALY_KINDS = {
                          '(job_lease_expired)',
     'dispatcher_failover': 'The dispatcher failed over to its standby '
                            '(dispatcher_failover)',
+    'slo_breach': 'An SLO error budget is burning too fast (slo_breach)',
 }
 
 #: every registered fault-injection site (:mod:`petastorm_tpu.faults`),

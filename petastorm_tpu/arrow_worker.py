@@ -397,8 +397,12 @@ class RowGroupWorker(WorkerBase):
         select_all = row_indices.size == num_rows
 
         if faults.ARMED:
-            faults.fault_hit('decode.rowgroup', key='%s#rg%d'
-                             % (piece.path, piece.row_group))
+            # inside a decode span so an injected delay is charged to the
+            # decode stage — the critical-path ground-truth drill depends
+            # on the slowdown being attributable; unarmed runs never enter
+            with span('decode'):
+                faults.fault_hit('decode.rowgroup', key='%s#rg%d'
+                                 % (piece.path, piece.row_group))
         columns = {}
         if read_columns:
             if late:
@@ -499,8 +503,11 @@ class RowGroupWorker(WorkerBase):
         table = self._read_columns(pf, piece, read_columns)
         num_rows = table.num_rows
         if faults.ARMED:
-            faults.fault_hit('decode.rowgroup', key='%s#rg%d'
-                             % (piece.path, piece.row_group))
+            # see the sibling site above: armed-only decode span so
+            # injected delays read as decode time in the critical path
+            with span('decode'):
+                faults.fault_hit('decode.rowgroup', key='%s#rg%d'
+                                 % (piece.path, piece.row_group))
         with span('decode'):
             decoded = {name: self._decode_column(name, table.column(name))
                        for name in read_columns}

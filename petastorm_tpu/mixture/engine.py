@@ -36,6 +36,7 @@ per-worker readahead depth follows the mixing ratio
 """
 
 import logging
+import time
 from collections import deque
 
 import numpy as np
@@ -43,7 +44,9 @@ import numpy as np
 from petastorm_tpu.mixture.interleave import InterleaveSchedule
 from petastorm_tpu.mixture.packing import SequencePacker
 from petastorm_tpu.mixture.spec import MixtureSpec
-from petastorm_tpu.telemetry import get_registry, knobs, metrics_disabled
+from petastorm_tpu.telemetry import (
+    get_registry, knobs, metrics_disabled, tracing,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -90,7 +93,7 @@ class _OrderedDocSource:
     rows — exact delivery-granular resume.
     """
 
-    def __init__(self, reader, token_field, reseq_max=None):
+    def __init__(self, reader, token_field, reseq_max=None, source=None):
         if not getattr(reader, 'batched_output', False):
             raise ValueError('Mixture sources need batched readers '
                              '(make_batch_reader)')
@@ -99,6 +102,7 @@ class _OrderedDocSource:
                                       DEFAULT_RESEQ_MAX, floor=1)
         self._reader = reader
         self._token_field = token_field
+        self._source = source
         self._reseq_max = int(reseq_max)
         self._epoch = 0
         self._order = deque(reader.ventilation_order(0))
@@ -161,11 +165,22 @@ class _OrderedDocSource:
             self._pull()
 
     def _pull(self):
+        t0 = time.time()
         try:
             columns, item, epoch = self._reader.next_batch_info()
         except StopIteration:
             self._drained = True
             return
+        if self._source is not None:
+            # join the row-group's lifeline from the mixture side: the
+            # pull event shares the trace id the source reader's worker
+            # stages minted, so per-source starvation is visible on the
+            # same timeline as decode/io (shard carries the source index)
+            ctx = tracing.ctx_for(item, epoch, shard=self._source)
+            if ctx is not None:
+                tracing.record_complete(
+                    'mixture_pull', t0, time.time() - t0, ctx,
+                    track='mixture-src-%d' % self._source)
         column = columns.get(self._token_field)
         if column is None:
             raise KeyError(
@@ -330,8 +345,8 @@ class MixtureStream:
         elif len(readers) != len(spec.sources):
             raise ValueError('readers has %d entries for %d sources'
                              % (len(readers), len(spec.sources)))
-        self._sources = [_OrderedDocSource(r, spec.token_field)
-                         for r in readers]
+        self._sources = [_OrderedDocSource(r, spec.token_field, source=idx)
+                         for idx, r in enumerate(readers)]
         self._packer = None
         if spec.seq_len is not None:
             self._packer = SequencePacker(spec.seq_len,

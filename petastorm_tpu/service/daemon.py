@@ -154,6 +154,13 @@ class ServiceDaemon:
         doc['role'] = 'primary'
         if self.supervisor is not None:
             doc['supervisor'] = self.supervisor.status()
+        if doc.get('qos'):
+            # per-job SLO view: while an error budget burns, jobs starved
+            # below their declared share are flagged raise_weight and
+            # over-share jobs lower_weight — advisory, the operator (or a
+            # rebinding loop) acts on it
+            from petastorm_tpu.telemetry import slo
+            doc['slo_advice'] = slo.qos_weight_advice(doc['qos'])
         return doc
 
     def report(self):

@@ -70,6 +70,9 @@ from petastorm_tpu.telemetry.timeseries import (  # noqa: F401
     recent_anomalies, record_anomaly,
 )
 from petastorm_tpu.telemetry import obs_server  # noqa: F401
+from petastorm_tpu.telemetry import critpath  # noqa: F401
+from petastorm_tpu.telemetry import obslog  # noqa: F401
+from petastorm_tpu.telemetry import slo  # noqa: F401
 
 #: registry counter names the wait clocks accumulate into (seconds)
 STALL_PRODUCER_WAIT = 'petastorm_tpu_stall_producer_wait_seconds_total'
@@ -152,6 +155,8 @@ def reset_for_tests():
     re-read (test isolation only)."""
     obs_server._reset_for_tests()
     timeseries._reset_for_tests()
+    slo._reset_for_tests()
+    obslog._reset_for_tests()
     reset_registry()
     reset_attributor()
     reset_recorder()

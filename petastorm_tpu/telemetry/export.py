@@ -246,7 +246,32 @@ def pipeline_report(registry=None, wall_time_s=None, baseline=None,
     autotune = _staging_autotune_section(registry)
     if autotune is not None:
         report['staging_autotune'] = autotune
+    critical = _critical_path_section()
+    if critical is not None:
+        report['critical_path'] = critical
+    slo = _slo_section()
+    if slo is not None:
+        report['slo'] = slo
     return report
+
+
+def _critical_path_section():
+    """Critical-path engine analysis (telemetry/critpath.py) — present
+    only when the flight recorder holds stage events (tracing was on),
+    so untraced pipelines keep their report shape unchanged."""
+    from petastorm_tpu.telemetry import recorder
+    if not len(recorder.get_recorder()):
+        return None
+    from petastorm_tpu.telemetry import critpath
+    return critpath.critpath_section()
+
+
+def _slo_section():
+    """SLO burn/budget accounting (telemetry/slo.py) — present only when
+    ``PETASTORM_TPU_SLO`` arms a policy, so objective-less pipelines
+    keep their report shape unchanged."""
+    from petastorm_tpu.telemetry import slo
+    return slo.slo_section()
 
 
 def _h2d_overlap_share(stages):
@@ -716,4 +741,34 @@ def format_pipeline_report(report):
             detail = {k: v for k, v in entry.items()
                       if k not in ('action', 'ts')}
             lines.append('  %s — %s' % (entry['action'], detail))
+    if 'critical_path' in report:
+        c = report['critical_path']
+        lines.append('critical path: bottleneck %s over %.3fs traced '
+                     'span (%d item(s), %d stage event(s))'
+                     % (c['bottleneck'], c['span_s'], c['items'],
+                        c['events']))
+        for stage, info in list(c['stages'].items())[:4]:
+            lines.append('  %-14s self %8.3fs  overlapped %8.3fs'
+                         % (stage, info['self_s'], info['overlap_s']))
+        for scenario in c['what_if'][:3]:
+            lines.append('  what-if: %s => epoch %+.1f%%'
+                         % (scenario['scenario'],
+                            scenario['epoch_delta_pct']))
+        check = c.get('autotune_crosscheck')
+        if check:
+            lines.append('  autotuner cross-check: %d agree / %d '
+                         'disagree over %d decision(s)'
+                         % (check['agree'], check['disagree'],
+                            check['decisions']))
+    if 'slo' in report:
+        for target in report['slo']['targets']:
+            lines.append('slo %s %s %g: last %s, burn short %.2fx / '
+                         'long %.2fx, budget %.0f%%%s'
+                         % (target['target'], target['op'],
+                            target['threshold'],
+                            ('%.4g' % target['last_value'])
+                            if target['last_value'] is not None else '-',
+                            target['short_burn'], target['long_burn'],
+                            100 * target['budget_remaining'],
+                            ' — BREACHING' if target['breaching'] else ''))
     return '\n'.join(lines)

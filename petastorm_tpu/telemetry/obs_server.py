@@ -20,6 +20,13 @@ sampler) thread is ever created. Routes:
   JSON, pulled on demand — no SIGUSR1, no file path needed
   (``PETASTORM_TPU_TRACE=1`` must have been on during the run for the
   events to exist).
+* ``/critpath`` — the critical-path engine's live analysis of the same
+  recorder (:mod:`~petastorm_tpu.telemetry.critpath`): self vs
+  overlapped time per stage and the what-if projections. The view is
+  whatever this process's recorder holds — a Reader shows the read
+  plane, a JaxLoader adds the staging stages, and the service
+  dispatcher (whose DONE-frame merges fold worker events in) serves the
+  fleet-merged view.
 
 Components *mount* themselves (:func:`mount`): the Reader, JaxLoader,
 service dispatcher (via the ServicePool) and worker servers each
@@ -167,7 +174,7 @@ def _ensure_server(port):
             name='petastorm-tpu-obs-http')
         _state.thread.start()
         logger.info('Observability endpoint listening on http://%s:%d '
-                    '(/metrics /report /health /trace)',
+                    '(/metrics /report /health /trace /critpath)',
                     *server.server_address[:2])
 
 
@@ -211,15 +218,24 @@ def _component_sections(attr):
 
 
 def build_health():
-    """The ``/health`` document (also the programmatic probe)."""
+    """The ``/health`` document (also the programmatic probe). Carries
+    the live SLO section whenever a ``PETASTORM_TPU_SLO`` policy is
+    armed, so every mounted component's health probe shows the burn."""
+    from petastorm_tpu.telemetry import slo
     started = _state.started_ts
-    return {
+    doc = {
         'status': 'ok',
         'pid': os.getpid(),
         'ts': time.time(),
         'uptime_s': round(time.time() - started, 3) if started else None,
         'components': _component_sections('health'),
     }
+    slo_view = slo.slo_section()
+    if slo_view is not None:
+        doc['slo'] = slo_view
+        if any(t['breaching'] for t in slo_view['targets']):
+            doc['status'] = 'slo-breach'
+    return doc
 
 
 def build_report():
@@ -282,9 +298,18 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                 export_chrome_trace(buf)
                 body = buf.getvalue().encode()
                 content_type = 'application/json'
+            elif route == '/critpath':
+                from petastorm_tpu.telemetry import critpath
+                section = critpath.critpath_section()
+                body = json.dumps(
+                    section if section is not None
+                    else {'error': 'no trace events recorded (set '
+                                   'PETASTORM_TPU_TRACE=1)'},
+                    default=_json_default).encode()
+                content_type = 'application/json'
             else:
                 self.send_error(404, 'routes: /metrics /report /health '
-                                     '/trace')
+                                     '/trace /critpath')
                 return
         except Exception:  # noqa: BLE001 - a scrape must not kill serving
             logger.debug('obs-http %s failed', route, exc_info=True)

@@ -1,0 +1,145 @@
+"""Black-box flight recorder: closed windows, anomalies and SLO verdicts
+on disk, size-capped.
+
+Every in-process observability surface — the rollup ring, the anomaly
+ring, the Chrome-trace recorder — dies with the process: a crashed
+daemon leaves NO record of the minutes before the crash, which is
+exactly when the record matters. This module is the black box: when
+``PETASTORM_TPU_OBS_LOG_DIR`` names a directory, the
+:class:`~petastorm_tpu.telemetry.timeseries.ObsCollector` appends each
+closed window (plus any anomalies it raised, the SLO verdicts and a
+periodic critical-path digest) as one JSON line to ``obslog.jsonl``
+there. The file is a two-slot size-capped ring: when the live file
+crosses ``PETASTORM_TPU_OBS_LOG_MB`` (default 64) it rotates to
+``obslog.jsonl.1`` (replacing the previous rotation), so disk use is
+bounded at ~2x the cap no matter how long the daemon runs.
+
+``tools/obs_replay.py`` renders the post-mortem — timeline, burn report,
+critical-path summary — from these files after the process is gone.
+
+One record per line: ``{'kind': 'window'|'anomaly'|'slo'|'critpath',
+'ts': ..., ...payload}``. Best-effort by design: a full disk or an
+unwritable directory degrades to a logged warning once, never an
+exception on the sampler thread.
+"""
+
+import json
+import logging
+import os
+import threading
+import time
+
+from petastorm_tpu.telemetry import knobs
+
+logger = logging.getLogger(__name__)
+
+_LOG_NAME = 'obslog.jsonl'
+_DEFAULT_CAP_MB = 64
+
+
+def log_dir():
+    """The armed directory, or None (= flight recording off)."""
+    return knobs.get_str('PETASTORM_TPU_OBS_LOG_DIR') or None
+
+
+def cap_bytes():
+    return knobs.get_int('PETASTORM_TPU_OBS_LOG_MB', _DEFAULT_CAP_MB,
+                         floor=1) * 1024 * 1024
+
+
+class ObsLogWriter:
+    """Appender over the two-slot on-disk ring; one per process."""
+
+    def __init__(self, directory, cap=None):
+        self.directory = directory
+        self.path = os.path.join(directory, _LOG_NAME)
+        self._cap = cap or cap_bytes()
+        self._lock = threading.Lock()
+        self._size = None
+        self._warned = False
+
+    def append(self, kind, record):
+        """Write one record; returns True when the line landed."""
+        line = json.dumps(dict(record, kind=kind), sort_keys=True,
+                          default=str)
+        with self._lock:
+            try:
+                if self._size is None:
+                    os.makedirs(self.directory, exist_ok=True)
+                    self._size = (os.path.getsize(self.path)
+                                  if os.path.exists(self.path) else 0)
+                if self._size >= self._cap:
+                    os.replace(self.path, self.path + '.1')
+                    self._size = 0
+                with open(self.path, 'a') as f:
+                    f.write(line + '\n')
+                self._size += len(line) + 1
+                return True
+            except OSError as e:
+                if not self._warned:
+                    self._warned = True
+                    logger.warning('obs log %s unwritable (%s); flight '
+                                   'recording degraded for this process',
+                                   self.path, e)
+                return False
+
+
+def read_log(directory):
+    """Every surviving record under ``directory``, oldest first (the
+    rotated slot, then the live file) — the replay tool's input. Torn
+    trailing lines (a crash mid-write) are skipped, not fatal."""
+    records = []
+    base = os.path.join(directory, _LOG_NAME)
+    for path in (base + '.1', base):
+        if not os.path.exists(path):
+            continue
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    records.append(json.loads(line))
+                except ValueError:
+                    continue
+        # the rotated slot strictly precedes the live file in time, and
+        # within a file append order is time order — no sort needed
+    return records
+
+
+_writer_lock = threading.Lock()
+_writer = None
+
+
+def get_writer():
+    """The process-wide writer when the knob arms a directory, else
+    None. Re-resolved when the directory changes (tests, refresh)."""
+    global _writer
+    directory = log_dir()
+    if directory is None:
+        return None
+    with _writer_lock:
+        if _writer is None or _writer.directory != directory:
+            _writer = ObsLogWriter(directory)
+        return _writer
+
+
+def append(kind, record):
+    """Module-level convenience: append when armed, no-op otherwise."""
+    writer = get_writer()
+    if writer is None:
+        return False
+    if 'ts' not in record:
+        record = dict(record, ts=time.time())
+    return writer.append(kind, record)
+
+
+def refresh_obslog():
+    """Knob-refresh hook: pick up a changed directory/cap next append."""
+    global _writer
+    with _writer_lock:
+        _writer = None
+
+
+def _reset_for_tests():
+    refresh_obslog()

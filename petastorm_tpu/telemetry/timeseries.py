@@ -279,6 +279,16 @@ def record_anomaly(kind, detail=None, window_start=None):
         get_registry().counter(ANOMALY_EVENTS, kind=kind).inc()
     logger.warning('Pipeline anomaly %s: %s (see %s)', kind,
                    event['detail'], event['runbook'])
+    from petastorm_tpu.telemetry import obslog
+    if obslog.log_dir() is not None:
+        # every anomaly source funnels through here (the detector, the
+        # SLO plane, the service dispatcher), so this is the one spot
+        # that guarantees the flight log sees them all; the log line's
+        # 'kind' field is the record type, the anomaly's own kind moves
+        # to 'anomaly'
+        rec = dict(event)
+        rec['anomaly'] = rec.pop('kind', None)
+        obslog.append('anomaly', rec)
     return event
 
 
@@ -490,6 +500,7 @@ class ObsCollector:
         self.detector = detector or AnomalyDetector()
         self._stop = threading.Event()
         self._thread = None
+        self._ticks = 0
 
     def start(self):
         if self._thread is not None:
@@ -505,16 +516,42 @@ class ObsCollector:
             except Exception:  # noqa: BLE001 - observability is advisory
                 logger.debug('Rollup tick failed', exc_info=True)
 
+    #: one critical-path digest lands in the flight log every N ticks —
+    #: the sweep over the recorder is the plane's priciest analysis and
+    #: per-tick it would eat the <2% overhead budget the bench gates
+    _CRITPATH_EVERY = 30
+
     def tick(self):
         """One sampling step (the thread's body; callable directly from
         tests). get_registry() is re-resolved per tick so a test-reset
-        registry swap is picked up instead of sampling a dead one."""
+        registry swap is picked up instead of sampling a dead one.
+
+        Each closed window additionally flows through the SLO policy
+        (when ``PETASTORM_TPU_SLO`` arms one) and — with
+        ``PETASTORM_TPU_OBS_LOG_DIR`` set — into the on-disk black box:
+        the window itself, any anomalies it raised, the SLO verdicts and
+        a periodic critical-path digest."""
+        from petastorm_tpu.telemetry import obslog, slo
         window = self.rollup.sample(get_registry().snapshot())
         if window is None:
             return None
         if not metrics_disabled():
             get_registry().counter(OBS_WINDOWS).inc()
         self.detector.observe(window)
+        verdict = slo.observe_window(window)
+        self._ticks += 1
+        if obslog.log_dir() is not None:
+            # anomalies (the detector's `events` included) reach the log
+            # via record_anomaly itself — every source funnels there
+            obslog.append('window', dict(window))
+            if verdict is not None:
+                obslog.append('slo', dict(verdict))
+            if self._ticks % self._CRITPATH_EVERY == 0:
+                from petastorm_tpu.telemetry import critpath
+                digest = critpath.analyze()
+                if digest is not None:
+                    digest.pop('stages', None)
+                    obslog.append('critpath', digest)
         return window
 
     def reload_config(self):
@@ -588,6 +625,9 @@ def refresh_obs():
     collector = _collector
     if collector is not None:
         collector.reload_config()
+    from petastorm_tpu.telemetry import obslog, slo
+    slo.refresh_slo()
+    obslog.refresh_obslog()
 
 
 def _reset_for_tests():

@@ -27,9 +27,16 @@ import threading
 import time
 
 from petastorm_tpu.fs import get_filesystem_and_path_or_paths, normalize_dir_url
+from petastorm_tpu.telemetry import get_registry, metrics_disabled
 from petastorm_tpu.write import manifest
 
 logger = logging.getLogger(__name__)
+
+#: observed commit-to-delivery lag at each follower poll: the committed
+#: manifest's age while undelivered rows exist, 0 once caught up — the
+#: measurable form of the bounded-staleness contract (and the
+#: ``append_staleness`` SLO target's input, telemetry/slo.py)
+APPEND_STALENESS = 'petastorm_tpu_append_staleness_s'
 
 
 class AppendFollower:
@@ -109,6 +116,17 @@ class AppendFollower:
         self.generation = committed['generation']
         return fresh
 
+    def _note_staleness(self, pending):
+        """Publish the observed lag: the committed manifest's age while
+        undelivered rows are pending, zero once this follower caught up.
+        Advisory — a filesystem hiccup degrades to no update."""
+        if metrics_disabled():
+            return
+        lag = 0.0
+        if pending:
+            lag = manifest.staleness_s(self.fs, self.root_path) or 0.0
+        get_registry().gauge(APPEND_STALENESS).set(round(lag, 3))
+
     def _on_disk(self, rel_path):
         try:
             return self.fs.exists(posixpath.join(self.root_path, rel_path))
@@ -131,6 +149,7 @@ class AppendFollower:
         idle_since = time.monotonic()
         while not self._stop.is_set():
             fresh = self._fresh_entries()
+            self._note_staleness(bool(fresh))
             if fresh:
                 idle_since = time.monotonic()
                 urls = [self._url.rstrip('/') + '/' + e['path']

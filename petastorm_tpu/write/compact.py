@@ -228,7 +228,14 @@ def _restamp_footer(url, fs, root_path, new_manifest, storage_options):
 class CompactionDaemon:
     """Standing compaction job: re-plans on an interval, folds when the
     small-file count crosses the floor, gc-sweeps superseded files after
-    a grace window. One daemon per dataset; idempotent start/stop."""
+    a grace window. One daemon per dataset; idempotent start/stop.
+
+    Mounts on the process's observability endpoint like Reader/JaxLoader
+    and the service daemon do: with ``PETASTORM_TPU_OBS_PORT`` armed,
+    ``/health`` carries a ``compaction-daemon`` component showing the
+    last published generation, folds completed, files gc-swept and the
+    latest self-check warnings — a standing job is only operable when
+    its progress is visible without reading logs."""
 
     def __init__(self, dataset_url, interval_s=30.0, gc_grace_s=300.0,
                  storage_options=None):
@@ -238,29 +245,74 @@ class CompactionDaemon:
         self._storage_options = storage_options
         self._stop = threading.Event()
         self._thread = None
+        self._mount = None
         self.runs = 0
+        self.generation = None  #: last generation this daemon published
+        self.gc_files = 0       #: superseded files swept by this daemon
+        self.last_warnings = []  #: latest fold's self-check warnings
 
     def start(self):
         if self._thread is not None:
             return
+        from petastorm_tpu.telemetry import obs_server
         self._stop.clear()
+        self._mount = obs_server.mount('compaction-daemon',
+                                       health=self.health)
         self._thread = threading.Thread(target=self._run,
                                         name='pt-compactd', daemon=True)
         self._thread.start()
 
+    def health(self):
+        """The ``/health`` component section."""
+        return {
+            'dataset_url': self._url,
+            'interval_s': self._interval_s,
+            'runs': self.runs,
+            'generation': self.generation,
+            'gc_files': self.gc_files,
+            'self_check_warnings': list(self.last_warnings),
+        }
+
     def _run(self):
         while not self._stop.wait(self._interval_s):
             try:
-                if compact_dataset(self._url,
-                                   storage_options=self._storage_options,
-                                   gc_grace_s=self._gc_grace_s) is not None:
+                published = compact_dataset(
+                    self._url, storage_options=self._storage_options)
+                if published is not None:
                     self.runs += 1
+                    self.generation = published['generation']
+                    self._self_check(published)
+                # the gc sweep runs every pass (not only fold passes):
+                # files superseded by an EARLIER fold age out of their
+                # grace window during quiet intervals too
+                fs, root_path = get_filesystem_and_path_or_paths(
+                    normalize_dir_url(self._url), self._storage_options)
+                removed = manifest.gc_superseded(fs, root_path,
+                                                 grace_s=self._gc_grace_s)
+                self.gc_files += len(removed)
             except Exception:  # noqa: BLE001 - a standing job never dies
                 logger.exception('compaction daemon: pass failed for %s',
                                  self._url)
+
+    def _self_check(self, published):
+        """Refresh the health section's warnings from a post-fold layout
+        self-check (footer-only analysis; knob-gated like the writer's)."""
+        if knobs.is_disabled('PETASTORM_TPU_WRITE_SELF_CHECK'):
+            return
+        try:
+            report = layout.self_check(
+                self._url, sort_key=published.get('sort_key'),
+                storage_options=self._storage_options)
+            self.last_warnings = list(report.get('warnings') or [])
+        except Exception:  # noqa: BLE001 - analysis must not kill the job
+            logger.exception('compaction daemon: self-check failed for %s',
+                             self._url)
 
     def stop(self):
         self._stop.set()
         thread, self._thread = self._thread, None
         if thread is not None:
             thread.join(timeout=30)
+        if self._mount is not None:
+            self._mount.close()
+            self._mount = None

@@ -37,7 +37,9 @@ from petastorm_tpu.etl.dataset_metadata import (
 )
 from petastorm_tpu.errors import MetadataError
 from petastorm_tpu.fs import get_filesystem_and_path_or_paths, normalize_dir_url
-from petastorm_tpu.telemetry import get_registry, knobs, metrics_disabled
+from petastorm_tpu.telemetry import (
+    get_registry, knobs, metrics_disabled, tracing,
+)
 from petastorm_tpu.unischema import Unischema
 from petastorm_tpu.workers.worker_base import WorkerBase
 from petastorm_tpu.write import layout, manifest
@@ -235,17 +237,28 @@ class DistributedDatasetWriter:
             return
         shard_id = self._shards_dispatched
         self._shards_dispatched += 1
+        # each shard is one traced item: the context rides the pools'
+        # reserved _trace_ctx kwarg exactly like read-plane row-groups,
+        # so encode/write_flush spans on remote workers join the same
+        # timeline the read plane records (PR 19: the write plane no
+        # longer drops trace contexts on the floor)
+        ctx = tracing.mint(shard_id, epoch=self.generation, shard=shard_id)
         if self._pool is None:
             if self._inline_worker is None:
                 self._inline_worker = WriteShardWorker(
                     0, self._inline_results.append, self._worker_args)
                 self._inline_worker.initialize()
-            self._inline_worker.process(shard_id, rows)
+            with tracing.attempt(ctx, 'write-inline-0'):
+                self._inline_worker.process(shard_id, rows)
             return
         if not self._pool_started:
             self._pool.start(WriteShardWorker, self._worker_args)
             self._pool_started = True
-        self._pool.ventilate(shard_id=shard_id, rows=rows)
+        if ctx is not None:
+            self._pool.ventilate(shard_id=shard_id, rows=rows,
+                                 **{tracing.TRACE_CTX_KEY: ctx})
+        else:
+            self._pool.ventilate(shard_id=shard_id, rows=rows)
 
     def _drain_pool(self):
         if self._pool is None:
@@ -353,6 +366,13 @@ class DistributedDatasetWriter:
             logger.debug('Not writing reference-compatible schema pickle: %s',
                          e)
         update_dataset_metadata(info, entries)
+
+    def dump_trace(self, path):
+        """Export this process's flight recorder (which the pool delta
+        channels already merged remote shard events into) as Chrome
+        trace-event JSON — the write-plane sibling of
+        ``Reader.dump_trace``. Returns the event count."""
+        return tracing.dump_trace(path)
 
     def _stop_pool(self):
         if self._pool is not None and self._pool_started:

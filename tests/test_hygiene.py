@@ -94,6 +94,34 @@ def test_span_and_trace_stage_names_are_canonical():
         'whitelist): %s' % offenders
 
 
+def test_every_canonical_stage_is_recorded_somewhere():
+    """The reverse custody check: every ``contracts.STAGES`` member is
+    actually instrumented — it appears as the literal first argument of
+    at least one ``span(...)``/``record_complete(...)``/
+    ``record_instant(...)`` call in the package. A stage that exists
+    only in the contract would make pipeline_report and the
+    critical-path engine silently blind to it (the ISSUE 19 lifeline
+    reconstruction assumes every canonical stage CAN appear in a
+    trace)."""
+    from petastorm_tpu.analysis.contracts import STAGES
+    recording_calls = ('span', 'record_complete', 'record_instant')
+    recorded = set()
+    for rel, source in _package_sources():
+        for node in ast.walk(ast.parse(source, filename=rel)):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            if _call_name(node) not in recording_calls:
+                continue
+            first = node.args[0]
+            if isinstance(first, ast.Constant) and \
+                    isinstance(first.value, str):
+                recorded.add(first.value)
+    missing = [stage for stage in STAGES if stage not in recorded]
+    assert not missing, \
+        'canonical stages never recorded by any span/trace call ' \
+        '(dead contract entries, or instrumentation lost): %s' % missing
+
+
 def test_exported_metric_names_are_documented():
     """Metric-name chain of custody, hubbed on analysis/contracts.py:
     every ``petastorm_tpu_*`` literal in the package is a member of

@@ -482,3 +482,28 @@ def test_daemon_service_path_bit_identical(mix_datasets, monkeypatch):
     finally:
         monkeypatch.delenv('PETASTORM_TPU_SERVICE_DAEMON', raising=False)
         daemon.stop()
+
+
+def test_stream_threads_per_source_trace_context(mix_datasets, monkeypatch):
+    """PR 19 satellite: with tracing armed, every document pull joins
+    its row-group's lifeline as a ``mixture_pull`` event on a
+    per-source track — the critical-path engine sees the mixture side,
+    not just the underlying readers."""
+    monkeypatch.setenv('PETASTORM_TPU_TRACE', '1')
+    monkeypatch.setenv('PETASTORM_TPU_TRACE_SAMPLE', '1')
+    T.reset_for_tests()
+    try:
+        from petastorm_tpu.telemetry import recorder
+        _drain(MixtureStream(_spec(mix_datasets),
+                             reader_pool_type='thread', workers_count=1))
+        pulls = [e for e in recorder.get_recorder().snapshot()
+                 if e.get('name') == 'mixture_pull']
+        assert pulls, 'no mixture_pull events reached the recorder'
+        tracks = {e.get('tid') for e in pulls}
+        # two sources in the spec -> two distinct mixture-side tracks
+        assert {'mixture-src-0', 'mixture-src-1'} <= tracks, tracks
+        assert all((e.get('args') or {}).get('trace_id') for e in pulls)
+    finally:
+        monkeypatch.delenv('PETASTORM_TPU_TRACE', raising=False)
+        monkeypatch.delenv('PETASTORM_TPU_TRACE_SAMPLE', raising=False)
+        T.reset_for_tests()

@@ -1,0 +1,336 @@
+"""SLO plane: spec parsing, multi-window burn-rate accounting, the
+edge-triggered ``slo_breach`` anomaly, the on-disk flight recorder, and
+the ISSUE 19 acceptance drill — a seeded slow consumer under a
+``queue_wait_p99`` SLO whose breach must be visible in the live
+``/health``, the final ``pipeline_report()`` AND the ``obs_replay``
+rendering of the obs log directory.
+"""
+
+import importlib
+import json
+import os
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from petastorm_tpu import telemetry as T
+from petastorm_tpu.telemetry import obs_server, obslog, slo, timeseries
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _obs_replay():
+    tools_dir = os.path.join(_REPO, 'tools')
+    if tools_dir not in sys.path:
+        sys.path.insert(0, tools_dir)
+    return importlib.import_module('obs_replay')
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    T.reset_for_tests()
+    yield
+    T.reset_for_tests()
+
+
+def _win(start, throughput=None, p99=None, staleness=None, rates=None):
+    """One synthetic closed rollup window in the shape SloPolicy reads."""
+    window = {'start': start, 'throughput': throughput,
+              'quantiles': {}, 'gauges': {}, 'rates': rates or {}}
+    if p99 is not None:
+        window['quantiles'][slo._QUEUE_WAIT_P99_KEY] = {'p99': p99}
+    if staleness is not None:
+        window['gauges'][slo._APPEND_STALENESS] = staleness
+    return window
+
+
+def _breach_events():
+    return [e for e in timeseries.recent_anomalies()
+            if e['kind'] == 'slo_breach']
+
+
+# -- spec parsing ------------------------------------------------------------
+
+
+def test_parse_spec_units_and_shapes():
+    targets = slo.parse_spec(
+        'rows_per_sec>=40000;queue_wait_p99<=50ms;'
+        'append_staleness<=30s;h2d_overlap>=0.3')
+    assert [(t['target'], t['op'], t['threshold']) for t in targets] == [
+        ('rows_per_sec', '>=', 40000.0),
+        ('queue_wait_p99', '<=', 0.05),
+        ('append_staleness', '<=', 30.0),
+        ('h2d_overlap', '>=', 0.3),
+    ]
+
+
+def test_parse_spec_drops_bad_clauses_not_the_plane():
+    targets = slo.parse_spec(
+        'frames_per_sec>=10;'      # unknown target
+        'rows_per_sec=10;'          # no operator
+        'queue_wait_p99<=fastms;'   # unparseable threshold
+        ';rows_per_sec>=100')       # empty clause + one good one
+    assert targets == [
+        {'target': 'rows_per_sec', 'op': '>=', 'threshold': 100.0}]
+    assert slo.parse_spec('') == []
+    assert slo.parse_spec(None) == []
+
+
+def test_h2d_overlap_resolver():
+    rates = {slo._STAGE_FILL_KEY: 0.3, slo._H2D_DISPATCH_KEY: 0.1,
+             slo._H2D_READY_KEY: 0.1}
+    assert slo._resolve_h2d_overlap(
+        _win(0.0, rates=rates)) == pytest.approx(0.8)
+    assert slo._resolve_h2d_overlap(_win(0.0)) is None
+
+
+# -- burn-rate state machine -------------------------------------------------
+
+
+def test_observe_skips_unresolvable_windows():
+    policy = slo.SloPolicy(slo.parse_spec('rows_per_sec>=100'))
+    assert policy.observe(_win(0.0)) is None
+    assert policy.section()['targets'][0]['windows_evaluated'] == 0
+
+
+def test_breach_needs_warmup_then_fires_once():
+    """A breach may not fire before ``_MIN_WINDOWS`` evaluated windows
+    (one rough window must not page), fires exactly once on the rising
+    edge, and re-arms only after the short horizon recovers."""
+    policy = slo.SloPolicy(slo.parse_spec('rows_per_sec>=100'))
+    start = 0.0
+    for _ in range(slo._MIN_WINDOWS - 1):
+        verdict = policy.observe(_win(start, throughput=10.0))
+        start += 1.0
+        assert not verdict['targets'][0]['breaching']
+    assert _breach_events() == []
+    # the _MIN_WINDOWS-th all-bad window crosses both horizons
+    verdict = policy.observe(_win(start, throughput=10.0))
+    assert verdict['targets'][0]['breaching']
+    assert len(_breach_events()) == 1
+    detail = _breach_events()[0]['detail']
+    assert detail['target'] == 'rows_per_sec'
+    assert detail['value'] == pytest.approx(10.0)
+    # still breaching: edge-triggered, no second anomaly
+    policy.observe(_win(start + 1, throughput=10.0))
+    assert len(_breach_events()) == 1
+    # a full short horizon of good windows clears the condition...
+    for i in range(slo._SHORT_WINDOWS):
+        verdict = policy.observe(_win(start + 2 + i, throughput=500.0))
+    assert not verdict['targets'][0]['breaching']
+    # ...so a fresh fast burn fires a SECOND anomaly (re-armed)
+    for i in range(3):
+        policy.observe(_win(start + 20 + i, throughput=10.0))
+    assert len(_breach_events()) == 2
+
+
+def test_budget_metrics_counter_and_gauge():
+    policy = slo.SloPolicy(slo.parse_spec('rows_per_sec>=100'))
+    policy.observe(_win(0.0, throughput=10.0))     # 1 bad window
+    for i in range(19):
+        policy.observe(_win(1.0 + i, throughput=500.0))
+    reg = T.get_registry()
+    assert reg.counter_value(slo.SLO_BREACH_WINDOWS,
+                             target='rows_per_sec') == 1
+    # 1 bad of 20 windows = 5% bad against a 10% budget: half remains
+    assert reg.gauge_value(slo.SLO_BUDGET_REMAINING,
+                           target='rows_per_sec') == pytest.approx(0.5)
+    section = policy.section()['targets'][0]
+    assert section['windows_evaluated'] == 20
+    assert section['windows_bad'] == 1
+    assert section['budget_remaining'] == pytest.approx(0.5)
+
+
+def test_queue_wait_and_staleness_targets_resolve():
+    policy = slo.SloPolicy(slo.parse_spec(
+        'queue_wait_p99<=50ms;append_staleness<=30s'))
+    verdict = policy.observe(_win(0.0, p99=0.2, staleness=5.0))
+    by_target = {v['target']: v for v in verdict['targets']}
+    assert by_target['queue_wait_p99']['bad']          # 0.2 > 0.05
+    assert not by_target['append_staleness']['bad']    # 5 <= 30
+
+
+# -- policy lifecycle --------------------------------------------------------
+
+
+def test_get_policy_keeps_burn_state_across_refresh(monkeypatch):
+    monkeypatch.setenv('PETASTORM_TPU_SLO', 'rows_per_sec>=100')
+    policy = slo.get_policy()
+    assert policy is not None
+    policy.observe(_win(0.0, throughput=10.0))
+    slo.refresh_slo()  # unchanged spec: same object, state intact
+    assert slo.get_policy() is policy
+    assert policy.section()['targets'][0]['windows_evaluated'] == 1
+    # a CHANGED spec re-parses from scratch
+    monkeypatch.setenv('PETASTORM_TPU_SLO', 'rows_per_sec>=200')
+    fresh = slo.get_policy()
+    assert fresh is not policy
+    assert fresh.section()['targets'][0]['windows_evaluated'] == 0
+    monkeypatch.delenv('PETASTORM_TPU_SLO')
+    assert slo.get_policy() is None
+    assert slo.slo_section() is None
+
+
+# -- QoS weight advice -------------------------------------------------------
+
+
+def test_qos_weight_advice_only_moves_weight_while_burning():
+    entries = [
+        {'job_id': 1, 'name': 'starved', 'worker_share': 0.2,
+         'target_share': 0.5},
+        {'job_id': 2, 'name': 'donor', 'worker_share': 0.6,
+         'target_share': 0.3},
+        {'job_id': 3, 'name': 'even', 'worker_share': 0.5,
+         'target_share': 0.5},
+    ]
+    burning = {'targets': [{'breaching': True}]}
+    calm = {'targets': [{'breaching': False}]}
+    advice = slo.qos_weight_advice(entries, slo=burning)
+    assert [a['advice'] for a in advice] == \
+        ['raise_weight', 'lower_weight', 'ok']
+    # with budgets intact weight churn is noise: everything is ok
+    assert all(a['advice'] == 'ok'
+               for a in slo.qos_weight_advice(entries, slo=calm))
+    assert slo.qos_weight_advice([], slo=burning) == []
+
+
+# -- the on-disk flight recorder ---------------------------------------------
+
+
+def test_obslog_append_merges_kind_and_stamps_ts(tmp_path, monkeypatch):
+    assert obslog.append('window', {'a': 1}) is False  # unarmed: no-op
+    monkeypatch.setenv('PETASTORM_TPU_OBS_LOG_DIR', str(tmp_path))
+    obslog.refresh_obslog()
+    assert obslog.append('window', {'a': 1}) is True
+    (record,) = obslog.read_log(str(tmp_path))
+    assert record['kind'] == 'window'
+    assert record['a'] == 1
+    assert record['ts'] > 0
+
+
+def test_obslog_two_slot_ring_rotates_at_cap(tmp_path):
+    writer = obslog.ObsLogWriter(str(tmp_path), cap=300)
+    for seq in range(40):
+        assert writer.append('window', {'seq': seq})
+    assert os.path.exists(writer.path + '.1')
+    # disk use stays bounded near 2x the cap no matter the append count
+    total = (os.path.getsize(writer.path)
+             + os.path.getsize(writer.path + '.1'))
+    assert total < 3 * 300
+    seqs = [r['seq'] for r in obslog.read_log(str(tmp_path))]
+    # oldest records fell off the ring, order survives, tail is intact
+    assert seqs == sorted(seqs)
+    assert seqs[-1] == 39
+    assert len(seqs) < 40
+
+
+def test_obslog_read_skips_torn_lines(tmp_path):
+    path = os.path.join(str(tmp_path), 'obslog.jsonl')
+    with open(path, 'w') as f:
+        f.write(json.dumps({'kind': 'window', 'seq': 0}) + '\n')
+        f.write('\n')
+        f.write('{"kind": "window", "seq": 1')  # crash mid-write
+    records = obslog.read_log(str(tmp_path))
+    assert [r['seq'] for r in records] == [0]
+
+
+# -- acceptance: seeded slow consumer breaches a queue_wait_p99 SLO ----------
+
+
+def _get_json(route, port=None):
+    port = port or obs_server.server_port()
+    assert port, 'no obs server bound'
+    return json.loads(urllib.request.urlopen(
+        'http://127.0.0.1:%d%s' % (port, route), timeout=10).read())
+
+
+def _wait_for(predicate, timeout_s=20, interval_s=0.05):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        value = predicate()
+        if value:
+            return value
+        time.sleep(interval_s)
+    return predicate()
+
+
+def test_slow_consumer_breaches_queue_wait_slo(tmp_path, monkeypatch):
+    """Acceptance (ISSUE 19): a seeded slow consumer under a
+    ``queue_wait_p99`` SLO fires ``slo_breach`` visible in the live
+    ``/health`` (status flips to ``slo-breach``), the final
+    ``pipeline_report()``, and the ``obs_replay`` rendering of the
+    flight-log directory.
+
+    The threshold sits below the first duration-histogram bucket
+    (0.1ms), so every window with any consumer pull is a bad window —
+    the drill exercises the burn/breach machinery deterministically
+    rather than depending on host timing.
+    """
+    from tests.test_common import create_test_scalar_dataset
+    from petastorm_tpu.reader import make_batch_reader
+    url = 'file://' + str(tmp_path / 'dataset')
+    create_test_scalar_dataset(url, num_rows=80, num_files=8)
+    log_dir = str(tmp_path / 'blackbox')
+
+    monkeypatch.setenv('PETASTORM_TPU_OBS_PORT', '0')
+    monkeypatch.setenv('PETASTORM_TPU_OBS_WINDOW_SEC', '0.2')
+    monkeypatch.setenv('PETASTORM_TPU_SLO', 'queue_wait_p99<=0.05ms')
+    monkeypatch.setenv('PETASTORM_TPU_OBS_LOG_DIR', log_dir)
+    T.refresh()
+
+    with make_batch_reader(url, reader_pool_type='thread',
+                           workers_count=2, results_queue_size=1,
+                           num_epochs=4, shuffle_row_groups=False) as reader:
+        for _ in reader:
+            time.sleep(0.12)  # deliberately slow consumer
+        # the breach persists once fired (no good windows can follow a
+        # sub-bucket threshold), so a post-loop poll settles it
+        health = _wait_for(
+            lambda: (lambda doc: doc
+                     if doc.get('status') == 'slo-breach' else None)(
+                         _get_json('/health')), timeout_s=10)
+    assert health and health['status'] == 'slo-breach', health
+    target = next(t for t in health['slo']['targets']
+                  if t['target'] == 'queue_wait_p99')
+    assert target['breaching']
+    assert target['windows_bad'] >= slo._MIN_WINDOWS
+
+    # final pipeline_report(): the SLO section and the anomaly ring
+    report = T.pipeline_report()
+    final = next(t for t in report['slo']['targets']
+                 if t['target'] == 'queue_wait_p99')
+    assert final['breaching']
+    assert final['budget_remaining'] == pytest.approx(0.0)
+    assert report['anomalies']['by_kind'].get('slo_breach', 0) >= 1
+    reg = T.get_registry()
+    assert reg.counter_value(slo.SLO_BREACH_WINDOWS,
+                             target='queue_wait_p99') >= slo._MIN_WINDOWS
+
+    # the flight recorder caught it all, and obs_replay folds it back
+    records = obslog.read_log(log_dir)
+    kinds = {r.get('kind') for r in records}
+    assert {'window', 'slo', 'anomaly'} <= kinds, kinds
+    breach_lines = [r for r in records if r.get('kind') == 'anomaly'
+                    and r.get('anomaly') == 'slo_breach']
+    assert breach_lines, 'no slo_breach anomaly reached the obs log'
+    assert 'runbook' in breach_lines[0]
+
+    replay = _obs_replay()
+    summary = replay.fold_summary(records)
+    assert summary['windows'] > 0
+    assert summary['anomaly_kinds'].get('slo_breach', 0) >= 1
+    folded = next(t for t in summary['slo']
+                  if t['target'] == 'queue_wait_p99')
+    assert folded['breaching_at_end']
+    assert folded['breaches'] and folded['breaches'][0][1] is None
+    assert folded['windows_bad'] >= slo._MIN_WINDOWS
+    # the human renderings name the breach too
+    lines = []
+    replay.render_burn_report(summary['slo'], out=lines.append)
+    assert any('BREACHING' in line for line in lines)
+    lines = []
+    replay.render_timeline(replay.split_records(records),
+                           out=lines.append)
+    assert any('!! slo_breach' in line for line in lines)

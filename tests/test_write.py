@@ -19,6 +19,7 @@ The load-bearing contracts:
 
 import glob
 import hashlib
+import json
 import os
 import threading
 import time
@@ -40,9 +41,9 @@ from petastorm_tpu.reader import make_batch_reader
 from petastorm_tpu.unischema import Unischema, UnischemaField
 from petastorm_tpu.workers.thread_pool import ThreadPool
 from petastorm_tpu.write import (
-    AppendFollower, DistributedDatasetWriter, ManifestError, compact_dataset,
-    gc_superseded, load_manifest, plan_compaction, self_check,
-    write_dataset_distributed,
+    AppendFollower, CompactionDaemon, DistributedDatasetWriter, ManifestError,
+    compact_dataset, gc_superseded, load_manifest, plan_compaction,
+    self_check, write_dataset_distributed,
 )
 from petastorm_tpu.write import manifest as wmanifest
 
@@ -751,3 +752,70 @@ class TestWriteReadContract:
             os.environ.clear()
             os.environ.update(saved)
         assert _read_ids(url, predicate=pred) == oracle == [3, 77, 150, 199]
+
+
+# ---------------------------------------------------------------------------
+# PR 19 satellites: trace threading, the staleness gauge, the daemon mount
+# ---------------------------------------------------------------------------
+
+
+class TestWriteObservability:
+    def test_writer_threads_trace_and_dumps_chrome_json(self, tmp_path,
+                                                        monkeypatch):
+        """With tracing armed the writer mints per-shard contexts, the
+        encode/write_flush stages land in the flight recorder, and
+        ``dump_trace`` exports them as Chrome trace-event JSON — the
+        write-plane sibling of ``Reader.dump_trace``."""
+        monkeypatch.setenv('PETASTORM_TPU_TRACE', '1')
+        monkeypatch.setenv('PETASTORM_TPU_TRACE_SAMPLE', '1')
+        T.refresh()
+        url = 'file://' + str(tmp_path / 'traced')
+        w = write_dataset_distributed(url, SCHEMA, _rows(40), shard_rows=20)
+        out = str(tmp_path / 'write-trace.json')
+        assert w.dump_trace(out) > 0
+        with open(out) as f:
+            doc = json.load(f)
+        names = {e.get('name') for e in doc['traceEvents']}
+        assert {'encode', 'write_flush'} <= names, names
+        # the shard lifelines carry minted contexts, so the critical-path
+        # engine can reconstruct the write plane too
+        assert any((e.get('args') or {}).get('trace_id')
+                   for e in doc['traceEvents'])
+
+    def test_append_follower_publishes_staleness_gauge(self, tmp_path):
+        from petastorm_tpu.write import append as wappend
+        url = 'file://' + str(tmp_path)
+        write_dataset_distributed(url, SCHEMA, _rows(20), shard_rows=20)
+        follower = AppendFollower(url, max_staleness_s=0.2)
+        reg = T.get_registry()
+        follower._note_staleness(True)   # undelivered rows pending
+        lag = reg.gauge_value(wappend.APPEND_STALENESS)
+        assert lag is not None and lag >= 0.0
+        follower._note_staleness(False)  # caught up
+        assert reg.gauge_value(wappend.APPEND_STALENESS) == 0.0
+
+    def test_compaction_daemon_mounts_health_section(self, tmp_path,
+                                                     monkeypatch):
+        from petastorm_tpu.telemetry import obs_server
+        monkeypatch.setenv('PETASTORM_TPU_OBS_PORT', '0')
+        T.refresh()
+        url, total, _ = _small_file_dataset(tmp_path, files=6, rows_per=30)
+        daemon = CompactionDaemon(url, interval_s=0.1, gc_grace_s=600.0)
+        daemon.start()
+        try:
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline and daemon.runs == 0:
+                time.sleep(0.05)
+            assert daemon.runs >= 1, 'daemon never folded the small files'
+            health = obs_server.build_health()
+            section = next(v for k, v in health['components'].items()
+                           if k.startswith('compaction-daemon'))
+            assert section['dataset_url'] == url
+            assert section['runs'] >= 1
+            assert section['generation'] >= 2  # the fold published
+        finally:
+            daemon.stop()
+        # stop() unmounts: a dead daemon must not linger in /health
+        assert not any(k.startswith('compaction-daemon')
+                       for k in obs_server.build_health()['components'])
+        assert _read_ids(url) == list(range(total))
