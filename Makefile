@@ -45,16 +45,18 @@ trend:
 	  --allow critpath_overhead_share
 
 # seeded chaos suite (docs/service.md "Failure semantics" + "Standing
-# service" + "High availability"): deterministic fault injection, poison
-# quarantine, dispatcher restart, daemon SIGKILL/restart, lease lapse,
-# breaker trips, standby failover/promotion, QoS preemption. The fast
-# subset is tier-1; the soak variant runs the slow-marked full-epoch
-# drills on top.
+# service" + "High availability" + "Fleet cache tier"): deterministic
+# fault injection, poison quarantine, dispatcher restart, daemon
+# SIGKILL/restart, lease lapse, breaker trips, standby
+# failover/promotion, QoS preemption, and the peer-loss drill (a holder
+# dies mid-fetch → local decode, exact rows, zero quarantines). The
+# fast subset is tier-1; the soak variant runs the slow-marked
+# full-epoch drills on top.
 chaos:
-	$(PYTHON) -m pytest tests/test_chaos.py tests/test_daemon.py tests/test_failover.py -q -m "not slow"
+	$(PYTHON) -m pytest tests/test_chaos.py tests/test_daemon.py tests/test_failover.py tests/test_peer_cache.py -q -m "not slow"
 
 chaos-soak:
-	$(PYTHON) -m pytest tests/test_chaos.py tests/test_daemon.py tests/test_failover.py -q
+	$(PYTHON) -m pytest tests/test_chaos.py tests/test_daemon.py tests/test_failover.py tests/test_peer_cache.py -q
 
 # streaming mixture engine (docs/mixture.md): determinism/resume/reshard
 # oracles plus the weighted-sampling regressions. Fast subset is tier-1

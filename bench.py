@@ -139,9 +139,11 @@ _START = time.monotonic()
 # the two sharded_staging keys, → 1800 for the two service HA keys,
 # → 1900 for the two mixture_stream keys (worst case ~1845), → 1950
 # for the write_throughput headline key, → 1980 for the two critpath
-# keys (worst case 1965; +newline still ≤ the 2,000-char driver tail)
+# keys, → 2000 for the peer_hit_share key (worst case 1999; +newline
+# is exactly the 2,000-char driver tail, so the key list is now FULL —
+# the next key must drop or shorten one)
 # — the emit loop still drops tail keys at the cap
-_HEADLINE_MAX_CHARS = 1980
+_HEADLINE_MAX_CHARS = 2000
 _HEADLINE_EXTRA_KEYS = (
     'vs_tfdata',
     'hello_world_warm_epoch_rows_per_sec',
@@ -174,6 +176,11 @@ _HEADLINE_EXTRA_KEYS = (
     # bindings that landed on a fingerprint-warm host
     'service_failover_blackout_s',
     'service_placement_hit_share',
+    # fleet cache tier (bench peer_cache section): share of warm-epoch
+    # row-groups a two-worker fleet served WITHOUT a fresh decode
+    # (local disk hit or peer fetch); decode counts and the warm
+    # speedup stay in the full cumulative dict
+    'peer_hit_share',
     'lm_train_mfu',
     'lm_train_input_bound_util',
     'lm_train_tuned_mfu',
@@ -2369,6 +2376,108 @@ def main():
                     p.kill()
                     p.wait()
 
+    def sec_peer_cache():
+        # Fleet cache tier record (docs/service.md, "Fleet cache
+        # tier"): two worker servers with DISJOINT host-local cache
+        # directories over one hot dataset. Epoch 1 decodes each
+        # row-group exactly once fleet-wide (cold fill with an injected
+        # decode cost). Epoch 2 is a fresh job over the same keys: the
+        # decoding host serves its own items from its disk tier and the
+        # OTHER host's items arrive by peer fetch — so the headline is
+        # the share of warm-epoch items served without a fresh decode.
+        from petastorm_tpu.materialized_cache import (
+            MaterializedRowGroupCache,
+        )
+        from petastorm_tpu.service.daemon import DaemonClientPool
+        from petastorm_tpu.service.protocol import free_tcp_port
+        from petastorm_tpu.workers.worker_base import WorkerBase
+
+        class _FleetDecode(WorkerBase):  # shipped to the workers via dill
+            def process(self, item):
+                import time as _time
+
+                import numpy as _np
+
+                from petastorm_tpu.arrow_worker import ColumnBatch
+                decoded = []
+
+                def fill():
+                    decoded.append(True)
+                    _time.sleep(self.args['decode_s'])
+                    cols = {'v': _np.full(256, item, dtype=_np.int64)}
+                    return ColumnBatch(cols, 256)
+
+                batch = self.args['cache'].get(('bench-peer', item), fill)
+                self.publish_func((item, bool(decoded),
+                                   int(batch.columns['v'][0])))
+
+        repo = os.path.dirname(os.path.abspath(__file__))
+        env = dict(os.environ, PYTHONPATH=repo, JAX_PLATFORMS='cpu')
+        endpoint = 'tcp://127.0.0.1:%d' % free_tcp_port()
+        procs = [subprocess.Popen(
+            [sys.executable, '-m', 'petastorm_tpu.service',
+             '--endpoint', endpoint, '--no-supervisor',
+             '--heartbeat-interval', '0.2'], env=env)]
+        procs += [subprocess.Popen(
+            [sys.executable, '-m', 'petastorm_tpu.service.worker_server',
+             '--endpoint', endpoint, '--heartbeat-interval', '0.2',
+             '--ack-timeout', '2', '--parent-pid', str(os.getpid()),
+             '--cache-dir', os.path.join(tmp, 'peer_host%d' % i)],
+            env=env) for i in range(2)]
+        cache = MaterializedRowGroupCache(
+            os.path.join(tmp, 'peer_seed'), disk_limit_bytes=1 << 30,
+            mem_limit_bytes=0)
+        n = 24 if SMOKE else 48
+        pools = []
+
+        def one_epoch(name):
+            pool = DaemonClientPool(endpoint, name=name,
+                                    heartbeat_interval_s=0.2,
+                                    ack_timeout_s=2,
+                                    connect_timeout_s=60)
+            pools.append(pool)
+            pool.start(_FleetDecode,
+                       worker_args={'cache': cache, 'decode_s': 0.02,
+                                    'placement_group': 'bench-peer'})
+            start = time.monotonic()
+            for i in range(n):
+                pool.ventilate(i)
+            rows = [pool.get_results(timeout=60) for _ in range(n)]
+            elapsed = time.monotonic() - start
+            pools.remove(pool)
+            pool.stop()
+            pool.join()
+            return rows, elapsed
+
+        try:
+            cold_rows, cold_s = one_epoch('bench-peer-cold')
+            warm_rows, warm_s = one_epoch('bench-peer-warm')
+            decodes = sum(1 for _, was_decoded, _v in warm_rows
+                          if was_decoded)
+            extra['peer_hit_share'] = round((n - decodes) / n, 3)
+            extra['peer_cache_warm_decodes'] = decodes
+            extra['peer_cache_cold_epoch_s'] = round(cold_s, 2)
+            extra['peer_cache_warm_epoch_s'] = round(warm_s, 2)
+            if warm_s > 0:
+                extra['peer_cache_warm_speedup'] = round(cold_s / warm_s, 2)
+            extra['peer_cache_exact'] = (
+                sorted(v for _i, _d, v in warm_rows)
+                == sorted(v for _i, _d, v in cold_rows)
+                == list(range(n)))
+        finally:
+            for p in pools:
+                p.stop()
+                p.join()
+            for p in procs:
+                if p.poll() is None:
+                    p.terminate()
+            for p in procs:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait()
+
     def sec_lm_tokens():
         _build_c4_like(c4_url)
         extra['lm_packed_tokens_per_sec'] = round(_measure_lm_tokens(c4_url),
@@ -2675,6 +2784,7 @@ def main():
         section('write_throughput', 15, sec_write_throughput)
         section('critpath', 10, sec_critpath)
         section('service', 20, sec_service)
+        section('peer_cache', 15, sec_peer_cache)
         section('lm_tokens', 10, sec_lm_tokens)
         section('imagenet', 20, sec_imagenet)
         section('probe', 20, lambda: _probe_tpu(extra))

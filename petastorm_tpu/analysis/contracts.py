@@ -48,12 +48,17 @@ telemetry back).
 #: into a parquet part file (etl/dataset_metadata.DatasetWriter._flush)
 #: · ``compact`` one compaction group folded: source part files read at
 #: the arrow level, re-chunked to readahead-friendly row-groups and
-#: rewritten (write/compact.py)
+#: rewritten (write/compact.py) · ``peer_fetch`` one finished decoded
+#: entry fetched from a peer worker's serve socket instead of decoded
+#: locally: request + streamed Arrow IPC bytes + verify + atomic
+#: publish into the local disk tier (service/peer_cache.py; wire-priced
+#: where ``decode`` would be decode-priced)
 STAGES = ('ventilate', 'io', 'decode', 'filter', 'transform', 'queue_wait',
           'collate', 'h2d', 'h2d_ready', 'stage_fill', 'h2d_dispatch',
           'cache_hit_read', 'cache_fill', 'decode_fused',
           'rowgroup_prune', 'late_materialize', 'autotune',
-          'readahead_fetch', 'pack', 'encode', 'write_flush', 'compact')
+          'readahead_fetch', 'pack', 'encode', 'write_flush', 'compact',
+          'peer_fetch')
 
 #: every trace-event name the package records outside the canonical stage
 #: spans (docs/telemetry.md, tracing section)
@@ -198,6 +203,16 @@ METRIC_NAMES = frozenset([
     # bounded-staleness append reads (write/append.py): observed lag
     # between the latest committed manifest and the follower's delivery
     'petastorm_tpu_append_staleness_s',
+    # fleet-wide decoded-cache tier: peer-served entries
+    # (service/peer_cache.py + dispatcher.py). Hits/bytes count
+    # successful peer fetches on the FETCHING worker; misses carry the
+    # degrade reason (no_holder, peer_miss, timeout, budget, corrupt,
+    # injected, send) — every miss decodes locally, never errors;
+    # evict_hints counts the dispatcher's advisory global-LRU hints
+    'petastorm_tpu_peer_cache_hits_total',
+    'petastorm_tpu_peer_cache_misses_total',
+    'petastorm_tpu_peer_cache_bytes_total',
+    'petastorm_tpu_peer_cache_evict_hints_total',
     # SLO plane (telemetry/slo.py): per-target breach windows + the
     # error budget left in the long burn window (1.0 = untouched)
     'petastorm_tpu_slo_breach_windows_total',
@@ -284,6 +299,11 @@ KNOWN_KNOBS = frozenset([
     'PETASTORM_TPU_SLO',
     'PETASTORM_TPU_OBS_LOG_DIR',
     'PETASTORM_TPU_OBS_LOG_MB',
+    'PETASTORM_TPU_PEER_CACHE',
+    'PETASTORM_TPU_PEER_CACHE_HOST',
+    'PETASTORM_TPU_PEER_CACHE_BUDGET_MB',
+    'PETASTORM_TPU_PEER_CACHE_TIMEOUT_S',
+    'PETASTORM_TPU_PEER_CACHE_COLD_S',
 ])
 
 #: canonical anomaly event kinds the live observability plane's detector
@@ -362,6 +382,17 @@ FAULTPOINTS = {
                        'retried with backoff inside the promote window '
                        '— the failover drill\'s knob for prolonging the '
                        'blackout deterministically)',
+    'zmq.peer_serve': 'a peer-cache serve reply (service/peer_cache.py '
+                      'server side; drop = the holding worker never '
+                      'answers and the fetcher times out into local '
+                      'decode — the peer-loss drill without killing a '
+                      'process; error = the serve fails mid-read)',
+    'zmq.peer_fetch': 'a peer-cache fetch attempt (service/peer_cache.py '
+                      'client side, hit before the request is sent; '
+                      'error/drop = the fetch fails and the worker '
+                      'degrades to local decode, counted in '
+                      'petastorm_tpu_peer_cache_misses_total'
+                      '{reason=injected} — never a wrong answer)',
     'io.write': 'the distributed write plane\'s publication seams '
                 '(write/writer.py, write/compact.py, write/manifest.py):'
                 ' part-file data write/close (keys end in #part), the '

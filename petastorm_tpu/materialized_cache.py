@@ -35,6 +35,7 @@ import pickle
 import re
 import tempfile
 import threading
+import time
 import types
 from collections import OrderedDict
 
@@ -98,6 +99,42 @@ _READ_FAULT_ERRNOS = frozenset((errno.EIO,))
 #: tolerate this many CONSECUTIVE occurrences before degrading anyway —
 #: a tier failing every single store is not caching, just burning time
 _CONSECUTIVE_FAILURE_LIMIT = 5
+
+#: memory-tier hits LRU-touch their backing disk entry at most this
+#: often per entry: the disk LRU only needs coarse freshness, and a hot
+#: in-memory loop must not pay one utime syscall per hit
+_UTIME_INTERVAL_S = 5.0
+_UTIME_TRACKED_CAP = 4096
+
+
+# -- publish notifications ----------------------------------------------------
+# The peer-cache serve plane (service/peer_cache.py) advertises entries
+# the moment THIS process publishes them instead of waiting out its
+# directory rescan; module-level so one hook covers every cache object.
+
+_PUBLISH_LISTENERS = []
+
+
+def add_publish_listener(listener):
+    """Register ``listener(entry_path, size)`` to run after every
+    successful disk-tier publish in this process."""
+    _PUBLISH_LISTENERS.append(listener)
+
+
+def remove_publish_listener(listener):
+    try:
+        _PUBLISH_LISTENERS.remove(listener)
+    except ValueError:
+        pass
+
+
+def _notify_published(entry, size):
+    for listener in list(_PUBLISH_LISTENERS):
+        try:
+            listener(entry, size)
+        except Exception:  # noqa: BLE001 - adverts are advisory
+            from petastorm_tpu.telemetry import count_swallowed
+            count_swallowed('cache-publish-listener')
 
 #: dtype kinds whose flat buffer round-trips through np.frombuffer —
 #: these columns mmap back zero-copy; everything else ('O' object arrays:
@@ -434,6 +471,10 @@ class MaterializedRowGroupCache(CacheBase):
         # failing syscall per row-group forever.
         self._degraded = False
         self._consecutive_failures = 0
+        # fleet peer-fetch hook (service/peer_cache.py), armed only by
+        # the service worker wiring; None = plain host-local cache
+        self._peer = None
+        self._utime_at = {}  # entry path -> monotonic time of last touch
         self._attach(path)
 
     def _attach(self, path):
@@ -463,7 +504,13 @@ class MaterializedRowGroupCache(CacheBase):
                                    pid=str(os.getpid())).set(0)
         self._degraded = False
         self._consecutive_failures = 0
+        with self._lock:
+            self._utime_at.clear()
         self._attach(path)
+        # a re-rooted dir that holds no real entries must not keep
+        # advertising placement fingerprints it no longer backs
+        from petastorm_tpu.service.placement import purge_stale_markers
+        purge_stale_markers(path)
 
     def __getstate__(self):
         # Crosses the process-pool/service spawn boundary: the lock can't
@@ -473,11 +520,16 @@ class MaterializedRowGroupCache(CacheBase):
         del state['_lock']
         state['_mem'] = OrderedDict()
         state['_mem_bytes'] = 0
+        state['_peer'] = None    # the fetch client owns sockets
+        state['_utime_at'] = {}
         return state
 
     def __setstate__(self, state):
         self.__dict__.update(state)
         self._lock = threading.Lock()
+        # entries pickled by a pre-fleet-tier build
+        self.__dict__.setdefault('_peer', None)
+        self.__dict__.setdefault('_utime_at', {})
 
     @property
     def path(self):
@@ -547,15 +599,13 @@ class MaterializedRowGroupCache(CacheBase):
         if hit is not None:
             registry.counter(DECODED_CACHE_HITS).inc()
             registry.counter(DECODED_CACHE_MEM_HITS).inc()
-            try:
-                # LRU touch even on memory-tier hits: the backing disk
-                # entry's atime is what eviction sorts by, and without it
-                # the disk LRU would evict exactly the hot working set —
-                # invisible to THIS process, devastating to every fresh
-                # pool worker and co-trained job sharing the directory.
-                os.utime(entry)
-            except OSError:
-                pass
+            # LRU touch even on memory-tier hits: the backing disk
+            # entry's atime is what eviction sorts by, and without it
+            # the disk LRU would evict exactly the hot working set —
+            # invisible to THIS process, devastating to every fresh
+            # pool worker and co-trained job sharing the directory.
+            # Rate-limited: coarse freshness is all the LRU needs.
+            self._touch_entry(entry)
             columns, length, _ = hit
             return ColumnBatch(dict(columns), length) if length else None
         if not self._degraded:
@@ -595,6 +645,17 @@ class MaterializedRowGroupCache(CacheBase):
                                  op='corrupt').inc()
                 self._remove_entry(entry)
         registry.counter(DECODED_CACHE_MISSES).inc()
+        if self._peer is not None and not self._degraded:
+            # fleet tier (docs/service.md, "Fleet cache tier"): a known
+            # holder serves the finished entry at wire price before we
+            # pay the decode; ANY failure returns None and the local
+            # fill below proceeds — degraded is never wrong
+            served = self._peer.fetch(key, entry, self)
+            if served is not None:
+                columns, length = served
+                self._mem_put(key, columns, length)
+                return (ColumnBatch(dict(columns), length) if length
+                        else None)
         batch = fill_cache_func()
         columns = dict(batch.columns) if batch is not None else {}
         length = batch.length if batch is not None else 0
@@ -616,6 +677,7 @@ class MaterializedRowGroupCache(CacheBase):
             self._size_gauge().set(self._total)
             self._consecutive_failures = 0
             self._mem_put(key, columns, length)
+            _notify_published(entry, size)
             if over_limit:
                 self._maybe_evict()
         except (OSError, ValueError, pickle.PicklingError) as e:
@@ -658,6 +720,46 @@ class MaterializedRowGroupCache(CacheBase):
         logger.warning('Decoded cache at %s degraded to decode-through: '
                        '%s', self._path, reason)
 
+    def _touch_entry(self, entry):
+        """Rate-limited LRU touch of a disk entry backing a memory-tier
+        hit (at most once per entry per :data:`_UTIME_INTERVAL_S`)."""
+        now = time.monotonic()
+        with self._lock:
+            last = self._utime_at.get(entry)
+            if last is not None and now - last < _UTIME_INTERVAL_S:
+                return
+            if len(self._utime_at) > _UTIME_TRACKED_CAP:
+                self._utime_at.clear()  # worst case: one extra utime each
+            self._utime_at[entry] = now
+        try:
+            os.utime(entry)
+        except OSError:
+            pass
+
+    # -- the fleet peer tier -------------------------------------------------
+
+    def attach_peer_client(self, client):
+        """Arm the fleet peer-fetch path (service worker wiring): on a
+        local disk miss, ``client.fetch`` is tried before the decode."""
+        self._peer = client
+
+    def publish_fetched(self, entry, write_func):
+        """Publish a peer-fetched entry into the disk tier with the same
+        atomic tmp+rename discipline and size/eviction accounting as a
+        local fill — on disk the peer path must be indistinguishable.
+        Returns the published size; raises on failure (the fetch path
+        degrades to local decode)."""
+        size, replaced = publish_entry(entry, write_func)
+        self._registry().counter(DECODED_CACHE_BYTES_WRITTEN).inc(size)
+        with self._lock:
+            self._total += size - replaced
+            over_limit = self._total > self._disk_limit
+        self._size_gauge().set(self._total)
+        _notify_published(entry, size)
+        if over_limit:
+            self._maybe_evict()
+        return size
+
     def _remove_entry(self, entry):
         try:
             size = os.stat(entry).st_size
@@ -688,3 +790,8 @@ class MaterializedRowGroupCache(CacheBase):
         if self._cleanup_on_exit:
             import shutil
             shutil.rmtree(self._path, ignore_errors=True)
+            return
+        # a kept directory must not advertise placement fingerprints for
+        # entries that no longer exist (stale `.fp_` markers)
+        from petastorm_tpu.service.placement import purge_stale_markers
+        purge_stale_markers(self._path)

@@ -98,6 +98,9 @@ import uuid
 
 from petastorm_tpu import faults
 from petastorm_tpu.service import protocol as proto
+from petastorm_tpu.service.peer_cache import (
+    PEER_CACHE_EVICT_HINTS, FleetCacheDirectory,
+)
 from petastorm_tpu.telemetry import (
     count_swallowed, get_registry, knobs, merge_worker_delta,
     metrics_disabled, note_producer_wait, tracing,
@@ -108,6 +111,15 @@ logger = logging.getLogger(__name__)
 
 _POLL_INTERVAL_MS = 50
 _STOP_BROADCASTS = 3
+
+#: how often the sweep recomputes fleet-global eviction hints from the
+#: peer-cache directory (hint queues drain on heartbeat ACKs between
+#: recomputes; coarser than the cold threshold is all that's needed)
+_PEER_HINT_INTERVAL_S = 5.0
+
+#: digests answered per DIRGET request (the asker re-asks for the rest;
+#: in practice it asks for one digest per fetch)
+_DIRGET_CAP = 64
 
 #: liveness floor for workers WAITING for a job (job_id None): their
 #: only liveness signal is the REGISTER re-send, whose worker-side
@@ -151,7 +163,8 @@ SERVICE_PREEMPTIONS = 'petastorm_tpu_service_preemptions_total'
 
 class _WorkerState:
     __slots__ = ('identity', 'last_heartbeat', 'ready', 'inflight',
-                 'job_id', 'cordoned', 'pid', 'cache_fps', 'preempted_to')
+                 'job_id', 'cordoned', 'pid', 'cache_fps', 'preempted_to',
+                 'peer_dir_seen')
 
     def __init__(self, identity, now):
         self.identity = identity
@@ -177,6 +190,9 @@ class _WorkerState:
         #: mid-item), then re-bound by priority. Distinct from
         #: ``cordoned``, which is the supervisor's TERMINATE path.
         self.preempted_to = None
+        #: peer-cache directory version last piggybacked to this worker
+        #: on a WORK frame (fleet cache tier, docs/service.md)
+        self.peer_dir_seen = 0
 
 
 class _Job:
@@ -344,6 +360,15 @@ class Dispatcher:
         # fleets where fingerprint adverts misbehave
         self._placement_enabled = not knobs.is_disabled(
             'PETASTORM_TPU_SERVICE_PLACEMENT')
+        # fleet cache tier (docs/service.md, "Fleet cache tier"): the
+        # digest -> holders directory folded from worker adverts, plus
+        # the advisory global-eviction machinery. On by default;
+        # PETASTORM_TPU_PEER_CACHE=0 is the host-local oracle.
+        self._peer_enabled = not knobs.is_disabled(
+            'PETASTORM_TPU_PEER_CACHE')
+        self._peer_dir = FleetCacheDirectory()
+        self._peer_hint_at = 0.0
+        self._peer_evict_hints_sent = 0
         #: this dispatcher incarnation's identity, riding every SPEC and
         #: HEARTBEAT_ACK: a worker that sees the token change knows its
         #: dispatcher was replaced and must re-register for the new job
@@ -473,6 +498,13 @@ class Dispatcher:
                                 + [j.job_id for j in self._jobs.values()])
             self._next_item_id = max(self._next_item_id,
                                      int(state.get('next_item_id', 0)))
+            # the replicated peer-cache directory: seeded under synthetic
+            # per-endpoint identities so DIRGET stays warm through the
+            # failover window (workers' re-REGISTER full adverts replace
+            # the seeds; unclaimed seeds age out in the sweep)
+            peer_snapshot = state.get('peer_directory')
+            if peer_snapshot and self._peer_enabled:
+                self._peer_dir.seed(peer_snapshot, time.monotonic())
         except Exception:  # noqa: BLE001 - degrade to a cold promote
             count_swallowed('dispatcher-seed-state')
             logger.warning('Unusable standby seed state; promoting cold '
@@ -505,7 +537,8 @@ class Dispatcher:
         return {'next_item_id': next_item_id,
                 'job_seq': self._job_seq,
                 'jobs': jobs,
-                'fleet_cache_fps': sorted(fleet_fps)}
+                'fleet_cache_fps': sorted(fleet_fps),
+                'peer_directory': self._peer_dir.snapshot()}
 
     # -- thread-safe surface (called from pool / ventilator threads) ---------
 
@@ -633,6 +666,13 @@ class Dispatcher:
         stats['last_standby_sync_age_s'] = (
             round(time.monotonic() - self._last_standby_sync, 3)
             if self._last_standby_sync is not None else None)
+        # fleet cache-tier directory view (docs/service.md, "Fleet cache
+        # tier"): how many entries the fleet advertises, by how many
+        # holders, and the advisory eviction-hint flow
+        peer = dict(self._peer_dir.stats())
+        peer['enabled'] = self._peer_enabled
+        peer['hints_sent'] = self._peer_evict_hints_sent
+        stats['peer_cache'] = peer
         return stats
 
     def fleet_view(self):
@@ -662,6 +702,9 @@ class Dispatcher:
                 entry['preempted_to'] = worker.preempted_to
             if worker.cache_fps:
                 entry['cache_fps'] = sorted(worker.cache_fps)
+            held = self._peer_dir.held_count(identity)
+            if held:
+                entry['peer_entries'] = held
             summary = self._worker_obs.get(identity)
             if summary is not None:
                 entry['summary'] = summary
@@ -865,6 +908,12 @@ class Dispatcher:
                 # the whole point (docs/service.md). Absent from older
                 # builds; a bad frame degrades to no advert.
                 self._note_cache_advert(worker, frames[3])
+            if len(frames) > 4 and self._peer_enabled:
+                # fleet cache tier: the FULL decoded-entry advert from
+                # the worker's startup scan — the directory is complete
+                # for this holder before its first WORK is assigned
+                self._peer_dir.note_advert(
+                    identity, proto.load_json_params(frames[4]))
             if worker.job_id is None:
                 self._bind_worker(worker)
             job = self._jobs.get(worker.job_id)
@@ -934,8 +983,24 @@ class Dispatcher:
                 fps = summary.get('cache_fp')
                 if isinstance(fps, list):
                     worker.cache_fps.update(str(fp) for fp in fps if fp)
-            sock.send_multipart([identity, proto.MSG_HEARTBEAT_ACK,
-                                 self.token])
+                if self._peer_enabled:
+                    peer = summary.get('peer')
+                    if peer:
+                        # bounded add/evict/touch delta of the worker's
+                        # decoded-cache entries (fleet cache tier)
+                        self._peer_dir.note_advert(identity, peer)
+            ack = [identity, proto.MSG_HEARTBEAT_ACK, self.token]
+            if self._peer_enabled:
+                hints = self._peer_dir.take_hints(identity)
+                if hints:
+                    # advisory global-eviction hints ride the ACK as one
+                    # additive trailing frame (old workers ignore it)
+                    ack.append(proto.dump_json_params({'evict': hints}))
+                    self._peer_evict_hints_sent += len(hints)
+                    if not metrics_disabled():
+                        get_registry().counter(
+                            PEER_CACHE_EVICT_HINTS).inc(len(hints))
+            sock.send_multipart(ack)
         elif msg == proto.MSG_DONE:
             item_id = proto.unpack_item_id(frames[2])
             # frames: [identity, DONE, item_id, metrics, result*]. The
@@ -960,6 +1025,25 @@ class Dispatcher:
             self._fail(identity, item_id, exc, now)
         elif msg == proto.MSG_BYE:
             self._deregister(identity, 'said goodbye')
+        elif msg == proto.MSG_DIR_GET:
+            # fleet cache-tier directory lookup: a worker's peer-cache
+            # client asking (on its OWN DEALER) who holds these entry
+            # digests. Disabled tier answers the empty map — the asker
+            # negative-caches and decodes locally. A malformed request
+            # costs that request, nothing else.
+            import json
+            try:
+                digests = json.loads(frames[2].decode('utf-8')) \
+                    if len(frames) > 2 else []
+                if not isinstance(digests, list):
+                    digests = []
+            except Exception:  # noqa: BLE001 - the directory is advisory
+                count_swallowed('dispatcher-dirget')
+                digests = []
+            mapping = (self._peer_dir.lookup(digests[:_DIRGET_CAP])
+                       if self._peer_enabled else {})
+            sock.send_multipart([identity, proto.MSG_DIR,
+                                 proto.dump_json_params(mapping)])
         elif msg == proto.MSG_STANDBY_SYNC:
             # a warm standby pulling its replication snapshot
             # (docs/service.md, "High availability"). The drop faultpoint
@@ -1772,9 +1856,20 @@ class Dispatcher:
                         'zmq.work', key=item_id) == 'drop':
                     pass  # injected: WORK frame lost; accounting intact
                 else:
-                    sock.send_multipart([worker.identity, proto.MSG_WORK,
-                                         proto.pack_item_id(item_id),
-                                         payload])
+                    work_frames = [worker.identity, proto.MSG_WORK,
+                                   proto.pack_item_id(item_id), payload]
+                    if self._peer_enabled:
+                        # piggyback the directory entries advertised
+                        # since this worker's last WORK (one additive
+                        # trailing frame, capped; DIRGET covers the rest)
+                        version, delta = self._peer_dir.delta_since(
+                            worker.peer_dir_seen,
+                            exclude_identity=worker.identity)
+                        worker.peer_dir_seen = version
+                        if delta:
+                            work_frames.append(
+                                proto.dump_json_params(delta))
+                    sock.send_multipart(work_frames)
                 self._inflight[item_id] = (worker.identity, payload)
                 worker.inflight.add(item_id)
                 self._item_owners.setdefault(item_id,
@@ -1822,6 +1917,14 @@ class Dispatcher:
                     job, 'lease expired (%.1fs > %.1fs silent)'
                     % (silent_s, job.lease_s))
         self._rebalance_step()
+        if self._peer_enabled \
+                and now - self._peer_hint_at > _PEER_HINT_INTERVAL_S:
+            # fleet-global eviction pressure, recomputed coarsely: hints
+            # queue per worker and drain on heartbeat ACKs; failover
+            # seeds nobody re-claimed age out here too
+            self._peer_hint_at = now
+            self._peer_dir.compute_evict_hints(time.time())
+            self._peer_dir.expire_seeds(now)
         # age out trace entries retained past completion for dedup marking
         # (see _complete): a ghost DONE races within ZMQ buffering of one
         # lapse, so several liveness timeouts is a generous window
@@ -1855,6 +1958,7 @@ class Dispatcher:
     def _deregister(self, identity, reason):
         worker = self._workers.pop(identity, None)
         self._worker_obs.pop(identity, None)
+        self._peer_dir.drop(identity)
         if worker is None:
             return
         job = self._jobs.get(worker.job_id)

@@ -85,6 +85,37 @@ def note_fingerprint(cache_dir, fingerprint):
         count_swallowed('placement-marker')
 
 
+def purge_stale_markers(cache_dir):
+    """Remove every ``.fp_`` marker from a cache directory that holds no
+    real entries — a re-rooted or cleaned-up cache must not keep
+    advertising a fingerprint it no longer backs (the marker would steer
+    placement at a cold host forever). Returns the number removed: 0
+    when any real entry still exists (the markers are earned), or on any
+    failure — advisory like everything here."""
+    try:
+        if not cache_dir or not os.path.isdir(cache_dir):
+            return 0
+        from petastorm_tpu.cache import is_tmp_entry
+        markers = []
+        for root, _, files in os.walk(cache_dir):
+            for name in files:
+                if name.startswith(_MARKER_PREFIX):
+                    markers.append(os.path.join(root, name))
+                elif not is_tmp_entry(name):
+                    return 0  # a real entry: the markers are earned
+        removed = 0
+        for path in markers:
+            try:
+                os.remove(path)
+                removed += 1
+            except OSError:
+                pass
+        return removed
+    except Exception:  # noqa: BLE001 - placement is advisory
+        count_swallowed('placement-marker-purge')
+        return 0
+
+
 def advertised_fingerprints(cache_dir, extra=()):
     """The fingerprints a worker server should advertise: marker files
     under ``cache_dir`` plus the in-process ``extra`` set, sorted and

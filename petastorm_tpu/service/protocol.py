@@ -118,6 +118,21 @@ MSG_RESULT = b'RES'              # [RES, <kind>, <client item id>, <payload>*]
 MSG_STANDBY_SYNC = b'SSYNC'      # [SSYNC] — standby pulls a snapshot
 MSG_STANDBY_STATE = b'SSTATE'    # [SSTATE, <token>, <state payload>]
 
+# fleet-wide decoded-cache tier (docs/service.md, "Fleet cache tier").
+# Two ADDITIVE vocabularies. (1) Directory lookups on the dispatcher's
+# ROUTER: a worker's peer-cache client is one more DEALER peer (its own
+# socket — the worker's network loop owns the main DEALER) asking which
+# fleet members hold a decoded entry digest. (2) Entry fetches on a
+# worker server's OWN serve ROUTER: a fetching peer asks for the
+# finished Arrow IPC bytes of one entry. Old builds on either side log
+# an unknown message type and the fetcher degrades to local decode —
+# never wrong, only decode-priced.
+MSG_DIR_GET = b'DIRGET'          # [DIRGET, <digests json list>]
+MSG_DIR = b'DIR'                 # [DIR, <{digest: [[endpoint, size], ...]} json>]
+MSG_PEER_FETCH = b'PFETCH'       # [PFETCH, <digest>]
+MSG_PEER_ENTRY = b'PENTRY'       # [PENTRY, <digest>, <meta json>, <chunk>*]
+MSG_PEER_MISS = b'PMISS'         # [PMISS, <digest>] — holder no longer has it
+
 
 def pack_item_id(item_id):
     return b'%d' % item_id

@@ -70,7 +70,7 @@ def _parent_died(parent_pid):
 
 
 def _register(sock, parent_pid, register_timeout_s, term_event=None,
-              cache_fps=()):
+              cache_fps=(), peer_server=None):
     """REGISTER with exponential backoff until the SPEC arrives.
 
     Returns ``(spec payload, dispatcher token)`` — token None from a
@@ -93,8 +93,23 @@ def _register(sock, parent_pid, register_timeout_s, term_event=None,
     except Exception:  # noqa: BLE001 - placement is advisory
         count_swallowed('worker-cache-advert')
         advert = b''
+    # fleet cache tier: the FULL set of decoded entries this host holds
+    # rides REGISTER (one more additive frame), so the dispatcher's peer
+    # directory is complete before the first WORK lands — a restarted
+    # worker's startup scan re-advertises everything it kept on disk
+    peer_advert = b''
+    if peer_server is not None:
+        try:
+            peer_advert = proto.dump_json_params(peer_server.full_advert())
+        except Exception:  # noqa: BLE001 - adverts are advisory
+            count_swallowed('worker-peer-advert')
+            peer_advert = b''
     frames_out = [proto.MSG_REGISTER, b'%d' % os.getpid()]
-    if advert:
+    if peer_advert:
+        # frame order is positional: the placement advert must occupy
+        # frame 3 (possibly empty) so the peer advert lands at frame 4
+        frames_out.extend([advert, peer_advert])
+    elif advert:
         frames_out.append(advert)
     while True:
         # the trailing pid frame is ADVISORY and additive (an old
@@ -152,7 +167,8 @@ def _reroot_decoded_cache(worker_args):
 
 def _run_job(sock, spec_payload, worker_id, heartbeat_interval_s,
              ack_timeout_s, parent_pid, status=None, token=None,
-             term_event=None, known_fps=None):
+             term_event=None, known_fps=None, endpoint=None,
+             peer_server=None, peer_live=None):
     """One job lifetime: build the worker, stream items until STOP, the
     dispatcher vanishes (ack timeout), or a DIFFERENT dispatcher
     incarnation takes the endpoint (heartbeat-ack token mismatch).
@@ -181,6 +197,36 @@ def _run_job(sock, spec_payload, worker_id, heartbeat_interval_s,
     buffer = []
     worker = worker_class(worker_id, buffer.append, worker_args)
     worker.initialize()
+
+    # fleet cache tier (docs/service.md, "Fleet cache tier"): serve this
+    # job's decoded cache to peers and fetch what peers already decoded.
+    # All best-effort — a failure here costs wire-priced hits, never the
+    # job.
+    peer_client = None
+    peer_cached = None
+    if endpoint and not knobs.is_disabled('PETASTORM_TPU_PEER_CACHE'):
+        from petastorm_tpu.materialized_cache import (
+            MaterializedRowGroupCache,
+        )
+        cache = worker_args.get('cache') \
+            if isinstance(worker_args, dict) else None
+        if isinstance(cache, MaterializedRowGroupCache) \
+                and not cache.degraded:
+            from petastorm_tpu.service import peer_cache
+            try:
+                if peer_server is None:
+                    # no eager --cache-dir server: serve the spec's own
+                    # directory for this job's lifetime
+                    peer_server = peer_cache.get_server(cache.path)
+                peer_client = peer_cache.PeerCacheClient(
+                    endpoint, self_endpoint=peer_server.endpoint)
+                cache.attach_peer_client(peer_client)
+                peer_cached = cache
+                if peer_live is not None:
+                    peer_live['client'] = peer_client
+            except Exception:  # noqa: BLE001 - the tier is advisory
+                count_swallowed('peer-client-wire')
+                peer_client = None
 
     work_queue = queue.Queue()
     out_queue = queue.Queue()
@@ -246,6 +292,13 @@ def _run_job(sock, spec_payload, worker_id, heartbeat_interval_s,
                         summary['items_done'] = status.get('items_done', 0)
                         if advertised:
                             summary['cache_fp'] = advertised
+                        if peer_server is not None:
+                            # bounded add/evict/touch delta since the
+                            # last heartbeat (carry-over keeps any one
+                            # frame small)
+                            delta = peer_server.advert_delta()
+                            if delta:
+                                summary['peer'] = delta
                         frame = proto.dump_obs_summary(summary)
                     except Exception:  # noqa: BLE001 - advisory telemetry
                         count_swallowed('worker-obs-summary')
@@ -277,11 +330,29 @@ def _run_job(sock, spec_payload, worker_id, heartbeat_interval_s,
                 if msg == proto.MSG_WORK:
                     work_queue.put((proto.unpack_item_id(frames[1]),
                                     frames[2]))
+                    if peer_client is not None and len(frames) > 3 \
+                            and frames[3]:
+                        # piggybacked fleet-directory delta: holders of
+                        # recently advertised entries, no DIRGET needed
+                        peer_client.update_directory(
+                            proto.load_json_params(frames[3]))
                 elif msg == proto.MSG_STOP:
                     logger.info('Dispatcher sent STOP; job over')
                     break
                 elif msg == proto.MSG_HEARTBEAT_ACK:
                     last_ack = now
+                    if peer_server is not None and len(frames) > 2 \
+                            and frames[2]:
+                        # advisory global-eviction hints (additive
+                        # trailing frame); the server re-checks local
+                        # atime before dropping anything
+                        try:
+                            hints = proto.load_json_params(
+                                frames[2]).get('evict')
+                            if hints:
+                                peer_server.apply_evict_hints(hints)
+                        except Exception:  # noqa: BLE001 - advisory
+                            count_swallowed('peer-evict-hint')
                     if token is not None and len(frames) > 1 \
                             and frames[1] != token:
                         # a NEW dispatcher incarnation answered on this
@@ -317,6 +388,13 @@ def _run_job(sock, spec_payload, worker_id, heartbeat_interval_s,
     finally:
         stop_flag.set()
         executor_thread.join(_EXECUTOR_JOIN_TIMEOUT_S)
+        if peer_client is not None:
+            # after the join: a live fetch must not race the close
+            if peer_cached is not None:
+                peer_cached.attach_peer_client(None)
+            if peer_live is not None:
+                peer_live.pop('client', None)
+            peer_client.close()
         if executor_thread.is_alive():
             # A decode is wedged past the join budget: shutting the worker
             # down under the live process() call would close its resources
@@ -363,14 +441,24 @@ def serve(endpoint, worker_id=0, heartbeat_interval_s=1.0,
     # worker's endpoint lives). Unarmed: a shared no-op handle.
     status = {'worker_id': worker_id, 'state': 'registering',
               'jobs_served': 0, 'items_done': 0, 'endpoint': endpoint}
+    peer_live = {}
 
     def _health():
         # per-host readahead visibility in fleet mode: each decode host
         # runs its own manager (the plan rides the job spec), so the
         # hit/miss/pool numbers belong on ITS /health, not the client's
         from petastorm_tpu import readahead
+        from petastorm_tpu.service import peer_cache
         out = dict(status)
         out['readahead'] = readahead.health_snapshot()
+        # fleet cache tier holder view: what this host serves to peers,
+        # and (while a job runs) the fetch client's hit/miss/budget
+        snap = peer_cache.server_snapshot()
+        if snap is not None:
+            out['peer_cache'] = snap
+        client = peer_live.get('client')
+        if client is not None:
+            out.setdefault('peer_cache', {})['client'] = client.stats()
         return out
 
     obs_mount = obs_server.mount('worker-server', health=_health)
@@ -380,6 +468,19 @@ def serve(endpoint, worker_id=0, heartbeat_interval_s=1.0,
     from petastorm_tpu.service import placement
     known_fps = set(placement.advertised_fingerprints(
         knobs.get_str('PETASTORM_TPU_DECODED_CACHE_DIR')))
+    # fleet cache tier: with a host-local cache dir configured, start the
+    # peer serve socket BEFORE registering — the startup scan makes the
+    # REGISTER advert carry everything this host kept across restarts,
+    # so the directory is complete before the first WORK is assigned
+    from petastorm_tpu.service import peer_cache
+    peer_server = None
+    if peer_cache.peer_cache_enabled():
+        cache_dir = knobs.get_str('PETASTORM_TPU_DECODED_CACHE_DIR')
+        if cache_dir:
+            try:
+                peer_server = peer_cache.get_server(cache_dir)
+            except Exception:  # noqa: BLE001 - the tier is advisory
+                count_swallowed('peer-server-start')
     try:
         while True:
             # Fresh socket (and identity) per job lifetime: a stale
@@ -397,7 +498,8 @@ def serve(endpoint, worker_id=0, heartbeat_interval_s=1.0,
                 spec_payload, token = _register(
                     sock, parent_pid, register_timeout_s,
                     term_event=term_event,
-                    cache_fps=sorted(known_fps)[:placement.MAX_ADVERTISED])
+                    cache_fps=sorted(known_fps)[:placement.MAX_ADVERTISED],
+                    peer_server=peer_server)
                 if spec_payload is None:
                     return
                 status['state'] = 'serving'
@@ -405,7 +507,10 @@ def serve(endpoint, worker_id=0, heartbeat_interval_s=1.0,
                                        heartbeat_interval_s, ack_timeout_s,
                                        parent_pid, status=status,
                                        token=token, term_event=term_event,
-                                       known_fps=known_fps)
+                                       known_fps=known_fps,
+                                       endpoint=endpoint,
+                                       peer_server=peer_server,
+                                       peer_live=peer_live)
                 status['jobs_served'] += 1
                 try:
                     sock.send_multipart([proto.MSG_BYE])
@@ -417,6 +522,7 @@ def serve(endpoint, worker_id=0, heartbeat_interval_s=1.0,
             if once or not serve_again:
                 return
     finally:
+        peer_cache.close_server()
         obs_mount.close()
 
 

@@ -234,6 +234,9 @@ def pipeline_report(registry=None, wall_time_s=None, baseline=None,
     readahead = _readahead_section(registry)
     if readahead is not None:
         report['readahead'] = readahead
+    peer = _peer_cache_section(registry)
+    if peer is not None:
+        report['peer_cache'] = peer
     write = _write_section(registry)
     if write is not None:
         report['write'] = write
@@ -500,6 +503,37 @@ def _readahead_section(registry):
     }
 
 
+def _peer_cache_section(registry):
+    """Fleet-wide decoded-cache tier activity (service/peer_cache.py) —
+    present only when a peer fetch ever hit, missed or an evict hint
+    shipped (the worker-side counters are fleet-merged over the DONE
+    delta channels), so host-local pipelines keep their report shape.
+    The "Warm dataset still decode-priced on a fleet" runbook in
+    docs/troubleshoot.md reads the hit share and degrade reasons."""
+    from petastorm_tpu.service import peer_cache
+    hits = registry.counter_value(peer_cache.PEER_CACHE_HITS)
+    misses = 0
+    degraded = {}
+    for key, value in registry.counters_with_prefix(
+            peer_cache.PEER_CACHE_MISSES).items():
+        reason = _label_of(key, 'reason') or 'unknown'
+        degraded[reason] = degraded.get(reason, 0) + int(value)
+        misses += int(value)
+    hints = registry.counter_value(peer_cache.PEER_CACHE_EVICT_HINTS)
+    if not hits and not misses and not hints:
+        return None
+    return {
+        'hits': int(hits),
+        'misses': int(misses),
+        'hit_share': (round(hits / (hits + misses), 4)
+                      if hits or misses else None),
+        'bytes_fetched': int(
+            registry.counter_value(peer_cache.PEER_CACHE_BYTES)),
+        'degraded': degraded,
+        'evict_hints': int(hints),
+    }
+
+
 def _write_section(registry):
     """Distributed write plane activity (petastorm_tpu/write/) — present
     only when this process (or its fleet, via the pool delta channels)
@@ -699,6 +733,17 @@ def format_pipeline_report(report):
                          if r['mean_coalesced_bytes'] is not None else ''),
                         r['depth'], r['pool_bytes'],
                         r['pool_budget_bytes'],
+                        (' — degraded: %s' % reasons) if reasons else ''))
+    if 'peer_cache' in report:
+        p = report['peer_cache']
+        reasons = ', '.join('%s: %d' % (k, v)
+                            for k, v in sorted(p['degraded'].items()))
+        lines.append('peer cache: %d hit / %d miss%s, %d B fetched from '
+                     'peers, %d evict hint(s)%s'
+                     % (p['hits'], p['misses'],
+                        (' (%.1f%%)' % (100 * p['hit_share'])
+                         if p['hit_share'] is not None else ''),
+                        p['bytes_fetched'], p['evict_hints'],
                         (' — degraded: %s' % reasons) if reasons else ''))
     if 'write' in report:
         w = report['write']

@@ -584,3 +584,104 @@ def test_warm_epoch_reads_at_least_as_fast_as_cold(synthetic_dataset,
     cold = one_pass()
     warm = max(one_pass() for _ in range(3))
     assert warm >= 0.8 * cold, (cold, warm)
+
+
+_RACE_READER = '''
+import sys
+import numpy as np
+sys.path.insert(0, sys.argv[1])
+from petastorm_tpu.arrow_worker import ColumnBatch
+from petastorm_tpu.materialized_cache import MaterializedRowGroupCache
+
+path, direction = sys.argv[2], int(sys.argv[3])
+cache = MaterializedRowGroupCache(path, 30_000)  # ~6 entries: heavy churn
+order = range(20) if direction else range(19, -1, -1)
+for _round in range(3):
+    for i in order:
+        def fill(i=i):
+            return ColumnBatch({'v': np.full(512, i, dtype=np.int64)}, 512)
+        batch = cache.get(('race', i), fill)
+        v = batch.columns['v']
+        assert batch.length == 512 and (np.asarray(v) == i).all(), i
+print('OK')
+'''
+
+
+class TestFleetTierSatellites:
+    """Satellites of the fleet cache tier: stale placement-marker purge,
+    the rate-limited LRU touch behind memory-tier hits, and eviction
+    racing a reader in another process."""
+
+    def test_reroot_purges_markers_with_no_entries_behind_them(
+            self, tmp_path):
+        from petastorm_tpu.service import placement
+        host_dir = str(tmp_path / 'host')
+        placement.note_fingerprint(host_dir, 'stale-fp')
+        cache = _cache(tmp_path)
+        cache.reroot(host_dir)
+        assert not [n for n in os.listdir(host_dir)
+                    if n.startswith('.fp_')]
+
+    def test_reroot_keeps_markers_backed_by_real_entries(self, tmp_path):
+        from petastorm_tpu.service import placement
+        host_dir = str(tmp_path / 'host')
+        warm = MaterializedRowGroupCache(host_dir, 10 ** 8)
+        warm.get('k', _fill(_sample_columns()))
+        placement.note_fingerprint(host_dir, 'earned-fp')
+        cache = _cache(tmp_path)
+        cache.reroot(host_dir)
+        assert '.fp_earned-fp' in os.listdir(host_dir)
+
+    def test_cleanup_purges_markers_from_kept_directory(self, tmp_path):
+        from petastorm_tpu.service import placement
+        cache = _cache(tmp_path)
+        placement.note_fingerprint(cache.path, 'fp')
+        cache.cleanup()  # cleanup_on_exit=False: the directory stays
+        assert os.path.isdir(cache.path)
+        assert not [n for n in os.listdir(cache.path)
+                    if n.startswith('.fp_')]
+
+    def test_mem_tier_hits_touch_disk_entry_rate_limited(self, tmp_path,
+                                                         monkeypatch):
+        """A hot in-memory loop must not pay one utime syscall per hit —
+        the disk LRU only needs coarse freshness."""
+        from petastorm_tpu import materialized_cache as MC
+        cache = _cache(tmp_path, mem_mb=64)
+        cols = _sample_columns()
+        cache.get('k', _fill(cols))
+        entry = cache._entry_path('k')
+        touched = []
+        real_utime = os.utime
+
+        def counting_utime(path, *args, **kwargs):
+            touched.append(path)
+            return real_utime(path, *args, **kwargs)
+
+        monkeypatch.setattr(os, 'utime', counting_utime)
+        for _ in range(10):
+            cache.get('k', _fill(cols))  # memory-tier hits
+        assert touched.count(entry) == 1
+        with cache._lock:  # age the record past the interval
+            cache._utime_at[entry] -= MC._UTIME_INTERVAL_S + 1
+        cache.get('k', _fill(cols))
+        assert touched.count(entry) == 2
+
+    def test_eviction_racing_a_reader_in_another_process(self, tmp_path):
+        """Two processes hammer one shared directory with a disk limit
+        far below the working set: each sees every entry either whole or
+        absent (refilled), never torn — values stay exact while the
+        other process evicts under its feet."""
+        shared = str(tmp_path / 'shared')
+        env = dict(os.environ, JAX_PLATFORMS='cpu')
+        procs = [subprocess.Popen(
+            [sys.executable, '-c', _RACE_READER, REPO, shared,
+             str(direction)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env)
+            for direction in (0, 1)]
+        outs = []
+        for p in procs:
+            out, _ = p.communicate(timeout=120)
+            outs.append((p.returncode, out.decode(errors='replace')))
+        for code, out in outs:
+            assert code == 0, out
+            assert 'OK' in out, out

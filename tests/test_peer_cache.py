@@ -1,0 +1,600 @@
+"""Fleet cache tier (docs/service.md, "Fleet cache tier"): adverts,
+the dispatcher directory, wire-priced peer fetches, advisory global
+eviction — and the acceptance drills: a two-worker fleet decodes each
+row-group exactly once fleet-wide, stays exactly parity-preserving
+against the ``PETASTORM_TPU_PEER_CACHE=0`` oracle, and degrades to
+local decode (never an error) through peer loss chaos.
+
+Worker servers are real CLI subprocesses over ``tcp://`` loopback with
+DISJOINT ``--cache-dir`` directories (one per simulated host). Hangs
+are bounded internally like test_service.py: every ``get_results``
+carries a timeout and fleets are reaped in ``finally``.
+"""
+
+import contextlib
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from petastorm_tpu import telemetry as T
+from petastorm_tpu.arrow_worker import ColumnBatch
+from petastorm_tpu.materialized_cache import MaterializedRowGroupCache
+from petastorm_tpu.service import peer_cache
+from petastorm_tpu.service import protocol as proto
+from petastorm_tpu.service.peer_cache import (
+    PEER_CACHE_HITS, PEER_CACHE_MISSES, FleetCacheDirectory,
+    PeerCacheClient, PeerCacheServer, digest_entry_path, entry_digest,
+)
+from petastorm_tpu.service.protocol import free_tcp_port
+from petastorm_tpu.service.service_pool import ServicePool
+from petastorm_tpu.workers.worker_base import WorkerBase
+
+pytestmark = pytest.mark.service
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_FAST = dict(heartbeat_interval_s=0.15, liveness_timeout_s=0.75,
+             connect_timeout_s=60, no_workers_timeout_s=20)
+
+
+@pytest.fixture(autouse=True)
+def fresh_telemetry():
+    T.reset_for_tests()
+    yield
+    T.reset_for_tests()
+
+
+def _cache(path, mem_mb=0):
+    return MaterializedRowGroupCache(str(path), 10 ** 9,
+                                     mem_limit_bytes=mem_mb * 2 ** 20)
+
+
+def _fill_entry(cache, key, value, n=64):
+    batch = cache.get(key, lambda: ColumnBatch(
+        {'v': np.full(n, value, dtype=np.int64)}, n))
+    return cache._entry_path(key), batch
+
+
+# -- worker side: the serve socket -------------------------------------------
+
+
+@contextlib.contextmanager
+def _server(cache_dir):
+    server = PeerCacheServer(str(cache_dir))
+    try:
+        yield server
+    finally:
+        server.close()
+
+
+def _raw_fetch(endpoint, digest, timeout_s=5.0):
+    import zmq
+    context = zmq.Context()
+    sock = context.socket(zmq.DEALER)
+    try:
+        sock.setsockopt(zmq.LINGER, 0)
+        sock.connect(endpoint)
+        sock.send_multipart([proto.MSG_PEER_FETCH, digest.encode()])
+        assert sock.poll(int(timeout_s * 1000)), 'serve socket silent'
+        return sock.recv_multipart()
+    finally:
+        sock.close(0)
+        context.term()
+
+
+class TestPeerCacheServer:
+    def test_serves_entry_bytes_chunked_and_verified(self, tmp_path,
+                                                     monkeypatch):
+        # tiny chunks force real multipart streaming of one entry
+        monkeypatch.setattr(peer_cache, '_CHUNK_BYTES', 1024)
+        cache = _cache(tmp_path / 'a')
+        entry, _ = _fill_entry(cache, 'k', 7, n=4096)
+        digest = entry_digest(entry)
+        with _server(cache.path) as server:
+            frames = _raw_fetch(server.endpoint, digest)
+            assert frames[0] == proto.MSG_PEER_ENTRY
+            assert frames[1] == digest.encode()
+            meta = proto.load_json_params(frames[2])
+            blob = b''.join(frames[3:])
+            assert len(frames) > 4  # actually chunked
+            assert len(blob) == meta['size'] == os.path.getsize(entry)
+            assert blob == open(entry, 'rb').read()
+            assert server.served == 1
+            assert server.health_snapshot()['entries'] == 1
+
+    def test_unknown_digest_answers_honest_miss(self, tmp_path):
+        with _server(tmp_path) as server:
+            frames = _raw_fetch(server.endpoint, '0' * 40)
+            assert frames[0] == proto.MSG_PEER_MISS
+
+    def test_register_advert_scans_directory_durably(self, tmp_path):
+        """Entries left by a PREVIOUS process are advertised from the
+        startup scan — the fleet map survives worker restarts."""
+        cache = _cache(tmp_path / 'a')
+        entry, _ = _fill_entry(cache, 'k1', 1)
+        _fill_entry(cache, 'k2', 2)
+        with _server(cache.path) as server:
+            full = server.full_advert()
+            assert full['ep'] == server.endpoint
+            digests = {row[0] for row in full['full']}
+            assert digests == {entry_digest(cache._entry_path('k1')),
+                               entry_digest(cache._entry_path('k2'))}
+            assert all(row[1] > 0 for row in full['full'])
+
+    def test_heartbeat_delta_carries_adds_and_removes(self, tmp_path):
+        cache = _cache(tmp_path / 'a')
+        with _server(cache.path) as server:
+            server.full_advert()
+            assert server.advert_delta() is None  # nothing changed
+            entry, _ = _fill_entry(cache, 'k', 3)  # publish listener path
+            delta = server.advert_delta()
+            assert delta['ep'] == server.endpoint
+            assert [row[0] for row in delta['add']] == [entry_digest(entry)]
+            os.remove(entry)
+            server._last_scan = 0.0  # bypass the rescan rate limit
+            delta = server.advert_delta()
+            assert delta['rm'] == [entry_digest(entry)]
+            assert server.advert_delta() is None
+
+    def test_evict_hint_declines_hot_entry_removes_cold(self, tmp_path):
+        cache = _cache(tmp_path / 'a')
+        entry, _ = _fill_entry(cache, 'k', 1)
+        digest = entry_digest(entry)
+        with _server(cache.path) as server:
+            server.full_advert()
+            # locally fresh: the hint is advisory and must be declined
+            assert server.apply_evict_hints([digest]) == 0
+            assert os.path.exists(entry)
+            os.utime(entry, (1.0, 1.0))  # ancient: fleet hint wins
+            assert server.apply_evict_hints([digest]) == 1
+            assert not os.path.exists(entry)
+            assert server.evicted_on_hint == 1
+            assert server.apply_evict_hints(['not-a-digest', digest]) == 0
+
+
+# -- worker side: the fetch path ---------------------------------------------
+
+
+class TestPeerCacheClient:
+    def _client(self, mirror=None, self_endpoint=None):
+        # dispatcher endpoint nobody answers: DIRGET lookups time out
+        client = PeerCacheClient(
+            'tcp://127.0.0.1:%d' % free_tcp_port(),
+            self_endpoint=self_endpoint)
+        if mirror:
+            client.update_directory(mirror)
+        return client
+
+    def test_fetch_serves_wire_parity_entry(self, tmp_path, monkeypatch):
+        monkeypatch.setenv('PETASTORM_TPU_PEER_CACHE_TIMEOUT_S', '5')
+        cache_a = _cache(tmp_path / 'a')
+        entry_a, _ = _fill_entry(cache_a, 'k', 42)
+        digest = entry_digest(entry_a)
+        cache_b = _cache(tmp_path / 'b')
+        entry_b = cache_b._entry_path('k')
+        with _server(cache_a.path) as server:
+            client = self._client(
+                {digest: [[server.endpoint, os.path.getsize(entry_a)]]})
+            try:
+                served = client.fetch('k', entry_b, cache_b)
+            finally:
+                client.close()
+        assert served is not None
+        columns, length = served
+        assert length == 64
+        np.testing.assert_array_equal(
+            columns['v'], np.full(64, 42, dtype=np.int64))
+        # on disk the peer path is indistinguishable from a local fill
+        assert open(entry_b, 'rb').read() == open(entry_a, 'rb').read()
+        registry = T.get_registry()
+        assert registry.counter_value(PEER_CACHE_HITS) == 1
+        assert registry.counter_value('petastorm_tpu_stage_calls_total',
+                                      stage='peer_fetch') == 1
+        assert client.stats()['hits'] == 1
+        assert client.stats()['budget_used'] == 0  # arena fully released
+
+    def test_every_failure_degrades_with_its_reason(self, tmp_path,
+                                                    monkeypatch):
+        monkeypatch.setenv('PETASTORM_TPU_PEER_CACHE_TIMEOUT_S', '0.1')
+        registry = T.get_registry()
+
+        def reason(name):
+            return registry.counter_value(PEER_CACHE_MISSES, reason=name)
+
+        cache = _cache(tmp_path / 'b')
+        entry = cache._entry_path('k')
+        digest = entry_digest(entry)
+
+        # no holder anywhere (and the DIRGET endpoint is dead too)
+        client = self._client()
+        try:
+            assert client.fetch('k', entry, cache) is None
+            assert reason('no_holder') == 1
+
+            # a holder that stopped answering: timeout, then forgotten
+            dead = 'tcp://127.0.0.1:%d' % free_tcp_port()
+            client.update_directory({digest: [[dead, 128]]})
+            assert client.fetch('k', entry, cache) is None
+            assert reason('timeout') == 1
+            assert client.stats()['mirror'] == 0  # holder forgotten
+        finally:
+            client.close()
+
+        # the advertised holder evicted the entry: honest peer_miss
+        with _server(tmp_path / 'empty') as server:
+            client = self._client({digest: [[server.endpoint, 128]]})
+            try:
+                assert client.fetch('k', entry, cache) is None
+                assert reason('peer_miss') == 1
+            finally:
+                client.close()
+
+        # corrupt bytes are discarded, never published
+        client = self._client()
+        try:
+            client.update_directory({digest: [['tcp://127.0.0.1:1', 3]]})
+            monkeypatch.setattr(
+                client, '_request',
+                lambda endpoint, d: [proto.MSG_PEER_ENTRY, d.encode(),
+                                     proto.dump_json_params(
+                                         {'size': 3, 'sha1': '0' * 40}),
+                                     b'abc'])
+            assert client.fetch('k', entry, cache) is None
+            assert reason('corrupt') == 1
+            assert not os.path.exists(entry)
+        finally:
+            client.close()
+
+        assert client.stats()['budget_used'] == 0
+
+    def test_budget_exhaustion_declines_not_buffers(self, tmp_path,
+                                                    monkeypatch):
+        monkeypatch.setenv('PETASTORM_TPU_PEER_CACHE_BUDGET_MB', '1')
+        cache = _cache(tmp_path / 'b')
+        entry = cache._entry_path('k')
+        digest = entry_digest(entry)
+        client = self._client({digest: [['tcp://127.0.0.1:1', 2 << 20]]})
+        try:
+            assert client.fetch('k', entry, cache) is None
+            assert T.get_registry().counter_value(
+                PEER_CACHE_MISSES, reason='budget') == 1
+        finally:
+            client.close()
+
+    def test_own_endpoint_is_never_fetched_from(self, tmp_path,
+                                                monkeypatch):
+        monkeypatch.setenv('PETASTORM_TPU_PEER_CACHE_TIMEOUT_S', '0.1')
+        cache = _cache(tmp_path / 'b')
+        entry = cache._entry_path('k')
+        digest = entry_digest(entry)
+        me = 'tcp://127.0.0.1:9'
+        client = self._client({digest: [[me, 128]]}, self_endpoint=me)
+        try:
+            assert client.fetch('k', entry, cache) is None
+            assert T.get_registry().counter_value(
+                PEER_CACHE_MISSES, reason='no_holder') == 1
+        finally:
+            client.close()
+
+
+# -- dispatcher side: the fleet directory ------------------------------------
+
+
+_D1 = 'a' * 40
+_D2 = 'b' * 40
+_D3 = 'c' * 40
+
+
+class TestFleetCacheDirectory:
+    def test_fold_lookup_drop(self):
+        directory = FleetCacheDirectory()
+        directory.note_advert(b'w1', {'ep': 'tcp://h1:1',
+                                      'full': [[_D1, 100, 50]]})
+        directory.note_advert(b'w2', {'ep': 'tcp://h2:2',
+                                      'add': [[_D1, 100, 90],
+                                              [_D2, 200, 10]]})
+        out = directory.lookup([_D1, _D2, _D3])
+        assert out[_D1] == [['tcp://h2:2', 100], ['tcp://h1:1', 100]]
+        assert out[_D2] == [['tcp://h2:2', 200]]
+        assert out[_D3] == []  # unknown: the asker negative-caches
+        assert directory.held_count(b'w2') == 2
+        directory.drop(b'w2')
+        assert directory.lookup([_D2])[_D2] == []
+        assert directory.lookup([_D1])[_D1] == [['tcp://h1:1', 100]]
+        assert directory.stats()['digests'] == 1
+
+    def test_lookup_excludes_the_asker_and_touch_reorders(self):
+        directory = FleetCacheDirectory()
+        directory.note_advert(b'w1', {'ep': 'tcp://h1:1',
+                                      'full': [[_D1, 100, 50]]})
+        directory.note_advert(b'w2', {'ep': 'tcp://h2:2',
+                                      'add': [[_D1, 100, 10]]})
+        assert directory.lookup([_D1], exclude_identity=b'w1')[_D1] == \
+            [['tcp://h2:2', 100]]
+        directory.note_advert(b'w2', {'ep': 'tcp://h2:2',
+                                      't': [[_D1, 99]]})
+        assert directory.lookup([_D1])[_D1][0] == ['tcp://h2:2', 100]
+
+    def test_delta_since_is_incremental_and_capped(self):
+        directory = FleetCacheDirectory()
+        version, delta = directory.delta_since(0)
+        assert version == 0 and delta is None
+        directory.note_advert(b'w1', {'ep': 'tcp://h1:1',
+                                      'full': [[_D1, 100, 50]]})
+        version, delta = directory.delta_since(0)
+        assert delta == {_D1: [['tcp://h1:1', 100]]}
+        assert directory.delta_since(version) == (version, None)
+        # the advertising worker's own rows never ride back to it
+        directory.note_advert(b'w2', {'ep': 'tcp://h2:2',
+                                      'add': [[_D2, 200, 60]]})
+        _, delta = directory.delta_since(version, exclude_identity=b'w2')
+        assert delta is None or _D2 not in delta
+
+    def test_malformed_adverts_are_swallowed(self):
+        directory = FleetCacheDirectory()
+        directory.note_advert(b'w1', None)
+        directory.note_advert(b'w1', {'full': [[_D1, 1, 1]]})  # no ep
+        directory.note_advert(b'w1', {'ep': 'tcp://h:1',
+                                      'full': [['nope', 1, 1],
+                                               [_D1, 'x', 'y']]})
+        assert directory.lookup([_D1])[_D1] == []
+        assert directory.stats()['digests'] == 0
+
+    def test_global_eviction_hints_spare_the_freshest(self, monkeypatch):
+        monkeypatch.setenv('PETASTORM_TPU_PEER_CACHE_COLD_S', '100')
+        directory = FleetCacheDirectory()
+        now = 10_000.0
+        # replicated + fleet-cold: hint every holder except the freshest
+        directory.note_advert(b'w1', {'ep': 'tcp://h1:1',
+                                      'full': [[_D1, 100, now - 500]]})
+        directory.note_advert(b'w2', {'ep': 'tcp://h2:2',
+                                      'add': [[_D1, 100, now - 900]]})
+        # single-copy entries are never hinted, however cold
+        directory.note_advert(b'w2', {'ep': 'tcp://h2:2',
+                                      'add': [[_D2, 100, now - 900]]})
+        # replicated but fleet-hot: untouched
+        directory.note_advert(b'w1', {'ep': 'tcp://h1:1',
+                                      'add': [[_D3, 100, now - 10]]})
+        directory.note_advert(b'w2', {'ep': 'tcp://h2:2',
+                                      'add': [[_D3, 100, now - 900]]})
+        directory.compute_evict_hints(now)
+        assert directory.take_hints(b'w1') is None  # w1 is freshest on D1
+        assert directory.take_hints(b'w2') == [_D1]
+        assert directory.take_hints(b'w2') is None  # drained
+        assert directory.stats()['hints_queued'] == 1
+
+    def test_snapshot_seed_supersede_expire(self):
+        primary = FleetCacheDirectory()
+        primary.note_advert(b'w1', {'ep': 'tcp://h1:1',
+                                    'full': [[_D1, 100, 50]]})
+        snapshot = primary.snapshot()
+        promoted = FleetCacheDirectory()
+        promoted.seed(snapshot, now_mono=1000.0)
+        assert promoted.lookup([_D1])[_D1] == [['tcp://h1:1', 100]]
+        assert promoted.stats()['seeded']
+        # the endpoint's first real advert supersedes its seed rows
+        promoted.note_advert(b'w9', {'ep': 'tcp://h1:1',
+                                     'full': [[_D2, 200, 60]]})
+        assert promoted.lookup([_D1])[_D1] == []
+        assert promoted.lookup([_D2])[_D2] == [['tcp://h1:1', 200]]
+        assert not promoted.stats()['seeded']
+        # unclaimed seeds age out
+        expiring = FleetCacheDirectory()
+        expiring.seed(snapshot, now_mono=1000.0)
+        expiring.expire_seeds(1030.0)  # before the TTL: kept
+        assert expiring.lookup([_D1])[_D1]
+        expiring.expire_seeds(1061.0)
+        assert expiring.lookup([_D1])[_D1] == []
+
+
+def test_dispatcher_snapshot_replicates_directory_to_standby():
+    """The failover path end-to-end at the state level: a primary
+    dispatcher's standby snapshot seeds a promoted dispatcher whose
+    DIRGET answers stay warm through the failover window."""
+    from petastorm_tpu.service.dispatcher import Dispatcher
+    primary = Dispatcher('tcp://127.0.0.1:0', None, None, threading.Event())
+    primary._peer_dir.note_advert(b'w1', {'ep': 'tcp://h1:1',
+                                          'full': [[_D1, 100, 50]]})
+    state = primary.standby_snapshot()
+    assert state['peer_directory'] == [[_D1, [['tcp://h1:1', 100, 50.0]]]]
+    promoted = Dispatcher('tcp://127.0.0.1:0', None, None,
+                          threading.Event(), seed_state=state)
+    assert promoted._peer_dir.lookup([_D1])[_D1] == [['tcp://h1:1', 100]]
+
+
+# -- acceptance: the two-worker decode-once drill ----------------------------
+
+
+class FleetDecodeWorker(WorkerBase):
+    """Publishes ``(item, was_decoded, value, pid)`` for one item served
+    through the job's materialized cache — ``was_decoded`` is the
+    fleet-wide decode-count probe."""
+
+    def process(self, item, sleep_s=0.0):
+        if sleep_s:
+            time.sleep(sleep_s)
+        decoded = []
+
+        def fill():
+            decoded.append(True)
+            return ColumnBatch(
+                {'v': np.full(16, item, dtype=np.int64)}, 16)
+
+        batch = self.args['cache'].get(('peer-e2e', item), fill)
+        self.publish_func((int(item), bool(decoded),
+                           int(batch.columns['v'][0]), os.getpid()))
+
+
+@contextlib.contextmanager
+def _fleet(endpoint, tmp_path, count=2, env_extra=None):
+    """CLI worker servers with DISJOINT --cache-dir dirs (one per
+    simulated host)."""
+    env = dict(os.environ,
+               PYTHONPATH=os.pathsep.join(
+                   [_REPO_ROOT, os.path.join(_REPO_ROOT, 'tests')]),
+               JAX_PLATFORMS='cpu')
+    env.update(env_extra or {})
+    procs = [
+        subprocess.Popen(
+            [sys.executable, '-m', 'petastorm_tpu.service.worker_server',
+             '--endpoint', endpoint,
+             '--heartbeat-interval', '0.2',
+             '--worker-id', str(i),
+             '--cache-dir', str(tmp_path / ('host%d' % i)),
+             '--parent-pid', str(os.getpid())],
+            env=env)
+        for i in range(count)
+    ]
+    try:
+        yield procs
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+
+
+def _run_epoch(endpoint, cache, n, expected_workers=2, sleep_s=0.0,
+               kill_after_first=None):
+    pool = ServicePool(endpoint=endpoint, expected_workers=expected_workers,
+                       **_FAST)
+    pool.start(FleetDecodeWorker, worker_args={'cache': cache})
+    try:
+        for i in range(n):
+            pool.ventilate(i, sleep_s=sleep_s)
+        rows = [pool.get_results(timeout=60)]
+        if kill_after_first is not None:
+            os.kill(kill_after_first.pid, signal.SIGKILL)
+        rows += [pool.get_results(timeout=60) for _ in range(n - 1)]
+        return rows, dict(pool.diagnostics)
+    finally:
+        pool.stop()
+        pool.join()
+
+
+def _values(rows):
+    return sorted(value for _item, _dec, value, _pid in rows)
+
+
+def test_fleet_decodes_each_rowgroup_exactly_once(tmp_path):
+    """The tentpole acceptance: epoch 1 decodes each item once
+    fleet-wide; epoch 2 (a fresh job on the same fleet) serves EVERY
+    item without a single decode — local disk hits on the decoding
+    host, wire-priced peer fetches across hosts."""
+    n = 24
+    endpoint = 'tcp://127.0.0.1:%d' % free_tcp_port()
+    cache = _cache(tmp_path / 'client')
+    with _fleet(endpoint, tmp_path):
+        cold, _ = _run_epoch(endpoint, cache, n)
+        assert _values(cold) == list(range(n))
+        assert sum(dec for _i, dec, _v, _p in cold) == n  # once each
+        hosts = {pid for _i, _d, _v, pid in cold}
+        assert len(hosts) == 2  # both simulated hosts participated
+
+        warm, diag = _run_epoch(endpoint, cache, n)
+        assert _values(warm) == list(range(n))
+        redecoded = [i for i, dec, _v, _p in warm if dec]
+        assert redecoded == [], \
+            'fleet re-decoded row-groups %s' % redecoded
+        assert diag['items_poisoned'] == 0
+
+
+def test_exact_parity_with_peer_cache_disabled_oracle(tmp_path):
+    """``PETASTORM_TPU_PEER_CACHE=0`` is the host-local oracle: the row
+    multiset must be identical with the tier off — each host just pays
+    its own decodes again."""
+    n = 16
+    endpoint = 'tcp://127.0.0.1:%d' % free_tcp_port()
+    cache = _cache(tmp_path / 'client')
+    oracle_env = {'PETASTORM_TPU_PEER_CACHE': '0'}
+    os.environ['PETASTORM_TPU_PEER_CACHE'] = '0'  # dispatcher side too
+    try:
+        with _fleet(endpoint, tmp_path, env_extra=oracle_env):
+            cold, _ = _run_epoch(endpoint, cache, n)
+            warm, diag = _run_epoch(endpoint, cache, n)
+    finally:
+        del os.environ['PETASTORM_TPU_PEER_CACHE']
+    assert _values(cold) == list(range(n))
+    assert _values(warm) == list(range(n))
+    assert diag['items_poisoned'] == 0
+    registry = T.get_registry()
+    assert registry.counter_value(PEER_CACHE_HITS) == 0
+
+
+def test_peer_loss_mid_epoch_degrades_to_local_decode(tmp_path):
+    """The chaos drill: SIGKILL the worker holding half the fleet's
+    entries mid-warm-epoch. Its in-flight items re-ventilate to the
+    survivor, fetches from the dead endpoint time out into local
+    decode, and the epoch still delivers the exact row multiset with
+    zero quarantines."""
+    n = 24
+    endpoint = 'tcp://127.0.0.1:%d' % free_tcp_port()
+    cache = _cache(tmp_path / 'client')
+    env_extra = {'PETASTORM_TPU_PEER_CACHE_TIMEOUT_S': '0.3'}
+    with _fleet(endpoint, tmp_path, env_extra=env_extra) as procs:
+        cold, _ = _run_epoch(endpoint, cache, n)
+        assert _values(cold) == list(range(n))
+        warm, diag = _run_epoch(endpoint, cache, n, sleep_s=0.05,
+                                kill_after_first=procs[1])
+        assert _values(warm) == list(range(n))
+        assert diag['items_poisoned'] == 0
+        assert diag['items_reventilated'] >= 1
+        assert diag['workers_alive'] == 1
+
+
+def test_injected_serve_drop_degrades_every_fetch(tmp_path):
+    """Every peer serve drops its reply (armed ``zmq.peer_serve``
+    faultpoint): fetchers time out into local decode — exact rows,
+    zero quarantines, the tier is advisory under total peer loss."""
+    n = 12
+    endpoint = 'tcp://127.0.0.1:%d' % free_tcp_port()
+    cache = _cache(tmp_path / 'client')
+    env_extra = {'PETASTORM_TPU_FAULTS': 'zmq.peer_serve:drop:1',
+                 'PETASTORM_TPU_PEER_CACHE_TIMEOUT_S': '0.3'}
+    with _fleet(endpoint, tmp_path, env_extra=env_extra):
+        cold, _ = _run_epoch(endpoint, cache, n)
+        warm, diag = _run_epoch(endpoint, cache, n)
+    assert _values(cold) == list(range(n))
+    assert _values(warm) == list(range(n))
+    assert diag['items_poisoned'] == 0
+
+
+def test_peer_fetch_report_section_and_format(tmp_path, monkeypatch):
+    """pipeline_report grows a ``peer_cache`` section (hit share +
+    degrade reasons) only when the tier did something."""
+    assert 'peer_cache' not in T.pipeline_report()
+    monkeypatch.setenv('PETASTORM_TPU_PEER_CACHE_TIMEOUT_S', '1')
+    cache_a = _cache(tmp_path / 'a')
+    entry_a, _ = _fill_entry(cache_a, 'k', 1)
+    cache_b = _cache(tmp_path / 'b')
+    with _server(cache_a.path) as server:
+        client = PeerCacheClient('tcp://127.0.0.1:%d' % free_tcp_port())
+        client.update_directory({entry_digest(entry_a): [
+            [server.endpoint, os.path.getsize(entry_a)]]})
+        try:
+            assert client.fetch('k', cache_b._entry_path('k'),
+                                cache_b) is not None
+            assert client.fetch('missing', cache_b._entry_path('missing'),
+                                cache_b) is None
+        finally:
+            client.close()
+    report = T.pipeline_report()
+    section = report['peer_cache']
+    assert section['hits'] == 1
+    assert section['misses'] == 1
+    assert section['hit_share'] == 0.5
+    assert section['degraded'] == {'no_holder': 1}
+    assert section['bytes_fetched'] == os.path.getsize(entry_a)
+    text = T.format_pipeline_report(report)
+    assert 'peer cache: 1 hit / 1 miss' in text

@@ -75,6 +75,9 @@ TRACKED = (
     # standing-service HA (bench service section): warm-placement share
     # (the blackout is lower-is-better and stays out of this gate)
     'service_placement_hit_share',
+    # fleet cache tier (bench peer_cache section): share of warm-epoch
+    # row-groups served without a fresh decode (local hit or peer fetch)
+    'peer_hit_share',
     # the mesh scoreboard (MULTICHIP_r*.json dryrun rounds)
     'multichip_checks',
     'multichip_sharded_overlap_share',
